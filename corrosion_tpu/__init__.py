@@ -1,0 +1,25 @@
+"""corrosion_tpu — a TPU-native re-design of Corrosion (gossip-based,
+eventually-consistent distributed SQLite).
+
+The framework has two halves:
+
+* **The TPU simulator** (``corrosion_tpu.sim``, ``corrosion_tpu.models``,
+  ``corrosion_tpu.ops``): SWIM membership, epidemic broadcast fanout and
+  anti-entropy sync re-expressed as vmapped / pjit'd graph-propagation
+  kernels over a sharded node dimension, with cr-sqlite's LWW /
+  causal-length CRDT merges as per-row packed-key max reductions.  This is
+  the path behind the north-star metric (p99 convergence time + msgs/node
+  vs cluster size N; see BASELINE.md).
+
+* **The host agent** (``corrosion_tpu.agent``): a real, runnable
+  distributed-SQLite agent — our own implementation of the cr-sqlite CRDT
+  semantics over stock sqlite3, SWIM membership, broadcast + sync over
+  loopback/UDP, HTTP API, reactive subscriptions, CLI and devcluster
+  tooling — mirroring the reference's serving surface
+  (see SURVEY.md §1 layer map).
+
+Reference parity notes cite files in the upstream Rust implementation as
+``crates/...:line``.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,44 @@
+"""Core value types shared by the simulator and the host agent.
+
+Mirrors the reference's ``corro-base-types`` and ``corro-types`` crates
+(`crates/corro-base-types/src/lib.rs`, `crates/corro-types/src/{actor,
+broadcast,change,sync}.rs`) — re-designed as plain Python data types whose
+array-of-structs forms live in ``corrosion_tpu.ops``.
+"""
+
+from corrosion_tpu.types.base import Version, CrsqlDbVersion, CrsqlSeq
+from corrosion_tpu.types.actor import ActorId, Actor, ClusterId
+from corrosion_tpu.types.hlc import Timestamp, HLClock, MAX_CLOCK_DELTA_NS
+from corrosion_tpu.types.change import Change, ChunkedChanges, MAX_CHANGES_BYTE_SIZE
+from corrosion_tpu.types.changeset import Changeset, ChangesetKind, ChangeV1, ChangeSource
+from corrosion_tpu.types.payload import (
+    BroadcastV1,
+    UniPayload,
+    BiPayload,
+    SyncStateV1,
+    SyncNeedV1,
+)
+
+__all__ = [
+    "Version",
+    "CrsqlDbVersion",
+    "CrsqlSeq",
+    "ActorId",
+    "Actor",
+    "ClusterId",
+    "Timestamp",
+    "HLClock",
+    "MAX_CLOCK_DELTA_NS",
+    "Change",
+    "ChunkedChanges",
+    "MAX_CHANGES_BYTE_SIZE",
+    "Changeset",
+    "ChangesetKind",
+    "ChangeV1",
+    "ChangeSource",
+    "BroadcastV1",
+    "UniPayload",
+    "BiPayload",
+    "SyncStateV1",
+    "SyncNeedV1",
+]

@@ -1,0 +1,3 @@
+from corrosion_tpu.utils.ranges import RangeSet
+
+__all__ = ["RangeSet"]

@@ -1,0 +1,97 @@
+import random
+
+import pytest
+
+from corrosion_tpu.utils.ranges import RangeSet
+
+
+def test_insert_coalesce_adjacent():
+    rs = RangeSet()
+    rs.insert(1, 5)
+    rs.insert(6, 9)
+    assert rs.spans() == [(1, 9)]
+
+
+def test_insert_overlap_merge():
+    rs = RangeSet([(1, 3), (10, 12)])
+    rs.insert(2, 11)
+    assert rs.spans() == [(1, 12)]
+
+
+def test_insert_disjoint_kept_sorted():
+    rs = RangeSet()
+    rs.insert(10, 12)
+    rs.insert(1, 2)
+    rs.insert(5, 6)
+    assert rs.spans() == [(1, 2), (5, 6), (10, 12)]
+
+
+def test_remove_middle_splits():
+    rs = RangeSet([(1, 10)])
+    rs.remove(4, 6)
+    assert rs.spans() == [(1, 3), (7, 10)]
+
+
+def test_remove_edges():
+    rs = RangeSet([(1, 10)])
+    rs.remove(1, 3)
+    assert rs.spans() == [(4, 10)]
+    rs.remove(8, 12)
+    assert rs.spans() == [(4, 7)]
+
+
+def test_remove_across_spans():
+    rs = RangeSet([(1, 3), (5, 7), (9, 11)])
+    rs.remove(2, 10)
+    assert rs.spans() == [(1, 1), (11, 11)]
+
+
+def test_contains_and_contains_span():
+    rs = RangeSet([(5, 10)])
+    assert rs.contains(5) and rs.contains(10) and not rs.contains(11)
+    assert rs.contains_span(6, 10)
+    assert not rs.contains_span(6, 11)
+
+
+def test_gaps():
+    rs = RangeSet([(3, 4), (8, 9)])
+    assert rs.gaps(1, 12) == [(1, 2), (5, 7), (10, 12)]
+    assert rs.gaps(3, 9) == [(5, 7)]
+    assert RangeSet().gaps(1, 5) == [(1, 5)]
+    assert rs.gaps(3, 4) == []
+
+
+def test_intersection_spans():
+    rs = RangeSet([(1, 5), (10, 20)])
+    assert rs.intersection_spans(3, 12) == [(3, 5), (10, 12)]
+
+
+def test_count_min_max():
+    rs = RangeSet([(1, 3), (10, 10)])
+    assert rs.count() == 4
+    assert rs.min() == 1 and rs.max() == 10
+
+
+def test_randomized_against_set_model():
+    rng = random.Random(42)
+    rs = RangeSet()
+    model = set()
+    for _ in range(500):
+        s = rng.randint(0, 120)
+        e = s + rng.randint(0, 15)
+        if rng.random() < 0.6:
+            rs.insert(s, e)
+            model.update(range(s, e + 1))
+        else:
+            rs.remove(s, e)
+            model.difference_update(range(s, e + 1))
+        # spans must be disjoint, sorted, non-adjacent, and match the model
+        flat = set()
+        prev_end = None
+        for a, b in rs:
+            assert a <= b
+            if prev_end is not None:
+                assert a > prev_end + 1
+            prev_end = b
+            flat.update(range(a, b + 1))
+        assert flat == model

@@ -1,0 +1,76 @@
+"""Golden tests for the sync needs algebra.
+
+The scenario sequence mirrors the reference's
+``crates/corro-types/src/sync.rs`` unit test for ``compute_available_needs``
+so our host-side algebra is behaviorally identical.
+"""
+
+from corrosion_tpu.types import ActorId, SyncStateV1, SyncNeedV1, Version
+
+
+def test_compute_available_needs_reference_scenarios():
+    actor1 = ActorId.generate()
+
+    ours = SyncStateV1(actor_id=ActorId.generate())
+    ours.heads[actor1] = Version(10)
+
+    theirs = SyncStateV1(actor_id=ActorId.generate())
+    theirs.heads[actor1] = Version(13)
+
+    # 1) head catch-up only
+    assert ours.compute_available_needs(theirs) == {
+        actor1: [SyncNeedV1.full(11, 13)]
+    }
+
+    # 2) plus our own gap ranges
+    ours.need.setdefault(actor1, []).append((2, 5))
+    ours.need.setdefault(actor1, []).append((7, 7))
+    assert ours.compute_available_needs(theirs) == {
+        actor1: [
+            SyncNeedV1.full(2, 5),
+            SyncNeedV1.full(7, 7),
+            SyncNeedV1.full(11, 13),
+        ]
+    }
+
+    # 3) plus a partial version they fully have
+    ours.partial_need[actor1] = {Version(9): [(100, 120), (130, 132)]}
+    assert ours.compute_available_needs(theirs) == {
+        actor1: [
+            SyncNeedV1.full(2, 5),
+            SyncNeedV1.full(7, 7),
+            SyncNeedV1.partial(9, [(100, 120), (130, 132)]),
+            SyncNeedV1.full(11, 13),
+        ]
+    }
+
+    # 4) they are partial too: only complementary seqs are available
+    theirs.partial_need[actor1] = {Version(9): [(100, 110), (130, 130)]}
+    assert ours.compute_available_needs(theirs) == {
+        actor1: [
+            SyncNeedV1.full(2, 5),
+            SyncNeedV1.full(7, 7),
+            SyncNeedV1.partial(9, [(111, 120), (131, 132)]),
+            SyncNeedV1.full(11, 13),
+        ]
+    }
+
+
+def test_zero_head_and_self_ignored():
+    me = ActorId.generate()
+    other_actor = ActorId.generate()
+    ours = SyncStateV1(actor_id=me)
+    theirs = SyncStateV1(actor_id=ActorId.generate())
+    theirs.heads[me] = Version(5)  # our own actor: ignored
+    theirs.heads[other_actor] = Version(0)  # zero head: ignored
+    assert ours.compute_available_needs(theirs) == {}
+
+
+def test_need_len():
+    a = ActorId.generate()
+    st = SyncStateV1(actor_id=ActorId.generate())
+    st.need[a] = [(1, 10), (20, 20)]
+    st.partial_need[a] = {Version(30): [(0, 99)]}
+    # 11 full + 100 seqs // 50 = 2 chunks
+    assert st.need_len() == 13
+    assert st.need_len_for_actor(a) == 12

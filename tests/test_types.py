@@ -1,0 +1,110 @@
+import time
+
+import pytest
+
+from corrosion_tpu.types import (
+    Actor,
+    ActorId,
+    Change,
+    ChunkedChanges,
+    ClusterId,
+    CrsqlDbVersion,
+    CrsqlSeq,
+    HLClock,
+    Timestamp,
+    Version,
+)
+from corrosion_tpu.types.hlc import ClockDriftError
+
+
+def test_u64_newtypes():
+    v = Version(5)
+    assert v.succ() == Version(6) and v.pred() == Version(4)
+    assert isinstance(v + 1, Version)
+    with pytest.raises(ValueError):
+        Version(-1)
+    with pytest.raises(ValueError):
+        CrsqlSeq(1 << 64)
+
+
+def test_actor_identity():
+    a = ActorId.generate()
+    assert len(a.bytes) == 16
+    assert ActorId.from_hex(str(a)) == a
+    act = Actor(id=a, addr="127.0.0.1:1234", ts=Timestamp(1), cluster_id=ClusterId(0))
+    renewed = act.renew(Timestamp(99))
+    assert renewed.has_same_prefix(act)
+    assert renewed.ts == Timestamp(99) and act.ts == Timestamp(1)
+
+
+def test_hlc_monotonic_and_merge():
+    clock = HLClock()
+    stamps = [clock.new_timestamp() for _ in range(100)]
+    assert stamps == sorted(stamps)
+    assert len(set(stamps)) == 100
+
+    # merging a remote timestamp moves `last` forward
+    remote = Timestamp(int(clock.last) + 1000)
+    clock.update_with_timestamp(remote)
+    assert int(clock.last) == int(remote)
+    assert int(clock.new_timestamp()) > int(remote)
+
+    # drift rejection
+    far_future = Timestamp.pack(time.time_ns() + 10_000_000_000, 0)
+    with pytest.raises(ClockDriftError):
+        clock.update_with_timestamp(far_future)
+
+
+def test_hlc_stalled_physical_clock_uses_logical():
+    t = [1_000_000_000]
+    clock = HLClock(now_ns=lambda: t[0])
+    a = clock.new_timestamp()
+    b = clock.new_timestamp()
+    assert int(b) > int(a)
+    assert b.physical_ns == a.physical_ns
+
+
+def _mk_change(seq: int, size: int = 0) -> Change:
+    return Change(
+        table="t",
+        pk=b"\x01",
+        cid="c",
+        val="x" * size,
+        col_version=1,
+        db_version=CrsqlDbVersion(1),
+        seq=CrsqlSeq(seq),
+        site_id=b"\x00" * 16,
+        cl=1,
+    )
+
+
+def test_chunker_single_chunk():
+    changes = [_mk_change(i) for i in range(3)]
+    chunks = list(ChunkedChanges(changes, 0, 2))
+    assert len(chunks) == 1
+    got, (s, e) = chunks[0]
+    assert len(got) == 3 and (int(s), int(e)) == (0, 2)
+
+
+def test_chunker_splits_on_budget():
+    changes = [_mk_change(i, size=600) for i in range(10)]
+    chunks = list(ChunkedChanges(changes, 0, 9, max_buf_size=2000))
+    # contiguous inclusive coverage of 0..=9
+    assert chunks[0][1][0] == 0
+    assert chunks[-1][1][1] == 9
+    for (_, (_, e0)), (_, (s1, _)) in zip(chunks, chunks[1:]):
+        assert int(s1) == int(e0) + 1
+    assert sum(len(c) for c, _ in chunks) == 10
+    assert len(chunks) > 1
+
+
+def test_chunker_empty_iter_yields_full_range():
+    chunks = list(ChunkedChanges([], 4, 7))
+    assert chunks == [([], (CrsqlSeq(4), CrsqlSeq(7)))]
+
+
+def test_chunker_last_chunk_extends_to_last_seq():
+    # trailing seqs with no changes (e.g. elided rows) still covered
+    changes = [_mk_change(0), _mk_change(1)]
+    chunks = list(ChunkedChanges(changes, 0, 5))
+    assert chunks[-1][1][1] == 5
