@@ -1,0 +1,17 @@
+"""Array kernels: the TPU-native forms of the CRDT merge and set algebra.
+
+These are the hot ops behind the simulator — pure, shape-static, fusible
+jnp/lax code (pallas variants can slot in underneath without changing the
+API).
+"""
+
+from corrosion_tpu.ops.keys import KeyCodec, DEFAULT_CODEC
+from corrosion_tpu.ops.merge import merge_keys, scatter_merge, merge_cells
+
+__all__ = [
+    "KeyCodec",
+    "DEFAULT_CODEC",
+    "merge_keys",
+    "scatter_merge",
+    "merge_cells",
+]

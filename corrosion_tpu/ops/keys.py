@@ -1,0 +1,103 @@
+"""Packed CRDT cell keys.
+
+The cr-sqlite merge rule (doc/crdts.md:13-16; LWW with causal length) is a
+lexicographic max over ``(cl, col_version, value)`` per (row, column) cell:
+
+1. larger causal length wins (row delete/resurrect dominates cell history;
+   even cl = deleted, odd = live),
+2. then larger ``col_version`` (per-cell lamport clock),
+3. then the larger value ("biggest value wins" tie-break).
+
+A lexicographic max is not expressible as independent per-field scatter-max,
+so the three fields are packed into ONE integer word whose numeric order
+equals the lexicographic order.  Then every merge — pairwise, segment, or
+scatter — is a plain ``max``, which XLA turns into a combiner on the VPU and
+into scatter-max for message delivery.
+
+The default codec packs into int32 (TPU-native lane width); an int64 codec
+is available when a simulation needs deeper version/value spaces.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class KeyCodec:
+    """Bit layout for packed (cl, col_version, value_rank) keys.
+
+    value_rank must be a non-negative int that preserves the desired value
+    order; host code maps real SQLite values to ranks (the sim uses small
+    ints directly).
+    """
+
+    cl_bits: int = 4
+    ver_bits: int = 13
+    val_bits: int = 14
+
+    def __post_init__(self):
+        total = self.cl_bits + self.ver_bits + self.val_bits
+        if total > 62:
+            raise ValueError(f"key layout needs {total} bits; max is 62")
+
+    @property
+    def total_bits(self) -> int:
+        return self.cl_bits + self.ver_bits + self.val_bits
+
+    @property
+    def dtype(self):
+        return jnp.int32 if self.total_bits <= 31 else jnp.int64
+
+    @property
+    def max_cl(self) -> int:
+        return (1 << self.cl_bits) - 1
+
+    @property
+    def max_ver(self) -> int:
+        return (1 << self.ver_bits) - 1
+
+    @property
+    def max_val(self) -> int:
+        return (1 << self.val_bits) - 1
+
+    def _check_dtype(self):
+        if self.dtype == jnp.int64 and not jax.config.jax_enable_x64:
+            raise RuntimeError(
+                f"KeyCodec with {self.total_bits} bits needs int64 keys: "
+                "enable jax_enable_x64 (or use jax.experimental.enable_x64)"
+            )
+
+    def pack(self, cl, col_version, value_rank):
+        """Pack field arrays into one key array (fields must be in range)."""
+        self._check_dtype()
+        cl = jnp.asarray(cl, self.dtype)
+        ver = jnp.asarray(col_version, self.dtype)
+        val = jnp.asarray(value_rank, self.dtype)
+        return (
+            (cl << (self.ver_bits + self.val_bits))
+            | (ver << self.val_bits)
+            | val
+        )
+
+    def unpack(self, key):
+        self._check_dtype()
+        key = jnp.asarray(key, self.dtype)
+        val = key & self.max_val
+        ver = (key >> self.val_bits) & self.max_ver
+        cl = (key >> (self.val_bits + self.ver_bits)) & self.max_cl
+        return cl, ver, val
+
+    def is_live(self, key):
+        """Row live iff causal length is odd (doc/crdts.md: cl parity)."""
+        cl, _, _ = self.unpack(key)
+        return (cl & 1) == 1
+
+
+DEFAULT_CODEC = KeyCodec()
+
+# Deeper spaces: 16-bit cl, 24-bit versions, 22-bit values.
+WIDE_CODEC = KeyCodec(cl_bits=16, ver_bits=24, val_bits=22)

@@ -1,0 +1,39 @@
+"""CRDT merge kernels.
+
+The merge of two replicas' cell states is an elementwise ``max`` over packed
+keys (see :mod:`corrosion_tpu.ops.keys`); message delivery into a replica
+array is a scatter-max.  Both shapes let XLA fuse the merge into surrounding
+elementwise work and keep everything HBM-resident — this is the pjit'd
+per-row reduction that replaces cr-sqlite's C merge
+(``crates/corro-types/src/sqlite.rs:103-121`` loads the extension;
+``doc/crdts.md:13-16`` defines the rule).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def merge_keys(a, b):
+    """Merge two equally-shaped packed-key arrays (commutative, idempotent,
+    associative — the CRDT join)."""
+    return jnp.maximum(a, b)
+
+
+def merge_cells(states):
+    """Merge replica states along the leading axis: [R, ...] -> [...]."""
+    return jnp.max(states, axis=0)
+
+
+def scatter_merge(state, targets, msg_keys):
+    """Deliver messages into a replica-indexed state via scatter-max.
+
+    state:    [N, ...cells] packed keys, one row per replica.
+    targets:  [M] int replica indices (may repeat; duplicates merge).
+    msg_keys: [M, ...cells] packed keys carried by each message.
+
+    Returns the updated state.  Out-of-range targets must be pre-clamped or
+    masked by pointing them at a dead row; ``mode="drop"`` makes XLA discard
+    them, which the sim uses for loss/partition masking.
+    """
+    return state.at[targets].max(msg_keys, mode="drop")

@@ -1,14 +1,28 @@
 """Test config: force an 8-device virtual CPU mesh before JAX initializes.
 
 Multi-chip sharding is validated on virtual CPU devices (no multi-chip TPU
-hardware in CI); set env BEFORE any jax import.
+hardware in CI).  Note: this environment's sitecustomize registers the
+`axon` TPU-tunnel PJRT plugin at interpreter start and pins
+``jax_platforms``; plain env vars are not enough, so we override the config
+directly before the first backend use.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", (
+    "tests must run on the virtual CPU mesh, got " + jax.default_backend()
+)
+assert jax.device_count() == 8, (
+    f"expected 8 virtual CPU devices, got {jax.device_count()} "
+    "(XLA_FLAGS set too late?)"
+)

@@ -1,0 +1,138 @@
+"""CRDT merge kernel vs a plain-Python oracle of the cr-sqlite rule:
+larger cl wins; tie -> larger col_version; tie -> larger value."""
+
+import contextlib
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corrosion_tpu.ops import DEFAULT_CODEC, merge_cells, merge_keys, scatter_merge
+from corrosion_tpu.ops.keys import KeyCodec, WIDE_CODEC
+
+
+def codec_ctx(codec):
+    """Wide (int64) codecs need x64 enabled."""
+    if codec.total_bits > 31:
+        return jax.enable_x64(True)
+    return contextlib.nullcontext()
+
+
+def oracle_merge(a, b):
+    # a, b: (cl, ver, val) tuples
+    return max(a, b)
+
+
+def rand_cell(rng, codec):
+    return (
+        rng.randint(0, codec.max_cl),
+        rng.randint(0, codec.max_ver),
+        rng.randint(0, codec.max_val),
+    )
+
+
+@pytest.mark.parametrize("codec", [DEFAULT_CODEC, WIDE_CODEC], ids=["i32", "i64"])
+def test_pack_unpack_roundtrip(codec):
+    rng = random.Random(1)
+    with codec_ctx(codec):
+        cells = [rand_cell(rng, codec) for _ in range(256)]
+        cl, ver, val = (jnp.array(x) for x in zip(*cells))
+        keys = codec.pack(cl, ver, val)
+        ucl, uver, uval = codec.unpack(keys)
+        np.testing.assert_array_equal(ucl, cl)
+        np.testing.assert_array_equal(uver, ver)
+        np.testing.assert_array_equal(uval, val)
+
+
+@pytest.mark.parametrize("codec", [DEFAULT_CODEC, WIDE_CODEC], ids=["i32", "i64"])
+def test_packed_order_is_lexicographic(codec):
+    rng = random.Random(2)
+    with codec_ctx(codec):
+        cells_a = [rand_cell(rng, codec) for _ in range(512)]
+        cells_b = [rand_cell(rng, codec) for _ in range(512)]
+        ka = codec.pack(*map(jnp.array, zip(*cells_a)))
+        kb = codec.pack(*map(jnp.array, zip(*cells_b)))
+        packed_lt = np.asarray(ka < kb).tolist()
+        lex_lt = [a < b for a, b in zip(cells_a, cells_b)]
+        assert packed_lt == lex_lt
+
+
+def test_merge_matches_oracle_elementwise():
+    rng = random.Random(3)
+    codec = DEFAULT_CODEC
+    a = [rand_cell(rng, codec) for _ in range(512)]
+    b = [rand_cell(rng, codec) for _ in range(512)]
+    ka = codec.pack(*map(jnp.array, zip(*a)))
+    kb = codec.pack(*map(jnp.array, zip(*b)))
+    merged = merge_keys(ka, kb)
+    expect = [oracle_merge(x, y) for x, y in zip(a, b)]
+    got = list(zip(*(np.asarray(x).tolist() for x in codec.unpack(merged))))
+    assert [tuple(g) for g in got] == expect
+
+
+def test_merge_is_join_semilattice():
+    # commutative, associative, idempotent — batched over random triples
+    rng = random.Random(4)
+    codec = DEFAULT_CODEC
+    mk = lambda cells: codec.pack(*map(jnp.array, zip(*cells)))
+    a = mk([rand_cell(rng, codec) for _ in range(256)])
+    b = mk([rand_cell(rng, codec) for _ in range(256)])
+    c = mk([rand_cell(rng, codec) for _ in range(256)])
+    np.testing.assert_array_equal(merge_keys(a, b), merge_keys(b, a))
+    np.testing.assert_array_equal(
+        merge_keys(a, merge_keys(b, c)), merge_keys(merge_keys(a, b), c)
+    )
+    np.testing.assert_array_equal(merge_keys(a, a), a)
+
+
+def test_merge_cells_reduces_replicas():
+    codec = DEFAULT_CODEC
+    # 3 replicas x 4 cells
+    cl = jnp.array([[1, 1, 2, 1], [1, 3, 1, 1], [1, 1, 1, 1]])
+    ver = jnp.array([[5, 1, 1, 2], [1, 1, 1, 2], [9, 1, 1, 2]])
+    val = jnp.array([[0, 7, 0, 3], [0, 0, 0, 9], [4, 0, 0, 9]])
+    keys = codec.pack(cl, ver, val)
+    merged = codec.unpack(merge_cells(keys))
+    mcl, mver, mval = (np.asarray(x).tolist() for x in merged)
+    # cell0: same cl -> ver 9 wins; cell1: cl 3 wins; cell2: cl 2 wins;
+    # cell3: all tie on (1,2) -> biggest value 9
+    assert mcl == [1, 3, 2, 1]
+    assert mver == [9, 1, 1, 2]
+    assert mval == [4, 0, 0, 9]
+
+
+def test_scatter_merge_delivers_and_merges_duplicates():
+    codec = DEFAULT_CODEC
+    state = codec.pack(
+        jnp.ones(4, jnp.int32), jnp.ones(4, jnp.int32), jnp.zeros(4, jnp.int32)
+    )
+    targets = jnp.array([2, 2, 0, 9])  # 9 out of range -> dropped
+    msgs = codec.pack(
+        jnp.array([1, 1, 1, 3]),
+        jnp.array([4, 6, 1, 9]),
+        jnp.array([0, 0, 0, 0]),
+    )
+    out = scatter_merge(state, targets, msgs)
+    cl, ver, val = (np.asarray(x).tolist() for x in codec.unpack(out))
+    assert ver == [1, 1, 6, 1]  # node2 got max(4,6); node0 msg didn't raise ver
+    assert cl == [1, 1, 1, 1]  # out-of-range cl=3 message dropped
+
+
+def test_is_live_parity():
+    codec = DEFAULT_CODEC
+    keys = codec.pack(
+        jnp.array([1, 2, 3, 0]), jnp.zeros(4, jnp.int32), jnp.zeros(4, jnp.int32)
+    )
+    assert np.asarray(codec.is_live(keys)).tolist() == [True, False, True, False]
+
+
+def test_wide_codec_guarded_without_x64():
+    with pytest.raises(RuntimeError, match="x64"):
+        WIDE_CODEC.pack(jnp.array([1]), jnp.array([2]), jnp.array([3]))
+
+
+def test_codec_layout_validation():
+    with pytest.raises(ValueError):
+        KeyCodec(cl_bits=20, ver_bits=24, val_bits=24)
