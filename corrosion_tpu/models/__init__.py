@@ -1,0 +1,31 @@
+"""Protocol state machines as array programs.
+
+Each module re-expresses one of the reference's distributed protocols as a
+pure, vmappable step function over dense node-indexed state:
+
+* :mod:`corrosion_tpu.models.broadcast` — epidemic broadcast fanout with
+  ring0 tiering, retransmit decay, loss/partition masks
+  (reference: ``crates/corro-agent/src/broadcast/mod.rs:405-1028``).
+* :mod:`corrosion_tpu.models.sync` — anti-entropy set reconciliation
+  (reference: ``crates/corro-agent/src/api/peer.rs:344-1719``, needs
+  algebra ``sync.rs:127-248``).
+* :mod:`corrosion_tpu.models.swim` — SWIM probe/suspect/down membership
+  with incarnation refutation (reference: foca runtime loop,
+  ``crates/corro-agent/src/broadcast/mod.rs:122-381``).
+"""
+
+from corrosion_tpu.models.broadcast import BroadcastParams, broadcast_step
+from corrosion_tpu.models.sync import SyncParams, sync_step, bitmap_needs
+from corrosion_tpu.models.swim import SwimParams, SwimState, swim_init, swim_step
+
+__all__ = [
+    "BroadcastParams",
+    "broadcast_step",
+    "SyncParams",
+    "sync_step",
+    "bitmap_needs",
+    "SwimParams",
+    "SwimState",
+    "swim_init",
+    "swim_step",
+]

@@ -1,0 +1,97 @@
+"""Epidemic broadcast fanout as a masked scatter kernel.
+
+Reference behavior (``crates/corro-agent/src/broadcast/mod.rs``):
+
+* a node holding a changeset transmits it to a random sample of peers,
+  preferring its **ring0** (lowest-RTT) tier first, then a global random
+  sample (``:586-702``);
+* each payload is retransmitted on subsequent rounds until its
+  ``send_count`` reaches ``max_transmissions`` (``:745-765``);
+* nodes that *receive* a broadcast-sourced changeset rebroadcast it with
+  their own transmission budget (``handlers.rs:939-949``).
+
+TPU design: all N nodes' sends in one tick are a single [N, K] target
+draw; delivery is one scatter-max of packed CRDT keys with loss and
+partition masks folded in by pointing masked messages at an out-of-range
+row (``mode="drop"``).  Ring0 is modeled as a contiguous index block of
+``ring0_size`` peers around the sender (the sim's stand-in for the RTT<6ms
+tier); the rest of the fanout is a uniform global draw.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from corrosion_tpu.models.common import block_peers, partition_ok, rand_peers
+from corrosion_tpu.ops.merge import scatter_merge
+
+
+@dataclass(frozen=True)
+class BroadcastParams:
+    n_nodes: int
+    fanout_ring0: int = 2  # sends/tick into the ring0 block
+    fanout_global: int = 2  # sends/tick into the whole cluster
+    ring0_size: int = 256  # ring0 block width (RTT<6ms tier stand-in)
+    max_transmissions: int = 8  # retransmit decay budget per payload
+    loss: float = 0.0  # per-message drop probability
+
+    @property
+    def fanout(self) -> int:
+        return self.fanout_ring0 + self.fanout_global
+
+
+def _draw_targets(key, params: BroadcastParams):
+    """[N, K] target draw: ring0 block neighbors first, then global."""
+    n = params.n_nodes
+    key_r, key_g = jax.random.split(key)
+    ring0_targets = block_peers(
+        key_r, n, (n, params.fanout_ring0), params.ring0_size
+    )
+    global_targets = rand_peers(key_g, n, (n, params.fanout_global))
+    return jnp.concatenate([ring0_targets, global_targets], axis=1)
+
+
+@partial(jax.jit, static_argnames=("params",))
+def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
+                   partition_id=None, partition_active=False):
+    """One gossip tick for every node at once.
+
+    rows:         [N, R] packed CRDT keys (the node's table state)
+    tx_remaining: [N] int32 remaining transmissions for the node's
+                  current knowledge (0 = quiescent)
+    msgs_sent:    [N] int32 cumulative sent-message counter
+    key:          PRNG key for this tick
+    partition_id: [N] int32 block id; messages crossing blocks are dropped
+                  while ``partition_active`` (pass a traced bool)
+
+    Returns (rows', tx_remaining', msgs_sent').
+    """
+    n, k = params.n_nodes, params.fanout
+    key_t, key_l = jax.random.split(key)
+
+    active = tx_remaining > 0  # [N]
+    targets = _draw_targets(key_t, params)  # [N, K]
+
+    # message viability: sender active, not lost, not across a partition
+    ok = jnp.broadcast_to(active[:, None], (n, k))
+    if params.loss > 0.0:
+        ok &= jax.random.uniform(key_l, (n, k)) >= params.loss
+    ok &= partition_ok(partition_id, targets, partition_active)
+
+    # masked delivery: dead messages point past the end and get dropped
+    flat_targets = jnp.where(ok, targets, n).reshape(-1)
+    msg_keys = jnp.repeat(rows, k, axis=0)  # [N*K, R] sender payloads
+    new_rows = scatter_merge(rows, flat_targets, msg_keys)
+
+    # retransmit decay for senders; fresh budget for nodes that learned
+    # something new (rebroadcast semantics)
+    learned = jnp.any(new_rows != rows, axis=1)
+    tx = jnp.where(active, tx_remaining - 1, tx_remaining)
+    tx = jnp.where(learned, params.max_transmissions, tx)
+
+    msgs = msgs_sent + jnp.where(active, k, 0).astype(msgs_sent.dtype)
+    return new_rows, tx, msgs

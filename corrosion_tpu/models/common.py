@@ -1,0 +1,42 @@
+"""Shared topology primitives for the protocol models."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rand_peers(key, n: int, shape):
+    """Uniform random peers, never self.
+
+    shape's leading dim must be n (one row per node); each entry is drawn
+    as ``(row + offset) % n`` with offset in 1..n-1.
+    """
+    offs = jax.random.randint(key, shape, 1, max(n, 2))
+    rows = jnp.arange(n, dtype=jnp.int32).reshape((n,) + (1,) * (len(shape) - 1))
+    return (rows + offs) % n
+
+
+def block_peers(key, n: int, shape, block: int):
+    """Random peers within a contiguous index block of ``block`` neighbors
+    (offsets 1..block inclusive, capped at n-1), never self."""
+    hi = min(block, n - 1) if n > 1 else 1
+    offs = jax.random.randint(key, shape, 1, hi + 1)
+    rows = jnp.arange(n, dtype=jnp.int32).reshape((n,) + (1,) * (len(shape) - 1))
+    return (rows + offs) % n
+
+
+def partition_ok(partition_id, senders_axis_targets, active):
+    """True where a message does NOT cross an active partition boundary.
+
+    partition_id: [N] block ids or None (no partition).
+    senders_axis_targets: [N, ...] target indices (row i = sender i).
+    active: traced bool (partition currently in force).
+    """
+    if partition_id is None:
+        return True
+    cross = (
+        partition_id.reshape((-1,) + (1,) * (senders_axis_targets.ndim - 1))
+        != partition_id[senders_axis_targets]
+    )
+    return ~(cross & active)

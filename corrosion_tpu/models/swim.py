@@ -1,0 +1,179 @@
+"""SWIM membership as a vmapped state machine over an [N, N] view matrix.
+
+Reference behavior (the foca runtime the agent drives at
+``crates/corro-agent/src/broadcast/mod.rs:122-381``; identity renewal at
+``corro-types/src/actor.rs:199-210``):
+
+* each protocol period a member **pings** one random peer; no ack →
+  **ping-req** through ``num_indirect_probes`` helpers; still nothing →
+  the peer is locally **suspected**;
+* a suspicion that isn't refuted within the suspicion timeout becomes
+  **down** and is disseminated;
+* a member that learns it is suspected **refutes** by re-announcing
+  itself with a bumped incarnation; a member declared down rejoins by
+  renewing its identity (modeled here as an incarnation bump past the
+  down record, the array analogue of ``Actor::renew``).
+
+State is dense: ``view[i, j]`` is node i's knowledge of node j packed as
+``incarnation * 4 + state_rank`` (alive=0 < suspect=1 < down=2), so SWIM's
+override rules — suspect@inc beats alive@inc, alive@inc+1 refutes
+suspect@inc, down@inc beats both, renewal beats down — are all one
+numeric ``max``.  Probes, indirect probes, suspicion timeouts, gossip
+dissemination and refutation are each one vectorized pass; the whole tick
+is a single jitted function over [N] and [N, N] arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from corrosion_tpu.models.common import rand_peers
+
+ALIVE, SUSPECT, DOWN = 0, 1, 2
+_NEVER = jnp.iinfo(jnp.int32).max
+
+
+@dataclass(frozen=True)
+class SwimParams:
+    n_nodes: int
+    num_indirect_probes: int = 3  # ping-req helpers after a failed ping
+    suspect_timeout: int = 6  # ticks before suspect -> down
+    gossip_targets: int = 3  # peers gossiped to per tick
+    gossip_entries: int = 6  # view entries piggybacked per gossip msg
+    loss: float = 0.0  # per-leg message drop probability
+
+
+class SwimState(NamedTuple):
+    view: jnp.ndarray  # [N, N] int32 packed (inc*4 + state)
+    suspect_since: jnp.ndarray  # [N, N] int32 tick, _NEVER when not suspect
+    incarnation: jnp.ndarray  # [N] int32 own incarnation
+    msgs: jnp.ndarray  # [N] int32 messages sent
+
+
+def member_key(inc, state):
+    return inc * 4 + state
+
+
+def key_state(key):
+    return key % 4
+
+
+def key_inc(key):
+    return key // 4
+
+
+def swim_init(n_nodes: int) -> SwimState:
+    """Everyone starts knowing everyone alive at incarnation 0."""
+    return SwimState(
+        view=jnp.zeros((n_nodes, n_nodes), jnp.int32),
+        suspect_since=jnp.full((n_nodes, n_nodes), _NEVER, jnp.int32),
+        incarnation=jnp.zeros(n_nodes, jnp.int32),
+        msgs=jnp.zeros(n_nodes, jnp.int32),
+    )
+
+
+@partial(jax.jit, static_argnames=("params",))
+def swim_step(state: SwimState, key, tick, params: SwimParams, alive):
+    """One protocol period for all N nodes at once.
+
+    alive: [N] bool ground truth (the churn schedule); dead nodes never
+    ack, send, or gossip.  Returns the next SwimState.
+    """
+    n = params.n_nodes
+    k_probe, k_loss1, k_loss2, k_help, k_hloss, k_gt, k_ge, k_gloss = (
+        jax.random.split(key, 8)
+    )
+    view, suspect_since, inc, msgs = state
+
+    def lossy(k, shape):
+        if params.loss > 0.0:
+            return jax.random.uniform(k, shape) >= params.loss
+        return jnp.ones(shape, dtype=bool)
+
+    # --- direct probe -----------------------------------------------------
+    target = rand_peers(k_probe, n, (n,))  # [N]
+    ping_ok = alive & lossy(k_loss1, (n,)) & alive[target]
+    ack_ok = ping_ok & lossy(k_loss2, (n,))
+    # msgs: ping (if sender alive) + ack (if it came back)
+    msgs = msgs + alive.astype(jnp.int32) + jnp.zeros_like(msgs).at[target].add(
+        ping_ok.astype(jnp.int32)
+    )
+
+    # --- indirect probes on direct failure --------------------------------
+    h = params.num_indirect_probes
+    helpers = rand_peers(k_help, n, (n, h))  # [N, H]
+    legs = lossy(k_hloss, (n, h, 4))  # req, ping, ack, relay-ack
+    indirect_ok = (
+        (~ack_ok[:, None])
+        & alive[:, None]
+        & alive[helpers]
+        & alive[target][:, None]
+        & legs.all(axis=2)
+    )  # [N, H]
+    # msgs: ping-req per helper + helper's ping + acks riding back
+    tried = (~ack_ok[:, None]) & alive[:, None]  # [N, H] requests sent
+    msgs = msgs + tried.sum(axis=1, dtype=jnp.int32)
+    msgs = msgs.at[helpers.reshape(-1)].add(
+        (tried & alive[helpers]).reshape(-1).astype(jnp.int32)
+    )
+    msgs = msgs.at[target].add(indirect_ok.sum(axis=1, dtype=jnp.int32))
+
+    probe_ok = ack_ok | indirect_ok.any(axis=1)  # [N]
+
+    # --- apply probe outcome ---------------------------------------------
+    rows = jnp.arange(n)
+    alive_key_t = member_key(inc[target], ALIVE)
+    cur = view[rows, target]
+    # success: learn the target is alive at its current incarnation
+    upd = jnp.where(probe_ok & alive, jnp.maximum(cur, alive_key_t), cur)
+    # failure: suspect at the incarnation we currently know
+    fail = (~probe_ok) & alive
+    suspected = member_key(key_inc(cur), SUSPECT)
+    upd = jnp.where(fail & (key_state(cur) == ALIVE), jnp.maximum(cur, suspected), upd)
+    view = view.at[rows, target].set(upd)
+
+    # --- suspicion timeout: suspect -> down -------------------------------
+    is_suspect = key_state(view) == SUSPECT
+    expired = is_suspect & (tick - suspect_since >= params.suspect_timeout)
+    view = jnp.where(expired, member_key(key_inc(view), DOWN), view)
+
+    # --- gossip dissemination ---------------------------------------------
+    g, m = params.gossip_targets, params.gossip_entries
+    gt = rand_peers(k_gt, n, (n, g))  # [N, G] gossip targets
+    ge = jax.random.randint(k_ge, (n, m), 0, n)  # [N, M] entries sampled
+    ok = alive[:, None, None] & lossy(k_gloss, (n, g, m)) & alive[gt][:, :, None]
+    payload = view[jnp.arange(n)[:, None], ge]  # [N, M] sender's entries
+    payload = jnp.broadcast_to(payload[:, None, :], (n, g, m))
+    members = jnp.broadcast_to(ge[:, None, :], (n, g, m))
+    flat_idx = jnp.where(
+        ok, gt[:, :, None] * n + members, n * n
+    ).reshape(-1)
+    view = (
+        view.reshape(-1).at[flat_idx].max(payload.reshape(-1), mode="drop")
+    ).reshape(n, n)
+    msgs = msgs + (alive * g).astype(jnp.int32)
+
+    # --- refutation / renewal --------------------------------------------
+    # a live node that sees itself non-alive in its own merged row bumps
+    # its incarnation past the offending record and re-announces
+    self_key = view[rows, rows]
+    offended = alive & (key_state(self_key) != ALIVE)
+    new_inc = jnp.where(offended, key_inc(self_key) + 1, jnp.maximum(inc, key_inc(self_key)))
+    inc = jnp.maximum(inc, new_inc)
+    view = view.at[rows, rows].set(
+        jnp.where(alive, member_key(inc, ALIVE), self_key)
+    )
+
+    # --- suspect_since maintenance ---------------------------------------
+    now_suspect = key_state(view) == SUSPECT
+    suspect_since = jnp.where(
+        now_suspect & (suspect_since == _NEVER), tick, suspect_since
+    )
+    suspect_since = jnp.where(now_suspect, suspect_since, _NEVER)
+
+    return SwimState(view, suspect_since, inc, msgs)

@@ -1,0 +1,29 @@
+"""The tpu-sim runtime: whole-cluster simulation as one array program.
+
+This is the north-star path (BASELINE.json): instead of spawning N tokio
+agents over loopback QUIC like ``corro-devcluster``, the cluster IS the
+tensor — node state lives in HBM, every gossip/sync/SWIM tick is one jitted
+step over the node axis, and independent seeds ("parallel universes") are
+vmapped to get p99 convergence distributions from a single scan.
+"""
+
+from corrosion_tpu.sim.epidemic import (
+    EpidemicConfig,
+    EpidemicState,
+    epidemic_init,
+    epidemic_tick,
+    run_epidemic,
+    run_epidemic_seeds,
+)
+from corrosion_tpu.sim.churn import ChurnConfig, run_churn
+
+__all__ = [
+    "EpidemicConfig",
+    "EpidemicState",
+    "epidemic_init",
+    "epidemic_tick",
+    "run_epidemic",
+    "run_epidemic_seeds",
+    "ChurnConfig",
+    "run_churn",
+]

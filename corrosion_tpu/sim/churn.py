@@ -1,0 +1,103 @@
+"""SWIM membership churn simulation (BASELINE.md config #2).
+
+A cluster runs the SWIM model while the ground-truth liveness schedule
+kills and revives nodes; the measured quantities are failure-detection
+latency (ticks from death until every live node marks the victim down)
+and rejoin propagation (ticks until every live node sees the revived
+node alive again), plus msgs/node — the SWIM slice of the north-star
+metric.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corrosion_tpu.models.swim import (
+    ALIVE,
+    DOWN,
+    SwimParams,
+    key_state,
+    swim_init,
+    swim_step,
+)
+
+
+@dataclass(frozen=True)
+class ChurnConfig:
+    n_nodes: int = 64
+    params: SwimParams = None  # type: ignore[assignment]
+    kill_tick: int = 4  # when the victim dies
+    revive_tick: int = 40  # when it comes back
+    victim: int = 1
+    max_ticks: int = 128
+    chunk_ticks: int = 8
+
+    def __post_init__(self):
+        if self.params is None:
+            object.__setattr__(self, "params", SwimParams(n_nodes=self.n_nodes))
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _scan_chunk(state, seed_key, start_tick, cfg: ChurnConfig):
+    p = cfg.params
+
+    def alive_at(t):
+        a = jnp.ones((cfg.n_nodes,), dtype=bool)
+        dead = (t >= cfg.kill_tick) & (t < cfg.revive_tick)
+        return a.at[cfg.victim].set(~dead)
+
+    def body(st, i):
+        t = start_tick + i
+        key = jax.random.fold_in(seed_key, t)
+        nxt = swim_step(st, key, t, p, alive_at(t))
+        others = jnp.arange(cfg.n_nodes) != cfg.victim
+        col = key_state(nxt.view[:, cfg.victim])
+        detected = jnp.all(jnp.where(others, col == DOWN, True))
+        rejoined = jnp.all(jnp.where(others, col == ALIVE, True))
+        return nxt, (detected, rejoined)
+
+    return jax.lax.scan(body, state, jnp.arange(cfg.chunk_ticks))
+
+
+def run_churn(cfg: ChurnConfig, seed: int = 0):
+    """Returns detection/rejoin latency stats for one churn cycle."""
+    state = swim_init(cfg.n_nodes)
+    seed_key = jax.random.PRNGKey(seed)
+
+    t0 = time.perf_counter()
+    det_flags, rej_flags = [], []
+    ticks = 0
+    while ticks < cfg.max_ticks:
+        state, (det, rej) = _scan_chunk(state, seed_key, ticks, cfg)
+        det_flags.append(np.asarray(det))
+        rej_flags.append(np.asarray(rej))
+        ticks += cfg.chunk_ticks
+        if ticks > cfg.revive_tick and rej_flags[-1][-1]:
+            break
+    wall = time.perf_counter() - t0
+
+    det = np.concatenate(det_flags)
+    rej = np.concatenate(rej_flags)
+    detect_tick = int(det.argmax()) if det.any() else None
+    # rejoin counts only after the revive tick
+    rej[: cfg.revive_tick] = False
+    rejoin_tick = int(rej.argmax()) if rej.any() else None
+    msgs = np.asarray(state.msgs)
+    return {
+        "n_nodes": cfg.n_nodes,
+        "detect_latency": (
+            None if detect_tick is None else detect_tick - cfg.kill_tick
+        ),
+        "rejoin_latency": (
+            None if rejoin_tick is None else rejoin_tick - cfg.revive_tick
+        ),
+        "msgs_per_node_mean": float(msgs.mean()),
+        "wall_s": wall,
+        "ticks_run": ticks,
+    }

@@ -1,0 +1,100 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corrosion_tpu.models.broadcast import BroadcastParams, broadcast_step
+from corrosion_tpu.ops.keys import DEFAULT_CODEC as C
+
+
+def _init(n, r=4):
+    base = C.pack(
+        jnp.ones((n, r), jnp.int32),
+        jnp.ones((n, r), jnp.int32),
+        jnp.zeros((n, r), jnp.int32),
+    )
+    news = C.pack(
+        jnp.ones((r,), jnp.int32),
+        jnp.full((r,), 2, jnp.int32),
+        jnp.ones((r,), jnp.int32),
+    )
+    rows = base.at[0].set(news)
+    return rows, news
+
+
+def test_lossless_epidemic_converges():
+    n = 512
+    p = BroadcastParams(n_nodes=n, fanout_ring0=2, fanout_global=2, ring0_size=64)
+    rows, news = _init(n)
+    tx = jnp.zeros((n,), jnp.int32).at[0].set(p.max_transmissions)
+    msgs = jnp.zeros((n,), jnp.int32)
+    key = jax.random.PRNGKey(0)
+    for t in range(40):
+        rows, tx, msgs = broadcast_step(rows, tx, msgs, jax.random.fold_in(key, t), p)
+        if bool(jnp.all(rows == news[None, :])):
+            break
+    assert bool(jnp.all(rows == news[None, :])), "did not converge in 40 ticks"
+    # epidemic should be fast: O(log N) plus decay tail
+    assert t < 30
+
+
+def test_messages_counted_only_for_active_senders():
+    n = 8
+    p = BroadcastParams(n_nodes=n, fanout_ring0=1, fanout_global=1, ring0_size=4)
+    rows, _ = _init(n)
+    tx = jnp.zeros((n,), jnp.int32).at[0].set(2)
+    msgs = jnp.zeros((n,), jnp.int32)
+    rows2, tx2, msgs2 = broadcast_step(rows, tx, msgs, jax.random.PRNGKey(1), p)
+    assert int(msgs2[0]) == p.fanout
+    assert int(tx2[0]) == 1
+    # quiescent nodes sent nothing (unless they just learned -> only recv)
+    assert int(msgs2[1:].sum()) == 0
+
+
+def test_retransmit_decay_quiesces():
+    n = 16
+    p = BroadcastParams(n_nodes=n, fanout_ring0=1, fanout_global=1, ring0_size=8,
+                        max_transmissions=3)
+    rows, news = _init(n)
+    tx = jnp.zeros((n,), jnp.int32).at[0].set(3)
+    msgs = jnp.zeros((n,), jnp.int32)
+    key = jax.random.PRNGKey(2)
+    for t in range(64):
+        rows, tx, msgs = broadcast_step(rows, tx, msgs, jax.random.fold_in(key, t), p)
+    assert int(tx.max()) == 0, "all transmission budgets must eventually drain"
+    total = int(msgs.sum())
+    for t in range(64, 70):
+        rows, tx, msgs = broadcast_step(rows, tx, msgs, jax.random.fold_in(key, t), p)
+    assert int(msgs.sum()) == total, "quiescent cluster must stop sending"
+
+
+def test_partition_blocks_cross_traffic():
+    n = 64
+    p = BroadcastParams(n_nodes=n, fanout_ring0=2, fanout_global=2, ring0_size=8)
+    rows, news = _init(n)
+    tx = jnp.zeros((n,), jnp.int32).at[0].set(p.max_transmissions)
+    msgs = jnp.zeros((n,), jnp.int32)
+    part = (jnp.arange(n) >= n // 2).astype(jnp.int32)
+    key = jax.random.PRNGKey(3)
+    for t in range(50):
+        rows, tx, msgs = broadcast_step(
+            rows, tx, msgs, jax.random.fold_in(key, t), p,
+            partition_id=part, partition_active=jnp.array(True),
+        )
+    has_news = np.asarray((rows == news[None, :]).all(axis=1))
+    assert has_news[: n // 2].all(), "writer's side should converge"
+    assert not has_news[n // 2 :].any(), "no message may cross the partition"
+
+
+def test_loss_slows_but_does_not_stop():
+    n = 256
+    p = BroadcastParams(n_nodes=n, fanout_ring0=2, fanout_global=2, ring0_size=32,
+                        loss=0.05, max_transmissions=8)
+    rows, news = _init(n)
+    tx = jnp.zeros((n,), jnp.int32).at[0].set(p.max_transmissions)
+    msgs = jnp.zeros((n,), jnp.int32)
+    key = jax.random.PRNGKey(4)
+    for t in range(60):
+        rows, tx, msgs = broadcast_step(rows, tx, msgs, jax.random.fold_in(key, t), p)
+        if bool(jnp.all(rows == news[None, :])):
+            break
+    assert bool(jnp.all(rows == news[None, :]))

@@ -1,0 +1,73 @@
+import numpy as np
+
+from corrosion_tpu.sim import (
+    ChurnConfig,
+    EpidemicConfig,
+    run_churn,
+    run_epidemic,
+    run_epidemic_seeds,
+)
+
+
+def test_small_epidemic_converges():
+    cfg = EpidemicConfig(
+        n_nodes=256, n_rows=4, ring0_size=32, max_ticks=96, chunk_ticks=8,
+    )
+    stats = run_epidemic(cfg, seed=0)
+    assert stats["converged_frac"] == 1.0
+    assert stats["ticks_to_converge"] < 40
+    assert stats["msgs_per_node_mean"] > 0
+
+
+def test_seeds_distribution():
+    cfg = EpidemicConfig(
+        n_nodes=128, n_rows=4, ring0_size=16, max_ticks=96, chunk_ticks=8,
+    )
+    stats = run_epidemic_seeds(cfg, n_seeds=8, seed=1)
+    assert stats["converged_frac"] == 1.0
+    assert stats["ticks_p99"] >= stats["ticks_p50"]
+
+
+def test_partition_heal_with_loss():
+    # BASELINE config #5 shape, tiny: 5% loss, 2-way partition healing at t=10
+    cfg = EpidemicConfig(
+        n_nodes=256,
+        n_rows=4,
+        ring0_size=32,
+        loss=0.05,
+        partition_blocks=2,
+        heal_tick=10,
+        sync_interval=4,
+        max_ticks=160,
+        chunk_ticks=8,
+    )
+    stats = run_epidemic(cfg, seed=2)
+    assert stats["converged_frac"] == 1.0
+    # convergence can't predate the heal
+    assert stats["ticks_to_converge"] >= 10
+
+
+def test_no_sync_partition_never_converges():
+    # with sync disabled and tx budget drained before the heal, the writer's
+    # side quiesces and the far side stays stale
+    cfg = EpidemicConfig(
+        n_nodes=128,
+        n_rows=4,
+        ring0_size=16,
+        max_transmissions=3,
+        partition_blocks=2,
+        heal_tick=10_000,
+        sync_interval=0,
+        max_ticks=32,
+        chunk_ticks=8,
+    )
+    stats = run_epidemic(cfg, seed=3)
+    assert stats["converged_frac"] == 0.0
+
+
+def test_churn_detection_and_rejoin():
+    cfg = ChurnConfig(n_nodes=64, kill_tick=4, revive_tick=40, max_ticks=160)
+    stats = run_churn(cfg, seed=0)
+    assert stats["detect_latency"] is not None and stats["detect_latency"] > 0
+    assert stats["rejoin_latency"] is not None and stats["rejoin_latency"] >= 0
+    assert stats["msgs_per_node_mean"] > 0
