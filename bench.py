@@ -61,9 +61,11 @@ def main() -> None:
     stats = run_epidemic_seeds(cfg, n_seeds=args.seeds, seed=0)
 
     if stats["converged_frac"] < 1.0:
-        print(
-            json.dumps({"error": "did not converge", **stats}), file=sys.stderr
-        )
+        safe = {
+            k: (None if isinstance(v, float) and not (v == v and abs(v) != float("inf")) else v)
+            for k, v in stats.items()
+        }
+        print(json.dumps({"error": "did not converge", **safe}), file=sys.stderr)
 
     baseline_s = 60.0  # BASELINE.json north-star budget on v5e-8
     value = round(stats["wall_s"], 3)

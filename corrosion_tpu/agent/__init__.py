@@ -1,0 +1,13 @@
+"""The host agent: a real, runnable distributed-SQLite node.
+
+This half of the framework mirrors the reference's serving surface
+(SURVEY.md §1 layers 1-12): a CRDT storage engine over stock sqlite3
+(our own implementation of the cr-sqlite semantics — the reference
+vendors a prebuilt C extension, ``crates/corro-types/crsqlite-*.so``),
+version bookkeeping, gossip membership + dissemination, anti-entropy
+sync, HTTP API, reactive subscriptions, and the CLI/devcluster tooling.
+
+The TPU simulator (:mod:`corrosion_tpu.sim`) shares the same wire types
+and merge semantics, which is what lets sim traces be diffed against real
+agents at small N.
+"""

@@ -1,0 +1,116 @@
+"""Stable binary codec for primary keys and a total order over SQL values.
+
+Parity targets:
+
+* the reference packs multi-column primary keys into a single blob for
+  wire transport and subscription bookkeeping
+  (``crates/corro-types/src/pubsub.rs:2302-2449``);
+* cr-sqlite's merge tie-break needs a total order over SQLite values
+  ("biggest value wins", ``doc/crdts.md:13-16``) following SQLite's
+  cross-type comparison order: NULL < INTEGER/REAL < TEXT < BLOB.
+
+The codec here is our own format (tag byte + big-endian payload) chosen so
+that packed blobs are self-describing and roundtrip exactly.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable, List, Tuple
+
+SqlValue = object  # None | int | float | str | bytes
+
+_T_NULL = 0
+_T_INT = 1
+_T_REAL = 2
+_T_TEXT = 3
+_T_BLOB = 4
+
+
+def _type_rank(v: SqlValue) -> int:
+    if v is None:
+        return 0
+    if isinstance(v, bool):
+        return 1
+    if isinstance(v, (int, float)):
+        return 1  # INTEGER and REAL compare numerically in one class
+    if isinstance(v, str):
+        return 2
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return 3
+    raise TypeError(f"unsupported SQL value: {type(v)!r}")
+
+
+def value_cmp(a: SqlValue, b: SqlValue) -> int:
+    """SQLite ORDER BY comparison: NULL < numeric < text < blob."""
+    ra, rb = _type_rank(a), _type_rank(b)
+    if ra != rb:
+        return -1 if ra < rb else 1
+    if ra == 0:
+        return 0
+    if ra == 1:
+        return (a > b) - (a < b)
+    if ra == 2:
+        ab, bb = a.encode("utf-8"), b.encode("utf-8")
+        return (ab > bb) - (ab < bb)
+    ab, bb = bytes(a), bytes(b)
+    return (ab > bb) - (ab < bb)
+
+
+def pack_values(values: Iterable[SqlValue]) -> bytes:
+    """Pack a tuple of SQL values into one self-describing blob."""
+    out = bytearray()
+    for v in values:
+        if v is None:
+            out.append(_T_NULL)
+        elif isinstance(v, bool):
+            out.append(_T_INT)
+            out += struct.pack(">q", int(v))
+        elif isinstance(v, int):
+            out.append(_T_INT)
+            out += struct.pack(">q", v)
+        elif isinstance(v, float):
+            out.append(_T_REAL)
+            out += struct.pack(">d", v)
+        elif isinstance(v, str):
+            b = v.encode("utf-8")
+            out.append(_T_TEXT)
+            out += struct.pack(">I", len(b)) + b
+        elif isinstance(v, (bytes, bytearray, memoryview)):
+            b = bytes(v)
+            out.append(_T_BLOB)
+            out += struct.pack(">I", len(b)) + b
+        else:
+            raise TypeError(f"unsupported SQL value: {type(v)!r}")
+    return bytes(out)
+
+
+def unpack_values(blob: bytes) -> List[SqlValue]:
+    """Inverse of :func:`pack_values`."""
+    out: List[SqlValue] = []
+    i = 0
+    n = len(blob)
+    while i < n:
+        tag = blob[i]
+        i += 1
+        if tag == _T_NULL:
+            out.append(None)
+        elif tag == _T_INT:
+            (v,) = struct.unpack_from(">q", blob, i)
+            i += 8
+            out.append(v)
+        elif tag == _T_REAL:
+            (v,) = struct.unpack_from(">d", blob, i)
+            i += 8
+            out.append(v)
+        elif tag in (_T_TEXT, _T_BLOB):
+            (ln,) = struct.unpack_from(">I", blob, i)
+            i += 4
+            raw = blob[i : i + ln]
+            if len(raw) != ln:
+                raise ValueError("truncated packed value")
+            i += ln
+            out.append(raw.decode("utf-8") if tag == _T_TEXT else raw)
+        else:
+            raise ValueError(f"bad tag {tag} at offset {i-1}")
+    return out

@@ -1,0 +1,568 @@
+"""CRDT storage engine: conflict-free replicated tables over stock sqlite3.
+
+This is our implementation of the semantics the reference gets from the
+vendored cr-sqlite C extension (loaded at
+``crates/corro-types/src/sqlite.rs:103-121``; semantics documented in
+``doc/crdts.md``):
+
+* ``as_crr(table)`` marks a table as a conflict-free replicated relation:
+  a ``<t>__corro_clock`` table tracks a lamport ``col_version`` per
+  (row, column) cell, and a ``<t>__corro_cl`` table tracks the row's
+  **causal length** (odd = live, even = deleted);
+* local writes run through generated AFTER INSERT/UPDATE/DELETE triggers
+  that maintain the clock tables with (db_version, seq) stamps — any SQL
+  write works, exactly like cr-sqlite's trigger machinery;
+* ``collect_changes`` is the ``crsql_changes`` SELECT side: cell-level
+  change rows, seq-ordered within a db_version;
+* ``apply_changes`` is the ``crsql_changes`` INSERT side — the merge:
+  bigger causal length wins the row; within an equal causal length the
+  bigger ``col_version`` wins the cell, ties broken by the bigger value
+  (SQLite value order, :func:`corrosion_tpu.agent.pack.value_cmp`);
+* ``site_id`` identifies this database (== the agent's ActorId), interned
+  remote sites get small ordinals like cr-sqlite's site table.
+
+Design difference from the reference (deliberate): no virtual tables —
+change collection and application are plain queries + Python merge logic
+(with a C fast path planned), because our hot path for bulk merges is the
+TPU kernel in :mod:`corrosion_tpu.ops.merge`, not the sqlite insert path.
+"""
+
+from __future__ import annotations
+
+import re
+import sqlite3
+import threading
+import uuid
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from corrosion_tpu.agent.pack import pack_values, unpack_values, value_cmp
+from corrosion_tpu.types.base import CrsqlDbVersion, CrsqlSeq
+from corrosion_tpu.types.change import Change, SENTINEL_CID
+
+_IDENT_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+
+def _ident(name: str) -> str:
+    if not _IDENT_RE.match(name):
+        raise ValueError(f"invalid identifier: {name!r}")
+    return name
+
+
+@dataclass(frozen=True)
+class TableInfo:
+    name: str
+    pk_cols: Tuple[str, ...]
+    data_cols: Tuple[str, ...]  # non-pk columns
+
+
+class CrConn:
+    """A sqlite3 connection with the CRDT layer installed."""
+
+    def __init__(self, path: str, site_id: Optional[bytes] = None):
+        self.path = path
+        self.conn = sqlite3.connect(path, check_same_thread=False)
+        self.conn.isolation_level = None  # manual transactions
+        self.conn.execute("PRAGMA journal_mode=WAL")
+        self.conn.execute("PRAGMA synchronous=NORMAL")
+        self.conn.execute("PRAGMA foreign_keys=OFF")
+        self._lock = threading.RLock()
+        self.conn.create_function("corro_pack", -1, _udf_pack, deterministic=True)
+        self.conn.create_function(
+            "corro_json_contains", 2, _udf_json_contains, deterministic=True
+        )
+        self._init_meta(site_id)
+        self._tables: Dict[str, TableInfo] = {}
+        self._load_crr_tables()
+
+    # ------------------------------------------------------------------
+    # metadata
+    # ------------------------------------------------------------------
+
+    def _init_meta(self, site_id: Optional[bytes]) -> None:
+        c = self.conn
+        c.execute(
+            "CREATE TABLE IF NOT EXISTS __corro_state "
+            "(key TEXT PRIMARY KEY, value INTEGER NOT NULL)"
+        )
+        c.execute(
+            "INSERT OR IGNORE INTO __corro_state VALUES "
+            "('db_version', 0), ('pending_db_version', 0), ('seq', 0), "
+            "('apply_mode', 0)"
+        )
+        c.execute(
+            "CREATE TABLE IF NOT EXISTS __corro_sites "
+            "(ordinal INTEGER PRIMARY KEY AUTOINCREMENT, site_id BLOB NOT NULL UNIQUE)"
+        )
+        c.execute(
+            "CREATE TABLE IF NOT EXISTS __corro_crr_tables (name TEXT PRIMARY KEY)"
+        )
+        row = c.execute(
+            "SELECT site_id FROM __corro_sites WHERE ordinal = 1"
+        ).fetchone()
+        if row is None:
+            sid = site_id or uuid.uuid4().bytes
+            c.execute("INSERT INTO __corro_sites (ordinal, site_id) VALUES (1, ?)", (sid,))
+            self.site_id = sid
+        else:
+            self.site_id = bytes(row[0])
+
+    def _load_crr_tables(self) -> None:
+        for (name,) in self.conn.execute("SELECT name FROM __corro_crr_tables"):
+            self._tables[name] = self._introspect(name)
+
+    def _introspect(self, table: str) -> TableInfo:
+        info = self.conn.execute(f'PRAGMA table_info("{_ident(table)}")').fetchall()
+        if not info:
+            raise ValueError(f"no such table: {table}")
+        pk = tuple(r[1] for r in sorted((r for r in info if r[5]), key=lambda r: r[5]))
+        data = tuple(r[1] for r in info if not r[5])
+        if not pk:
+            raise ValueError(f"CRR table {table} must have a primary key")
+        return TableInfo(name=table, pk_cols=pk, data_cols=data)
+
+    @property
+    def tables(self) -> Dict[str, TableInfo]:
+        return dict(self._tables)
+
+    # ------------------------------------------------------------------
+    # site interning
+    # ------------------------------------------------------------------
+
+    def site_ordinal(self, site_id: bytes) -> int:
+        with self._lock:
+            row = self.conn.execute(
+                "SELECT ordinal FROM __corro_sites WHERE site_id = ?", (site_id,)
+            ).fetchone()
+            if row:
+                return row[0]
+            cur = self.conn.execute(
+                "INSERT INTO __corro_sites (site_id) VALUES (?)", (site_id,)
+            )
+            return cur.lastrowid
+
+    def site_for_ordinal(self, ordinal: int) -> bytes:
+        row = self.conn.execute(
+            "SELECT site_id FROM __corro_sites WHERE ordinal = ?", (ordinal,)
+        ).fetchone()
+        if row is None:
+            raise KeyError(f"unknown site ordinal {ordinal}")
+        return bytes(row[0])
+
+    # ------------------------------------------------------------------
+    # CRR setup (crsql_as_crr)
+    # ------------------------------------------------------------------
+
+    def as_crr(self, table: str) -> None:
+        t = _ident(table)
+        info = self._introspect(t)
+        c = self.conn
+        c.execute(
+            f'CREATE TABLE IF NOT EXISTS "{t}__corro_clock" ('
+            " pk BLOB NOT NULL, cid TEXT NOT NULL,"
+            " col_version INTEGER NOT NULL, db_version INTEGER NOT NULL,"
+            " seq INTEGER NOT NULL, site_ordinal INTEGER NOT NULL,"
+            " PRIMARY KEY (pk, cid))"
+        )
+        c.execute(
+            f'CREATE INDEX IF NOT EXISTS "{t}__corro_clock_dbv" '
+            f'ON "{t}__corro_clock" (site_ordinal, db_version)'
+        )
+        c.execute(
+            f'CREATE TABLE IF NOT EXISTS "{t}__corro_cl" ('
+            " pk BLOB NOT NULL PRIMARY KEY, cl INTEGER NOT NULL,"
+            " db_version INTEGER NOT NULL, seq INTEGER NOT NULL,"
+            " site_ordinal INTEGER NOT NULL)"
+        )
+        c.execute(
+            f'CREATE INDEX IF NOT EXISTS "{t}__corro_cl_dbv" '
+            f'ON "{t}__corro_cl" (site_ordinal, db_version)'
+        )
+        self._create_triggers(info)
+        c.execute("INSERT OR IGNORE INTO __corro_crr_tables VALUES (?)", (t,))
+        self._tables[t] = info
+
+    def _create_triggers(self, info: TableInfo) -> None:
+        t = info.name
+        new_pk = "corro_pack(" + ", ".join(f'NEW."{p}"' for p in info.pk_cols) + ")"
+        old_pk = "corro_pack(" + ", ".join(f'OLD."{p}"' for p in info.pk_cols) + ")"
+        pending = "(SELECT value FROM __corro_state WHERE key='pending_db_version')"
+        seq_now = "(SELECT value FROM __corro_state WHERE key='seq') - 1"
+        not_applying = "(SELECT value FROM __corro_state WHERE key='apply_mode') = 0"
+        bump_seq = "UPDATE __corro_state SET value = value + 1 WHERE key='seq'"
+
+        def cell_upsert(pk_expr: str, col: str, guard: str = "") -> str:
+            return (
+                f"{bump_seq}{guard};\n"
+                f'INSERT INTO "{t}__corro_clock" '
+                "(pk, cid, col_version, db_version, seq, site_ordinal) "
+                f"SELECT {pk_expr}, '{col}', 1, {pending}, {seq_now}, 1 "
+                f"WHERE 1=1{guard} "
+                "ON CONFLICT(pk, cid) DO UPDATE SET "
+                "col_version = col_version + 1, "
+                "db_version = excluded.db_version, "
+                "seq = excluded.seq, site_ordinal = 1;"
+            )
+
+        ins_cells = "\n".join(cell_upsert(new_pk, c) for c in info.data_cols)
+        upd_cells = "\n".join(
+            cell_upsert(new_pk, c, f' AND NEW."{c}" IS NOT OLD."{c}"')
+            for c in info.data_cols
+        )
+
+        self.conn.executescript(
+            f"""
+DROP TRIGGER IF EXISTS "{t}__corro_ins";
+CREATE TRIGGER "{t}__corro_ins" AFTER INSERT ON "{t}"
+WHEN {not_applying}
+BEGIN
+  {bump_seq};
+  INSERT INTO "{t}__corro_cl" (pk, cl, db_version, seq, site_ordinal)
+    VALUES ({new_pk}, 1, {pending}, {seq_now}, 1)
+    ON CONFLICT(pk) DO UPDATE SET
+      cl = CASE WHEN cl % 2 = 0 THEN cl + 1 ELSE cl END,
+      db_version = excluded.db_version,
+      seq = excluded.seq, site_ordinal = 1;
+  {ins_cells}
+END;
+DROP TRIGGER IF EXISTS "{t}__corro_upd";
+CREATE TRIGGER "{t}__corro_upd" AFTER UPDATE ON "{t}"
+WHEN {not_applying}
+BEGIN
+  {upd_cells}
+END;
+DROP TRIGGER IF EXISTS "{t}__corro_del";
+CREATE TRIGGER "{t}__corro_del" AFTER DELETE ON "{t}"
+WHEN {not_applying}
+BEGIN
+  {bump_seq};
+  INSERT INTO "{t}__corro_cl" (pk, cl, db_version, seq, site_ordinal)
+    VALUES ({old_pk}, 2, {pending}, {seq_now}, 1)
+    ON CONFLICT(pk) DO UPDATE SET
+      cl = CASE WHEN cl % 2 = 1 THEN cl + 1 ELSE cl END,
+      db_version = excluded.db_version,
+      seq = excluded.seq, site_ordinal = 1;
+  DELETE FROM "{t}__corro_clock" WHERE pk = {old_pk};
+END;
+"""
+        )
+
+    # ------------------------------------------------------------------
+    # versions & transactions
+    # ------------------------------------------------------------------
+
+    def db_version(self) -> int:
+        """Last committed local db_version (crsql: current db version)."""
+        with self._lock:
+            return self._state("db_version")
+
+    def next_db_version(self) -> int:
+        return self.db_version() + 1
+
+    def _state(self, key: str) -> int:
+        (v,) = self.conn.execute(
+            "SELECT value FROM __corro_state WHERE key=?", (key,)
+        ).fetchone()
+        return v
+
+    def _set_state(self, key: str, value: int) -> None:
+        self.conn.execute(
+            "UPDATE __corro_state SET value=? WHERE key=?", (value, key)
+        )
+
+    @contextmanager
+    def write_tx(self):
+        """One local transaction == at most one allocated db_version.
+
+        Mirrors cr-sqlite: the version is only consumed if the transaction
+        actually produced changes.
+        """
+        with self._lock:
+            self.conn.execute("BEGIN IMMEDIATE")
+            pending = self._state("db_version") + 1
+            self._set_state("pending_db_version", pending)
+            self._set_state("seq", 0)
+            try:
+                yield self.conn
+            except BaseException:
+                self.conn.execute("ROLLBACK")
+                raise
+            wrote = self._state("seq") > 0
+            if wrote:
+                self._set_state("db_version", pending)
+            self.conn.execute("COMMIT")
+
+    def execute(self, sql: str, params: Sequence = ()):
+        """Run one write statement in its own transaction."""
+        with self.write_tx() as conn:
+            return conn.execute(sql, params)
+
+    # ------------------------------------------------------------------
+    # change collection (the SELECT side of crsql_changes)
+    # ------------------------------------------------------------------
+
+    def collect_changes(
+        self,
+        db_version_range: Tuple[int, int],
+        site_id: Optional[bytes] = None,
+    ) -> List[Change]:
+        """All cell changes stamped with a db_version in the inclusive
+        range, for one origin site (default: local)."""
+        with self._lock:
+            ordinal = 1 if site_id is None else self.site_ordinal(site_id)
+            origin = self.site_id if site_id is None else site_id
+            lo, hi = db_version_range
+            out: List[Change] = []
+            for t, info in self._tables.items():
+                # row-level causal-length rows (deletes/resurrects)
+                for pk, cl, dbv, seq in self.conn.execute(
+                    f'SELECT pk, cl, db_version, seq FROM "{t}__corro_cl" '
+                    "WHERE site_ordinal=? AND db_version BETWEEN ? AND ? "
+                    "AND cl % 2 = 0",
+                    (ordinal, lo, hi),
+                ):
+                    out.append(
+                        Change(
+                            table=t,
+                            pk=bytes(pk),
+                            cid=SENTINEL_CID,
+                            val=None,
+                            col_version=cl,
+                            db_version=CrsqlDbVersion(dbv),
+                            seq=CrsqlSeq(seq),
+                            site_id=origin,
+                            cl=cl,
+                        )
+                    )
+                # cell-level rows with current values, one JOIN per table:
+                # cl from the causal-length table, the live value picked out
+                # of the data row by a generated CASE over the column name
+                val_case = (
+                    "CASE k.cid "
+                    + " ".join(f"WHEN '{c}' THEN d.\"{c}\"" for c in info.data_cols)
+                    + " END"
+                )
+                d_pk = "corro_pack(" + ", ".join(f'd."{p}"' for p in info.pk_cols) + ")"
+                for pk, cid, colv, dbv, seq, cl, val in self.conn.execute(
+                    f"SELECT k.pk, k.cid, k.col_version, k.db_version, k.seq,"
+                    f" COALESCE(c.cl, 1), {val_case} "
+                    f'FROM "{t}__corro_clock" k '
+                    f'LEFT JOIN "{t}__corro_cl" c ON c.pk = k.pk '
+                    f'LEFT JOIN "{t}" d ON {d_pk} = k.pk '
+                    "WHERE k.site_ordinal=? AND k.db_version BETWEEN ? AND ?",
+                    (ordinal, lo, hi),
+                ):
+                    out.append(
+                        Change(
+                            table=t,
+                            pk=bytes(pk),
+                            cid=cid,
+                            val=val,
+                            col_version=colv,
+                            db_version=CrsqlDbVersion(dbv),
+                            seq=CrsqlSeq(seq),
+                            site_id=origin,
+                            cl=cl,
+                        )
+                    )
+            out.sort(key=lambda ch: (int(ch.db_version), int(ch.seq)))
+            return out
+
+    def changes_for_version(self, db_version: int, site_id: Optional[bytes] = None):
+        return self.collect_changes((db_version, db_version), site_id)
+
+    def _row_cl(self, table: str, pk: bytes) -> int:
+        row = self._row_cl_entry(table, pk)
+        return row[0] if row else 1
+
+    def _cell_value(self, info: TableInfo, pk: bytes, cid: str):
+        pk_vals = unpack_values(pk)
+        where = " AND ".join(f'"{p}" IS ?' for p in info.pk_cols)
+        row = self.conn.execute(
+            f'SELECT "{_ident(cid)}" FROM "{info.name}" WHERE {where}', pk_vals
+        ).fetchone()
+        return row[0] if row else None
+
+    # ------------------------------------------------------------------
+    # change application (the INSERT side of crsql_changes: the merge)
+    # ------------------------------------------------------------------
+
+    def apply_changes(self, changes: Iterable[Change]) -> int:
+        """Merge remote changes; returns rows impacted (applied changes).
+
+        Must be called inside ``apply_tx`` (or standalone, where it opens
+        its own transaction).
+        """
+        with self._lock:
+            self.conn.execute("BEGIN IMMEDIATE")
+            try:
+                self._set_state("apply_mode", 1)
+                n = 0
+                for ch in changes:
+                    n += self._apply_one(ch)
+            except BaseException:
+                self._set_state("apply_mode", 0)
+                self.conn.execute("ROLLBACK")
+                raise
+            self._set_state("apply_mode", 0)
+            self.conn.execute("COMMIT")
+            return n
+
+    def _apply_one(self, ch: Change) -> int:
+        info = self._tables.get(ch.table)
+        if info is None:
+            return 0
+        t = info.name
+        ordinal = self.site_ordinal(ch.site_id)
+        local_cl = self._row_cl_entry(t, ch.pk)
+
+        if ch.cid == SENTINEL_CID:
+            # row-level: delete (even cl) or bare resurrect marker
+            if local_cl is not None and ch.cl <= local_cl[0]:
+                return 0
+            self._set_row_cl(t, ch.pk, ch.cl, ch.db_version, ch.seq, ordinal)
+            if ch.is_delete():
+                self._delete_row(info, ch.pk)
+                self.conn.execute(
+                    f'DELETE FROM "{t}__corro_clock" WHERE pk=?', (ch.pk,)
+                )
+            else:
+                # a new row generation: previous-generation cells are gone
+                self._reset_row(info, ch.pk)
+                self.conn.execute(
+                    f'DELETE FROM "{t}__corro_clock" WHERE pk=?', (ch.pk,)
+                )
+            return 1
+
+        # cell-level change
+        have_cl = local_cl[0] if local_cl is not None else None
+        if have_cl is not None and ch.cl < have_cl:
+            return 0  # stale: our row history is causally ahead
+        if have_cl is None or ch.cl > have_cl:
+            # the change's row generation is ahead of ours: adopt it, and
+            # reset the row so previous-generation cell values (now
+            # untracked) can't linger in the data table
+            self._set_row_cl(t, ch.pk, ch.cl, ch.db_version, ch.seq, ordinal)
+            if ch.cl % 2 == 0:
+                self._delete_row(info, ch.pk)
+                self.conn.execute(
+                    f'DELETE FROM "{t}__corro_clock" WHERE pk=?', (ch.pk,)
+                )
+                return 1
+            self._reset_row(info, ch.pk)
+            self.conn.execute(
+                f'DELETE FROM "{t}__corro_clock" WHERE pk=?', (ch.pk,)
+            )
+        elif ch.cl % 2 == 0:
+            return 0  # equal even cl: row already deleted
+        else:
+            self._ensure_row(info, ch.pk)
+
+        # LWW on the cell
+        row = self.conn.execute(
+            f'SELECT col_version FROM "{t}__corro_clock" WHERE pk=? AND cid=?',
+            (ch.pk, ch.cid),
+        ).fetchone()
+        if row is not None:
+            local_ver = row[0]
+            if ch.col_version < local_ver:
+                return 0
+            if ch.col_version == local_ver:
+                cur = self._cell_value(info, ch.pk, ch.cid)
+                if value_cmp(ch.val, cur) <= 0:
+                    return 0
+        self._write_cell(info, ch.pk, ch.cid, ch.val)
+        self.conn.execute(
+            f'INSERT INTO "{t}__corro_clock" '
+            "(pk, cid, col_version, db_version, seq, site_ordinal) "
+            "VALUES (?, ?, ?, ?, ?, ?) "
+            "ON CONFLICT(pk, cid) DO UPDATE SET "
+            "col_version=excluded.col_version, db_version=excluded.db_version,"
+            "seq=excluded.seq, site_ordinal=excluded.site_ordinal",
+            (ch.pk, ch.cid, ch.col_version, int(ch.db_version), int(ch.seq), ordinal),
+        )
+        return 1
+
+    # -- row helpers ----------------------------------------------------
+
+    def _row_cl_entry(self, table: str, pk: bytes):
+        return self.conn.execute(
+            f'SELECT cl FROM "{table}__corro_cl" WHERE pk=?', (pk,)
+        ).fetchone()
+
+    def _set_row_cl(self, table, pk, cl, db_version, seq, ordinal) -> None:
+        self.conn.execute(
+            f'INSERT INTO "{table}__corro_cl" '
+            "(pk, cl, db_version, seq, site_ordinal) VALUES (?, ?, ?, ?, ?) "
+            "ON CONFLICT(pk) DO UPDATE SET cl=excluded.cl, "
+            "db_version=excluded.db_version, seq=excluded.seq, "
+            "site_ordinal=excluded.site_ordinal",
+            (pk, cl, int(db_version), int(seq), ordinal),
+        )
+
+    def _reset_row(self, info: TableInfo, pk: bytes) -> None:
+        """Start a fresh row generation: drop any old values, re-create
+        the row with column defaults (cr-sqlite resurrect semantics)."""
+        self._delete_row(info, pk)
+        self._ensure_row(info, pk)
+
+    def _ensure_row(self, info: TableInfo, pk: bytes) -> None:
+        pk_vals = unpack_values(pk)
+        cols = ", ".join(f'"{p}"' for p in info.pk_cols)
+        ph = ", ".join("?" for _ in info.pk_cols)
+        self.conn.execute(
+            f'INSERT OR IGNORE INTO "{info.name}" ({cols}) VALUES ({ph})',
+            pk_vals,
+        )
+
+    def _delete_row(self, info: TableInfo, pk: bytes) -> None:
+        pk_vals = unpack_values(pk)
+        where = " AND ".join(f'"{p}" IS ?' for p in info.pk_cols)
+        self.conn.execute(f'DELETE FROM "{info.name}" WHERE {where}', pk_vals)
+
+    def _write_cell(self, info: TableInfo, pk: bytes, cid: str, val) -> None:
+        pk_vals = unpack_values(pk)
+        where = " AND ".join(f'"{p}" IS ?' for p in info.pk_cols)
+        self.conn.execute(
+            f'UPDATE "{info.name}" SET "{_ident(cid)}" = ? WHERE {where}',
+            [val] + pk_vals,
+        )
+
+    def close(self) -> None:
+        self.conn.close()
+
+
+# ---------------------------------------------------------------------------
+# UDFs
+# ---------------------------------------------------------------------------
+
+
+def _udf_pack(*args):
+    return pack_values(args)
+
+
+def _udf_json_contains(a, b) -> int:
+    """corro_json_contains(a, b): does JSON doc a contain doc b?
+
+    Parity: the reference registers this custom SQL function
+    (``crates/sqlite-functions/src/lib.rs:5-51``) — recursive containment:
+    every key/element of ``b`` must appear in ``a``.
+    """
+    import json
+
+    def contains(x, y) -> bool:
+        if isinstance(y, dict):
+            return isinstance(x, dict) and all(
+                k in x and contains(x[k], v) for k, v in y.items()
+            )
+        if isinstance(y, list):
+            return isinstance(x, list) and all(
+                any(contains(xi, yi) for xi in x) for yi in y
+            )
+        return x == y
+
+    try:
+        return int(contains(json.loads(a), json.loads(b)))
+    except (TypeError, ValueError):
+        return 0

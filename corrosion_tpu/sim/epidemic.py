@@ -170,15 +170,6 @@ def _scan_chunk(state: EpidemicState, seed_key, target_row, cfg: EpidemicConfig)
     return jax.lax.scan(body, state, xs=None, length=cfg.chunk_ticks)
 
 
-def _target_row(cfg: EpidemicConfig):
-    codec = DEFAULT_CODEC
-    return codec.pack(
-        jnp.ones((cfg.n_rows,), jnp.int32),
-        jnp.full((cfg.n_rows,), 2, jnp.int32),
-        jnp.ones((cfg.n_rows,), jnp.int32),
-    )
-
-
 def run_epidemic(cfg: EpidemicConfig, seed: int = 0):
     """Single-universe run.  Returns a stats dict (host values)."""
     stats = run_epidemic_seeds(cfg, n_seeds=1, seed=seed)
@@ -193,8 +184,10 @@ def run_epidemic_seeds(cfg: EpidemicConfig, n_seeds: int = 16, seed: int = 0):
     stops as soon as every universe has converged (or max_ticks hit).
     """
     keys = jax.random.split(jax.random.PRNGKey(seed), n_seeds)
-    target = _target_row(cfg)
     init = epidemic_init(cfg)
+    # convergence target = the writer's committed state (the join of all
+    # writes in this single-writer scenario)
+    target = init.rows[0]
     states = jax.tree.map(
         lambda x: jnp.broadcast_to(x, (n_seeds,) + x.shape), init
     )

@@ -1,0 +1,242 @@
+"""CRDT storage engine tests: trigger bookkeeping, change collection,
+merge application, and multi-replica convergence."""
+
+import random
+
+import pytest
+
+from corrosion_tpu.agent.storage import CrConn
+from corrosion_tpu.agent.pack import pack_values, unpack_values, value_cmp
+from corrosion_tpu.types.change import SENTINEL_CID
+
+SCHEMA = (
+    "CREATE TABLE IF NOT EXISTS machines ("
+    " id INTEGER PRIMARY KEY NOT NULL,"
+    " name TEXT NOT NULL DEFAULT '',"
+    " status TEXT NOT NULL DEFAULT 'broken')"
+)
+
+
+@pytest.fixture
+def db(tmp_path):
+    def mk(name):
+        conn = CrConn(str(tmp_path / f"{name}.db"))
+        conn.conn.execute(SCHEMA)
+        conn.as_crr("machines")
+        return conn
+
+    return mk
+
+
+def test_pack_roundtrip_and_order():
+    vals = [None, -5, 3.5, "abc", b"\x00\xff", True]
+    assert unpack_values(pack_values(vals)) == [None, -5, 3.5, "abc", b"\x00\xff", 1]
+    assert value_cmp(None, 0) < 0
+    assert value_cmp(2, "a") < 0
+    assert value_cmp("z", b"\x00") < 0
+    assert value_cmp("b", "a") > 0
+    assert value_cmp(2, 2.5) < 0
+
+
+def test_local_write_creates_clock_rows(db):
+    a = db("a")
+    a.execute("INSERT INTO machines (id, name, status) VALUES (1, 'meow', 'created')")
+    assert a.db_version() == 1
+    changes = a.changes_for_version(1)
+    cids = sorted(ch.cid for ch in changes)
+    assert cids == ["name", "status"]
+    assert all(int(ch.db_version) == 1 and ch.cl == 1 for ch in changes)
+    seqs = sorted(int(ch.seq) for ch in changes)
+    assert seqs == [1, 2]  # seq 0 went to the causal-length row
+
+    a.execute("INSERT INTO machines (id, name, status) VALUES (2, 'woof', 'created')")
+    assert a.db_version() == 2
+
+
+def test_transaction_is_one_version(db):
+    a = db("a")
+    with a.write_tx() as conn:
+        for i in range(5):
+            conn.execute(
+                "INSERT INTO machines (id, name) VALUES (?, ?)", (i, f"m{i}")
+            )
+    assert a.db_version() == 1
+    assert len(a.changes_for_version(1)) == 10  # 2 cols x 5 rows
+
+
+def test_readonly_tx_consumes_no_version(db):
+    a = db("a")
+    with a.write_tx() as conn:
+        conn.execute("SELECT * FROM machines").fetchall()
+    assert a.db_version() == 0
+
+
+def test_update_only_touches_changed_columns(db):
+    a = db("a")
+    a.execute("INSERT INTO machines (id, name, status) VALUES (1, 'meow', 'created')")
+    a.execute("UPDATE machines SET status='started' WHERE id=1")
+    changes = a.changes_for_version(2)
+    assert [ch.cid for ch in changes] == ["status"]
+    assert changes[0].col_version == 2
+    assert changes[0].val == "started"
+
+
+def test_changes_replicate(db):
+    a, b = db("a"), db("b")
+    a.execute("INSERT INTO machines (id, name, status) VALUES (1, 'meow', 'created')")
+    applied = b.apply_changes(a.changes_for_version(1))
+    assert applied > 0
+    row = b.conn.execute("SELECT name, status FROM machines WHERE id=1").fetchone()
+    assert row == ("meow", "created")
+
+
+def test_lww_bigger_col_version_wins(db):
+    a, b = db("a"), db("b")
+    a.execute("INSERT INTO machines (id, status) VALUES (1, 'created')")
+    b.apply_changes(a.changes_for_version(1))
+    # b updates twice (col_version 3), a updates once (col_version 2)
+    b.execute("UPDATE machines SET status='starting' WHERE id=1")
+    b.execute("UPDATE machines SET status='started' WHERE id=1")
+    a.execute("UPDATE machines SET status='destroyed' WHERE id=1")
+    # cross-apply
+    a.apply_changes(b.collect_changes((1, b.db_version()), b.site_id))
+    b.apply_changes(a.collect_changes((1, a.db_version()), a.site_id))
+    sa = a.conn.execute("SELECT status FROM machines WHERE id=1").fetchone()[0]
+    sb = b.conn.execute("SELECT status FROM machines WHERE id=1").fetchone()[0]
+    assert sa == sb == "started"  # col_version 3 beats 2
+
+
+def test_lww_tie_biggest_value_wins(db):
+    a, b = db("a"), db("b")
+    a.execute("INSERT INTO machines (id) VALUES (1)")
+    b.apply_changes(a.changes_for_version(1))
+    a.execute("UPDATE machines SET status='apple' WHERE id=1")
+    b.execute("UPDATE machines SET status='zebra' WHERE id=1")
+    a.apply_changes(b.collect_changes((1, b.db_version()), b.site_id))
+    b.apply_changes(a.collect_changes((1, a.db_version()), a.site_id))
+    sa = a.conn.execute("SELECT status FROM machines WHERE id=1").fetchone()[0]
+    sb = b.conn.execute("SELECT status FROM machines WHERE id=1").fetchone()[0]
+    assert sa == sb == "zebra"
+
+
+def test_delete_propagates_and_wins_over_update(db):
+    a, b = db("a"), db("b")
+    a.execute("INSERT INTO machines (id, name) VALUES (1, 'meow')")
+    b.apply_changes(a.changes_for_version(1))
+    # concurrent: a deletes, b updates
+    a.execute("DELETE FROM machines WHERE id=1")
+    b.execute("UPDATE machines SET name='woof' WHERE id=1")
+    a.apply_changes(b.collect_changes((1, b.db_version()), b.site_id))
+    b.apply_changes(a.collect_changes((1, a.db_version()), a.site_id))
+    assert a.conn.execute("SELECT * FROM machines").fetchall() == []
+    assert b.conn.execute("SELECT * FROM machines").fetchall() == []
+
+
+def test_resurrect_after_delete(db):
+    a, b = db("a"), db("b")
+    a.execute("INSERT INTO machines (id, name) VALUES (1, 'meow')")
+    a.execute("DELETE FROM machines WHERE id=1")
+    a.execute("INSERT INTO machines (id, name) VALUES (1, 'reborn')")
+    b.apply_changes(a.collect_changes((1, a.db_version()), a.site_id))
+    row = b.conn.execute("SELECT name FROM machines WHERE id=1").fetchone()
+    assert row == ("reborn",)
+    # causal length is 3 (insert -> delete -> insert)
+    changes = a.collect_changes((1, a.db_version()))
+    assert max(ch.cl for ch in changes) == 3
+
+
+def test_delete_has_sentinel_change(db):
+    a = db("a")
+    a.execute("INSERT INTO machines (id, name) VALUES (1, 'x')")
+    a.execute("DELETE FROM machines WHERE id=1")
+    changes = a.changes_for_version(2)
+    assert len(changes) == 1
+    assert changes[0].cid == SENTINEL_CID
+    assert changes[0].cl == 2 and changes[0].is_delete()
+
+
+def test_apply_is_idempotent(db):
+    a, b = db("a"), db("b")
+    a.execute("INSERT INTO machines (id, name, status) VALUES (1, 'm', 's')")
+    chs = a.changes_for_version(1)
+    b.apply_changes(chs)
+    before = b.conn.execute("SELECT * FROM machines").fetchall()
+    applied_again = b.apply_changes(chs)
+    assert applied_again == 0
+    assert b.conn.execute("SELECT * FROM machines").fetchall() == before
+
+
+def test_three_replicas_converge_random_ops():
+    """Property: any op interleaving + any delivery order converges."""
+    import tempfile, os
+
+    rng = random.Random(7)
+    with tempfile.TemporaryDirectory() as d:
+        nodes = []
+        for name in "abc":
+            c = CrConn(os.path.join(d, f"{name}.db"))
+            c.conn.execute(SCHEMA)
+            c.as_crr("machines")
+            nodes.append(c)
+
+        for step in range(60):
+            n = rng.choice(nodes)
+            op = rng.random()
+            rid = rng.randint(1, 6)
+            if op < 0.5:
+                n.execute(
+                    "INSERT INTO machines (id, name, status) VALUES (?, ?, ?) "
+                    "ON CONFLICT(id) DO UPDATE SET name=excluded.name",
+                    (rid, f"n{step}", rng.choice(["a", "b", "c"])),
+                )
+            elif op < 0.8:
+                n.execute(
+                    "UPDATE machines SET status=? WHERE id=?",
+                    (rng.choice(["x", "y", "z"]), rid),
+                )
+            else:
+                n.execute("DELETE FROM machines WHERE id=?", (rid,))
+
+        # full exchange, arbitrary order, applied twice for idempotence
+        for _ in range(2):
+            order = nodes * 2
+            rng.shuffle(order)
+            for dst in order:
+                for src in nodes:
+                    if src is dst:
+                        continue
+                    chs = src.collect_changes((1, src.db_version()), src.site_id)
+                    rng.shuffle(chs)  # delivery order must not matter
+                    dst.apply_changes(chs)
+
+        snaps = [
+            n.conn.execute(
+                "SELECT id, name, status FROM machines ORDER BY id"
+            ).fetchall()
+            for n in nodes
+        ]
+        assert snaps[0] == snaps[1] == snaps[2]
+        assert len(snaps[0]) > 0
+        for n in nodes:
+            n.close()
+
+
+def test_partial_new_generation_resets_stale_cells(db):
+    """A cell change from a newer row generation must not leave previous-
+    generation values in other columns (8KiB chunking can deliver a
+    resurrected row's cells across messages)."""
+    a, b = db("a"), db("b")
+    a.execute("INSERT INTO machines (id, name, status) VALUES (1, 'meow', 'old')")
+    b.apply_changes(a.collect_changes((1, 1), a.site_id))
+    a.execute("DELETE FROM machines WHERE id=1")
+    a.execute("INSERT INTO machines (id, name, status) VALUES (1, 'reborn', 'new')")
+    gen3 = a.collect_changes((2, a.db_version()), a.site_id)
+    # deliver ONLY the gen-3 'status' cell first
+    status_only = [ch for ch in gen3 if ch.cid == "status"]
+    b.apply_changes(status_only)
+    row = b.conn.execute("SELECT name, status FROM machines WHERE id=1").fetchone()
+    assert row == ("", "new"), f"stale previous-generation cell survived: {row}"
+    # the rest arrives later; replicas converge
+    b.apply_changes(gen3)
+    row = b.conn.execute("SELECT name, status FROM machines WHERE id=1").fetchone()
+    assert row == ("reborn", "new")
