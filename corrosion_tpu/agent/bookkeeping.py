@@ -1,0 +1,360 @@
+"""Per-actor version bookkeeping: what we have, what's missing, what's
+partially buffered, what's been cleared.
+
+Parity: ``crates/corro-types/src/agent.rs`` — ``BookedVersions`` (needed
+gaps as a range set, ``partials`` map, ``max``, ``last_cleared_ts``;
+``agent.rs:1393-1578``), the ``VersionsSnapshot::insert_db`` gap-collapse
+algorithm (``agent.rs:1231-1367``), ``store_empty_changeset`` cleared-range
+merging (``corro-types/src/change.rs:314-436``), and the
+``__corro_bookkeeping`` / ``__corro_seq_bookkeeping`` /
+``__corro_buffered_changes`` / ``__corro_bookkeeping_gaps`` tables
+(``agent.rs:430-512``).
+
+Design: one ``Bookie`` owns a map actor → ``BookedVersions``; each
+``BookedVersions`` keeps exact in-memory range sets (our
+:class:`corrosion_tpu.utils.ranges.RangeSet`) and persists through the
+same sqlite connection as the storage engine, so a bookkeeping update
+commits atomically with the change application that caused it.  Restart =
+resume: everything rebuilds from the tables.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from corrosion_tpu.types.hlc import Timestamp
+from corrosion_tpu.utils.ranges import RangeSet
+
+
+@dataclass
+class PartialVersion:
+    """A version whose seq-chunks are still being assembled."""
+
+    seqs: RangeSet = field(default_factory=RangeSet)
+    last_seq: int = 0
+    ts: Optional[Timestamp] = None
+
+    def is_complete(self) -> bool:
+        return self.seqs.contains_span(0, self.last_seq)
+
+    def gaps(self) -> List[Tuple[int, int]]:
+        return self.seqs.gaps(0, self.last_seq)
+
+
+class BookedVersions:
+    """One remote (or local) actor's version ledger."""
+
+    def __init__(self, actor_id: bytes):
+        self.actor_id = actor_id
+        self.needed = RangeSet()  # versions we know exist but don't have
+        self.partials: Dict[int, PartialVersion] = {}
+        self.cleared = RangeSet()  # versions cleared/overwritten (empty)
+        # version -> (db_version, last_seq) for locally-applied versions
+        self.versions: Dict[int, Tuple[int, int]] = {}
+        self.max_version: int = 0
+        self.last_cleared_ts: Optional[Timestamp] = None
+
+    # -- queries ---------------------------------------------------------
+
+    def last(self) -> int:
+        return self.max_version
+
+    def contains_version(self, v: int) -> bool:
+        """Do we fully have v (applied or known-cleared)?"""
+        if v > self.max_version:
+            return False
+        if self.needed.contains(v):
+            return False
+        if v in self.partials:
+            return False
+        return True
+
+    def contains_range(self, start: int, end: int) -> bool:
+        return all(self.contains_version(v) for v in range(start, end + 1))
+
+    def db_version_for(self, v: int) -> Optional[int]:
+        entry = self.versions.get(v)
+        return entry[0] if entry else None
+
+    # -- mutation --------------------------------------------------------
+
+    def _extend_max(self, version: int) -> None:
+        """Seeing version v implies 1..v exist; anything between our old
+        max and v that we didn't just get becomes a gap (insert_db
+        semantics)."""
+        if version > self.max_version:
+            if version > self.max_version + 1:
+                self.needed.insert(self.max_version + 1, version - 1)
+            self.max_version = version
+
+    def apply_version(
+        self,
+        version: int,
+        db_version: int,
+        last_seq: int,
+        ts: Optional[Timestamp] = None,
+    ) -> None:
+        """A complete version has been applied to storage."""
+        self._extend_max(version)
+        self.needed.remove(version, version)
+        self.partials.pop(version, None)
+        self.versions[version] = (db_version, last_seq)
+
+    def mark_cleared(self, start: int, end: int, ts: Optional[Timestamp] = None) -> None:
+        """Versions [start, end] are empty (overwritten or compacted)."""
+        self._extend_max(end)
+        self.needed.remove(start, end)
+        # iterate entries present, never the (remote-supplied) span width
+        for v in [v for v in self.partials if start <= v <= end]:
+            del self.partials[v]
+        for v in [v for v in self.versions if start <= v <= end]:
+            del self.versions[v]
+        self.cleared.insert(start, end)
+        if ts is not None and (
+            self.last_cleared_ts is None or int(ts) > int(self.last_cleared_ts)
+        ):
+            self.last_cleared_ts = ts
+
+    def insert_partial(
+        self,
+        version: int,
+        seqs: Tuple[int, int],
+        last_seq: int,
+        ts: Optional[Timestamp] = None,
+    ) -> PartialVersion:
+        """Buffer a seq-range chunk of a large version; returns the
+        partial (check ``is_complete`` to promote)."""
+        self._extend_max(version)
+        self.needed.remove(version, version)
+        partial = self.partials.get(version)
+        if partial is None:
+            partial = self.partials[version] = PartialVersion(
+                last_seq=last_seq, ts=ts
+            )
+        partial.last_seq = max(partial.last_seq, last_seq)
+        if ts is not None:
+            partial.ts = ts
+        partial.seqs.insert(seqs[0], seqs[1])
+        return partial
+
+    # -- sync handshake feed ---------------------------------------------
+
+    def needed_spans(self) -> List[Tuple[int, int]]:
+        return self.needed.spans()
+
+    def partial_needs(self) -> Dict[int, List[Tuple[int, int]]]:
+        return {
+            v: p.gaps() for v, p in self.partials.items() if not p.is_complete()
+        }
+
+
+class Bookie:
+    """actor → BookedVersions, with sqlite persistence."""
+
+    TABLES = """
+CREATE TABLE IF NOT EXISTS __corro_bookkeeping (
+  actor_id BLOB NOT NULL,
+  start_version INTEGER NOT NULL,
+  end_version INTEGER,          -- set => cleared range [start, end]
+  db_version INTEGER,           -- set => concrete applied version
+  last_seq INTEGER,
+  ts INTEGER,
+  PRIMARY KEY (actor_id, start_version)
+);
+CREATE TABLE IF NOT EXISTS __corro_seq_bookkeeping (
+  actor_id BLOB NOT NULL,
+  version INTEGER NOT NULL,
+  start_seq INTEGER NOT NULL,
+  end_seq INTEGER NOT NULL,
+  last_seq INTEGER NOT NULL,
+  ts INTEGER,
+  PRIMARY KEY (actor_id, version, start_seq)
+);
+CREATE TABLE IF NOT EXISTS __corro_buffered_changes (
+  actor_id BLOB NOT NULL,
+  version INTEGER NOT NULL,
+  seq INTEGER NOT NULL,
+  change BLOB NOT NULL,
+  PRIMARY KEY (actor_id, version, seq)
+);
+CREATE TABLE IF NOT EXISTS __corro_bookkeeping_gaps (
+  actor_id BLOB NOT NULL,
+  start INTEGER NOT NULL,
+  end INTEGER NOT NULL,
+  PRIMARY KEY (actor_id, start)
+);
+"""
+
+    def __init__(self, conn, lock: Optional[threading.RLock] = None):
+        """conn: a sqlite3 connection (shared with the storage engine so
+        commits are atomic with change application)."""
+        self.conn = conn
+        self._lock = lock or threading.RLock()
+        with self._lock:
+            conn.executescript(self.TABLES)
+        self._actors: Dict[bytes, BookedVersions] = {}
+        self._persisted_gaps: Dict[bytes, set] = {}
+        self._load()
+
+    # -- persistence -----------------------------------------------------
+
+    def _load(self) -> None:
+        with self._lock:
+            for actor, start, end, dbv, last_seq, ts in self.conn.execute(
+                "SELECT actor_id, start_version, end_version, db_version,"
+                " last_seq, ts FROM __corro_bookkeeping"
+            ):
+                bv = self.for_actor(bytes(actor))
+                if end is not None:
+                    bv.mark_cleared(start, end, Timestamp(ts) if ts else None)
+                else:
+                    bv.apply_version(
+                        start, dbv or 0, last_seq or 0,
+                        Timestamp(ts) if ts else None,
+                    )
+            for actor, version, s, e, last_seq, ts in self.conn.execute(
+                "SELECT actor_id, version, start_seq, end_seq, last_seq, ts"
+                " FROM __corro_seq_bookkeeping"
+            ):
+                bv = self.for_actor(bytes(actor))
+                bv.insert_partial(
+                    version, (s, e), last_seq, Timestamp(ts) if ts else None
+                )
+            for actor, start, end in self.conn.execute(
+                "SELECT actor_id, start, end FROM __corro_bookkeeping_gaps"
+            ):
+                bv = self.for_actor(bytes(actor))
+                bv.needed.insert(start, end)
+                bv.max_version = max(bv.max_version, end)
+
+    def persist_version(
+        self, actor_id: bytes, version: int, db_version: int, last_seq: int,
+        ts: Optional[int] = None,
+    ) -> None:
+        """Write-through for apply_version (call inside the storage tx)."""
+        self.conn.execute(
+            "INSERT OR REPLACE INTO __corro_bookkeeping "
+            "(actor_id, start_version, end_version, db_version, last_seq, ts)"
+            " VALUES (?, ?, NULL, ?, ?, ?)",
+            (actor_id, version, db_version, last_seq, ts),
+        )
+        self._persist_gaps(actor_id)
+
+    def persist_cleared(self, actor_id: bytes, start: int, end: int,
+                        ts: Optional[int] = None) -> None:
+        """store_empty_changeset: merge with overlapping/adjacent cleared
+        ranges instead of stacking rows."""
+        rows = self.conn.execute(
+            "SELECT start_version, end_version, ts FROM __corro_bookkeeping "
+            "WHERE actor_id=? AND end_version IS NOT NULL "
+            "AND start_version <= ? AND end_version >= ?",
+            (actor_id, end + 1, start - 1),
+        ).fetchall()
+        lo, hi = start, end
+        keep_ts = ts
+        for s, e, row_ts in rows:
+            lo, hi = min(lo, s), max(hi, e)
+            if row_ts is not None and (keep_ts is None or row_ts > keep_ts):
+                keep_ts = row_ts
+            self.conn.execute(
+                "DELETE FROM __corro_bookkeeping WHERE actor_id=? "
+                "AND start_version=?",
+                (actor_id, s),
+            )
+        # concrete rows swallowed by the cleared range go away too
+        self.conn.execute(
+            "DELETE FROM __corro_bookkeeping WHERE actor_id=? "
+            "AND end_version IS NULL AND start_version BETWEEN ? AND ?",
+            (actor_id, lo, hi),
+        )
+        self.conn.execute(
+            "INSERT OR REPLACE INTO __corro_bookkeeping "
+            "(actor_id, start_version, end_version, db_version, last_seq, ts)"
+            " VALUES (?, ?, ?, NULL, NULL, ?)",
+            (actor_id, lo, hi, keep_ts),
+        )
+        self._persist_gaps(actor_id)
+
+    def persist_partial(
+        self, actor_id: bytes, version: int, seqs: Tuple[int, int],
+        last_seq: int, ts: Optional[int] = None,
+    ) -> None:
+        self.conn.execute(
+            "INSERT OR REPLACE INTO __corro_seq_bookkeeping "
+            "(actor_id, version, start_seq, end_seq, last_seq, ts) "
+            "VALUES (?, ?, ?, ?, ?, ?)",
+            (actor_id, version, seqs[0], seqs[1], last_seq, ts),
+        )
+        self._persist_gaps(actor_id)
+
+    def clear_partial(self, actor_id: bytes, version: int) -> None:
+        self.conn.execute(
+            "DELETE FROM __corro_seq_bookkeeping WHERE actor_id=? AND version=?",
+            (actor_id, version),
+        )
+        self.conn.execute(
+            "DELETE FROM __corro_buffered_changes WHERE actor_id=? AND version=?",
+            (actor_id, version),
+        )
+
+    def _persist_gaps(self, actor_id: bytes) -> None:
+        """Differential write-through: only spans that changed are touched
+        (a naive delete-all/rewrite amplifies every sync catch-up step)."""
+        bv = self.for_actor(actor_id)
+        new = set(bv.needed.spans())
+        old = self._persisted_gaps.get(actor_id)
+        if old is None:
+            old = {
+                (s, e)
+                for s, e in self.conn.execute(
+                    "SELECT start, end FROM __corro_bookkeeping_gaps "
+                    "WHERE actor_id=?",
+                    (actor_id,),
+                )
+            }
+        if new == old:
+            self._persisted_gaps[actor_id] = new
+            return
+        self.conn.executemany(
+            "DELETE FROM __corro_bookkeeping_gaps WHERE actor_id=? AND start=?",
+            [(actor_id, s) for s, e in old - new],
+        )
+        self.conn.executemany(
+            "INSERT OR REPLACE INTO __corro_bookkeeping_gaps "
+            "(actor_id, start, end) VALUES (?, ?, ?)",
+            [(actor_id, s, e) for s, e in new - old],
+        )
+        self._persisted_gaps[actor_id] = new
+
+    # -- buffered changes (partial version assembly) ---------------------
+
+    def buffer_change(self, actor_id: bytes, version: int, seq: int,
+                      blob: bytes) -> None:
+        self.conn.execute(
+            "INSERT OR REPLACE INTO __corro_buffered_changes "
+            "(actor_id, version, seq, change) VALUES (?, ?, ?, ?)",
+            (actor_id, version, seq, blob),
+        )
+
+    def buffered_changes(self, actor_id: bytes, version: int) -> List[Tuple[int, bytes]]:
+        return [
+            (seq, bytes(blob))
+            for seq, blob in self.conn.execute(
+                "SELECT seq, change FROM __corro_buffered_changes "
+                "WHERE actor_id=? AND version=? ORDER BY seq",
+                (actor_id, version),
+            )
+        ]
+
+    # -- access ----------------------------------------------------------
+
+    def for_actor(self, actor_id: bytes) -> BookedVersions:
+        bv = self._actors.get(actor_id)
+        if bv is None:
+            bv = self._actors[actor_id] = BookedVersions(actor_id)
+        return bv
+
+    def actors(self) -> Dict[bytes, BookedVersions]:
+        return dict(self._actors)

@@ -1,0 +1,150 @@
+"""Cluster member registry with RTT tiers.
+
+Parity: ``crates/corro-types/src/members.rs`` — member states keyed by
+actor, per-member RTT ring buffers (20 samples), latency buckets and the
+**ring0** tier (peers under 6 ms) that broadcast fanout prefers; persisted
+to ``__corro_members`` (``broadcast/mod.rs:803-935``).
+"""
+
+from __future__ import annotations
+
+import enum
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+RING0_MAX_RTT_MS = 6.0
+RTT_SAMPLES = 20
+
+
+class MemberState(enum.Enum):
+    ALIVE = "alive"
+    SUSPECT = "suspect"
+    DOWN = "down"
+
+
+@dataclass
+class Member:
+    actor_id: bytes
+    addr: Tuple[str, int]
+    state: MemberState = MemberState.ALIVE
+    incarnation: int = 0
+    cluster_id: int = 0
+    rtts: deque = field(default_factory=lambda: deque(maxlen=RTT_SAMPLES))
+    last_sync_ts: float = 0.0
+    last_seen: float = field(default_factory=time.monotonic)
+
+    @property
+    def rtt_ms(self) -> Optional[float]:
+        if not self.rtts:
+            return None
+        return sum(self.rtts) / len(self.rtts)
+
+    @property
+    def is_ring0(self) -> bool:
+        rtt = self.rtt_ms
+        return rtt is not None and rtt < RING0_MAX_RTT_MS
+
+
+class Members:
+    """Thread-safe membership view (written by the SWIM loop, read by
+    broadcast fanout and sync peer selection)."""
+
+    def __init__(self, self_actor: bytes):
+        self.self_actor = self_actor
+        self._members: Dict[bytes, Member] = {}
+        self._lock = threading.RLock()
+
+    def upsert(
+        self,
+        actor_id: bytes,
+        addr: Tuple[str, int],
+        state: MemberState = MemberState.ALIVE,
+        incarnation: int = 0,
+        cluster_id: int = 0,
+    ) -> bool:
+        """Merge a member record; SWIM override rules (higher incarnation
+        wins; equal incarnation: down > suspect > alive).  Returns True if
+        the record changed."""
+        if actor_id == self.self_actor:
+            return False
+        rank = {MemberState.ALIVE: 0, MemberState.SUSPECT: 1, MemberState.DOWN: 2}
+        with self._lock:
+            m = self._members.get(actor_id)
+            if m is None:
+                self._members[actor_id] = Member(
+                    actor_id=actor_id, addr=tuple(addr), state=state,
+                    incarnation=incarnation, cluster_id=cluster_id,
+                )
+                return True
+            if (incarnation, rank[state]) <= (m.incarnation, rank[m.state]):
+                return False
+            m.state = state
+            m.incarnation = incarnation
+            m.addr = tuple(addr)
+            m.last_seen = time.monotonic()
+            return True
+
+    def revive(self, actor_id: bytes) -> None:
+        """Direct evidence (a probe ack) clears OUR suspicion locally.
+
+        SWIM's incarnation rules only let a higher incarnation demote
+        suspect→alive cluster-wide, but first-hand contact is stronger
+        than hearsay for the local view — without this, one dropped ack
+        excludes a healthy peer from sync forever."""
+        with self._lock:
+            m = self._members.get(actor_id)
+            if m and m.state is MemberState.SUSPECT:
+                m.state = MemberState.ALIVE
+                m.last_seen = time.monotonic()
+
+    def remove(self, actor_id: bytes) -> None:
+        with self._lock:
+            self._members.pop(actor_id, None)
+
+    def get(self, actor_id: bytes) -> Optional[Member]:
+        with self._lock:
+            return self._members.get(actor_id)
+
+    def record_rtt(self, actor_id: bytes, rtt_ms: float) -> None:
+        with self._lock:
+            m = self._members.get(actor_id)
+            if m:
+                m.rtts.append(rtt_ms)
+                m.last_seen = time.monotonic()
+
+    def update_sync_ts(self, actor_id: bytes, ts: float) -> None:
+        with self._lock:
+            m = self._members.get(actor_id)
+            if m:
+                m.last_sync_ts = ts
+
+    def alive(self) -> List[Member]:
+        with self._lock:
+            return [
+                m for m in self._members.values()
+                if m.state is not MemberState.DOWN
+            ]
+
+    def all(self) -> List[Member]:
+        with self._lock:
+            return list(self._members.values())
+
+    def ring0(self) -> List[Member]:
+        return [m for m in self.alive() if m.is_ring0]
+
+    def sample(self, k: int, rng: Optional[random.Random] = None) -> List[Member]:
+        """Broadcast fanout choice: ring0 first, then a global sample."""
+        rng = rng or random
+        alive = self.alive()
+        if len(alive) <= k:
+            return alive
+        ring0 = [m for m in alive if m.is_ring0]
+        rest = [m for m in alive if not m.is_ring0]
+        take0 = min(len(ring0), max(1, k // 2)) if ring0 else 0
+        picked = rng.sample(ring0, take0) if take0 else []
+        picked += rng.sample(rest, min(len(rest), k - len(picked)))
+        return picked

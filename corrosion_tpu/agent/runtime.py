@@ -1,0 +1,862 @@
+"""Agent runtime: the full node assembly.
+
+Parity map (SURVEY.md §1):
+
+* layer 5 (SWIM membership): asyncio UDP datagrams — announce, probe/ack
+  with nonce matching, ping-req indirect probes, piggybacked member
+  gossip, suspicion timeout → down, incarnation refutation
+  (reference: foca runtime loop, ``broadcast/mod.rs:122-381``).
+* layer 6 (dissemination): changesets gossiped over UDP to a ring0-first
+  member sample with retransmit decay and rebroadcast-on-learn
+  (``broadcast/mod.rs:405-1028``).
+* layer 7 (anti-entropy): TCP sync sessions — handshake states, needs
+  algebra, chunked changeset streaming, inbound session semaphore
+  (``api/peer.rs:344-1719``).
+* layer 8 (ingestion): dedupe cache, complete-version apply, partial
+  buffering + promotion, emptyset clearing — all committed atomically
+  with bookkeeping (``agent/util.rs:761-1380``).
+
+The transport is length-prefixed JSON (see ``wire.py``) over plain
+UDP/TCP — the codec/transport are deliberately isolated behind small
+functions so QUIC/mTLS or a native codec can replace them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from corrosion_tpu.agent import wire
+from corrosion_tpu.agent.bookkeeping import Bookie
+from corrosion_tpu.agent.members import Member, Members, MemberState
+from corrosion_tpu.agent.schema import apply_schema
+from corrosion_tpu.agent.storage import CrConn
+from corrosion_tpu.types import (
+    ActorId,
+    ChangeV1,
+    Changeset,
+    ChangeSource,
+    HLClock,
+    SyncNeedV1,
+    SyncStateV1,
+    Timestamp,
+    Version,
+)
+from corrosion_tpu.types.change import ChunkedChanges, MAX_CHANGES_BYTE_SIZE
+
+
+@dataclass
+class AgentConfig:
+    db_path: str
+    gossip_host: str = "127.0.0.1"
+    gossip_port: int = 0
+    api_host: str = "127.0.0.1"
+    api_port: int = 0
+    bootstrap: List[str] = field(default_factory=list)
+    schema_sql: Optional[str] = None
+    cluster_id: int = 0
+    # perf knobs (reference defaults in config.rs / broadcast mod)
+    probe_interval: float = 0.4
+    probe_timeout: float = 0.35
+    suspect_timeout: float = 2.0
+    num_indirect_probes: int = 3
+    fanout: int = 3
+    max_transmissions: int = 5
+    rebroadcast_delay: float = 0.15
+    sync_interval_min: float = 0.5
+    sync_interval_max: float = 2.0
+    sync_peers: int = 3
+    max_sync_sessions: int = 3
+    seen_cache_size: int = 65536
+    api_authz: Optional[str] = None
+
+
+class Agent:
+    """A full node: storage + bookkeeping + gossip + sync (+ HTTP API)."""
+
+    def __init__(self, config: AgentConfig):
+        self.config = config
+        self.storage = CrConn(config.db_path)
+        self.bookie = Bookie(self.storage.conn, lock=self.storage._lock)
+        self.clock = HLClock()
+        self.actor_id = self.storage.site_id
+        self.members = Members(self.actor_id)
+        self._members_table()
+        if config.schema_sql:
+            apply_schema(self.storage, config.schema_sql)
+        self.incarnation = 0
+        self._seen: Dict[tuple, None] = {}
+        self._acks: Dict[int, asyncio.Future] = {}
+        self._suspects: Dict[bytes, float] = {}
+        self._bcast_queue: asyncio.Queue = asyncio.Queue()
+        self._tasks: List[asyncio.Task] = []
+        self._udp: Optional[asyncio.DatagramTransport] = None
+        self._tcp: Optional[asyncio.AbstractServer] = None
+        self._sync_sem: Optional[asyncio.Semaphore] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._rng = random.Random(int.from_bytes(self.actor_id[:4], "big"))
+        self._http = None
+        self.gossip_addr: Tuple[str, int] = (config.gossip_host, config.gossip_port)
+        self.api_addr: Tuple[str, int] = (config.api_host, config.api_port)
+        self.on_change = None  # hook(ChangeV1) for subscriptions layer
+        self.subs = None  # SubsManager, attached by setup when enabled
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._sync_sem = asyncio.Semaphore(self.config.max_sync_sessions)
+        self._udp, _ = await self._loop.create_datagram_endpoint(
+            lambda: _UdpProtocol(self),
+            local_addr=(self.config.gossip_host, self.config.gossip_port),
+        )
+        self.gossip_addr = self._udp.get_extra_info("sockname")[:2]
+        self._tcp = await asyncio.start_server(
+            self._serve_sync, self.config.gossip_host, self.gossip_addr[1]
+        )
+        self._load_members()
+        self._tasks = [
+            asyncio.create_task(self._announce_loop()),
+            asyncio.create_task(self._probe_loop()),
+            asyncio.create_task(self._suspect_reaper()),
+            asyncio.create_task(self._broadcast_loop()),
+            asyncio.create_task(self._sync_loop()),
+        ]
+        if self.config.api_port is not None:
+            from corrosion_tpu.agent.http import start_http_api
+
+            self._http = start_http_api(self)
+            self.api_addr = self._http.server_address[:2]
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks = []
+        if self._udp:
+            self._udp.close()
+        if self._tcp:
+            self._tcp.close()
+            await self._tcp.wait_closed()
+        if self._http:
+            self._http.shutdown()
+            self._http.server_close()
+        self._persist_members()
+        self.storage.close()
+
+    # ------------------------------------------------------------------
+    # member persistence (__corro_members parity)
+    # ------------------------------------------------------------------
+
+    def _members_table(self) -> None:
+        self.storage.conn.execute(
+            "CREATE TABLE IF NOT EXISTS __corro_members ("
+            " actor_id BLOB PRIMARY KEY, host TEXT, port INTEGER,"
+            " state TEXT, incarnation INTEGER)"
+        )
+
+    def _persist_members(self) -> None:
+        with self.storage._lock:
+            self.storage.conn.execute("DELETE FROM __corro_members")
+            self.storage.conn.executemany(
+                "INSERT OR REPLACE INTO __corro_members VALUES (?, ?, ?, ?, ?)",
+                [
+                    (m.actor_id, m.addr[0], m.addr[1], m.state.value, m.incarnation)
+                    for m in self.members.all()
+                ],
+            )
+
+    def _load_members(self) -> None:
+        for actor, host, port, state, inc in self.storage.conn.execute(
+            "SELECT actor_id, host, port, state, incarnation FROM __corro_members"
+        ):
+            self.members.upsert(
+                bytes(actor), (host, port), MemberState(state), inc
+            )
+
+    # ------------------------------------------------------------------
+    # SWIM: announce / probe / suspicion
+    # ------------------------------------------------------------------
+
+    def _self_entry(self) -> list:
+        return [
+            wire._b64(self.actor_id),
+            self.gossip_addr[0],
+            self.gossip_addr[1],
+            MemberState.ALIVE.value,
+            self.incarnation,
+        ]
+
+    def _piggyback(self, k: int = 5) -> list:
+        entries = [self._self_entry()]
+        members = self.members.all()
+        for m in self._rng.sample(members, min(k, len(members))):
+            entries.append(
+                [
+                    wire._b64(m.actor_id),
+                    m.addr[0],
+                    m.addr[1],
+                    m.state.value,
+                    m.incarnation,
+                ]
+            )
+        return entries
+
+    def _ingest_piggyback(self, entries: list) -> None:
+        for actor_b64, host, port, state, inc in entries:
+            actor = wire._unb64(actor_b64)
+            if actor == self.actor_id:
+                # refute anything non-alive said about us
+                if state != MemberState.ALIVE.value and inc >= self.incarnation:
+                    self.incarnation = inc + 1
+                continue
+            self.members.upsert(actor, (host, port), MemberState(state), inc)
+
+    def _send_udp(self, addr: Tuple[str, int], msg: dict) -> None:
+        if self._udp:
+            self._udp.sendto(wire.encode_datagram(msg), tuple(addr))
+
+    async def _announce_loop(self) -> None:
+        delay = 0.1
+        while True:
+            known = {m.addr for m in self.members.alive()}
+            targets = [
+                _parse_addr(b) for b in self.config.bootstrap
+            ]
+            for addr in targets:
+                if addr != self.gossip_addr and addr not in known:
+                    self._send_udp(
+                        addr, {"k": "announce", "pb": self._piggyback()}
+                    )
+            if known or not targets:
+                delay = min(delay * 2, 30.0)
+            await asyncio.sleep(delay)
+
+    async def _probe_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.probe_interval)
+            alive = self.members.alive()
+            if not alive:
+                continue
+            target = self._rng.choice(alive)
+            ok = await self._probe(target)
+            if not ok:
+                ok = await self._indirect_probe(target)
+            if not ok:
+                self._mark_suspect(target)
+
+    async def _probe(self, m: Member, timeout: Optional[float] = None) -> bool:
+        nonce = self._rng.getrandbits(48)
+        fut = self._loop.create_future()
+        self._acks[nonce] = fut
+        t0 = time.monotonic()
+        self._send_udp(m.addr, {"k": "probe", "n": nonce, "pb": self._piggyback()})
+        try:
+            await asyncio.wait_for(fut, timeout or self.config.probe_timeout)
+            self.members.record_rtt(m.actor_id, (time.monotonic() - t0) * 1e3)
+            self._suspects.pop(m.actor_id, None)
+            self.members.revive(m.actor_id)
+            return True
+        except asyncio.TimeoutError:
+            return False
+        finally:
+            self._acks.pop(nonce, None)
+
+    async def _indirect_probe(self, target: Member) -> bool:
+        helpers = [
+            m for m in self.members.alive() if m.actor_id != target.actor_id
+        ]
+        if not helpers:
+            return False
+        helpers = self._rng.sample(
+            helpers, min(self.config.num_indirect_probes, len(helpers))
+        )
+        nonce = self._rng.getrandbits(48)
+        fut = self._loop.create_future()
+        self._acks[nonce] = fut
+        for h in helpers:
+            self._send_udp(
+                h.addr,
+                {
+                    "k": "ping_req",
+                    "n": nonce,
+                    "target": [target.addr[0], target.addr[1]],
+                    "reply_to": [self.gossip_addr[0], self.gossip_addr[1]],
+                },
+            )
+        try:
+            await asyncio.wait_for(fut, self.config.probe_timeout * 2)
+            self._suspects.pop(target.actor_id, None)
+            self.members.revive(target.actor_id)
+            return True
+        except asyncio.TimeoutError:
+            return False
+        finally:
+            self._acks.pop(nonce, None)
+
+    def _mark_suspect(self, m: Member) -> None:
+        if self.members.upsert(
+            m.actor_id, m.addr, MemberState.SUSPECT, m.incarnation
+        ):
+            self._suspects[m.actor_id] = time.monotonic()
+
+    async def _suspect_reaper(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.probe_interval)
+            now = time.monotonic()
+            for actor, since in list(self._suspects.items()):
+                if now - since >= self.config.suspect_timeout:
+                    m = self.members.get(actor)
+                    if m and m.state is MemberState.SUSPECT:
+                        self.members.upsert(
+                            actor, m.addr, MemberState.DOWN, m.incarnation
+                        )
+                    self._suspects.pop(actor, None)
+
+    # ------------------------------------------------------------------
+    # local writes + broadcast
+    # ------------------------------------------------------------------
+
+    def execute_transaction(self, statements: Sequence) -> dict:
+        """Run write statements in one tx; version + bookkeeping + queue
+        the broadcast (``make_broadcastable_changes`` parity)."""
+        results = []
+        booked = self.bookie.for_actor(self.actor_id)
+        with self.storage.write_tx() as conn:
+            for stmt in statements:
+                if isinstance(stmt, str):
+                    sql, params = stmt, ()
+                else:
+                    sql, params = stmt[0], stmt[1] if len(stmt) > 1 else ()
+                cur = conn.execute(sql, params)
+                results.append({"rows_affected": cur.rowcount})
+            n_changes = self.storage._state("seq")
+            if n_changes > 0:
+                version = booked.last() + 1
+                db_version = self.storage._state("pending_db_version")
+                ts = self.clock.new_timestamp()
+                booked.apply_version(version, db_version, n_changes - 1, ts)
+                self.bookie.persist_version(
+                    self.actor_id, version, db_version, n_changes - 1, int(ts)
+                )
+            else:
+                version = None
+        if version is not None and self._loop is not None:
+            self._loop.call_soon_threadsafe(
+                self._queue_local_broadcast, version, db_version, n_changes - 1, ts
+            )
+        return {"results": results, "version": version}
+
+    def _queue_local_broadcast(
+        self, version: int, db_version: int, last_seq: int, ts: Timestamp
+    ) -> None:
+        changes = self.storage.collect_changes((db_version, db_version))
+        for chunk, seqs in ChunkedChanges(changes, 0, last_seq):
+            cs = Changeset.full(
+                Version(version), chunk, seqs, last_seq=last_seq, ts=ts
+            )
+            cv = ChangeV1(actor_id=ActorId(self.actor_id), changeset=cs)
+            if self.on_change is not None:
+                self.on_change(cv)
+            self._bcast_queue.put_nowait((cv, self.config.max_transmissions))
+
+    async def _broadcast_loop(self) -> None:
+        while True:
+            cv, remaining = await self._bcast_queue.get()
+            targets = self.members.sample(self.config.fanout, self._rng)
+            msg = {"k": "change", "cv": wire.change_v1_to_dict(cv)}
+            for m in targets:
+                self._send_udp(m.addr, msg)
+            if remaining > 1:
+                self._loop.call_later(
+                    self.config.rebroadcast_delay,
+                    self._bcast_queue.put_nowait,
+                    (cv, remaining - 1),
+                )
+
+    # ------------------------------------------------------------------
+    # change ingestion (handle_changes parity)
+    # ------------------------------------------------------------------
+
+    def _seen_key(self, cv: ChangeV1):
+        cs = cv.changeset
+        if cs.is_full:
+            return (cv.actor_id.bytes, int(cs.version), cs.seqs)
+        if cs.is_empty_variant:
+            return (cv.actor_id.bytes, "empty", cs.versions)
+        return (cv.actor_id.bytes, "empty_set", cs.ranges)
+
+    def handle_change(self, cv: ChangeV1, source: ChangeSource) -> bool:
+        """Process one incoming changeset; returns True if it was news."""
+        if cv.actor_id.bytes == self.actor_id:
+            return False
+        key = self._seen_key(cv)
+        if source is ChangeSource.BROADCAST:
+            if key in self._seen:
+                return False
+            self._seen[key] = None
+            if len(self._seen) > self.config.seen_cache_size:
+                self._seen.pop(next(iter(self._seen)))
+        if cv.changeset.ts is not None:
+            try:
+                self.clock.update_with_timestamp(cv.changeset.ts)
+            except Exception:
+                pass
+        news = self._process_changeset(cv)
+        if news and source is ChangeSource.BROADCAST and self._loop:
+            self._bcast_queue.put_nowait((cv, self.config.max_transmissions))
+        if news and self.on_change is not None:
+            self.on_change(cv)
+        return news
+
+    def _process_changeset(self, cv: ChangeV1) -> bool:
+        actor = cv.actor_id.bytes
+        cs = cv.changeset
+        booked = self.bookie.for_actor(actor)
+        ts = int(cs.ts) if cs.ts is not None else None
+
+        if cs.is_empty_variant:
+            s, e = int(cs.versions[0]), int(cs.versions[1])
+            if booked.cleared.contains_span(s, e):
+                return False
+            with self.storage.apply_tx():
+                booked.mark_cleared(s, e, cs.ts)
+                self.bookie.persist_cleared(actor, s, e, ts)
+            return True
+
+        if cs.is_empty_set:
+            new = False
+            with self.storage.apply_tx():
+                for s, e in cs.ranges:
+                    if booked.cleared.contains_span(int(s), int(e)):
+                        continue
+                    booked.mark_cleared(int(s), int(e), cs.ts)
+                    self.bookie.persist_cleared(actor, int(s), int(e), ts)
+                    new = True
+            return new
+
+        v = int(cs.version)
+        if booked.contains_version(v) and v not in booked.partials:
+            return False
+
+        if cs.is_complete():
+            with self.storage.apply_tx():
+                self.storage.apply_changes_in_tx(cs.changes)
+                booked.apply_version(
+                    v, cs.max_db_version(), int(cs.last_seq), cs.ts
+                )
+                self.bookie.persist_version(
+                    actor, v, cs.max_db_version(), int(cs.last_seq), ts
+                )
+                self.bookie.clear_partial(actor, v)
+            return True
+
+        # partial: buffer + maybe promote
+        with self.storage.apply_tx():
+            for ch in cs.changes:
+                self.bookie.buffer_change(
+                    actor, v, int(ch.seq),
+                    wire.encode_datagram(wire.change_to_dict(ch)),
+                )
+            partial = booked.insert_partial(
+                v, (int(cs.seqs[0]), int(cs.seqs[1])), int(cs.last_seq), cs.ts
+            )
+            self.bookie.persist_partial(
+                actor, v, (int(cs.seqs[0]), int(cs.seqs[1])),
+                int(cs.last_seq), ts,
+            )
+            if partial.is_complete():
+                buffered = [
+                    wire.change_from_dict(wire.decode_datagram(blob))
+                    for _, blob in self.bookie.buffered_changes(actor, v)
+                ]
+                self.storage.apply_changes_in_tx(buffered)
+                booked.apply_version(
+                    v, max((int(c.db_version) for c in buffered), default=0),
+                    int(cs.last_seq), cs.ts,
+                )
+                self.bookie.persist_version(
+                    actor, v,
+                    max((int(c.db_version) for c in buffered), default=0),
+                    int(cs.last_seq), ts,
+                )
+                self.bookie.clear_partial(actor, v)
+        return True
+
+    # ------------------------------------------------------------------
+    # anti-entropy sync
+    # ------------------------------------------------------------------
+
+    def generate_sync(self) -> SyncStateV1:
+        state = SyncStateV1(actor_id=ActorId(self.actor_id))
+        for actor, bv in self.bookie.actors().items():
+            last = bv.last()
+            if last == 0:
+                continue
+            aid = ActorId(actor)
+            state.heads[aid] = Version(last)
+            spans = bv.needed_spans()
+            if spans:
+                state.need[aid] = spans
+            partials = bv.partial_needs()
+            if partials:
+                state.partial_need[aid] = {
+                    Version(v): gaps for v, gaps in partials.items()
+                }
+            if actor == self.actor_id:
+                state.last_cleared_ts = bv.last_cleared_ts
+        return state
+
+    async def _sync_loop(self) -> None:
+        while True:
+            await asyncio.sleep(
+                self._rng.uniform(
+                    self.config.sync_interval_min, self.config.sync_interval_max
+                )
+            )
+            peers = [
+                m for m in self.members.alive() if m.state is MemberState.ALIVE
+            ]
+            if not peers:
+                continue
+            chosen = self._rng.sample(
+                peers, min(self.config.sync_peers, len(peers))
+            )
+            await asyncio.gather(
+                *(self._sync_with(m) for m in chosen), return_exceptions=True
+            )
+
+    async def _sync_with(self, m: Member) -> int:
+        try:
+            reader, writer = await asyncio.open_connection(m.addr[0], m.addr[1])
+        except OSError:
+            return 0
+        count = 0
+        try:
+            ours = self.generate_sync()
+            writer.write(
+                wire.encode_msg(
+                    {
+                        "k": "sync_start",
+                        "actor": wire._b64(self.actor_id),
+                        "cluster": self.config.cluster_id,
+                        "state": _sync_state_to_dict(ours),
+                        "clock": int(self.clock.new_timestamp()),
+                    }
+                )
+            )
+            await writer.drain()
+            frames = wire.FrameReader()
+            theirs: Optional[SyncStateV1] = None
+            done = False
+            while not done:
+                data = await asyncio.wait_for(reader.read(65536), timeout=10.0)
+                if not data:
+                    break
+                for msg in frames.feed(data):
+                    kind = msg.get("k")
+                    if kind == "sync_reject":
+                        return 0
+                    if kind == "sync_state":
+                        theirs = _sync_state_from_dict(msg["state"])
+                        needs = ours.compute_available_needs(theirs)
+                        writer.write(
+                            wire.encode_msg(
+                                {
+                                    "k": "sync_request",
+                                    "needs": _needs_to_dict(needs),
+                                }
+                            )
+                        )
+                        await writer.drain()
+                        if not needs:
+                            done = True
+                    elif kind == "sync_change":
+                        cv = wire.change_v1_from_dict(msg["cv"])
+                        if self.handle_change(cv, ChangeSource.SYNC):
+                            count += 1
+                    elif kind == "sync_done":
+                        done = True
+            self.members.update_sync_ts(m.actor_id, time.time())
+            return count
+        except (asyncio.TimeoutError, OSError, ConnectionError):
+            return count
+        finally:
+            writer.close()
+
+    async def _serve_sync(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        if self._sync_sem.locked():
+            writer.write(wire.encode_msg({"k": "sync_reject", "reason": "busy"}))
+            await writer.drain()
+            writer.close()
+            return
+        async with self._sync_sem:
+            try:
+                frames = wire.FrameReader()
+                their_state: Optional[SyncStateV1] = None
+                while True:
+                    data = await asyncio.wait_for(reader.read(65536), timeout=10.0)
+                    if not data:
+                        return
+                    for msg in frames.feed(data):
+                        kind = msg.get("k")
+                        if kind == "sync_start":
+                            if msg.get("cluster", 0) != self.config.cluster_id:
+                                writer.write(
+                                    wire.encode_msg(
+                                        {"k": "sync_reject", "reason": "cluster"}
+                                    )
+                                )
+                                await writer.drain()
+                                return
+                            their_state = _sync_state_from_dict(msg["state"])
+                            writer.write(
+                                wire.encode_msg(
+                                    {
+                                        "k": "sync_state",
+                                        "state": _sync_state_to_dict(
+                                            self.generate_sync()
+                                        ),
+                                    }
+                                )
+                            )
+                            await writer.drain()
+                        elif kind == "sync_request":
+                            for actor_b64, needs in msg["needs"]:
+                                actor = wire._unb64(actor_b64)
+                                for need in needs:
+                                    await self._serve_need(writer, actor, need)
+                            writer.write(wire.encode_msg({"k": "sync_done"}))
+                            await writer.drain()
+                            return
+            except (asyncio.TimeoutError, OSError, ConnectionError):
+                return
+            finally:
+                writer.close()
+
+    async def _serve_need(self, writer: asyncio.StreamWriter, actor: bytes,
+                          need: dict) -> None:
+        bv = self.bookie.for_actor(actor)
+        kind = need["kind"]
+        if kind == "full":
+            s, e = need["versions"]
+            # clamp hostile/stale ranges to what we can possibly serve
+            s, e = max(1, int(s)), min(int(e), bv.last())
+            for i, v in enumerate(range(s, e + 1)):
+                await self._serve_version(writer, actor, bv, v)
+                if i % 64 == 63:
+                    await asyncio.sleep(0)  # don't starve the event loop
+        elif kind == "partial":
+            v = need["version"]
+            await self._serve_version(
+                writer, actor, bv, v,
+                seq_spans=[tuple(sp) for sp in need["seqs"]],
+            )
+        elif kind == "empty":
+            spans = bv.cleared.spans()
+            if spans:
+                cs = Changeset.empty_set(spans, bv.last_cleared_ts or Timestamp(0))
+                await self._send_sync_change(writer, actor, cs)
+
+    async def _serve_version(
+        self, writer, actor: bytes, bv, v: int,
+        seq_spans: Optional[List[Tuple[int, int]]] = None,
+    ) -> None:
+        if bv.cleared.contains(v):
+            lo, hi = v, v
+            for s, e in bv.cleared:
+                if s <= v <= e:
+                    lo, hi = s, e
+                    break
+            cs = Changeset.empty((Version(lo), Version(hi)), bv.last_cleared_ts)
+            await self._send_sync_change(writer, actor, cs)
+            return
+        entry = bv.versions.get(v)
+        if entry is None:
+            # we may still hold part of it: serve the buffered seqs we have
+            # (two partial peers with complementary chunks can complete each
+            # other even after the origin dies)
+            partial = bv.partials.get(v)
+            if partial is None:
+                return
+            have = partial.seqs.spans()
+            if seq_spans is not None:
+                have = [
+                    clipped
+                    for s, e in seq_spans
+                    for clipped in partial.seqs.intersection_spans(s, e)
+                ]
+            buffered = {
+                seq: wire.change_from_dict(wire.decode_datagram(blob))
+                for seq, blob in self.bookie.buffered_changes(actor, v)
+            }
+            for s, e in have:
+                chunk = [buffered[q] for q in range(s, e + 1) if q in buffered]
+                cs = Changeset.full(
+                    Version(v), chunk, (s, e), partial.last_seq, partial.ts
+                )
+                await self._send_sync_change(writer, actor, cs)
+            return
+        db_version, last_seq = entry
+        site = None if actor == self.actor_id else actor
+        changes = self.storage.collect_changes((db_version, db_version), site)
+        if seq_spans is not None:
+            changes = [
+                c
+                for c in changes
+                if any(s <= int(c.seq) <= e for s, e in seq_spans)
+            ]
+            for s, e in seq_spans:
+                span_changes = [c for c in changes if s <= int(c.seq) <= e]
+                cs = Changeset.full(
+                    Version(v), span_changes, (s, e), last_seq,
+                    bv.partials.get(v).ts if v in bv.partials else None,
+                )
+                await self._send_sync_change(writer, actor, cs)
+            return
+        for chunk, seqs in ChunkedChanges(changes, 0, last_seq):
+            cs = Changeset.full(Version(v), chunk, seqs, last_seq, None)
+            await self._send_sync_change(writer, actor, cs)
+
+    async def _send_sync_change(self, writer, actor: bytes, cs: Changeset) -> None:
+        cv = ChangeV1(actor_id=ActorId(actor), changeset=cs)
+        writer.write(
+            wire.encode_msg({"k": "sync_change", "cv": wire.change_v1_to_dict(cv)})
+        )
+        await writer.drain()
+
+
+# ---------------------------------------------------------------------------
+# UDP protocol
+# ---------------------------------------------------------------------------
+
+
+class _UdpProtocol(asyncio.DatagramProtocol):
+    def __init__(self, agent: Agent):
+        self.agent = agent
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        a = self.agent
+        try:
+            msg = wire.decode_datagram(data)
+        except ValueError:
+            return
+        kind = msg.get("k")
+        if kind == "announce":
+            a._ingest_piggyback(msg.get("pb", []))
+            a._send_udp(addr, {"k": "announce_ack", "pb": a._piggyback(10)})
+        elif kind == "announce_ack":
+            a._ingest_piggyback(msg.get("pb", []))
+        elif kind == "probe":
+            a._ingest_piggyback(msg.get("pb", []))
+            a._send_udp(addr, {"k": "ack", "n": msg["n"], "pb": a._piggyback()})
+        elif kind == "ack":
+            a._ingest_piggyback(msg.get("pb", []))
+            fut = a._acks.get(msg.get("n"))
+            if fut and not fut.done():
+                fut.set_result(True)
+        elif kind == "ping_req":
+            target = tuple(msg["target"])
+            a._send_udp(
+                target,
+                {
+                    "k": "probe_relay",
+                    "n": msg["n"],
+                    "reply_to": msg["reply_to"],
+                    "pb": a._piggyback(),
+                },
+            )
+        elif kind == "probe_relay":
+            a._ingest_piggyback(msg.get("pb", []))
+            a._send_udp(
+                tuple(msg["reply_to"]),
+                {"k": "ack", "n": msg["n"], "pb": a._piggyback()},
+            )
+        elif kind == "change":
+            try:
+                cv = wire.change_v1_from_dict(msg["cv"])
+            except (KeyError, ValueError):
+                return
+            a.handle_change(cv, ChangeSource.BROADCAST)
+
+
+# ---------------------------------------------------------------------------
+# sync state <-> wire dicts
+# ---------------------------------------------------------------------------
+
+
+def _sync_state_to_dict(st: SyncStateV1) -> dict:
+    return {
+        "actor": wire._b64(st.actor_id.bytes),
+        "heads": {wire._b64(a.bytes): int(v) for a, v in st.heads.items()},
+        "need": {
+            wire._b64(a.bytes): [list(sp) for sp in spans]
+            for a, spans in st.need.items()
+        },
+        "partial_need": {
+            wire._b64(a.bytes): {
+                str(int(v)): [list(sp) for sp in spans]
+                for v, spans in partials.items()
+            }
+            for a, partials in st.partial_need.items()
+        },
+        "last_cleared_ts": (
+            int(st.last_cleared_ts) if st.last_cleared_ts is not None else None
+        ),
+    }
+
+
+def _sync_state_from_dict(d: dict) -> SyncStateV1:
+    st = SyncStateV1(actor_id=ActorId(wire._unb64(d["actor"])))
+    st.heads = {
+        ActorId(wire._unb64(a)): Version(v) for a, v in d.get("heads", {}).items()
+    }
+    st.need = {
+        ActorId(wire._unb64(a)): [tuple(sp) for sp in spans]
+        for a, spans in d.get("need", {}).items()
+    }
+    st.partial_need = {
+        ActorId(wire._unb64(a)): {
+            Version(int(v)): [tuple(sp) for sp in spans]
+            for v, spans in partials.items()
+        }
+        for a, partials in d.get("partial_need", {}).items()
+    }
+    ts = d.get("last_cleared_ts")
+    st.last_cleared_ts = Timestamp(ts) if ts is not None else None
+    return st
+
+
+def _needs_to_dict(needs: Dict[ActorId, List[SyncNeedV1]]) -> list:
+    out = []
+    for actor, lst in needs.items():
+        entries = []
+        for n in lst:
+            if n.kind == "full":
+                entries.append({"kind": "full", "versions": list(n.versions)})
+            elif n.kind == "partial":
+                entries.append(
+                    {
+                        "kind": "partial",
+                        "version": int(n.version),
+                        "seqs": [list(sp) for sp in n.seqs],
+                    }
+                )
+            else:
+                entries.append(
+                    {"kind": "empty", "ts": int(n.ts) if n.ts else None}
+                )
+        out.append([wire._b64(actor.bytes), entries])
+    return out
+
+
+def _parse_addr(s: str) -> Tuple[str, int]:
+    host, _, port = s.rpartition(":")
+    return (host or "127.0.0.1", int(port))

@@ -75,6 +75,32 @@ class CrConn:
         self._init_meta(site_id)
         self._tables: Dict[str, TableInfo] = {}
         self._load_crr_tables()
+        self._ro_conn: Optional[sqlite3.Connection] = None
+        self._ro_lock = threading.Lock()
+
+    def read_query(self, sql: str, params: Sequence = ()):
+        """Run a query on a read-only connection (split-pool parity: the
+        reference keeps 1 RW + 20 RO connections, ``agent.rs:614-765``).
+        Writes through this path fail with a sqlite 'readonly' error
+        instead of corrupting version accounting."""
+        with self._ro_lock:
+            if self._ro_conn is None:
+                self._ro_conn = sqlite3.connect(
+                    f"file:{self.path}?mode=ro", uri=True,
+                    check_same_thread=False,
+                )
+                # triggers resolve functions at prepare time, so the RO
+                # conn needs them registered even though writes will fail
+                self._ro_conn.create_function(
+                    "corro_pack", -1, _udf_pack, deterministic=True
+                )
+                self._ro_conn.create_function(
+                    "corro_json_contains", 2, _udf_json_contains,
+                    deterministic=True,
+                )
+            cur = self._ro_conn.execute(sql, params)
+            cols = [d[0] for d in cur.description or []]
+            return cols, cur.fetchall()
 
     # ------------------------------------------------------------------
     # metadata
@@ -388,26 +414,32 @@ END;
     # change application (the INSERT side of crsql_changes: the merge)
     # ------------------------------------------------------------------
 
-    def apply_changes(self, changes: Iterable[Change]) -> int:
-        """Merge remote changes; returns rows impacted (applied changes).
-
-        Must be called inside ``apply_tx`` (or standalone, where it opens
-        its own transaction).
-        """
+    @contextmanager
+    def apply_tx(self):
+        """Open one merge transaction; bookkeeping writes through the same
+        connection commit atomically with the applied changes."""
         with self._lock:
             self.conn.execute("BEGIN IMMEDIATE")
             try:
                 self._set_state("apply_mode", 1)
-                n = 0
-                for ch in changes:
-                    n += self._apply_one(ch)
+                yield self.conn
             except BaseException:
-                self._set_state("apply_mode", 0)
-                self.conn.execute("ROLLBACK")
+                try:
+                    self._set_state("apply_mode", 0)
+                finally:
+                    self.conn.execute("ROLLBACK")
                 raise
             self._set_state("apply_mode", 0)
             self.conn.execute("COMMIT")
-            return n
+
+    def apply_changes_in_tx(self, changes: Iterable[Change]) -> int:
+        """Merge changes inside an open ``apply_tx``; returns rows impacted."""
+        return sum(self._apply_one(ch) for ch in changes)
+
+    def apply_changes(self, changes: Iterable[Change]) -> int:
+        """Merge remote changes in their own transaction."""
+        with self.apply_tx():
+            return self.apply_changes_in_tx(changes)
 
     def _apply_one(self, ch: Change) -> int:
         info = self._tables.get(ch.table)
@@ -530,6 +562,8 @@ END;
         )
 
     def close(self) -> None:
+        if self._ro_conn is not None:
+            self._ro_conn.close()
         self.conn.close()
 
 

@@ -1,0 +1,67 @@
+"""Test harness: boot complete real agents on loopback.
+
+Parity: ``crates/corro-tests/src/lib.rs:13-95`` — ``launch_test_agent``
+boots a full agent (gossip on 127.0.0.1:0, HTTP on :0, tempdir DB, schema
+applied) so integration tests exercise real gossip, not mocks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import tempfile
+from typing import List, Optional
+
+from corrosion_tpu.agent.runtime import Agent, AgentConfig
+
+TEST_SCHEMA = """
+CREATE TABLE IF NOT EXISTS tests (
+  id INTEGER NOT NULL PRIMARY KEY,
+  text TEXT NOT NULL DEFAULT ''
+);
+CREATE TABLE IF NOT EXISTS tests2 (
+  id INTEGER NOT NULL PRIMARY KEY,
+  text TEXT NOT NULL DEFAULT ''
+);
+CREATE TABLE IF NOT EXISTS testsblob (
+  id BLOB NOT NULL PRIMARY KEY,
+  text TEXT NOT NULL DEFAULT ''
+);
+"""
+
+
+async def launch_test_agent(
+    bootstrap: Optional[List[str]] = None,
+    schema: str = TEST_SCHEMA,
+    tmpdir: Optional[str] = None,
+    **overrides,
+) -> Agent:
+    d = tmpdir or tempfile.mkdtemp(prefix="corro-test-")
+    cfg = AgentConfig(
+        db_path=f"{d}/corrosion.db",
+        bootstrap=bootstrap or [],
+        schema_sql=schema,
+        # fast timers for tests
+        probe_interval=0.1,
+        probe_timeout=0.15,
+        suspect_timeout=0.6,
+        rebroadcast_delay=0.05,
+        sync_interval_min=0.15,
+        sync_interval_max=0.4,
+        **overrides,
+    )
+    agent = Agent(cfg)
+    await agent.start()
+    return agent
+
+
+async def wait_for(predicate, timeout: float = 10.0, interval: float = 0.05):
+    """Poll until predicate() is truthy or raise TimeoutError."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while True:
+        v = predicate()
+        if v:
+            return v
+        if loop.time() > deadline:
+            raise TimeoutError("condition not met in time")
+        await asyncio.sleep(interval)

@@ -1,0 +1,271 @@
+"""Multi-agent integration tests over real loopback gossip.
+
+Mirrors the reference's primary strategy (SURVEY.md §4): boot complete
+agents in-process, wire them via bootstrap, and exercise real network
+paths — no mocks.
+"""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.agent.testing import launch_test_agent, wait_for
+
+
+def addr_str(agent):
+    h, p = agent.gossip_addr
+    return f"{h}:{p}"
+
+
+@pytest.fixture
+def run():
+    def _run(coro):
+        return asyncio.run(coro)
+
+    return _run
+
+
+def test_two_agents_meet_and_gossip(run):
+    async def main():
+        a = await launch_test_agent()
+        b = await launch_test_agent(bootstrap=[addr_str(a)])
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+            assert a.members.get(b.actor_id) is not None
+            assert b.members.get(a.actor_id) is not None
+
+            a.execute_transaction(
+                [["INSERT INTO tests (id, text) VALUES (?, ?)", [1, "hello"]]]
+            )
+            await wait_for(
+                lambda: b.storage.conn.execute(
+                    "SELECT text FROM tests WHERE id=1"
+                ).fetchone()
+            )
+            row = b.storage.conn.execute(
+                "SELECT text FROM tests WHERE id=1"
+            ).fetchone()
+            assert row == ("hello",)
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())
+
+
+def test_three_agents_write_everywhere(run):
+    async def main():
+        a = await launch_test_agent()
+        b = await launch_test_agent(bootstrap=[addr_str(a)])
+        c = await launch_test_agent(bootstrap=[addr_str(a)])
+        agents = [a, b, c]
+        try:
+            await wait_for(
+                lambda: all(len(x.members.alive()) == 2 for x in agents)
+            )
+            for i, agent in enumerate(agents):
+                agent.execute_transaction(
+                    [["INSERT INTO tests (id, text) VALUES (?, ?)",
+                      [i, f"from-{i}"]]]
+                )
+
+            def all_have_all():
+                for x in agents:
+                    rows = x.storage.conn.execute(
+                        "SELECT id, text FROM tests ORDER BY id"
+                    ).fetchall()
+                    if rows != [(0, "from-0"), (1, "from-1"), (2, "from-2")]:
+                        return False
+                return True
+
+            await wait_for(all_have_all)
+        finally:
+            for x in agents:
+                await x.stop()
+
+    run(main())
+
+
+def test_sync_catches_up_late_joiner(run):
+    async def main():
+        a = await launch_test_agent()
+        try:
+            for i in range(10):
+                a.execute_transaction(
+                    [["INSERT INTO tests (id, text) VALUES (?, ?)", [i, f"t{i}"]]]
+                )
+            # b joins AFTER the writes: only anti-entropy can catch it up
+            b = await launch_test_agent(bootstrap=[addr_str(a)])
+            try:
+                await wait_for(
+                    lambda: b.storage.conn.execute(
+                        "SELECT COUNT(*) FROM tests"
+                    ).fetchone()[0] == 10,
+                    timeout=15.0,
+                )
+                # bookkeeping caught up too
+                bv = b.bookie.for_actor(a.actor_id)
+                assert bv.last() == 10
+                assert bv.needed_spans() == []
+            finally:
+                await b.stop()
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_large_tx_chunked_and_reassembled(run):
+    async def main():
+        a = await launch_test_agent()
+        b = await launch_test_agent(bootstrap=[addr_str(a)])
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+            # one transaction big enough to split into multiple 8KiB chunks
+            stmts = [
+                ["INSERT INTO tests (id, text) VALUES (?, ?)", [i, "x" * 512]]
+                for i in range(200)
+            ]
+            out = a.execute_transaction(stmts)
+            assert out["version"] == 1
+            await wait_for(
+                lambda: b.storage.conn.execute(
+                    "SELECT COUNT(*) FROM tests"
+                ).fetchone()[0] == 200,
+                timeout=15.0,
+            )
+            bv = b.bookie.for_actor(a.actor_id)
+            assert bv.partials == {}
+            assert bv.contains_version(1)
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())
+
+
+def test_deletes_propagate(run):
+    async def main():
+        a = await launch_test_agent()
+        b = await launch_test_agent(bootstrap=[addr_str(a)])
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+            a.execute_transaction(
+                [["INSERT INTO tests (id, text) VALUES (1, 'gone soon')"]]
+            )
+            await wait_for(
+                lambda: b.storage.conn.execute(
+                    "SELECT COUNT(*) FROM tests"
+                ).fetchone()[0] == 1
+            )
+            a.execute_transaction([["DELETE FROM tests WHERE id=1"]])
+            await wait_for(
+                lambda: b.storage.conn.execute(
+                    "SELECT COUNT(*) FROM tests"
+                ).fetchone()[0] == 0
+            )
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())
+
+
+def test_failure_detection_and_member_state(run):
+    async def main():
+        a = await launch_test_agent()
+        b = await launch_test_agent(bootstrap=[addr_str(a)])
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+            await b.stop()
+            # a must eventually mark b suspect then down
+            await wait_for(
+                lambda: (
+                    (m := a.members.get(b.actor_id)) is not None
+                    and m.state.value == "down"
+                ),
+                timeout=15.0,
+            )
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_complementary_partials_complete_each_other(run):
+    """Two peers holding complementary chunks of a version can complete
+    each other through sync even after the origin is gone."""
+    from corrosion_tpu.types import ChangeSource, ChangeV1, Changeset, Version, ActorId, Timestamp
+    from corrosion_tpu.types.change import ChunkedChanges
+
+    async def main():
+        origin = await launch_test_agent()
+        # build a big version on the origin while it is alone
+        stmts = [
+            ["INSERT INTO tests (id, text) VALUES (?, ?)", [i, "y" * 600]]
+            for i in range(60)
+        ]
+        origin.execute_transaction(stmts)
+        changes = origin.storage.collect_changes((1, 1))
+        last_seq = max(int(c.seq) for c in changes)
+        chunks = list(ChunkedChanges(changes, 0, last_seq, max_buf_size=8192))
+        assert len(chunks) >= 2, "need a multi-chunk version for this test"
+
+        a = await launch_test_agent()
+        b = await launch_test_agent(bootstrap=[addr_str(a)])
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+            actor = ActorId(origin.actor_id)
+            ts = Timestamp(int(origin.clock.new_timestamp()))
+            # a gets even chunks, b gets odd chunks — nobody has all
+            for i, (chunk, seqs) in enumerate(chunks):
+                cs = Changeset.full(Version(1), chunk, seqs, last_seq, ts)
+                cv = ChangeV1(actor_id=actor, changeset=cs)
+                (a if i % 2 == 0 else b).handle_change(cv, ChangeSource.SYNC)
+            assert 1 in a.bookie.for_actor(origin.actor_id).partials
+            assert 1 in b.bookie.for_actor(origin.actor_id).partials
+            await origin.stop()
+
+            def both_complete():
+                for x in (a, b):
+                    bv = x.bookie.for_actor(origin.actor_id)
+                    if not bv.contains_version(1) or 1 in bv.partials:
+                        return False
+                    n = x.storage.conn.execute(
+                        "SELECT COUNT(*) FROM tests"
+                    ).fetchone()[0]
+                    if n != 60:
+                        return False
+                return True
+
+            await wait_for(both_complete, timeout=20.0)
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())
+
+
+def test_queries_endpoint_is_read_only(run):
+    import json, urllib.request, urllib.error
+
+    async def main():
+        a = await launch_test_agent()
+        try:
+            url = f"http://{a.api_addr[0]}:{a.api_addr[1]}/v1/queries"
+            req = urllib.request.Request(
+                url, data=json.dumps("INSERT INTO tests (id) VALUES (99)").encode()
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=5)
+            assert exc.value.code == 500
+            assert "readonly" in exc.value.read().decode()
+            # nothing was written, no version consumed
+            assert a.storage.conn.execute(
+                "SELECT COUNT(*) FROM tests"
+            ).fetchone()[0] == 0
+            assert a.storage.db_version() == 0
+        finally:
+            await a.stop()
+
+    run(main())

@@ -1,0 +1,136 @@
+import sqlite3
+
+import pytest
+
+from corrosion_tpu.agent.bookkeeping import BookedVersions, Bookie, PartialVersion
+from corrosion_tpu.types.hlc import Timestamp
+
+A = b"\x01" * 16
+B = b"\x02" * 16
+
+
+def test_gap_creation_and_collapse():
+    bv = BookedVersions(A)
+    bv.apply_version(1, 10, 0)
+    assert bv.last() == 1 and bv.needed_spans() == []
+    # version 5 arrives: 2..4 become needed
+    bv.apply_version(5, 11, 0)
+    assert bv.needed_spans() == [(2, 4)]
+    assert not bv.contains_version(3)
+    bv.apply_version(3, 12, 0)
+    assert bv.needed_spans() == [(2, 2), (4, 4)]
+    bv.apply_version(2, 13, 0)
+    bv.apply_version(4, 14, 0)
+    assert bv.needed_spans() == []
+    assert bv.contains_range(1, 5)
+
+
+def test_cleared_ranges_absorb_needs_and_partials():
+    bv = BookedVersions(A)
+    bv.apply_version(10, 1, 0)  # gaps 1..9
+    bv.insert_partial(7, (0, 3), 10)
+    assert 7 in bv.partials
+    bv.mark_cleared(1, 9, Timestamp(5))
+    assert bv.needed_spans() == []
+    assert bv.partials == {}
+    assert bv.contains_range(1, 10)
+    assert bv.last_cleared_ts == Timestamp(5)
+
+
+def test_partial_assembly():
+    bv = BookedVersions(A)
+    p = bv.insert_partial(1, (0, 10), 30)
+    assert not p.is_complete()
+    assert p.gaps() == [(11, 30)]
+    bv.insert_partial(1, (20, 30), 30)
+    assert bv.partials[1].gaps() == [(11, 19)]
+    p = bv.insert_partial(1, (11, 19), 30)
+    assert p.is_complete()
+    # promotion to applied
+    bv.apply_version(1, 99, 30)
+    assert 1 not in bv.partials
+    assert bv.contains_version(1)
+
+
+def test_partial_needs_feed():
+    bv = BookedVersions(A)
+    bv.insert_partial(2, (5, 9), 20)
+    feeds = bv.partial_needs()
+    assert feeds == {2: [(0, 4), (10, 20)]}
+
+
+@pytest.fixture
+def conn():
+    c = sqlite3.connect(":memory:")
+    c.isolation_level = None
+    return c
+
+
+def test_bookie_persistence_roundtrip(conn):
+    bookie = Bookie(conn)
+    bv = bookie.for_actor(A)
+    bv.apply_version(1, 100, 2)
+    bookie.persist_version(A, 1, 100, 2, ts=111)
+    bv.apply_version(5, 101, 0)
+    bookie.persist_version(A, 5, 101, 0)
+    bv.insert_partial(8, (0, 3), 50, Timestamp(7))
+    bookie.persist_partial(A, 8, (0, 3), 50, ts=7)
+    bv.mark_cleared(2, 3, Timestamp(9))
+    bookie.persist_cleared(A, 2, 3, ts=9)
+
+    # boot a fresh bookie from the same db: state must match
+    reborn = Bookie(conn)
+    bv2 = reborn.for_actor(A)
+    assert bv2.last() == 8
+    assert bv2.needed_spans() == [(4, 4), (6, 7)]
+    assert bv2.contains_range(1, 3)
+    assert 8 in bv2.partials and bv2.partials[8].gaps() == [(4, 50)]
+    assert bv2.db_version_for(1) == 100
+
+
+def test_bookie_cleared_range_merging(conn):
+    bookie = Bookie(conn)
+    bv = bookie.for_actor(B)
+    bv.mark_cleared(1, 5)
+    bookie.persist_cleared(B, 1, 5)
+    bv.mark_cleared(6, 10)
+    bookie.persist_cleared(B, 6, 10)  # adjacent: must merge
+    rows = conn.execute(
+        "SELECT start_version, end_version FROM __corro_bookkeeping "
+        "WHERE actor_id=? AND end_version IS NOT NULL",
+        (B,),
+    ).fetchall()
+    assert rows == [(1, 10)]
+    bv.mark_cleared(3, 7)
+    bookie.persist_cleared(B, 3, 7)  # contained: still one row
+    rows = conn.execute(
+        "SELECT start_version, end_version FROM __corro_bookkeeping "
+        "WHERE actor_id=? AND end_version IS NOT NULL",
+        (B,),
+    ).fetchall()
+    assert rows == [(1, 10)]
+
+
+def test_bookie_cleared_swallows_concrete_rows(conn):
+    bookie = Bookie(conn)
+    bv = bookie.for_actor(A)
+    bv.apply_version(1, 50, 0)
+    bookie.persist_version(A, 1, 50, 0)
+    bv.mark_cleared(1, 4)
+    bookie.persist_cleared(A, 1, 4)
+    rows = conn.execute(
+        "SELECT start_version, end_version, db_version FROM __corro_bookkeeping "
+        "WHERE actor_id=?",
+        (A,),
+    ).fetchall()
+    assert rows == [(1, 4, None)]
+
+
+def test_buffered_changes_roundtrip(conn):
+    bookie = Bookie(conn)
+    bookie.buffer_change(A, 3, 0, b"zero")
+    bookie.buffer_change(A, 3, 2, b"two")
+    bookie.buffer_change(A, 3, 1, b"one")
+    assert bookie.buffered_changes(A, 3) == [(0, b"zero"), (1, b"one"), (2, b"two")]
+    bookie.clear_partial(A, 3)
+    assert bookie.buffered_changes(A, 3) == []
