@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import json
 import threading
+
+from corrosion_tpu.agent.pack import jsonable_row
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import TYPE_CHECKING
 
@@ -147,7 +149,7 @@ def _make_handler(agent: "Agent"):
             self._stream_start()
             self._stream_line({"columns": cols})
             for i, row in enumerate(rows):
-                self._stream_line({"row": [i + 1, _jsonable_row(row)]})
+                self._stream_line({"row": [i + 1, jsonable_row(row)]})
             self._stream_line({"eoq": {"time": 0.0}})
             self._stream_end()
 
@@ -242,7 +244,7 @@ def _make_handler(agent: "Agent"):
     return Handler
 
 
-def _jsonable_row(row):
+def jsonable_row(row):
     out = []
     for v in row:
         if isinstance(v, bytes):

@@ -57,6 +57,17 @@ def value_cmp(a: SqlValue, b: SqlValue) -> int:
     return (ab > bb) - (ab < bb)
 
 
+def jsonable_row(row: Iterable) -> List:
+    """Coerce a SQL result row for JSON transport (bytes -> hex)."""
+    out: List = []
+    for v in row:
+        if isinstance(v, (bytes, bytearray, memoryview)):
+            out.append(bytes(v).hex())
+        else:
+            out.append(v)
+    return out
+
+
 def pack_values(values: Iterable[SqlValue]) -> bytes:
     """Pack a tuple of SQL values into one self-describing blob."""
     out = bytearray()

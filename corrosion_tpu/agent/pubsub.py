@@ -1,0 +1,462 @@
+"""Reactive query layer: streaming subscriptions + table-level updates.
+
+Parity: ``crates/corro-types/src/pubsub.rs`` (the Matcher engine —
+incremental materialized views over SQL subscriptions, per-subscription
+persistence, buffered candidate batching, ``QueryEvent`` streams) and
+``updates.rs`` (table-level notify streams), served over HTTP by
+``api/public/pubsub.rs`` / ``update.rs``.
+
+Design differences (deliberate):
+
+* table extraction uses sqlite's authorizer hook during prepare — the
+  database is the SQL parser (the reference rewrites ASTs with
+  ``sqlite3-parser``);
+* incremental maintenance re-evaluates the subscription query on the
+  read-only connection and diffs against the previous materialized rows
+  (keyed by row identity), batched behind a short debounce window — the
+  reference's per-table candidate rewrite is an optimization of the same
+  observable behavior, and can slot in later without changing events;
+* per-subscription state (sql, rows, change log) persists in its own
+  sqlite file under ``subs_path`` and is restored on boot
+  (``pubsub.rs:819-856`` parity).
+
+Event wire format (matches the reference's ``TypedQueryEvent``):
+  {"columns": [...]}            first frame of a snapshot
+  {"row": [row_id, cells]}      snapshot row
+  {"eoq": {"time": t, "change_id": id}}
+  {"change": [kind, row_id, cells, change_id]}   kind: insert|update|delete
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import queue
+import sqlite3
+import threading
+import time
+import uuid
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from corrosion_tpu.agent.pack import jsonable_row, unpack_values
+from corrosion_tpu.types.change import SENTINEL_CID
+from corrosion_tpu.types.changeset import ChangeV1
+
+DEBOUNCE_S = 0.05
+MAX_CHANGE_LOG = 100_000
+
+
+def normalize_sql(sql: str) -> str:
+    """Collapse whitespace OUTSIDE string literals only."""
+    out = []
+    in_str: Optional[str] = None
+    ws = False
+    for ch in sql.strip().rstrip(";").strip():
+        if in_str:
+            out.append(ch)
+            if ch == in_str:
+                in_str = None
+            continue
+        if ch in ("'", '"'):
+            in_str = ch
+            out.append(ch)
+            ws = False
+        elif ch.isspace():
+            ws = True
+        else:
+            if ws and out:
+                out.append(" ")
+            ws = False
+            out.append(ch)
+    return "".join(out)
+
+
+def tables_of_query(conn: sqlite3.Connection, sql: str) -> Set[str]:
+    """Which tables does this SELECT read?  The authorizer sees every
+    SQLITE_READ during prepare."""
+    tables: Set[str] = set()
+
+    def auth(action, arg1, arg2, dbname, trigger):
+        if action == sqlite3.SQLITE_READ and arg1:
+            tables.add(arg1)
+        return sqlite3.SQLITE_OK
+
+    conn.set_authorizer(auth)
+    try:
+        conn.execute(f"EXPLAIN {sql}")
+    finally:
+        conn.set_authorizer(None)
+    return tables
+
+
+class SubscriptionHandle:
+    """One live subscription; many HTTP streams can attach."""
+
+    def __init__(self, manager: "SubsManager", sub_id: str, sql: str,
+                 columns: List[str], tables: Set[str], db_path: str):
+        self.manager = manager
+        self.id = sub_id
+        self.sql = sql
+        self.columns = columns
+        self.tables = tables
+        self.db_path = db_path
+        self._lock = threading.RLock()
+        # row identity -> (row_id, cells); change log for catch-up
+        self.rows: Dict[str, Tuple[int, list]] = {}
+        self.last_row_id = 0
+        self.last_change_id = 0
+        self._closed = False
+        self._streams: List[queue.Queue] = []
+        self._db = sqlite3.connect(db_path, check_same_thread=False)
+        self._db.executescript(
+            """
+CREATE TABLE IF NOT EXISTS meta (key TEXT PRIMARY KEY, value TEXT);
+CREATE TABLE IF NOT EXISTS rows (
+  identity TEXT PRIMARY KEY, row_id INTEGER NOT NULL, cells TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS changes (
+  change_id INTEGER PRIMARY KEY, kind TEXT NOT NULL,
+  row_id INTEGER NOT NULL, cells TEXT NOT NULL);
+"""
+        )
+        self._db.execute(
+            "INSERT OR REPLACE INTO meta VALUES ('sql', ?)", (sql,)
+        )
+        self._db.commit()
+
+    # -- persistence -----------------------------------------------------
+
+    def _restore(self) -> bool:
+        # read the change-log high-water mark FIRST: even with an empty
+        # materialized set (all rows deleted pre-restart), new change ids
+        # must continue after the persisted log or they collide
+        last = self._db.execute("SELECT MAX(change_id) FROM changes").fetchone()
+        self.last_change_id = last[0] or 0
+        rows = self._db.execute(
+            "SELECT identity, row_id, cells FROM rows"
+        ).fetchall()
+        for identity, row_id, cells in rows:
+            self.rows[identity] = (row_id, json.loads(cells))
+            self.last_row_id = max(self.last_row_id, row_id)
+        return bool(rows) or self.last_change_id > 0
+
+    def _persist_rows(self, upserts, deletes) -> None:
+        self._db.executemany(
+            "INSERT OR REPLACE INTO rows (identity, row_id, cells) "
+            "VALUES (?, ?, ?)",
+            [(i, rid, json.dumps(c)) for i, (rid, c) in upserts.items()],
+        )
+        self._db.executemany(
+            "DELETE FROM rows WHERE identity=?", [(i,) for i in deletes]
+        )
+
+    def _persist_change(self, change_id, kind, row_id, cells) -> None:
+        self._db.execute(
+            "INSERT INTO changes (change_id, kind, row_id, cells) "
+            "VALUES (?, ?, ?, ?)",
+            (change_id, kind, row_id, json.dumps(cells)),
+        )
+        if change_id % 1000 == 0:
+            self._db.execute(
+                "DELETE FROM changes WHERE change_id <= ?",
+                (change_id - MAX_CHANGE_LOG,),
+            )
+
+    # -- evaluation ------------------------------------------------------
+
+    @staticmethod
+    def _identity(cells: list, occurrence: int) -> str:
+        """Row identity = content hash + occurrence index, so duplicate
+        result rows keep multiset cardinality (a projection can make rows
+        non-distinct)."""
+        h = hashlib.blake2s(
+            json.dumps(cells, sort_keys=True, default=str).encode(),
+            digest_size=16,
+        ).hexdigest()
+        return f"{h}:{occurrence}"
+
+    def refresh(self, initial: bool = False) -> None:
+        """Re-evaluate the query and emit diff events."""
+        cols, rows = self.manager.agent.storage.read_query(self.sql)
+        with self._lock:
+            self.columns = cols
+            new_ids: Dict[str, list] = {}
+            counts: Dict[str, int] = {}
+            for r in rows:
+                cells = jsonable_row(r)
+                key = json.dumps(cells, sort_keys=True, default=str)
+                occ = counts.get(key, 0)
+                counts[key] = occ + 1
+                new_ids[self._identity(cells, occ)] = cells
+            old = self.rows
+            upserts: Dict[str, Tuple[int, list]] = {}
+            events = []
+            for identity, cells in new_ids.items():
+                if identity not in old:
+                    self.last_row_id += 1
+                    rid = self.last_row_id
+                    upserts[identity] = (rid, cells)
+                    if not initial:
+                        self.last_change_id += 1
+                        events.append(
+                            ("insert", rid, cells, self.last_change_id)
+                        )
+            deletes = []
+            for identity, (rid, cells) in old.items():
+                if identity not in new_ids:
+                    deletes.append(identity)
+                    if not initial:
+                        self.last_change_id += 1
+                        events.append(
+                            ("delete", rid, cells, self.last_change_id)
+                        )
+            old.update(upserts)
+            for i in deletes:
+                del old[i]
+            self._persist_rows(upserts, deletes)
+            for kind, rid, cells, cid in events:
+                self._persist_change(cid, kind, rid, cells)
+            self._db.commit()
+            for kind, rid, cells, cid in events:
+                self._fanout({"change": [kind, rid, cells, cid]})
+
+    def _fanout(self, event: dict) -> None:
+        for q in list(self._streams):
+            try:
+                q.put_nowait(event)
+            except queue.Full:
+                pass
+
+    # -- streaming -------------------------------------------------------
+
+    def stream(self, from_change_id: Optional[int] = None):
+        """Generator of events: snapshot (or catch-up) then live tail."""
+        q: queue.Queue = queue.Queue(maxsize=4096)
+        with self._lock:
+            self._streams.append(q)
+            if from_change_id is not None and self._can_catch_up(from_change_id):
+                backlog = [
+                    {"change": [kind, rid, json.loads(cells), cid]}
+                    for cid, kind, rid, cells in self._db.execute(
+                        "SELECT change_id, kind, row_id, cells FROM changes "
+                        "WHERE change_id > ? ORDER BY change_id",
+                        (from_change_id,),
+                    )
+                ]
+            else:
+                backlog = [{"columns": self.columns}]
+                backlog += [
+                    {"row": [rid, cells]}
+                    for rid, cells in sorted(self.rows.values())
+                ]
+                backlog.append(
+                    {"eoq": {"time": 0.0, "change_id": self.last_change_id}}
+                )
+        try:
+            for ev in backlog:
+                yield ev
+            while not self._closed:
+                try:
+                    ev = q.get(timeout=5.0)
+                except queue.Empty:
+                    continue
+                if ev is None:  # close sentinel
+                    return
+                yield ev
+        finally:
+            with self._lock:
+                if q in self._streams:
+                    self._streams.remove(q)
+
+    def unsubscribe_stream(self) -> None:
+        pass  # generator finally-block handles removal
+
+    def _can_catch_up(self, from_change_id: int) -> bool:
+        row = self._db.execute("SELECT MIN(change_id) FROM changes").fetchone()
+        lo = row[0]
+        return lo is not None and from_change_id >= lo - 1
+
+    def close(self) -> None:
+        self._closed = True
+        for q in list(self._streams):
+            try:
+                q.put_nowait(None)  # wake + end attached streams
+            except queue.Full:
+                pass
+        self._db.close()
+
+
+class SubsManager:
+    """Owns all subscriptions + the table-update notify streams."""
+
+    def __init__(self, agent, subs_path: Optional[str] = None):
+        self.agent = agent
+        self.subs_path = subs_path or os.path.join(
+            os.path.dirname(agent.config.db_path) or ".", "subs"
+        )
+        os.makedirs(self.subs_path, exist_ok=True)
+        self._subs: Dict[str, SubscriptionHandle] = {}
+        self._by_sql: Dict[str, str] = {}
+        self._lock = threading.RLock()
+        self._pending: Set[str] = set()
+        self._update_streams: Dict[str, List[queue.Queue]] = {}
+        self._wake = threading.Event()
+        self._closed = False
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+        agent.on_change = self.on_change
+        self._restore()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def _restore(self) -> None:
+        for fn in os.listdir(self.subs_path):
+            if not fn.endswith(".db"):
+                continue
+            sub_id = fn[:-3]
+            path = os.path.join(self.subs_path, fn)
+            try:
+                db = sqlite3.connect(path)
+                row = db.execute(
+                    "SELECT value FROM meta WHERE key='sql'"
+                ).fetchone()
+                db.close()
+                if not row:
+                    continue
+                sql = row[0]
+                handle = self._create(sub_id, sql)
+                if not handle._restore():
+                    handle.refresh(initial=True)
+                else:
+                    # state may have moved while we were down
+                    handle.refresh(initial=False)
+            except sqlite3.Error:
+                continue
+
+    def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        self._worker.join(timeout=2)
+        with self._lock:
+            for h in self._subs.values():
+                h.close()
+
+    # -- subscription management ----------------------------------------
+
+    def subscribe(self, sql: str) -> SubscriptionHandle:
+        nsql = normalize_sql(sql)
+        with self._lock:
+            sub_id = self._by_sql.get(nsql)
+            if sub_id:
+                return self._subs[sub_id]
+            # create while holding the lock: two racing subscribers with
+            # the same new SQL must share one subscription
+            handle = self._create(str(uuid.uuid4()), nsql)
+        handle.refresh(initial=True)
+        return handle
+
+    def _create(self, sub_id: str, nsql: str) -> SubscriptionHandle:
+        scratch = sqlite3.connect(self.agent.config.db_path)
+        try:
+            tables = tables_of_query(scratch, nsql)
+        finally:
+            scratch.close()
+        crr = set(self.agent.storage.tables)
+        tables &= crr
+        if not tables:
+            raise ValueError("query does not read any replicated table")
+        # columns are filled by the first refresh (probing with an extra
+        # LIMIT clause would break queries that already have one)
+        handle = SubscriptionHandle(
+            self, sub_id, nsql, [], tables,
+            os.path.join(self.subs_path, f"{sub_id}.db"),
+        )
+        with self._lock:
+            self._subs[sub_id] = handle
+            self._by_sql[nsql] = sub_id
+        return handle
+
+    def get(self, sub_id: str) -> Optional[SubscriptionHandle]:
+        with self._lock:
+            return self._subs.get(sub_id)
+
+    def list(self) -> List[dict]:
+        with self._lock:
+            return [
+                {
+                    "id": h.id,
+                    "sql": h.sql,
+                    "tables": sorted(h.tables),
+                    "rows": len(h.rows),
+                    "last_change_id": h.last_change_id,
+                }
+                for h in self._subs.values()
+            ]
+
+    # -- change intake ---------------------------------------------------
+
+    def on_change(self, cv: ChangeV1) -> None:
+        """Called by the agent for every local commit + applied remote
+        changeset (``match_changes`` parity)."""
+        cs = cv.changeset
+        touched: Dict[str, List] = {}
+        for ch in cs.changes:
+            touched.setdefault(ch.table, []).append(ch)
+        with self._lock:
+            for h in self._subs.values():
+                if any(t in h.tables for t in touched):
+                    self._pending.add(h.id)
+        for table, chs in touched.items():
+            self._notify_updates(table, chs)
+        if touched:
+            self._wake.set()
+
+    def _run(self) -> None:
+        while not self._closed:
+            self._wake.wait()
+            if self._closed:
+                return
+            time.sleep(DEBOUNCE_S)  # batch candidates
+            self._wake.clear()
+            with self._lock:
+                pending, self._pending = self._pending, set()
+                handles = [self._subs[i] for i in pending if i in self._subs]
+            for h in handles:
+                try:
+                    h.refresh()
+                except sqlite3.Error:
+                    pass
+
+    # -- table-level updates (updates.rs parity) -------------------------
+
+    def table_updates(self, table: str):
+        """Generator of {"change": [kind, pk_cells]} events for one table."""
+        q: queue.Queue = queue.Queue(maxsize=4096)
+        self._update_streams.setdefault(table, []).append(q)
+        try:
+            while True:
+                try:
+                    yield q.get(timeout=30.0)
+                except queue.Empty:
+                    continue
+        finally:
+            self._update_streams.get(table, []).remove(q)
+
+    def _notify_updates(self, table: str, changes: List) -> None:
+        streams = self._update_streams.get(table)
+        if not streams:
+            return
+        seen_pks = set()
+        for ch in changes:
+            if ch.pk in seen_pks:
+                continue
+            seen_pks.add(ch.pk)
+            # cl parity: even causal length means the row is deleted
+            kind = "delete" if ch.cl % 2 == 0 else "upsert"
+            cells = jsonable_row(unpack_values(ch.pk))
+            for q in list(streams):
+                try:
+                    q.put_nowait({"change": [kind, cells]})
+                except queue.Full:
+                    pass
+

@@ -73,6 +73,8 @@ class AgentConfig:
     max_sync_sessions: int = 3
     seen_cache_size: int = 65536
     api_authz: Optional[str] = None
+    subs_enabled: bool = True
+    subs_path: Optional[str] = None
 
 
 class Agent:
@@ -121,6 +123,10 @@ class Agent:
             self._serve_sync, self.config.gossip_host, self.gossip_addr[1]
         )
         self._load_members()
+        if self.config.subs_enabled:
+            from corrosion_tpu.agent.pubsub import SubsManager
+
+            self.subs = SubsManager(self, self.config.subs_path)
         self._tasks = [
             asyncio.create_task(self._announce_loop()),
             asyncio.create_task(self._probe_loop()),
@@ -147,6 +153,8 @@ class Agent:
         if self._http:
             self._http.shutdown()
             self._http.server_close()
+        if self.subs is not None:
+            self.subs.close()
         self._persist_members()
         self.storage.close()
 

@@ -1,0 +1,212 @@
+"""Subscription matcher + table-update stream tests (over real agents)."""
+
+import asyncio
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from corrosion_tpu.agent.testing import launch_test_agent, wait_for
+
+
+@pytest.fixture
+def run():
+    def _run(coro):
+        return asyncio.run(coro)
+
+    return _run
+
+
+def _collect_stream(url, events, body=None, n_target=64):
+    """Read NDJSON events from an endpoint into `events` until closed."""
+
+    def reader():
+        req = urllib.request.Request(
+            url, data=json.dumps(body).encode() if body is not None else None
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                events.append(("__headers__", dict(resp.headers)))
+                for line in resp:
+                    events.append(json.loads(line))
+                    if len(events) > n_target:
+                        return
+        except Exception as e:  # noqa: BLE001 - surfaced via events
+            events.append(("__error__", repr(e)))
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    return t
+
+
+def test_subscription_snapshot_then_live_changes(run):
+    async def main():
+        a = await launch_test_agent()
+        try:
+            a.execute_transaction(
+                [["INSERT INTO tests (id, text) VALUES (1, 'one')"]]
+            )
+            handle = a.subs.subscribe("SELECT id, text FROM tests ORDER BY id")
+            gen = handle.stream()
+            assert next(gen) == {"columns": ["id", "text"]}
+            assert next(gen)["row"][1] == [1, "one"]
+            eoq = next(gen)
+            assert "eoq" in eoq
+
+            # live: insert, update, delete
+            a.execute_transaction(
+                [["INSERT INTO tests (id, text) VALUES (2, 'two')"]]
+            )
+            ev = await asyncio.to_thread(next, gen)
+            assert ev["change"][0] == "insert" and ev["change"][2] == [2, "two"]
+
+            a.execute_transaction(
+                [["UPDATE tests SET text='TWO' WHERE id=2"]]
+            )
+            kinds = set()
+            for _ in range(2):
+                ev = await asyncio.to_thread(next, gen)
+                kinds.add((ev["change"][0], tuple(ev["change"][2])))
+            # an update appears as delete(old)+insert(new) in diff terms
+            assert ("insert", (2, "TWO")) in kinds
+            assert ("delete", (2, "two")) in kinds
+
+            a.execute_transaction([["DELETE FROM tests WHERE id=1"]])
+            ev = await asyncio.to_thread(next, gen)
+            assert ev["change"][0] == "delete" and ev["change"][2] == [1, "one"]
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_same_sql_shares_subscription(run):
+    async def main():
+        a = await launch_test_agent()
+        try:
+            h1 = a.subs.subscribe("SELECT id FROM tests")
+            h2 = a.subs.subscribe("  SELECT id FROM tests ; ")
+            assert h1.id == h2.id
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_subscription_sees_remote_changes(run):
+    async def main():
+        a = await launch_test_agent()
+        b = await launch_test_agent(
+            bootstrap=[f"{a.gossip_addr[0]}:{a.gossip_addr[1]}"]
+        )
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+            handle = b.subs.subscribe("SELECT id, text FROM tests")
+            gen = handle.stream()
+            while "eoq" not in (ev := next(gen)):
+                pass
+            a.execute_transaction(
+                [["INSERT INTO tests (id, text) VALUES (7, 'remote')"]]
+            )
+            ev = await asyncio.to_thread(next, gen)
+            assert ev["change"][0] == "insert"
+            assert ev["change"][2] == [7, "remote"]
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())
+
+
+def test_catch_up_from_change_id(run):
+    async def main():
+        a = await launch_test_agent()
+        try:
+            handle = a.subs.subscribe("SELECT id FROM tests")
+            a.execute_transaction([["INSERT INTO tests (id) VALUES (1)"]])
+            await wait_for(lambda: handle.last_change_id >= 1)
+            cid = handle.last_change_id
+            a.execute_transaction([["INSERT INTO tests (id) VALUES (2)"]])
+            await wait_for(lambda: handle.last_change_id >= cid + 1)
+            # re-attach from the observed change id: only the delta arrives
+            gen = handle.stream(from_change_id=cid)
+            ev = next(gen)
+            assert ev["change"][0] == "insert" and ev["change"][2] == [2]
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_subscription_http_roundtrip(run):
+    async def main():
+        a = await launch_test_agent()
+        try:
+            a.execute_transaction(
+                [["INSERT INTO tests (id, text) VALUES (1, 'seed')"]]
+            )
+            events = []
+            url = f"http://{a.api_addr[0]}:{a.api_addr[1]}/v1/subscriptions"
+            _collect_stream(url, events, body="SELECT id, text FROM tests")
+            await wait_for(
+                lambda: any(isinstance(e, dict) and "eoq" in e for e in events)
+            )
+            headers = events[0][1]
+            assert "x-corro-query-id" in headers
+            a.execute_transaction(
+                [["INSERT INTO tests (id, text) VALUES (2, 'live')"]]
+            )
+            await wait_for(
+                lambda: any(
+                    isinstance(e, dict) and e.get("change", [None])[0] == "insert"
+                    and e["change"][2] == [2, "live"]
+                    for e in events
+                )
+            )
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_table_updates_stream(run):
+    async def main():
+        a = await launch_test_agent()
+        try:
+            gen = a.subs.table_updates("tests")
+            a.execute_transaction([["INSERT INTO tests (id) VALUES (5)"]])
+            ev = await asyncio.to_thread(next, gen)
+            assert ev["change"][0] == "upsert" and ev["change"][1] == [5]
+            a.execute_transaction([["DELETE FROM tests WHERE id=5"]])
+            ev = await asyncio.to_thread(next, gen)
+            assert ev["change"][0] == "delete" and ev["change"][1] == [5]
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_subscription_restored_after_restart(run):
+    async def main():
+        import tempfile
+
+        d = tempfile.mkdtemp(prefix="corro-subs-")
+        a = await launch_test_agent(tmpdir=d)
+        try:
+            a.subs.subscribe("SELECT id, text FROM tests")
+            a.execute_transaction([["INSERT INTO tests (id) VALUES (1)"]])
+        finally:
+            await a.stop()
+
+        a2 = await launch_test_agent(tmpdir=d)
+        try:
+            subs = a2.subs.list()
+            assert len(subs) == 1
+            assert subs[0]["sql"] == "SELECT id, text FROM tests"
+            h = a2.subs.get(subs[0]["id"])
+            assert len(h.rows) == 1
+        finally:
+            await a2.stop()
+
+    run(main())
