@@ -1,0 +1,178 @@
+"""Admin server over a unix domain socket.
+
+Parity: ``crates/corro-admin`` — JSON-framed request/response protocol on
+a UDS: ``ping``, ``sync generate`` (dump the sync handshake state),
+``sync reconcile-gaps``, ``cluster members`` / ``membership-states``,
+``actor version``, ``subs list`` / ``subs info``, ``locks``
+(``corro-admin/src/lib.rs:95-619``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from typing import TYPE_CHECKING
+
+from corrosion_tpu.agent import wire
+
+if TYPE_CHECKING:
+    from corrosion_tpu.agent.runtime import Agent
+
+
+async def start_admin(agent: "Agent", path: str) -> asyncio.AbstractServer:
+    if os.path.exists(path):
+        os.unlink(path)
+    server = await asyncio.start_unix_server(
+        lambda r, w: _serve(agent, r, w), path=path
+    )
+    return server
+
+
+async def _serve(agent: "Agent", reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter) -> None:
+    frames = wire.FrameReader()
+    try:
+        while True:
+            data = await reader.read(65536)
+            if not data:
+                return
+            for msg in frames.feed(data):
+                try:
+                    resp = _handle(agent, msg)
+                except Exception as e:  # bad input -> error frame, not EOF
+                    resp = {"error": f"{type(e).__name__}: {e}"}
+                writer.write(wire.encode_msg(resp))
+                await writer.drain()
+    except (ConnectionError, asyncio.IncompleteReadError):
+        return
+    finally:
+        writer.close()
+
+
+def _handle(agent: "Agent", msg: dict) -> dict:
+    cmd = msg.get("cmd")
+    if cmd == "ping":
+        return {"ok": "pong"}
+
+    if cmd == "sync_generate":
+        st = agent.generate_sync()
+        from corrosion_tpu.agent.runtime import _sync_state_to_dict
+
+        return {"ok": _sync_state_to_dict(st)}
+
+    if cmd == "sync_reconcile_gaps":
+        # collapse gaps that are actually satisfied by cleared ranges
+        fixed = 0
+        with agent.storage._lock:
+            for actor, bv in agent.bookie.actors().items():
+                for s, e in list(bv.needed):
+                    if bv.cleared.contains_span(s, e):
+                        bv.needed.remove(s, e)
+                        fixed += 1
+                agent.bookie._persist_gaps(actor)
+            agent.storage.conn.commit()
+        return {"ok": {"reconciled": fixed}}
+
+    if cmd == "cluster_members":
+        return {
+            "ok": [
+                {
+                    "actor": m.actor_id.hex(),
+                    "addr": list(m.addr),
+                    "state": m.state.value,
+                    "incarnation": m.incarnation,
+                    "rtt_ms": m.rtt_ms,
+                    "ring0": m.is_ring0,
+                }
+                for m in agent.members.all()
+            ]
+        }
+
+    if cmd == "actor_version":
+        actor = bytes.fromhex(msg.get("actor", agent.actor_id.hex()))
+        bv = agent.bookie.for_actor(actor)
+        return {
+            "ok": {
+                "actor": actor.hex(),
+                "last": bv.last(),
+                "needed": bv.needed_spans(),
+                "partials": {
+                    str(v): p.gaps() for v, p in bv.partials.items()
+                },
+                "cleared": bv.cleared.spans(),
+            }
+        }
+
+    if cmd == "subs_list":
+        if agent.subs is None:
+            return {"ok": []}
+        return {"ok": agent.subs.list()}
+
+    if cmd == "subs_info":
+        if agent.subs is None:
+            return {"error": "subscriptions disabled"}
+        h = agent.subs.get(msg.get("id", ""))
+        if h is None:
+            return {"error": "no such subscription"}
+        return {
+            "ok": {
+                "id": h.id,
+                "sql": h.sql,
+                "tables": sorted(h.tables),
+                "rows": len(h.rows),
+                "last_change_id": h.last_change_id,
+                "streams": len(h._streams),
+            }
+        }
+
+    if cmd == "locks":
+        # lock observability (LockRegistry parity): report holders of the
+        # storage write lock if instrumented
+        return {"ok": agent.lock_registry.snapshot()}
+
+    if cmd == "db_info":
+        with agent.storage._lock:
+            (page_count,) = agent.storage.conn.execute(
+                "PRAGMA page_count"
+            ).fetchone()
+            (freelist,) = agent.storage.conn.execute(
+                "PRAGMA freelist_count"
+            ).fetchone()
+        return {
+            "ok": {
+                "db_version": agent.storage.db_version(),
+                "page_count": page_count,
+                "freelist_count": freelist,
+            }
+        }
+
+    return {"error": f"unknown command {cmd!r}"}
+
+
+class AdminClient:
+    """Synchronous UDS client for the admin protocol (CLI-side)."""
+
+    def __init__(self, path: str, timeout: float = 5.0):
+        import socket
+
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.settimeout(timeout)
+        self.sock.connect(path)
+        self._frames = wire.FrameReader()
+
+    def call(self, cmd: str, **kwargs) -> dict:
+        self.sock.sendall(wire.encode_msg({"cmd": cmd, **kwargs}))
+        while True:
+            data = self.sock.recv(65536)
+            if not data:
+                raise ConnectionError("admin socket closed")
+            msgs = self._frames.feed(data)
+            if msgs:
+                resp = msgs[0]
+                if "error" in resp:
+                    raise RuntimeError(resp["error"])
+                return resp["ok"]
+
+    def close(self) -> None:
+        self.sock.close()

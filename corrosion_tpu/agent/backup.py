@@ -1,0 +1,107 @@
+"""Backup / restore.
+
+Parity: ``corrosion backup`` (``corrosion/src/main.rs:155-220``: VACUUM
+INTO a consistent snapshot, then scrub node-local state — members and the
+site-local identity marker — so the backup can seed any node) and
+``corrosion restore`` (``sqlite3-restore``: take exclusive locks and swap
+the database in place; ``main.rs:221-324``).
+
+Ours uses sqlite's online backup API for the copy-in (safe against a live
+writer on the same connection path thanks to WAL + the backup API's
+page-tracking), which replaces the reference's byte-range lock dance.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+from typing import Optional
+
+
+def backup(db_path: str, out_path: str) -> None:
+    """Write a consistent, scrubbed snapshot of the database."""
+    if os.path.exists(out_path):
+        raise FileExistsError(out_path)
+    src = sqlite3.connect(db_path)
+    try:
+        src.execute("VACUUM INTO ?", (out_path,))
+    finally:
+        src.close()
+    snap = sqlite3.connect(out_path)
+    try:
+        # scrub node-local state: membership and gossip runtime tables are
+        # not part of the data being backed up
+        tables = {
+            r[0]
+            for r in snap.execute(
+                "SELECT name FROM sqlite_master WHERE type='table'"
+            )
+        }
+        if "__corro_members" in tables:
+            snap.execute("DELETE FROM __corro_members")
+        snap.commit()
+        snap.execute("VACUUM")
+    finally:
+        snap.close()
+
+
+def restore(backup_path: str, db_path: str,
+            site_id: Optional[bytes] = None) -> None:
+    """Replace the database at db_path with the backup's contents, giving
+    the restored node its OWN identity.
+
+    The site-ordinal rewrite (reference: ``main.rs:221-324``): the backup
+    origin's site_id is moved to a fresh ordinal — keeping every clock
+    row's attribution intact — and ordinal 1 (the local-identity slot our
+    triggers stamp) gets a new site_id, so the restored node never
+    impersonates the node that made the backup.
+
+    Must run while no agent owns db_path (the CLI enforces this).
+    """
+    import uuid
+
+    src = sqlite3.connect(backup_path)
+    dst = sqlite3.connect(db_path)
+    try:
+        src.backup(dst)
+        new_site = site_id or uuid.uuid4().bytes
+        row = dst.execute(
+            "SELECT site_id FROM __corro_sites WHERE ordinal=1"
+        ).fetchone()
+        if row is not None and bytes(row[0]) != new_site:
+            old_site = bytes(row[0])
+            # move the origin identity to a fresh ordinal...
+            (max_ord,) = dst.execute(
+                "SELECT COALESCE(MAX(ordinal), 1) FROM __corro_sites"
+            ).fetchone()
+            new_ord = max_ord + 1
+            dst.execute(
+                "UPDATE __corro_sites SET ordinal=? WHERE ordinal=1", (new_ord,)
+            )
+            # ...rewriting its attribution in every clock table...
+            tables = [
+                r[0]
+                for r in dst.execute(
+                    "SELECT name FROM __corro_crr_tables"
+                ).fetchall()
+            ]
+            for t in tables:
+                for suffix in ("__corro_clock", "__corro_cl"):
+                    dst.execute(
+                        f'UPDATE "{t}{suffix}" SET site_ordinal=? '
+                        "WHERE site_ordinal=1",
+                        (new_ord,),
+                    )
+            # ...and installing the restored node's own identity at slot 1
+            dst.execute(
+                "INSERT INTO __corro_sites (ordinal, site_id) VALUES (1, ?)",
+                (new_site,),
+            )
+        dst.commit()
+    finally:
+        src.close()
+        dst.close()
+    for ext in ("-wal", "-shm"):
+        p = db_path + ext
+        if os.path.exists(p):
+            os.unlink(p)

@@ -1,0 +1,119 @@
+"""TOML configuration.
+
+Parity: ``crates/corro-types/src/config.rs`` — sections ``[db]``,
+``[api]``, ``[gossip]``, ``[perf]``, ``[admin]``, ``[telemetry]``,
+``[consul]``; env-var overlay using ``__``-separated keys
+(``CORRO_GOSSIP__ADDR=...``), and a builder used by tests.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from typing import Any, Dict, List, Optional
+
+from corrosion_tpu.agent.runtime import AgentConfig
+
+ENV_PREFIX = "CORRO_"
+
+
+def _deep_set(d: Dict[str, Any], keys: List[str], value: Any) -> None:
+    for k in keys[:-1]:
+        d = d.setdefault(k, {})
+    d[keys[-1]] = value
+
+
+def _env_overlay(data: Dict[str, Any]) -> None:
+    for name, raw in os.environ.items():
+        if not name.startswith(ENV_PREFIX):
+            continue
+        keys = [k.lower() for k in name[len(ENV_PREFIX):].split("__")]
+        value: Any = raw
+        if raw.lower() in ("true", "false"):
+            value = raw.lower() == "true"
+        elif "," in raw:
+            value = [s.strip() for s in raw.split(",") if s.strip()]
+        else:
+            for conv in (int, float):
+                try:
+                    value = conv(raw)
+                    break
+                except ValueError:
+                    continue
+        _deep_set(data, keys, value)
+
+
+def _split_addr(addr: str, default_port: int = 0):
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port or default_port)
+
+
+def load_config(path: Optional[str] = None, **overrides) -> AgentConfig:
+    """Load a TOML config file (+ CORRO_* env overlay) into AgentConfig."""
+    data: Dict[str, Any] = {}
+    if path:
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+    _env_overlay(data)
+
+    db = data.get("db", {})
+    api = data.get("api", {})
+    gossip = data.get("gossip", {})
+    perf = data.get("perf", {})
+    admin = data.get("admin", {})
+
+    api_host, api_port = _split_addr(api.get("addr", "127.0.0.1:0"))
+    g_host, g_port = _split_addr(gossip.get("addr", "127.0.0.1:0"))
+
+    schema_sql = None
+    schema_paths = db.get("schema_paths", [])
+    if schema_paths:
+        parts = []
+        for p in schema_paths:
+            if os.path.isdir(p):
+                for fn in sorted(os.listdir(p)):
+                    if fn.endswith(".sql"):
+                        with open(os.path.join(p, fn)) as f:
+                            parts.append(f.read())
+            elif os.path.exists(p):
+                with open(p) as f:
+                    parts.append(f.read())
+        schema_sql = "\n".join(parts) or None
+
+    bootstrap = gossip.get("bootstrap", [])
+    if isinstance(bootstrap, str):
+        bootstrap = [bootstrap]
+
+    kwargs: Dict[str, Any] = dict(
+        db_path=db.get("path", "corrosion.db"),
+        gossip_host=g_host,
+        gossip_port=g_port,
+        api_host=api_host,
+        api_port=api_port,
+        bootstrap=list(bootstrap),
+        admin_path=admin.get("path"),
+        schema_sql=schema_sql,
+        cluster_id=int(gossip.get("cluster_id", 0)),
+        api_authz=(api.get("authorization") or {}).get("bearer")
+        if isinstance(api.get("authorization"), dict)
+        else api.get("authorization"),
+        subs_path=data.get("subscriptions", {}).get("path"),
+    )
+    for key in (
+        "probe_interval",
+        "probe_timeout",
+        "suspect_timeout",
+        "num_indirect_probes",
+        "fanout",
+        "max_transmissions",
+        "rebroadcast_delay",
+        "sync_interval_min",
+        "sync_interval_max",
+        "sync_peers",
+        "max_sync_sessions",
+        "seen_cache_size",
+    ):
+        if key in perf:
+            kwargs[key] = perf[key]
+    kwargs.update(overrides)
+    return AgentConfig(**kwargs)

@@ -1,0 +1,128 @@
+"""Lock observability.
+
+Parity: the reference has no TSan — instead a bespoke ``LockRegistry``:
+every counted-lock acquisition is registered with a label/state/started_at
+(``corro-types/src/agent.rs:958-1181``), a watchdog logs locks held >10 s
+(``setup.rs:186-230``), and ``corrosion locks`` surfaces it via admin.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+
+@dataclass
+class LockEntry:
+    id: int
+    label: str
+    kind: str  # "write" | "apply" | "read"
+    state: str  # "acquiring" | "locked"
+    started_at: float
+
+
+class LockRegistry:
+    """Tracks in-flight lock acquisitions for the admin `locks` command."""
+
+    def __init__(self):
+        self._entries: Dict[int, LockEntry] = {}
+        self._guard = threading.Lock()
+        self._ids = itertools.count(1)
+        self.slow_threshold_s = 10.0
+
+    def begin(self, label: str, kind: str) -> int:
+        lid = next(self._ids)
+        with self._guard:
+            self._entries[lid] = LockEntry(
+                lid, label, kind, "acquiring", time.monotonic()
+            )
+        return lid
+
+    def acquired(self, lid: int) -> None:
+        with self._guard:
+            e = self._entries.get(lid)
+            if e:
+                e.state = "locked"
+                e.started_at = time.monotonic()
+
+    def released(self, lid: int) -> None:
+        with self._guard:
+            self._entries.pop(lid, None)
+
+    def snapshot(self) -> List[dict]:
+        now = time.monotonic()
+        with self._guard:
+            return [
+                {
+                    "id": e.id,
+                    "label": e.label,
+                    "kind": e.kind,
+                    "state": e.state,
+                    "held_s": round(now - e.started_at, 3),
+                }
+                for e in self._entries.values()
+            ]
+
+    def slow(self) -> List[dict]:
+        return [
+            e for e in self.snapshot() if e["held_s"] > self.slow_threshold_s
+        ]
+
+
+class TrackedLock:
+    """An RLock whose acquisitions appear in a LockRegistry."""
+
+    def __init__(self, registry: LockRegistry, default_label: str = "storage"):
+        self._lock = threading.RLock()
+        self.registry = registry
+        self.default_label = default_label
+        self._local = threading.local()  # per-thread stack of entry ids
+
+    def hold(self, label: str, kind: str = "write"):
+        return _Hold(self, label, kind)
+
+    # RLock interface (so it can drop in where threading.RLock was used)
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self):
+        return self._lock.release()
+
+    def __enter__(self):
+        lid = self.registry.begin(self.default_label, "write")
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        stack.append(lid)
+        self._lock.acquire()
+        self.registry.acquired(lid)
+        return self
+
+    def __exit__(self, *exc):
+        stack = getattr(self._local, "stack", [])
+        if stack:
+            self.registry.released(stack.pop())
+        self._lock.release()
+        return False
+
+
+class _Hold:
+    def __init__(self, lock: TrackedLock, label: str, kind: str):
+        self.lock = lock
+        self.label = label
+        self.kind = kind
+        self.lid: Optional[int] = None
+
+    def __enter__(self):
+        self.lid = self.lock.registry.begin(self.label, self.kind)
+        self.lock.acquire()
+        self.lock.registry.acquired(self.lid)
+        return self
+
+    def __exit__(self, *exc):
+        self.lock.registry.released(self.lid)
+        self.lock.release()
+        return False

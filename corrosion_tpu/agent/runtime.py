@@ -75,6 +75,7 @@ class AgentConfig:
     api_authz: Optional[str] = None
     subs_enabled: bool = True
     subs_path: Optional[str] = None
+    admin_path: Optional[str] = None
 
 
 class Agent:
@@ -82,7 +83,15 @@ class Agent:
 
     def __init__(self, config: AgentConfig):
         self.config = config
-        self.storage = CrConn(config.db_path)
+        from corrosion_tpu.agent.locks import LockRegistry
+
+        # lock tracking costs a few ops per acquisition on the hottest
+        # lock; only pay for it when the admin surface can read it
+        self.lock_registry = LockRegistry()
+        self.storage = CrConn(
+            config.db_path,
+            lock_registry=self.lock_registry if config.admin_path else None,
+        )
         self.bookie = Bookie(self.storage.conn, lock=self.storage._lock)
         self.clock = HLClock()
         self.actor_id = self.storage.site_id
@@ -106,6 +115,7 @@ class Agent:
         self.api_addr: Tuple[str, int] = (config.api_host, config.api_port)
         self.on_change = None  # hook(ChangeV1) for subscriptions layer
         self.subs = None  # SubsManager, attached by setup when enabled
+        self._admin = None
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -139,6 +149,10 @@ class Agent:
 
             self._http = start_http_api(self)
             self.api_addr = self._http.server_address[:2]
+        if self.config.admin_path:
+            from corrosion_tpu.agent.admin import start_admin
+
+            self._admin = await start_admin(self, self.config.admin_path)
 
     async def stop(self) -> None:
         for t in self._tasks:
@@ -153,6 +167,9 @@ class Agent:
         if self._http:
             self._http.shutdown()
             self._http.server_close()
+        if self._admin is not None:
+            self._admin.close()
+            await self._admin.wait_closed()
         if self.subs is not None:
             self.subs.close()
         self._persist_members()

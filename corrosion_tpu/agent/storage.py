@@ -60,14 +60,20 @@ class TableInfo:
 class CrConn:
     """A sqlite3 connection with the CRDT layer installed."""
 
-    def __init__(self, path: str, site_id: Optional[bytes] = None):
+    def __init__(self, path: str, site_id: Optional[bytes] = None,
+                 lock_registry=None):
         self.path = path
         self.conn = sqlite3.connect(path, check_same_thread=False)
         self.conn.isolation_level = None  # manual transactions
         self.conn.execute("PRAGMA journal_mode=WAL")
         self.conn.execute("PRAGMA synchronous=NORMAL")
         self.conn.execute("PRAGMA foreign_keys=OFF")
-        self._lock = threading.RLock()
+        if lock_registry is not None:
+            from corrosion_tpu.agent.locks import TrackedLock
+
+            self._lock = TrackedLock(lock_registry, "storage")
+        else:
+            self._lock = threading.RLock()
         self.conn.create_function("corro_pack", -1, _udf_pack, deterministic=True)
         self.conn.create_function(
             "corro_json_contains", 2, _udf_json_contains, deterministic=True

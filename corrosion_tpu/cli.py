@@ -1,0 +1,234 @@
+"""The `corrosion` command-line interface.
+
+Parity: ``crates/corrosion/src/main.rs`` command set — ``agent``,
+``query``, ``exec``, ``backup``, ``restore``, ``cluster members`` /
+``membership-states``, ``sync generate`` / ``reconcile-gaps``, ``locks``,
+``actor version``, ``subs list`` / ``info``, ``reload``, ``template``,
+``consul sync``.
+
+Run as ``python -m corrosion_tpu.cli <command>`` (or the ``corrosion-tpu``
+entry point).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import signal
+import sys
+from typing import List, Optional
+
+
+def _client(args):
+    from corrosion_tpu.client import CorrosionApiClient
+
+    host, _, port = args.api_addr.rpartition(":")
+    return CorrosionApiClient((host or "127.0.0.1", int(port)), token=args.token)
+
+
+def _admin(args):
+    from corrosion_tpu.agent.admin import AdminClient
+
+    return AdminClient(args.admin_path)
+
+
+def cmd_agent(args) -> int:
+    from corrosion_tpu.agent.config import load_config
+    from corrosion_tpu.agent.runtime import Agent
+
+    cfg = load_config(args.config)
+
+    async def main():
+        agent = Agent(cfg)
+        await agent.start()
+        print(
+            f"agent {agent.actor_id.hex()} gossip={agent.gossip_addr} "
+            f"api={agent.api_addr}",
+            flush=True,
+        )
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await agent.stop()
+
+    asyncio.run(main())
+    return 0
+
+
+def cmd_query(args) -> int:
+    client = _client(args)
+    stmt = [args.sql, args.param] if args.param else args.sql
+    cols, rows = client.query(stmt)
+    if args.columns:
+        print("\t".join(cols))
+    for row in rows:
+        print("\t".join("" if v is None else str(v) for v in row))
+    return 0
+
+
+def cmd_exec(args) -> int:
+    client = _client(args)
+    stmt = [args.sql, args.param] if args.param else [args.sql]
+    out = client.execute([stmt])
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_reload(args) -> int:
+    client = _client(args)
+    out = client.schema_from_paths(args.paths)
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_backup(args) -> int:
+    from corrosion_tpu.agent.backup import backup
+
+    backup(args.db, args.out)
+    print(f"backed up {args.db} -> {args.out}")
+    return 0
+
+
+def cmd_restore(args) -> int:
+    from corrosion_tpu.agent.backup import restore
+
+    restore(args.backup, args.db)
+    print(f"restored {args.backup} -> {args.db}")
+    return 0
+
+
+def cmd_admin(args, command: str, **kwargs) -> int:
+    client = _admin(args)
+    try:
+        out = client.call(command, **kwargs)
+        print(json.dumps(out, indent=2))
+    finally:
+        client.close()
+    return 0
+
+
+def cmd_template(args) -> int:
+    from corrosion_tpu.tpl import render_loop, render_once
+
+    host, _, port = args.api_addr.rpartition(":")
+    addr = (host or "127.0.0.1", int(port))
+    if args.once:
+        render_once(addr, args.template, args.out, token=args.token)
+    else:
+        render_loop(addr, args.template, args.out, token=args.token)
+    return 0
+
+
+def cmd_consul_sync(args) -> int:
+    from corrosion_tpu.consul import sync_loop
+
+    host, _, port = args.api_addr.rpartition(":")
+    sync_loop(
+        (host or "127.0.0.1", int(port)),
+        consul_addr=args.consul_addr,
+        token=args.token,
+        once=args.once,
+    )
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="corrosion-tpu")
+    p.add_argument("--api-addr", default="127.0.0.1:8080")
+    p.add_argument("--admin-path", default="./admin.sock")
+    p.add_argument("--token", default=None, help="API bearer token")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("agent", help="run the agent")
+    sp.add_argument("--config", "-c", default=None)
+    sp.set_defaults(fn=cmd_agent)
+
+    sp = sub.add_parser("query", help="run a read-only SQL statement")
+    sp.add_argument("sql")
+    sp.add_argument("--param", action="append")
+    sp.add_argument("--columns", action="store_true")
+    sp.set_defaults(fn=cmd_query)
+
+    sp = sub.add_parser("exec", help="execute a write statement")
+    sp.add_argument("sql")
+    sp.add_argument("--param", action="append")
+    sp.set_defaults(fn=cmd_exec)
+
+    sp = sub.add_parser("reload", help="apply schema files")
+    sp.add_argument("paths", nargs="+")
+    sp.set_defaults(fn=cmd_reload)
+
+    sp = sub.add_parser("backup")
+    sp.add_argument("db")
+    sp.add_argument("out")
+    sp.set_defaults(fn=cmd_backup)
+
+    sp = sub.add_parser("restore")
+    sp.add_argument("backup")
+    sp.add_argument("db")
+    sp.set_defaults(fn=cmd_restore)
+
+    cluster = sub.add_parser("cluster").add_subparsers(dest="sub", required=True)
+    sp = cluster.add_parser("members")
+    sp.set_defaults(fn=lambda a: cmd_admin(a, "cluster_members"))
+    sp = cluster.add_parser("membership-states")
+    sp.set_defaults(fn=lambda a: cmd_admin(a, "cluster_members"))
+
+    syncp = sub.add_parser("sync").add_subparsers(dest="sub", required=True)
+    sp = syncp.add_parser("generate")
+    sp.set_defaults(fn=lambda a: cmd_admin(a, "sync_generate"))
+    sp = syncp.add_parser("reconcile-gaps")
+    sp.set_defaults(fn=lambda a: cmd_admin(a, "sync_reconcile_gaps"))
+
+    sp = sub.add_parser("locks")
+    sp.set_defaults(fn=lambda a: cmd_admin(a, "locks"))
+
+    actor = sub.add_parser("actor").add_subparsers(dest="sub", required=True)
+    sp = actor.add_parser("version")
+    sp.add_argument("--actor", default=None)
+    sp.set_defaults(
+        fn=lambda a: cmd_admin(
+            a, "actor_version", **({"actor": a.actor} if a.actor else {})
+        )
+    )
+
+    subs = sub.add_parser("subs").add_subparsers(dest="sub", required=True)
+    sp = subs.add_parser("list")
+    sp.set_defaults(fn=lambda a: cmd_admin(a, "subs_list"))
+    sp = subs.add_parser("info")
+    sp.add_argument("id")
+    sp.set_defaults(fn=lambda a: cmd_admin(a, "subs_info", id=a.id))
+
+    sp = sub.add_parser("template", help="render a template from live queries")
+    sp.add_argument("template")
+    sp.add_argument("out")
+    sp.add_argument("--once", action="store_true")
+    sp.set_defaults(fn=cmd_template)
+
+    consul = sub.add_parser("consul").add_subparsers(dest="sub", required=True)
+    sp = consul.add_parser("sync")
+    sp.add_argument("--consul-addr", default="127.0.0.1:8500")
+    sp.add_argument("--once", action="store_true")
+    sp.set_defaults(fn=cmd_consul_sync)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except Exception as e:  # surfaced as a message, not a traceback
+        from corrosion_tpu.client import ClientError
+
+        if isinstance(e, (ClientError, OSError, RuntimeError, ValueError)):
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        raise
+
+
+if __name__ == "__main__":
+    sys.exit(main())

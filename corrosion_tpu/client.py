@@ -1,0 +1,173 @@
+"""Python client for the corrosion_tpu HTTP API.
+
+Parity: ``crates/corro-client`` — ``CorrosionApiClient`` (typed queries,
+execute/transactions, schema migration) and ``sub.rs``'s
+``SubscriptionStream`` (NDJSON event stream with observed-change-id gap
+detection and automatic re-attach via ``from=``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
+
+
+class ClientError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+class SubscriptionStream:
+    """Iterate subscription events; transparently re-attaches on drop.
+
+    Gap detection: every ``change`` event carries a change id; if the
+    stream drops, we re-attach with ``from=<last observed>`` so no event
+    is lost or duplicated (``corro-client/src/sub.rs`` behavior).
+    """
+
+    def __init__(self, client: "CorrosionApiClient", query_id: str,
+                 initial_resp, max_retries: int = 10):
+        self.client = client
+        self.id = query_id
+        self._resp = initial_resp
+        self.last_change_id: Optional[int] = None
+        self.max_retries = max_retries
+
+    def __iter__(self) -> Iterator[dict]:
+        retries = 0
+        while True:
+            try:
+                for raw in self._resp:
+                    event = json.loads(raw)
+                    if "change" in event:
+                        cid = event["change"][3]
+                        if (
+                            self.last_change_id is not None
+                            and cid > self.last_change_id + 1
+                        ):
+                            # missed events: force a re-attach from the
+                            # last id we actually observed
+                            raise ConnectionResetError("change id gap")
+                        self.last_change_id = cid
+                    retries = 0
+                    yield event
+                return
+            except (ConnectionError, TimeoutError, OSError):
+                retries += 1
+                if retries > self.max_retries:
+                    raise
+                try:
+                    self._resp.close()  # don't leak the dropped connection
+                except Exception:
+                    pass
+                time.sleep(min(0.1 * 2**retries, 5.0))
+                self._resp = self.client._subscribe_raw(
+                    sub_id=self.id, from_change_id=self.last_change_id
+                )
+
+
+class CorrosionApiClient:
+    def __init__(self, addr: Tuple[str, int], token: Optional[str] = None,
+                 timeout: float = 30.0):
+        self.base = f"http://{addr[0]}:{addr[1]}"
+        self.token = token
+        self.timeout = timeout
+
+    # -- plumbing --------------------------------------------------------
+
+    def _request(self, path: str, body=None, method: Optional[str] = None,
+                 stream: bool = False):
+        req = urllib.request.Request(
+            self.base + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method or ("POST" if body is not None else "GET"),
+        )
+        req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout)
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            try:
+                detail = json.loads(detail).get("error", detail)
+            except (ValueError, AttributeError):
+                pass
+            raise ClientError(e.code, detail) from None
+        except urllib.error.URLError as e:
+            raise ClientError(0, f"cannot reach {self.base}: {e.reason}") from None
+        if stream:
+            return resp
+        with resp:
+            return json.loads(resp.read() or b"null")
+
+    # -- API -------------------------------------------------------------
+
+    def execute(self, statements: Sequence) -> dict:
+        """POST /v1/transactions."""
+        return self._request("/v1/transactions", list(statements))
+
+    def query(self, statement) -> Tuple[List[str], List[list]]:
+        """POST /v1/queries -> (columns, rows)."""
+        resp = self._request("/v1/queries", statement, stream=True)
+        cols: List[str] = []
+        rows: List[list] = []
+        with resp:
+            for raw in resp:
+                ev = json.loads(raw)
+                if "columns" in ev:
+                    cols = ev["columns"]
+                elif "row" in ev:
+                    rows.append(ev["row"][1])
+                elif "error" in ev:
+                    raise ClientError(500, ev["error"])
+        return cols, rows
+
+    def migrate(self, schema_sql) -> dict:
+        """POST /v1/migrations."""
+        body = schema_sql if isinstance(schema_sql, list) else [schema_sql]
+        return self._request("/v1/migrations", body)
+
+    def schema_from_paths(self, paths: Iterable[str]) -> dict:
+        sqls = []
+        for p in paths:
+            with open(p) as f:
+                sqls.append(f.read())
+        return self.migrate(sqls)
+
+    def table_stats(self) -> dict:
+        return self._request("/v1/table_stats")
+
+    def members(self) -> dict:
+        return self._request("/v1/members")
+
+    def subscribe(self, statement) -> SubscriptionStream:
+        """POST /v1/subscriptions -> resumable event stream."""
+        resp = self._request("/v1/subscriptions", statement, stream=True)
+        query_id = resp.headers.get("x-corro-query-id", "")
+        return SubscriptionStream(self, query_id, resp)
+
+    def subscription(self, sub_id: str,
+                     from_change_id: Optional[int] = None) -> SubscriptionStream:
+        """GET /v1/subscriptions/:id — re-attach to an existing sub."""
+        resp = self._subscribe_raw(sub_id, from_change_id)
+        stream = SubscriptionStream(self, sub_id, resp)
+        stream.last_change_id = from_change_id
+        return stream
+
+    def _subscribe_raw(self, sub_id: str, from_change_id: Optional[int]):
+        path = f"/v1/subscriptions/{sub_id}"
+        if from_change_id is not None:
+            path += f"?from={from_change_id}"
+        return self._request(path, stream=True)
+
+    def updates(self, table: str) -> Iterator[dict]:
+        """GET /v1/updates/:table — raw per-table change stream."""
+        resp = self._request(f"/v1/updates/{table}", stream=True)
+        with resp:
+            for raw in resp:
+                yield json.loads(raw)

@@ -1,0 +1,192 @@
+"""Consul service/check sync bridge.
+
+Parity: ``crates/consul-client`` (minimal Consul HTTP client) +
+``corrosion consul sync`` (``corrosion/src/command/consul/sync.rs``): on
+an interval, pull the local Consul agent's services and checks, diff
+against hashes remembered in node-local ``__corro_consul_*`` tables, and
+upsert/delete the differences into the gossiped ``consul_services`` /
+``consul_checks`` CRR tables so the whole cluster sees them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+import urllib.request
+from typing import Callable, Dict, Optional, Tuple
+
+CONSUL_SCHEMA = """
+CREATE TABLE IF NOT EXISTS consul_services (
+  node TEXT NOT NULL,
+  id TEXT NOT NULL,
+  name TEXT NOT NULL DEFAULT '',
+  tags TEXT NOT NULL DEFAULT '[]',
+  meta TEXT NOT NULL DEFAULT '{}',
+  port INTEGER NOT NULL DEFAULT 0,
+  address TEXT NOT NULL DEFAULT '',
+  updated_at INTEGER NOT NULL DEFAULT 0,
+  PRIMARY KEY (node, id)
+);
+CREATE TABLE IF NOT EXISTS consul_checks (
+  node TEXT NOT NULL,
+  id TEXT NOT NULL,
+  service_id TEXT NOT NULL DEFAULT '',
+  service_name TEXT NOT NULL DEFAULT '',
+  name TEXT NOT NULL DEFAULT '',
+  status TEXT NOT NULL DEFAULT '',
+  output TEXT NOT NULL DEFAULT '',
+  updated_at INTEGER NOT NULL DEFAULT 0,
+  PRIMARY KEY (node, id)
+);
+"""
+
+
+class ConsulClient:
+    """Minimal Consul agent HTTP client (/v1/agent/services, /checks)."""
+
+    def __init__(self, addr: str = "127.0.0.1:8500", timeout: float = 5.0):
+        self.base = f"http://{addr}"
+        self.timeout = timeout
+
+    def _get(self, path: str):
+        with urllib.request.urlopen(self.base + path, timeout=self.timeout) as r:
+            return json.loads(r.read())
+
+    def services(self) -> Dict[str, dict]:
+        return self._get("/v1/agent/services")
+
+    def checks(self) -> Dict[str, dict]:
+        return self._get("/v1/agent/checks")
+
+
+def _hash(obj) -> str:
+    return hashlib.blake2s(
+        json.dumps(obj, sort_keys=True).encode(), digest_size=16
+    ).hexdigest()
+
+
+def sync_once(
+    client,
+    node: str,
+    services: Dict[str, dict],
+    checks: Dict[str, dict],
+    state: Dict[str, Dict[str, str]],
+) -> Tuple[int, int]:
+    """Diff services/checks against remembered hashes and push changes
+    through the API ``client``.  ``state`` holds {"services": {id: hash},
+    "checks": {id: hash}} and is mutated in place.  Returns
+    (n_upserts, n_deletes)."""
+    now = int(time.time())
+    stmts = []
+    # hash-state mutations are deferred until the push succeeds: a failed
+    # execute must NOT mark changes as synced
+    effects = []
+    upserts = deletes = 0
+
+    def diff(kind: str, current: Dict[str, dict], make_upsert, table: str):
+        nonlocal upserts, deletes
+        seen = state.setdefault(kind, {})
+        for sid, svc in current.items():
+            h = _hash(svc)
+            if seen.get(sid) == h:
+                continue
+            stmts.append(make_upsert(sid, svc))
+            effects.append((seen, sid, h))
+            upserts += 1
+        for sid in list(seen):
+            if sid not in current:
+                stmts.append(
+                    [f"DELETE FROM {table} WHERE node = ? AND id = ?", [node, sid]]
+                )
+                effects.append((seen, sid, None))
+                deletes += 1
+
+    diff(
+        "services",
+        services,
+        lambda sid, svc: [
+            "INSERT INTO consul_services (node, id, name, tags, meta, port,"
+            " address, updated_at) VALUES (?, ?, ?, ?, ?, ?, ?, ?)"
+            " ON CONFLICT (node, id) DO UPDATE SET name=excluded.name,"
+            " tags=excluded.tags, meta=excluded.meta, port=excluded.port,"
+            " address=excluded.address, updated_at=excluded.updated_at",
+            [
+                node,
+                sid,
+                svc.get("Service", svc.get("Name", "")),
+                json.dumps(svc.get("Tags") or []),
+                json.dumps(svc.get("Meta") or {}),
+                svc.get("Port") or 0,
+                svc.get("Address") or "",
+                now,
+            ],
+        ],
+        "consul_services",
+    )
+    diff(
+        "checks",
+        checks,
+        lambda cid, chk: [
+            "INSERT INTO consul_checks (node, id, service_id, service_name,"
+            " name, status, output, updated_at) VALUES (?, ?, ?, ?, ?, ?, ?, ?)"
+            " ON CONFLICT (node, id) DO UPDATE SET service_id=excluded.service_id,"
+            " service_name=excluded.service_name, name=excluded.name,"
+            " status=excluded.status, output=excluded.output,"
+            " updated_at=excluded.updated_at",
+            [
+                node,
+                cid,
+                chk.get("ServiceID", ""),
+                chk.get("ServiceName", ""),
+                chk.get("Name", ""),
+                chk.get("Status", ""),
+                chk.get("Output", ""),
+                now,
+            ],
+        ],
+        "consul_checks",
+    )
+    if stmts:
+        client.execute(stmts)
+        for seen, sid, h in effects:
+            if h is None:
+                seen.pop(sid, None)
+            else:
+                seen[sid] = h
+    return upserts, deletes
+
+
+def sync_loop(
+    api_addr,
+    consul_addr: str = "127.0.0.1:8500",
+    node: Optional[str] = None,
+    token: Optional[str] = None,
+    interval: float = 1.0,
+    once: bool = False,
+    fetch: Optional[Callable[[], Tuple[Dict, Dict]]] = None,
+) -> None:
+    """Pull-from-consul push-to-corrosion loop (1 s cadence like the
+    reference)."""
+    import socket
+
+    from corrosion_tpu.client import CorrosionApiClient
+
+    api = CorrosionApiClient(api_addr, token=token)
+    api.migrate(CONSUL_SCHEMA)
+    consul = ConsulClient(consul_addr)
+    node = node or socket.gethostname()
+    state: Dict[str, Dict[str, str]] = {}
+    from corrosion_tpu.client import ClientError
+
+    while True:
+        try:
+            services, checks = (
+                fetch() if fetch else (consul.services(), consul.checks())
+            )
+            sync_once(api, node, services, checks, state)
+        except (OSError, ValueError, ClientError):
+            pass  # transient: retried next interval
+        if once:
+            return
+        time.sleep(interval)

@@ -1,0 +1,137 @@
+"""Dev cluster runner: boot N agents from a topology file.
+
+Parity: ``crates/corro-devcluster`` — parse a topology file of
+``A -> B`` edges (B bootstraps from A), assign ports, generate configs,
+run the agents, tear down on exit (``corro-devcluster/src/main.rs``).
+
+Two runtimes:
+
+* ``run_inprocess`` — N agents as asyncio tasks in this process (what the
+  sim's bit-match harness and tests use);
+* ``main`` — CLI entry spawning one ``corrosion-tpu agent`` subprocess
+  per node with generated TOML configs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class Topology:
+    nodes: List[str] = field(default_factory=list)
+    edges: List[Tuple[str, str]] = field(default_factory=list)  # (a, b): b boots from a
+
+    @classmethod
+    def parse(cls, text: str) -> "Topology":
+        topo = cls()
+        seen = set()
+        for line in text.splitlines():
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            if "->" in line:
+                a, b = (s.strip() for s in line.split("->", 1))
+                for n in (a, b):
+                    if n not in seen:
+                        seen.add(n)
+                        topo.nodes.append(n)
+                topo.edges.append((a, b))
+            else:
+                if line not in seen:
+                    seen.add(line)
+                    topo.nodes.append(line)
+        return topo
+
+    def bootstraps_for(self, node: str) -> List[str]:
+        return [a for a, b in self.edges if b == node]
+
+
+async def run_inprocess(
+    topo: Topology,
+    schema: Optional[str] = None,
+    base_dir: Optional[str] = None,
+    **agent_overrides,
+) -> Dict[str, "object"]:
+    """Boot all agents; returns {name: Agent}.  Caller stops them."""
+    from corrosion_tpu.agent.testing import launch_test_agent
+
+    base = base_dir or tempfile.mkdtemp(prefix="corro-devcluster-")
+    agents: Dict[str, object] = {}
+    for name in topo.nodes:
+        boots = []
+        for up in topo.bootstraps_for(name):
+            a = agents.get(up)
+            if a is not None:
+                boots.append(f"{a.gossip_addr[0]}:{a.gossip_addr[1]}")
+        d = os.path.join(base, name)
+        os.makedirs(d, exist_ok=True)
+        kwargs = dict(bootstrap=boots, tmpdir=d)
+        if schema is not None:
+            kwargs["schema"] = schema
+        agents[name] = await launch_test_agent(**kwargs, **agent_overrides)
+    return agents
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import signal
+    import subprocess
+    import sys
+
+    ap = argparse.ArgumentParser(prog="corro-devcluster")
+    ap.add_argument("topology", help="file of 'A -> B' edges")
+    ap.add_argument("--schema", default=None, help="schema .sql file")
+    ap.add_argument("--base-dir", default=None)
+    args = ap.parse_args(argv)
+
+    with open(args.topology) as f:
+        topo = Topology.parse(f.read())
+    base = args.base_dir or tempfile.mkdtemp(prefix="corro-devcluster-")
+
+    procs: List[subprocess.Popen] = []
+    port = 42000
+    addrs: Dict[str, str] = {}
+    try:
+        for name in topo.nodes:
+            d = os.path.join(base, name)
+            os.makedirs(d, exist_ok=True)
+            gossip = f"127.0.0.1:{port}"
+            api = f"127.0.0.1:{port + 1}"
+            port += 2
+            addrs[name] = gossip
+            boots = [addrs[a] for a in topo.bootstraps_for(name) if a in addrs]
+            cfg = os.path.join(d, "config.toml")
+            with open(cfg, "w") as f:
+                f.write(f'[db]\npath = "{d}/corrosion.db"\n')
+                if args.schema:
+                    f.write(f'schema_paths = ["{os.path.abspath(args.schema)}"]\n')
+                f.write(f'\n[gossip]\naddr = "{gossip}"\n')
+                f.write("bootstrap = [" + ", ".join(f'"{b}"' for b in boots) + "]\n")
+                f.write(f'\n[api]\naddr = "{api}"\n')
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "corrosion_tpu.cli", "agent",
+                     "--config", cfg],
+                )
+            )
+            print(f"{name}: gossip={gossip} api={api} dir={d}")
+        print("devcluster up; ctrl-c to stop")
+        signal.sigwait({signal.SIGINT, signal.SIGTERM})
+        return 0
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

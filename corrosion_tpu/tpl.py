@@ -1,0 +1,237 @@
+"""Template engine: render config files from live queries.
+
+Parity: ``crates/corro-tpl`` — the reference embeds Rhai with a ``sql()``
+function streaming query rows, ``hostname()``, ``to_json``/``to_csv``
+helpers, and re-renders the template whenever a subscribed query's state
+changes.  Ours is a small built-in template dialect (Rhai isn't a thing
+in Python):
+
+* ``{{ expr }}`` — evaluate and substitute
+* ``{% for x in expr %} ... {% endfor %}`` — iterate (nestable)
+* ``{% if expr %} ... {% else %} ... {% endif %}``
+
+The expression namespace provides ``sql(query)`` (rows with attribute and
+index access), ``hostname()``, ``to_json(v)``, ``to_csv(rows)`` and
+``env(name, default)``.  ``render_loop`` re-renders whenever any
+``sql()`` query used by the template changes, via the subscriptions API.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import socket
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+_TOKEN = re.compile(r"(\{\{.*?\}\}|\{%.*?%\})", re.S)
+
+
+class Row:
+    """A query row with attribute, index and iteration access."""
+
+    def __init__(self, columns: Sequence[str], cells: Sequence):
+        self.__dict__["_cols"] = list(columns)
+        self.__dict__["_cells"] = list(cells)
+
+    def __getattr__(self, name):
+        try:
+            return self._cells[self._cols.index(name)]
+        except ValueError:
+            raise AttributeError(name) from None
+
+    def __getitem__(self, i):
+        if isinstance(i, str):
+            return getattr(self, i)
+        return self._cells[i]
+
+    def __iter__(self):
+        return iter(self._cells)
+
+    def __repr__(self):
+        return f"Row({dict(zip(self._cols, self._cells))})"
+
+
+class TemplateError(Exception):
+    pass
+
+
+def _parse(src: str) -> List:
+    """Parse into a tree of ('text', s) | ('expr', code) |
+    ('for', var, iter_code, body) | ('if', cond, body, else_body)."""
+    tokens = _TOKEN.split(src)
+    pos = 0
+
+    def block(terminators):
+        nonlocal pos
+        nodes = []
+        while pos < len(tokens):
+            tok = tokens[pos]
+            pos += 1
+            if not tok:
+                continue
+            if tok.startswith("{{"):
+                nodes.append(("expr", tok[2:-2].strip()))
+            elif tok.startswith("{%"):
+                stmt = tok[2:-2].strip()
+                word = stmt.split(None, 1)[0] if stmt else ""
+                if word in terminators:
+                    return nodes, word
+                if word == "for":
+                    m = re.match(r"for\s+(\w+)\s+in\s+(.+)", stmt, re.S)
+                    if not m:
+                        raise TemplateError(f"bad for: {stmt}")
+                    body, _ = block({"endfor"})
+                    nodes.append(("for", m.group(1), m.group(2), body))
+                elif word == "if":
+                    cond = stmt[2:].strip()
+                    body, term = block({"else", "endif"})
+                    else_body = []
+                    if term == "else":
+                        else_body, _ = block({"endif"})
+                    nodes.append(("if", cond, body, else_body))
+                else:
+                    raise TemplateError(f"unknown directive: {stmt}")
+            else:
+                nodes.append(("text", tok))
+        if terminators:
+            raise TemplateError(f"missing {terminators}")
+        return nodes, None
+
+    nodes, _ = block(set())
+    return nodes
+
+
+def _to_csv(rows) -> str:
+    import csv
+    import io
+
+    buf = io.StringIO()
+    w = csv.writer(buf)
+    for r in rows:
+        w.writerow(list(r))
+    return buf.getvalue()
+
+
+class Template:
+    def __init__(self, source: str):
+        self.nodes = _parse(source)
+
+    def render(self, sql: Callable[[str], List[Row]], extra: Optional[dict] = None
+               ) -> Tuple[str, List[str]]:
+        """Render; returns (output, list of sql queries used)."""
+        queries: List[str] = []
+
+        def tracked_sql(q: str) -> List[Row]:
+            queries.append(q)
+            return sql(q)
+
+        ns = {
+            "sql": tracked_sql,
+            "hostname": socket.gethostname,
+            "to_json": lambda v: json.dumps(
+                list(v) if isinstance(v, Row) else v, default=str
+            ),
+            "to_csv": _to_csv,
+            "env": lambda name, default="": os.environ.get(name, default),
+        }
+        if extra:
+            ns.update(extra)
+        out: List[str] = []
+
+        def walk(nodes, scope):
+            for node in nodes:
+                kind = node[0]
+                if kind == "text":
+                    out.append(node[1])
+                elif kind == "expr":
+                    val = eval(node[1], {"__builtins__": {}}, {**ns, **scope})  # noqa: S307
+                    out.append("" if val is None else str(val))
+                elif kind == "for":
+                    _, var, it, body = node
+                    for item in eval(it, {"__builtins__": {}}, {**ns, **scope}):  # noqa: S307
+                        walk(body, {**scope, var: item})
+                elif kind == "if":
+                    _, cond, body, else_body = node
+                    if eval(cond, {"__builtins__": {}}, {**ns, **scope}):  # noqa: S307
+                        walk(body, scope)
+                    else:
+                        walk(else_body, scope)
+
+        walk(self.nodes, {})
+        return "".join(out), queries
+
+
+def _client_sql(client) -> Callable[[str], List[Row]]:
+    def sql(q: str) -> List[Row]:
+        cols, rows = client.query(q)
+        return [Row(cols, r) for r in rows]
+
+    return sql
+
+
+def render_once(api_addr, template_path: str, out_path: str,
+                token: Optional[str] = None) -> List[str]:
+    """Render a template once; returns the queries it used."""
+    from corrosion_tpu.client import CorrosionApiClient
+
+    client = CorrosionApiClient(api_addr, token=token)
+    with open(template_path) as f:
+        tpl = Template(f.read())
+    output, queries = tpl.render(_client_sql(client))
+    _write_atomic(out_path, output)
+    return queries
+
+
+def render_loop(api_addr, template_path: str, out_path: str,
+                token: Optional[str] = None,
+                stop: Optional[threading.Event] = None,
+                on_render: Optional[Callable[[str], None]] = None) -> None:
+    """Render, then re-render whenever any used query's results change."""
+    from corrosion_tpu.client import CorrosionApiClient
+
+    client = CorrosionApiClient(api_addr, token=token)
+    with open(template_path) as f:
+        tpl = Template(f.read())
+    stop = stop or threading.Event()
+    wake = threading.Event()
+
+    output, queries = tpl.render(_client_sql(client))
+    _write_atomic(out_path, output)
+    if on_render:
+        on_render(output)
+
+    def watch(query: str) -> None:
+        while not stop.is_set():
+            try:
+                for ev in client.subscribe(query):
+                    if "change" in ev:
+                        wake.set()
+                    if stop.is_set():
+                        return
+            except Exception:
+                time.sleep(0.5)
+
+    for q in set(queries):
+        threading.Thread(target=watch, args=(q,), daemon=True).start()
+
+    while not stop.is_set():
+        wake.wait(timeout=0.5)
+        if not wake.is_set():
+            continue
+        wake.clear()
+        new_out, _ = tpl.render(_client_sql(client))
+        if new_out != output:
+            output = new_out
+            _write_atomic(out_path, output)
+            if on_render:
+                on_render(output)
+
+
+def _write_atomic(path: str, content: str) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(content)
+    os.replace(tmp, path)
