@@ -113,6 +113,8 @@ def _make_handler(agent: "Agent"):
             if not self._authorized():
                 return self._json(401, {"error": "unauthorized"})
             try:
+                if self.path == "/metrics":
+                    return self._metrics()
                 if self.path == "/v1/table_stats":
                     return self._table_stats()
                 if self.path == "/v1/members":
@@ -161,6 +163,27 @@ def _make_handler(agent: "Agent"):
             with agent.storage._lock:
                 touched = apply_schema(agent.storage, sql)
             self._json(200, {"tables": touched})
+
+        def _metrics(self):
+            extra = []
+            with agent.storage._lock:
+                for t in agent.storage.tables:
+                    (n,) = agent.storage.conn.execute(
+                        f'SELECT COUNT(*) FROM "{t}"'
+                    ).fetchone()
+                    extra.append(("corro_table_rows", float(n), {"table": t}))
+                extra.append(
+                    ("corro_db_version", float(agent.storage.db_version()), {})
+                )
+            extra.append(
+                ("corro_members_alive", float(len(agent.members.alive())), {})
+            )
+            body = agent.metrics.render(extra).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
 
         def _table_stats(self):
             stats = {}

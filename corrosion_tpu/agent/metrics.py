@@ -1,0 +1,102 @@
+"""Metrics registry + Prometheus text exposition.
+
+Parity: the reference instruments ~150 series through the ``metrics``
+crate facade and exposes them via a Prometheus HTTP exporter
+(``config.rs:69-80``, ``agent/metrics.rs:18-108``): gossip/broadcast
+counters, sync counters, channel depths, pool timings, per-table row
+counts, db/WAL size gauges.  Ours is a small thread-safe registry the
+agent exposes at ``GET /metrics`` on the API listener.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Iterable, List, Tuple
+
+LabelKV = Tuple[Tuple[str, str], ...]
+
+
+class Metrics:
+    def __init__(self):
+        self._counters: Dict[str, Dict[LabelKV, float]] = defaultdict(dict)
+        self._gauges: Dict[str, Dict[LabelKV, float]] = defaultdict(dict)
+        self._histos: Dict[str, Dict[LabelKV, List[float]]] = defaultdict(dict)
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, value: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._counters[name][key] = self._counters[name].get(key, 0.0) + value
+
+    def gauge(self, name: str, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._gauges[name][key] = value
+
+    def histogram(self, name: str, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            buf = self._histos[name].setdefault(key, [])
+            buf.append(value)
+            if len(buf) > 1024:
+                del buf[: len(buf) - 1024]
+
+    def timed(self, name: str, **labels):
+        return _Timer(self, name, labels)
+
+    # -- exposition ------------------------------------------------------
+
+    def render(self, extra_gauges: Iterable[Tuple[str, float, dict]] = ()) -> str:
+        out: List[str] = []
+
+        def fmt(name: str, key: LabelKV, v: float, suffix: str = "") -> str:
+            if key:
+                lbl = ",".join(f'{k}="{val}"' for k, val in key)
+                return f"{name}{suffix}{{{lbl}}} {v}"
+            return f"{name}{suffix} {v}"
+
+        with self._lock:
+            for name, series in sorted(self._counters.items()):
+                out.append(f"# TYPE {name} counter")
+                for key, v in series.items():
+                    out.append(fmt(name, key, v))
+            for name, series in sorted(self._gauges.items()):
+                out.append(f"# TYPE {name} gauge")
+                for key, v in series.items():
+                    out.append(fmt(name, key, v))
+            for name, series in sorted(self._histos.items()):
+                out.append(f"# TYPE {name} summary")
+                for key, buf in series.items():
+                    if not buf:
+                        continue
+                    s = sorted(buf)
+                    out.append(fmt(name, key + (("quantile", "0.5"),), s[len(s) // 2]))
+                    out.append(
+                        fmt(name, key + (("quantile", "0.99"),), s[int(len(s) * 0.99)])
+                    )
+                    out.append(fmt(name, key, float(len(buf)), "_count"))
+                    out.append(fmt(name, key, float(sum(buf)), "_sum"))
+        for name, v, labels in extra_gauges:
+            key = tuple(sorted(labels.items()))
+            out.append(f"# TYPE {name} gauge")
+            out.append(fmt(name, key, v))
+        return "\n".join(out) + "\n"
+
+
+class _Timer:
+    def __init__(self, metrics: Metrics, name: str, labels: dict):
+        self.metrics = metrics
+        self.name = name
+        self.labels = labels
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.metrics.histogram(
+            self.name, time.perf_counter() - self.t0, **self.labels
+        )
+        return False

@@ -1,0 +1,442 @@
+"""PostgreSQL wire-protocol (v3) front-end.
+
+Parity: ``crates/corro-pg`` ◆ — a pgwire server that lets any Postgres
+client read and write the CRDT database: startup/auth handshake, the
+**simple** query protocol, the **extended** protocol
+(Parse/Bind/Describe/Execute/Close/Sync with prepared statements and
+portals), transaction status tracking, and error responses.  Writes go
+through the agent's versioned write path so they broadcast like any HTTP
+transaction (``corro-pg/src/lib.rs:545``).
+
+Implementation notes:
+
+* SQL passes through with a light PG→SQLite translation ($N params →
+  ?, ``::type`` casts stripped, a few function renames) — the reference
+  does a full sqlparser→sqlite3-parser AST translation; ours leans on
+  the large shared SQL dialect instead.
+* results are sent in text format with OID 25 (TEXT) per column, which
+  every driver accepts; ``version()`` and trivial ``pg_catalog`` probes
+  get canned answers.
+* BEGIN/COMMIT group writes into ONE replication version (buffered until
+  COMMIT); reads always see committed state.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+import struct
+from typing import Dict, List, Optional, Tuple, TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from corrosion_tpu.agent.runtime import Agent
+
+PROTO_V3 = 196608
+SSL_REQUEST = 80877103
+CANCEL_REQUEST = 80877102
+
+TEXT_OID = 25
+
+
+def _msg(tag: bytes, payload: bytes = b"") -> bytes:
+    return tag + struct.pack(">I", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+class _Buffer:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def int16(self) -> int:
+        v = struct.unpack_from(">h", self.data, self.pos)[0]
+        self.pos += 2
+        return v
+
+    def int32(self) -> int:
+        v = struct.unpack_from(">i", self.data, self.pos)[0]
+        self.pos += 4
+        return v
+
+    def string(self) -> str:
+        end = self.data.index(b"\x00", self.pos)
+        s = self.data[self.pos : end].decode()
+        self.pos = end + 1
+        return s
+
+    def read(self, n: int) -> bytes:
+        v = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return v
+
+
+_CAST_RE = re.compile(r"::[a-zA-Z_][a-zA-Z0-9_]*(\[\])?")
+_FUNC_MAP = {
+    "now()": "datetime('now')",
+    "current_timestamp": "datetime('now')",
+}
+
+
+def translate_query(sql: str) -> Tuple[str, List[int]]:
+    """Light PG→SQLite translation, string-literal aware.
+
+    Returns (sql, param_order): each ``$N`` becomes ``?`` and
+    ``param_order`` records N per placeholder, so callers can bind
+    out-of-order / repeated parameter references correctly.
+    """
+    out: List[str] = []
+    order: List[int] = []
+    i, n = 0, len(sql)
+    while i < n:
+        ch = sql[i]
+        if ch == "'":
+            j = i + 1
+            while j < n:
+                if sql[j] == "'":
+                    if j + 1 < n and sql[j + 1] == "'":
+                        j += 2
+                        continue
+                    break
+                j += 1
+            out.append(sql[i : j + 1])
+            i = j + 1
+            continue
+        if ch == "$" and i + 1 < n and sql[i + 1].isdigit():
+            j = i + 1
+            while j < n and sql[j].isdigit():
+                j += 1
+            order.append(int(sql[i + 1 : j]))
+            out.append("?")
+            i = j
+            continue
+        if ch == ":" and i + 1 < n and sql[i + 1] == ":":
+            m = _CAST_RE.match(sql, i)
+            if m:
+                i = m.end()
+                continue
+        out.append(ch)
+        i += 1
+    text = "".join(out)
+    for k, v in _FUNC_MAP.items():
+        text = re.sub(re.escape(k), v, text, flags=re.IGNORECASE)
+    return text, order
+
+
+def translate_sql(sql: str) -> str:
+    return translate_query(sql)[0]
+
+
+def _is_write(sql: str) -> bool:
+    head = sql.lstrip().split(None, 1)
+    return bool(head) and head[0].upper() in (
+        "INSERT", "UPDATE", "DELETE", "REPLACE", "CREATE", "DROP", "ALTER",
+    )
+
+
+def _tag_for(sql: str, rowcount: int, nrows: int) -> str:
+    word = sql.lstrip().split(None, 1)[0].upper() if sql.strip() else ""
+    if word == "SELECT" or word == "WITH":
+        return f"SELECT {nrows}"
+    if word == "INSERT":
+        return f"INSERT 0 {max(rowcount, 0)}"
+    if word in ("UPDATE", "DELETE"):
+        return f"{word} {max(rowcount, 0)}"
+    return word or "OK"
+
+
+class _Session:
+    def __init__(self, agent: "Agent"):
+        self.agent = agent
+        self.stmts: Dict[str, Tuple[str, str]] = {}  # name -> (raw, translated)
+        self.portals: Dict[str, Tuple[str, List[Optional[bytes]]]] = {}
+        self.in_txn = False
+        self.txn_failed = False
+        self.txn_writes: List[list] = []
+
+    # -- execution -------------------------------------------------------
+
+    def execute(self, sql: str, params: Tuple = ()) -> Tuple[List[str], List[tuple], int, str]:
+        """Returns (columns, rows, rowcount, tag)."""
+        raw = sql.strip().rstrip(";")
+        word = raw.split(None, 1)[0].upper() if raw else ""
+        if word == "BEGIN" or word == "START":
+            self.in_txn, self.txn_failed, self.txn_writes = True, False, []
+            return [], [], 0, "BEGIN"
+        if word == "COMMIT" or word == "END":
+            writes, self.txn_writes = self.txn_writes, []
+            self.in_txn = False
+            if self.txn_failed:
+                self.txn_failed = False
+                return [], [], 0, "ROLLBACK"
+            if writes:
+                self.agent.execute_transaction(writes)
+            return [], [], 0, "COMMIT"
+        if word == "ROLLBACK":
+            self.in_txn, self.txn_failed, self.txn_writes = False, False, []
+            return [], [], 0, "ROLLBACK"
+        if not raw:
+            return [], [], 0, ""
+
+        canned = self._canned(raw)
+        if canned is not None:
+            return canned
+
+        tsql = translate_sql(raw)
+        if _is_write(tsql):
+            stmt = [tsql, list(params)] if params else [tsql]
+            if self.in_txn:
+                self.txn_writes.append(stmt)
+                # rowcount unknown until commit; report optimistically
+                return [], [], 1, _tag_for(tsql, 1, 0)
+            out = self.agent.execute_transaction([stmt])
+            rc = out["results"][0].get("rows_affected", 0)
+            return [], [], rc, _tag_for(tsql, rc, 0)
+        cols, rows = self.agent.storage.read_query(tsql, params)
+        return cols, rows, len(rows), _tag_for(tsql, -1, len(rows))
+
+    def _canned(self, raw: str):
+        low = " ".join(raw.lower().split())
+        if low in ("select version()", "select version();"):
+            return (
+                ["version"],
+                [("PostgreSQL 14.9 (corrosion-tpu sqlite CRDT)",)],
+                1,
+                "SELECT 1",
+            )
+        if low.startswith("set ") or low.startswith("reset "):
+            return [], [], 0, "SET"
+        if low.startswith("show "):
+            return ["setting"], [("",)], 1, "SELECT 1"
+        if "pg_catalog" in low or "information_schema" in low:
+            # minimal catalog: list CRR tables for pg_class-style probes
+            if "pg_class" in low or "tables" in low:
+                rows = [(t,) for t in self.agent.storage.tables]
+                return ["relname"], rows, len(rows), f"SELECT {len(rows)}"
+            return ["?column?"], [], 0, "SELECT 0"
+        return None
+
+
+async def serve_pg(agent: "Agent", host: str = "127.0.0.1", port: int = 0):
+    """Start the pgwire listener; returns the asyncio server."""
+    return await asyncio.start_server(
+        lambda r, w: _handle_conn(agent, r, w), host, port
+    )
+
+
+async def _handle_conn(agent: "Agent", reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+    session = _Session(agent)
+    try:
+        # --- startup ----------------------------------------------------
+        while True:
+            head = await reader.readexactly(4)
+            (length,) = struct.unpack(">I", head)
+            body = await reader.readexactly(length - 4)
+            (proto,) = struct.unpack_from(">I", body, 0)
+            if proto == SSL_REQUEST:
+                writer.write(b"N")  # no TLS
+                await writer.drain()
+                continue
+            if proto == CANCEL_REQUEST:
+                return
+            if proto != PROTO_V3:
+                _error(writer, "08P01", f"unsupported protocol {proto}")
+                return
+            break
+        writer.write(_msg(b"R", struct.pack(">I", 0)))  # AuthenticationOk
+        for k, v in (
+            ("server_version", "14.9"),
+            ("server_encoding", "UTF8"),
+            ("client_encoding", "UTF8"),
+            ("DateStyle", "ISO"),
+        ):
+            writer.write(_msg(b"S", _cstr(k) + _cstr(v)))
+        writer.write(_msg(b"K", struct.pack(">II", 0, 0)))
+        _ready(writer, session)
+        await writer.drain()
+
+        # --- message loop -----------------------------------------------
+        while True:
+            tag = await reader.readexactly(1)
+            (length,) = struct.unpack(">I", await reader.readexactly(4))
+            body = await reader.readexactly(length - 4)
+            if tag == b"X":
+                return
+            if tag == b"Q":
+                await _simple_query(writer, session, _Buffer(body).string())
+            elif tag == b"P":
+                b = _Buffer(body)
+                name, query = b.string(), b.string()
+                session.stmts[name] = (query, translate_sql(query))
+                writer.write(_msg(b"1"))
+            elif tag == b"B":
+                _bind(writer, session, _Buffer(body))
+            elif tag == b"D":
+                _describe(writer, session, _Buffer(body))
+            elif tag == b"E":
+                await _execute_portal(writer, session, _Buffer(body))
+            elif tag == b"C":
+                b = _Buffer(body)
+                kind, name = b.read(1), b.string()
+                (session.stmts if kind == b"S" else session.portals).pop(name, None)
+                writer.write(_msg(b"3"))
+            elif tag == b"S":
+                _ready(writer, session)
+            elif tag == b"H":
+                pass  # flush: we always flush below
+            else:
+                _error(writer, "08P01", f"unsupported message {tag!r}")
+                _ready(writer, session)
+            await writer.drain()
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return
+    finally:
+        writer.close()
+
+
+def _ready(writer, session: _Session) -> None:
+    status = b"E" if session.txn_failed else (b"T" if session.in_txn else b"I")
+    writer.write(_msg(b"Z", status))
+
+
+def _error(writer, code: str, message: str) -> None:
+    payload = (
+        b"S" + _cstr("ERROR") + b"C" + _cstr(code) + b"M" + _cstr(message) + b"\x00"
+    )
+    writer.write(_msg(b"E", payload))
+
+
+def _row_description(writer, cols: List[str]) -> None:
+    payload = struct.pack(">h", len(cols))
+    for c in cols:
+        payload += _cstr(c) + struct.pack(">IhIhih", 0, 0, TEXT_OID, -1, -1, 0)
+    writer.write(_msg(b"T", payload))
+
+
+def _data_rows(writer, rows: List[tuple]) -> None:
+    for row in rows:
+        payload = struct.pack(">h", len(row))
+        for v in row:
+            if v is None:
+                payload += struct.pack(">i", -1)
+            else:
+                if isinstance(v, bool):
+                    s = b"t" if v else b"f"
+                elif isinstance(v, (bytes, bytearray, memoryview)):
+                    s = b"\\x" + bytes(v).hex().encode()
+                else:
+                    s = str(v).encode()
+                payload += struct.pack(">i", len(s)) + s
+        writer.write(_msg(b"D", payload))
+
+
+async def _simple_query(writer, session: _Session, query: str) -> None:
+    parts = [p for p in _split_statements(query) if p.strip()]
+    if not parts:
+        writer.write(_msg(b"I"))  # EmptyQueryResponse
+        _ready(writer, session)
+        return
+    for part in parts:
+        try:
+            cols, rows, rc, tag = session.execute(part)
+        except Exception as e:
+            if session.in_txn:
+                session.txn_failed = True
+            _error(writer, "42601", str(e))
+            break
+        if cols:
+            _row_description(writer, cols)
+            _data_rows(writer, rows)
+        writer.write(_msg(b"C", _cstr(tag)))
+    _ready(writer, session)
+
+
+def _bind(writer, session: _Session, b: _Buffer) -> None:
+    portal, stmt = b.string(), b.string()
+    nfmt = b.int16()
+    fmts = [b.int16() for _ in range(nfmt)]
+    nparams = b.int16()
+    params: List[Optional[bytes]] = []
+    for i in range(nparams):
+        ln = b.int32()
+        params.append(None if ln == -1 else b.read(ln))
+    if stmt not in session.stmts:
+        _error(writer, "26000", f"unknown prepared statement {stmt!r}")
+        return
+    # text format assumed (fmt 0); binary params are rejected
+    if any(f == 1 for f in fmts):
+        _error(writer, "0A000", "binary parameter format not supported")
+        return
+    session.portals[portal] = (stmt, params)
+    writer.write(_msg(b"2"))
+
+
+def _describe(writer, session: _Session, b: _Buffer) -> None:
+    kind, name = b.read(1), b.string()
+    # we don't know result columns until execution: report NoData for
+    # writes, ParameterDescription+NoData for statements
+    if kind == b"S":
+        raw = session.stmts.get(name, ("", ""))[0]
+        nparams = len(set(re.findall(r"\$(\d+)", raw)))
+        writer.write(
+            _msg(b"t", struct.pack(">h", nparams) + struct.pack(">I", TEXT_OID) * nparams)
+        )
+    writer.write(_msg(b"n"))  # NoData; RowDescription arrives with Execute
+
+
+async def _execute_portal(writer, session: _Session, b: _Buffer) -> None:
+    portal = b.string()
+    b.int32()  # row limit (0 = all); portals are always drained fully
+    entry = session.portals.get(portal)
+    if entry is None:
+        _error(writer, "34000", f"unknown portal {portal!r}")
+        return
+    stmt_name, raw_params = entry
+    raw, tsql = session.stmts[stmt_name]
+    params = tuple(
+        None if p is None else p.decode() for p in raw_params
+    )
+    try:
+        cols, rows, rc, tag = session.execute(raw, params)
+    except Exception as e:
+        if session.in_txn:
+            session.txn_failed = True
+        _error(writer, "42601", str(e))
+        return
+    if cols:
+        _row_description(writer, cols)
+        _data_rows(writer, rows)
+    writer.write(_msg(b"C", _cstr(tag)))
+
+
+def _split_statements(query: str) -> List[str]:
+    """Split on top-level semicolons (string-literal aware)."""
+    parts: List[str] = []
+    buf: List[str] = []
+    in_str = False
+    i = 0
+    while i < len(query):
+        ch = query[i]
+        if in_str:
+            buf.append(ch)
+            if ch == "'":
+                if i + 1 < len(query) and query[i + 1] == "'":
+                    buf.append("'")
+                    i += 1
+                else:
+                    in_str = False
+        elif ch == "'":
+            in_str = True
+            buf.append(ch)
+        elif ch == ";":
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+        i += 1
+    if buf:
+        parts.append("".join(buf))
+    return parts

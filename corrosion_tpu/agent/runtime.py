@@ -76,6 +76,10 @@ class AgentConfig:
     subs_enabled: bool = True
     subs_path: Optional[str] = None
     admin_path: Optional[str] = None
+    pg_port: Optional[int] = None  # PostgreSQL wire protocol (None = off)
+    maintenance_interval: float = 60.0
+    wal_truncate_pages: int = 250_000  # ~1 GB at 4 KiB pages
+    vacuum_free_pages: int = 10_000
 
 
 class Agent:
@@ -96,6 +100,9 @@ class Agent:
         self.clock = HLClock()
         self.actor_id = self.storage.site_id
         self.members = Members(self.actor_id)
+        from corrosion_tpu.agent.metrics import Metrics
+
+        self.metrics = Metrics()
         self._members_table()
         if config.schema_sql:
             apply_schema(self.storage, config.schema_sql)
@@ -116,6 +123,8 @@ class Agent:
         self.on_change = None  # hook(ChangeV1) for subscriptions layer
         self.subs = None  # SubsManager, attached by setup when enabled
         self._admin = None
+        self._pg = None
+        self.pg_addr: Optional[Tuple[str, int]] = None
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -143,6 +152,7 @@ class Agent:
             asyncio.create_task(self._suspect_reaper()),
             asyncio.create_task(self._broadcast_loop()),
             asyncio.create_task(self._sync_loop()),
+            asyncio.create_task(self._maintenance_loop()),
         ]
         if self.config.api_port is not None:
             from corrosion_tpu.agent.http import start_http_api
@@ -153,6 +163,13 @@ class Agent:
             from corrosion_tpu.agent.admin import start_admin
 
             self._admin = await start_admin(self, self.config.admin_path)
+        if self.config.pg_port is not None:
+            from corrosion_tpu.agent.pg import serve_pg
+
+            self._pg = await serve_pg(
+                self, self.config.api_host, self.config.pg_port
+            )
+            self.pg_addr = self._pg.sockets[0].getsockname()[:2]
 
     async def stop(self) -> None:
         for t in self._tasks:
@@ -170,6 +187,9 @@ class Agent:
         if self._admin is not None:
             self._admin.close()
             await self._admin.wait_closed()
+        if self._pg is not None:
+            self._pg.close()
+            await self._pg.wait_closed()
         if self.subs is not None:
             self.subs.close()
         self._persist_members()
@@ -398,6 +418,7 @@ class Agent:
             msg = {"k": "change", "cv": wire.change_v1_to_dict(cv)}
             for m in targets:
                 self._send_udp(m.addr, msg)
+            self.metrics.counter("corro_broadcast_sent_total", len(targets))
             if remaining > 1:
                 self._loop.call_later(
                     self.config.rebroadcast_delay,
@@ -434,6 +455,11 @@ class Agent:
             except Exception:
                 pass
         news = self._process_changeset(cv)
+        self.metrics.counter(
+            "corro_changes_received_total",
+            source=source.value,
+            news=str(news).lower(),
+        )
         if news and source is ChangeSource.BROADCAST and self._loop:
             self._bcast_queue.put_nowait((cv, self.config.max_transmissions))
         if news and self.on_change is not None:
@@ -538,13 +564,41 @@ class Agent:
                 state.last_cleared_ts = bv.last_cleared_ts
         return state
 
-    async def _sync_loop(self) -> None:
+    async def _maintenance_loop(self) -> None:
+        """WAL checkpoint + incremental vacuum (handlers.rs:394-534)."""
         while True:
-            await asyncio.sleep(
-                self._rng.uniform(
-                    self.config.sync_interval_min, self.config.sync_interval_max
-                )
+            await asyncio.sleep(self.config.maintenance_interval)
+            try:
+                with self.storage._lock:
+                    (wal_pages, _) = self.storage.conn.execute(
+                        "PRAGMA wal_checkpoint(PASSIVE)"
+                    ).fetchone()[1:]
+                    if wal_pages is not None and wal_pages > self.config.wal_truncate_pages:
+                        self.storage.conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+                        self.metrics.counter("corro_db_wal_truncations")
+                    (freelist,) = self.storage.conn.execute(
+                        "PRAGMA freelist_count"
+                    ).fetchone()
+                    if freelist > self.config.vacuum_free_pages:
+                        self.storage.conn.execute(
+                            f"PRAGMA incremental_vacuum({freelist // 2})"
+                        )
+                        self.metrics.counter("corro_db_vacuums")
+            except Exception:
+                pass
+
+    async def _sync_loop(self) -> None:
+        from corrosion_tpu.utils.backoff import Backoff
+
+        delays = iter(
+            Backoff(
+                base=self.config.sync_interval_min,
+                cap=self.config.sync_interval_max,
+                rng=self._rng,
             )
+        )
+        while True:
+            await asyncio.sleep(next(delays))
             peers = [
                 m for m in self.members.alive() if m.state is MemberState.ALIVE
             ]
@@ -609,6 +663,8 @@ class Agent:
                     elif kind == "sync_done":
                         done = True
             self.members.update_sync_ts(m.actor_id, time.time())
+            self.metrics.counter("corro_sync_client_rounds_total")
+            self.metrics.counter("corro_sync_changes_received_total", count)
             return count
         except (asyncio.TimeoutError, OSError, ConnectionError):
             return count

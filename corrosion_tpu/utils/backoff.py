@@ -1,0 +1,34 @@
+"""Decorrelated-jitter backoff.
+
+Parity: ``crates/backoff`` — the iterator the reference's SWIM announcer
+and sync scheduler use: each delay is drawn uniformly from
+``[base, prev * 3]``, clamped to ``[base, cap]`` (decorrelated jitter),
+optionally with a retry limit.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterator, Optional
+
+
+class Backoff:
+    def __init__(self, base: float = 0.1, cap: float = 15.0,
+                 max_retries: Optional[int] = None,
+                 rng: Optional[random.Random] = None):
+        self.base = base
+        self.cap = cap
+        self.max_retries = max_retries
+        self.rng = rng or random.Random()
+
+    def __iter__(self) -> Iterator[float]:
+        prev = self.base
+        n = 0
+        while self.max_retries is None or n < self.max_retries:
+            delay = min(self.cap, self.rng.uniform(self.base, prev * 3))
+            prev = delay
+            n += 1
+            yield delay
+
+    def reset(self) -> "Backoff":
+        return Backoff(self.base, self.cap, self.max_retries, self.rng)

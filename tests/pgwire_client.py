@@ -1,0 +1,157 @@
+"""Minimal synchronous pgwire v3 client used by the PG server tests
+(no Postgres driver is available in the image)."""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import List, Optional, Tuple
+
+
+class PgClient:
+    def __init__(self, host: str, port: int, user: str = "test",
+                 database: str = "db", timeout: float = 10.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        params = b""
+        for k, v in (("user", user), ("database", database)):
+            params += k.encode() + b"\x00" + v.encode() + b"\x00"
+        params += b"\x00"
+        body = struct.pack(">I", 196608) + params
+        self.sock.sendall(struct.pack(">I", len(body) + 4) + body)
+        self._buf = b""
+        # read until ReadyForQuery
+        self.params: dict = {}
+        for tag, payload in self._messages_until(b"Z"):
+            if tag == b"S":
+                k, v = payload.split(b"\x00")[:2]
+                self.params[k.decode()] = v.decode()
+        self.txn_status = None
+
+    # -- plumbing --------------------------------------------------------
+
+    def _recv_msg(self) -> Tuple[bytes, bytes]:
+        while len(self._buf) < 5:
+            self._buf += self._recv()
+        tag = self._buf[:1]
+        (ln,) = struct.unpack(">I", self._buf[1:5])
+        while len(self._buf) < 1 + ln:
+            self._buf += self._recv()
+        payload = self._buf[5 : 1 + ln]
+        self._buf = self._buf[1 + ln :]
+        return tag, payload
+
+    def _recv(self) -> bytes:
+        data = self.sock.recv(65536)
+        if not data:
+            raise ConnectionError("server closed")
+        return data
+
+    def _messages_until(self, end_tag: bytes):
+        while True:
+            tag, payload = self._recv_msg()
+            yield tag, payload
+            if tag == end_tag:
+                return
+
+    def _send(self, tag: bytes, payload: bytes = b"") -> None:
+        self.sock.sendall(tag + struct.pack(">I", len(payload) + 4) + payload)
+
+    # -- simple protocol -------------------------------------------------
+
+    def query(self, sql: str):
+        """Simple query; returns (columns, rows, tags, errors)."""
+        self._send(b"Q", sql.encode() + b"\x00")
+        cols: List[str] = []
+        rows: List[list] = []
+        tags: List[str] = []
+        errors: List[str] = []
+        for tag, payload in self._messages_until(b"Z"):
+            if tag == b"T":
+                cols = self._parse_rowdesc(payload)
+            elif tag == b"D":
+                rows.append(self._parse_datarow(payload))
+            elif tag == b"C":
+                tags.append(payload.rstrip(b"\x00").decode())
+            elif tag == b"E":
+                errors.append(self._parse_error(payload))
+            elif tag == b"Z":
+                self.txn_status = payload.decode()
+        return cols, rows, tags, errors
+
+    # -- extended protocol -----------------------------------------------
+
+    def prepared(self, sql: str, params: Tuple = ()):
+        """Parse/Bind/Execute/Sync round; returns (cols, rows, tag, err)."""
+        self._send(b"P", b"\x00" + sql.encode() + b"\x00" + struct.pack(">h", 0))
+        bind = b"\x00\x00" + struct.pack(">h", 0)  # portal, stmt, no fmts
+        bind += struct.pack(">h", len(params))
+        for p in params:
+            if p is None:
+                bind += struct.pack(">i", -1)
+            else:
+                s = str(p).encode()
+                bind += struct.pack(">i", len(s)) + s
+        bind += struct.pack(">h", 0)
+        self._send(b"B", bind)
+        self._send(b"D", b"P\x00")
+        self._send(b"E", b"\x00" + struct.pack(">i", 0))
+        self._send(b"S")
+        cols: List[str] = []
+        rows: List[list] = []
+        tag_out: Optional[str] = None
+        err: Optional[str] = None
+        for tag, payload in self._messages_until(b"Z"):
+            if tag == b"T":
+                cols = self._parse_rowdesc(payload)
+            elif tag == b"D" and len(payload) >= 2:
+                rows.append(self._parse_datarow(payload))
+            elif tag == b"C":
+                tag_out = payload.rstrip(b"\x00").decode()
+            elif tag == b"E":
+                err = self._parse_error(payload)
+            elif tag == b"Z":
+                self.txn_status = payload.decode()
+        return cols, rows, tag_out, err
+
+    # -- parsing ---------------------------------------------------------
+
+    @staticmethod
+    def _parse_rowdesc(payload: bytes) -> List[str]:
+        (n,) = struct.unpack_from(">h", payload, 0)
+        cols = []
+        pos = 2
+        for _ in range(n):
+            end = payload.index(b"\x00", pos)
+            cols.append(payload[pos:end].decode())
+            pos = end + 1 + 18
+        return cols
+
+    @staticmethod
+    def _parse_datarow(payload: bytes) -> list:
+        (n,) = struct.unpack_from(">h", payload, 0)
+        pos = 2
+        out = []
+        for _ in range(n):
+            (ln,) = struct.unpack_from(">i", payload, pos)
+            pos += 4
+            if ln == -1:
+                out.append(None)
+            else:
+                out.append(payload[pos : pos + ln].decode())
+                pos += ln
+        return out
+
+    @staticmethod
+    def _parse_error(payload: bytes) -> str:
+        fields = {}
+        for part in payload.split(b"\x00"):
+            if part:
+                fields[chr(part[0])] = part[1:].decode()
+        return fields.get("M", "unknown error")
+
+    def close(self) -> None:
+        try:
+            self._send(b"X")
+        except OSError:
+            pass
+        self.sock.close()

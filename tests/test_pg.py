@@ -1,0 +1,167 @@
+"""PostgreSQL wire-protocol server tests (raw pgwire v3 client)."""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.agent.testing import launch_test_agent, wait_for
+from tests.pgwire_client import PgClient
+
+
+@pytest.fixture
+def run():
+    def _run(coro):
+        return asyncio.run(coro)
+
+    return _run
+
+
+def test_pg_handshake_and_simple_query(run):
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                c = PgClient(*a.pg_addr)
+                assert c.params.get("server_version") == "14.9"
+                cols, rows, tags, errs = c.query("SELECT version()")
+                assert not errs and "corrosion-tpu" in rows[0][0]
+                cols, rows, tags, errs = c.query(
+                    "INSERT INTO tests (id, text) VALUES (1, 'via pg')"
+                )
+                assert tags == ["INSERT 0 1"] and not errs
+                cols, rows, tags, errs = c.query(
+                    "SELECT id, text FROM tests"
+                )
+                assert cols == ["id", "text"]
+                assert rows == [["1", "via pg"]]
+                assert tags == ["SELECT 1"]
+                c.close()
+
+            await asyncio.to_thread(drive)
+            # the PG write went through the versioned path
+            assert a.bookie.for_actor(a.actor_id).last() == 1
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_extended_protocol_params(run):
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                c = PgClient(*a.pg_addr)
+                _, _, tag, err = c.prepared(
+                    "INSERT INTO tests (id, text) VALUES ($1, $2)", (5, "param")
+                )
+                assert err is None and tag == "INSERT 0 1"
+                cols, rows, tag, err = c.prepared(
+                    "SELECT text FROM tests WHERE id = $1", (5,)
+                )
+                assert err is None
+                assert rows == [["param"]]
+                c.close()
+
+            await asyncio.to_thread(drive)
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_transaction_groups_one_version(run):
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                c = PgClient(*a.pg_addr)
+                c.query("BEGIN")
+                assert c.txn_status == "T"
+                c.query("INSERT INTO tests (id, text) VALUES (1, 'a')")
+                c.query("INSERT INTO tests (id, text) VALUES (2, 'b')")
+                c.query("COMMIT")
+                assert c.txn_status == "I"
+                c.close()
+
+            await asyncio.to_thread(drive)
+            assert a.bookie.for_actor(a.actor_id).last() == 1  # one version
+            n = a.storage.conn.execute("SELECT COUNT(*) FROM tests").fetchone()[0]
+            assert n == 2
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_rollback_discards(run):
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                c = PgClient(*a.pg_addr)
+                c.query("BEGIN")
+                c.query("INSERT INTO tests (id) VALUES (9)")
+                c.query("ROLLBACK")
+                c.close()
+
+            await asyncio.to_thread(drive)
+            n = a.storage.conn.execute("SELECT COUNT(*) FROM tests").fetchone()[0]
+            assert n == 0
+            assert a.bookie.for_actor(a.actor_id).last() == 0
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_errors_and_multi_statement(run):
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                c = PgClient(*a.pg_addr)
+                _, _, _, errs = c.query("SELECT FROM no_such")
+                assert errs, "bad SQL must produce an ErrorResponse"
+                # connection still usable
+                cols, rows, tags, errs = c.query(
+                    "INSERT INTO tests (id) VALUES (1); SELECT COUNT(*) FROM tests"
+                )
+                assert not errs
+                assert tags[-1] == "SELECT 1" and rows == [["1"]]
+                # pg write gossips like any write
+                c.close()
+
+            await asyncio.to_thread(drive)
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_write_broadcasts_to_cluster(run):
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        b = await launch_test_agent(
+            bootstrap=[f"{a.gossip_addr[0]}:{a.gossip_addr[1]}"]
+        )
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+
+            def drive():
+                c = PgClient(*a.pg_addr)
+                c.query("INSERT INTO tests (id, text) VALUES (3, 'pg-gossip')")
+                c.close()
+
+            await asyncio.to_thread(drive)
+            await wait_for(
+                lambda: b.storage.conn.execute(
+                    "SELECT text FROM tests WHERE id=3"
+                ).fetchone()
+                == ("pg-gossip",)
+            )
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())

@@ -282,3 +282,39 @@ def test_cli_offline_commands(tmp_path):
     assert callable(args.fn)
     args = p.parse_args(["consul", "sync", "--once"])
     assert args.once
+
+
+def test_metrics_endpoint_and_backoff(run):
+    from corrosion_tpu.utils.backoff import Backoff
+
+    # backoff: decorrelated jitter within [base, cap], respects max_retries
+    delays = list(Backoff(base=0.1, cap=2.0, max_retries=20))
+    assert len(delays) == 20
+    assert all(0.1 <= d <= 2.0 for d in delays)
+
+    async def main():
+        import urllib.request
+
+        a = await launch_test_agent()
+        b = await launch_test_agent(
+            bootstrap=[f"{a.gossip_addr[0]}:{a.gossip_addr[1]}"]
+        )
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+            a.execute_transaction([["INSERT INTO tests (id) VALUES (1)"]])
+            await wait_for(
+                lambda: b.storage.conn.execute(
+                    "SELECT COUNT(*) FROM tests"
+                ).fetchone()[0] == 1
+            )
+            url = f"http://{b.api_addr[0]}:{b.api_addr[1]}/metrics"
+            with urllib.request.urlopen(url, timeout=5) as r:
+                text = r.read().decode()
+            assert "corro_changes_received_total" in text
+            assert 'corro_table_rows{table="tests"} 1.0' in text
+            assert "corro_members_alive 1.0" in text
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())
