@@ -6,8 +6,12 @@ Parity targets:
   wire transport and subscription bookkeeping
   (``crates/corro-types/src/pubsub.rs:2302-2449``);
 * cr-sqlite's merge tie-break needs a total order over SQLite values
-  ("biggest value wins", ``doc/crdts.md:13-16``) following SQLite's
-  cross-type comparison order: NULL < INTEGER/REAL < TEXT < BLOB.
+  ("biggest value wins", ``doc/crdts.md:13-16``).  Empirically (pinned by
+  tests/test_crsqlite_golden.py against the vendored extension), cr-sqlite
+  does NOT use SQLite's ORDER BY cross-type order: it compares the
+  ``sqlite3_value_type`` enum first, where a *smaller* enum wins —
+  INTEGER > FLOAT > TEXT > BLOB > NULL — and only compares
+  numerically/bytewise within one type.
 
 The codec here is our own format (tag byte + big-endian payload) chosen so
 that packed blobs are self-describing and roundtrip exactly.
@@ -28,27 +32,37 @@ _T_BLOB = 4
 
 
 def _type_rank(v: SqlValue) -> int:
+    """cr-sqlite tie-break rank: NULL < BLOB < TEXT < REAL < INTEGER.
+
+    This is the inverse of the ``sqlite3_value_type`` enum (INTEGER=1,
+    FLOAT=2, TEXT=3, BLOB=4, NULL=5): cr-sqlite's merge treats the value
+    with the smaller type enum as "bigger".  Pinned empirically against
+    the vendored extension in tests/test_crsqlite_golden.py — note this
+    differs from SQLite's ORDER BY order (NULL < numeric < text < blob).
+    """
     if v is None:
         return 0
     if isinstance(v, bool):
-        return 1
-    if isinstance(v, (int, float)):
-        return 1  # INTEGER and REAL compare numerically in one class
+        return 4  # bools bind as INTEGER
+    if isinstance(v, int):
+        return 4
+    if isinstance(v, float):
+        return 3
     if isinstance(v, str):
         return 2
     if isinstance(v, (bytes, bytearray, memoryview)):
-        return 3
+        return 1
     raise TypeError(f"unsupported SQL value: {type(v)!r}")
 
 
 def value_cmp(a: SqlValue, b: SqlValue) -> int:
-    """SQLite ORDER BY comparison: NULL < numeric < text < blob."""
+    """cr-sqlite merge-tie-break comparison (see :func:`_type_rank`)."""
     ra, rb = _type_rank(a), _type_rank(b)
     if ra != rb:
         return -1 if ra < rb else 1
     if ra == 0:
         return 0
-    if ra == 1:
+    if ra in (3, 4):
         return (a > b) - (a < b)
     if ra == 2:
         ab, bb = a.encode("utf-8"), b.encode("utf-8")

@@ -17,7 +17,10 @@ vendored cr-sqlite C extension (loaded at
 * ``apply_changes`` is the ``crsql_changes`` INSERT side — the merge:
   bigger causal length wins the row; within an equal causal length the
   bigger ``col_version`` wins the cell, ties broken by the bigger value
-  (SQLite value order, :func:`corrosion_tpu.agent.pack.value_cmp`);
+  in cr-sqlite's type-enum order — INTEGER > FLOAT > TEXT > BLOB > NULL,
+  numeric/bytewise within a type
+  (:func:`corrosion_tpu.agent.pack.value_cmp`, pinned against the real
+  extension by tests/test_crsqlite_golden.py);
 * ``site_id`` identifies this database (== the agent's ActorId), interned
   remote sites get small ordinals like cr-sqlite's site table.
 

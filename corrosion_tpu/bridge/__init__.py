@@ -1,0 +1,18 @@
+"""Interop bridge: golden cr-sqlite reference engine + speedy wire codec.
+
+The north-star bit-match path (SURVEY §7.6): validate our CRDT merge
+against the real cr-sqlite extension, and speak the reference agent's
+speedy-encoded wire types so traces can be diffed against real agents.
+"""
+
+from corrosion_tpu.bridge.crsqlite_ref import (
+    CrsqliteRef,
+    crsqlite_available,
+    find_crsqlite_so,
+)
+
+__all__ = [
+    "CrsqliteRef",
+    "crsqlite_available",
+    "find_crsqlite_so",
+]

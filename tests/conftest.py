@@ -1,24 +1,25 @@
 """Test config: force an 8-device virtual CPU mesh before JAX initializes.
 
 Multi-chip sharding is validated on virtual CPU devices (no multi-chip TPU
-hardware in CI).  Note: this environment's sitecustomize registers the
-`axon` TPU-tunnel PJRT plugin at interpreter start and pins
-``jax_platforms``; plain env vars are not enough, so we override the config
-directly before the first backend use.
+hardware in CI).  The provisioning logic lives in
+``__graft_entry__._force_virtual_cpu`` (shared with the driver's multichip
+dry run): this environment's sitecustomize registers the `axon` TPU-tunnel
+PJRT plugin at interpreter start and pins ``jax_platforms``, so plain env
+vars are not enough — the config must be overridden directly before the
+first backend use.
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _force_virtual_cpu  # noqa: E402
+
+_force_virtual_cpu(8)
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu", (
     "tests must run on the virtual CPU mesh, got " + jax.default_backend()
 )

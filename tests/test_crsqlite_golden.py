@@ -1,0 +1,248 @@
+"""Golden parity tests: our CRDT engine vs the real cr-sqlite extension.
+
+SURVEY §7.1 / VERDICT round-1 item 2(a): replay identical op sequences on
+two replica clusters — one backed by :class:`corrosion_tpu.agent.storage.
+CrConn` (our engine over stock sqlite3), one by the vendored cr-sqlite
+native extension (:class:`corrosion_tpu.bridge.CrsqliteRef`) — exchanging
+changes through each engine's own replication mechanism, and assert the
+replicated *data tables* bit-match at every exchange point.
+
+This pins our merge semantics (LWW biggest col_version, tie → biggest
+value in cr-sqlite's type-enum order INTEGER > FLOAT > TEXT > BLOB >
+NULL, numeric/bytewise within a type; causal-length delete/resurrect)
+to the actual C implementation the reference ships.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from corrosion_tpu.agent.storage import CrConn
+from corrosion_tpu.bridge import CrsqliteRef, crsqlite_available
+from corrosion_tpu.bridge.crsqlite_ref import _sort_key
+
+pytestmark = pytest.mark.skipif(
+    not crsqlite_available(),
+    reason="vendored cr-sqlite extension not loadable",
+)
+
+# `v` has no type name → no affinity: values keep their storage class, so
+# cross-type tie-breaks actually exercise cr-sqlite's type-enum ordering
+# (INTEGER > FLOAT > TEXT > BLOB > NULL) instead of being coerced first.
+SCHEMA = (
+    "CREATE TABLE foo ("
+    " id INTEGER NOT NULL PRIMARY KEY,"
+    " a TEXT, b INTEGER, c REAL, v)"
+)
+
+# Values spanning every SQLite storage class.
+VALUE_POOL = [
+    None, -7, 0, 1, 10, 2**40, 0.5, -2.25, 10.0,
+    "", "a", "z", "hello", "héllo", "10",
+    b"", b"\x00", b"blob", b"\xff\xff",
+]
+
+
+class DualCluster:
+    """N logical replicas, each realized in both engines."""
+
+    def __init__(self, n: int, tmp_path):
+        self.refs = []
+        self.mine = []
+        for i in range(n):
+            ref = CrsqliteRef(":memory:")
+            ref.conn.executescript(SCHEMA)
+            ref.as_crr("foo")
+            self.refs.append(ref)
+
+            c = CrConn(str(tmp_path / f"mine_{i}.db"), site_id=ref.site_id)
+            c.conn.executescript(SCHEMA)
+            c.as_crr("foo")
+            self.mine.append(c)
+
+    def close(self):
+        for r in self.refs:
+            r.close()
+        for c in self.mine:
+            c.close()
+
+    # -- ops (applied to both engines) ---------------------------------
+
+    def run(self, i: int, sql: str, params=()):
+        self.refs[i].execute(sql, params)
+        self.mine[i].execute(sql, params)
+
+    def exchange(self, i: int, j: int):
+        """One-way: replica i sends everything it knows to replica j."""
+        self.refs[j].apply(self.refs[i].changes())
+        self.mine[j].apply_changes(_my_all_changes(self.mine[i]))
+
+    def assert_parity(self, label: str = ""):
+        for idx, (r, m) in enumerate(zip(self.refs, self.mine)):
+            ref_rows = r.data("foo")
+            my_cols, my_raw = m.read_query("SELECT * FROM foo")
+            my_rows = sorted(
+                (tuple(row) for row in my_raw), key=_sort_key
+            )
+            assert my_rows == ref_rows, (
+                f"{label}: replica {idx} diverged from cr-sqlite:\n"
+                f"  crsqlite: {ref_rows}\n  ours:     {my_rows}"
+            )
+
+    def live_pks(self, i: int):
+        return {
+            row[0]
+            for row in self.refs[i].conn.execute("SELECT id FROM foo")
+        }
+
+
+def _my_all_changes(c: CrConn):
+    out = []
+    for _, sid in c.conn.execute(
+        "SELECT ordinal, site_id FROM __corro_sites ORDER BY ordinal"
+    ):
+        sid = bytes(sid)
+        out.extend(
+            c.collect_changes(
+                (0, 1 << 60), None if sid == c.site_id else sid
+            )
+        )
+    return out
+
+
+def test_insert_update_exchange_parity(tmp_path):
+    cl = DualCluster(2, tmp_path)
+    cl.run(0, "INSERT INTO foo VALUES (1, 'x', 10, 0.5, NULL)")
+    cl.run(0, "UPDATE foo SET a='y' WHERE id=1")
+    cl.exchange(0, 1)
+    cl.assert_parity("after exchange")
+    cl.run(1, "UPDATE foo SET a='z', b=20 WHERE id=1")
+    cl.exchange(1, 0)
+    cl.assert_parity("after return exchange")
+    cl.close()
+
+
+@pytest.mark.parametrize(
+    "va,vb",
+    [
+        (10, 20),
+        (10, "10"),           # integer vs text: integer wins
+        ("abc", b"abc"),      # text vs blob: text wins
+        (None, 0),            # null loses to everything
+        (1.5, 1),             # real vs integer: integer wins (enum order!)
+        (1.5, 2.5),           # real vs real: numeric
+        ("héllo", "hello"),   # utf-8 byte ordering
+        (b"\x00", b""),
+    ],
+)
+def test_concurrent_insert_tie_break(tmp_path, va, vb):
+    """Both replicas insert the same pk concurrently with col_version 1 —
+    the merged cell must be cr-sqlite's 'biggest value wins'.  Uses the
+    no-affinity column `v` so values keep their storage class."""
+    cl = DualCluster(2, tmp_path)
+    cl.run(0, "INSERT INTO foo (id, v) VALUES (5, ?)", (va,))
+    cl.run(1, "INSERT INTO foo (id, v) VALUES (5, ?)", (vb,))
+    cl.exchange(0, 1)
+    cl.exchange(1, 0)
+    cl.assert_parity(f"tie {va!r} vs {vb!r}")
+    # and both replicas agree with each other
+    assert cl.refs[0].data("foo") == cl.refs[1].data("foo")
+    cl.close()
+
+
+def test_delete_vs_update_conflict(tmp_path):
+    """Concurrent delete vs update: causal length decides (delete wins
+    over the same generation's update)."""
+    cl = DualCluster(2, tmp_path)
+    cl.run(0, "INSERT INTO foo VALUES (1, 'x', 1, NULL, NULL)")
+    cl.exchange(0, 1)
+    cl.assert_parity("seeded")
+    cl.run(0, "DELETE FROM foo WHERE id=1")
+    cl.run(1, "UPDATE foo SET a='updated' WHERE id=1")
+    cl.exchange(0, 1)
+    cl.exchange(1, 0)
+    cl.assert_parity("delete vs update")
+    assert cl.refs[0].data("foo") == cl.refs[1].data("foo")
+    cl.close()
+
+
+def test_resurrect_parity(tmp_path):
+    """Delete then re-insert (higher causal length) vs concurrent update
+    of the dead generation: the resurrected generation must win."""
+    cl = DualCluster(2, tmp_path)
+    cl.run(0, "INSERT INTO foo VALUES (2, 'gen1', 1, NULL, NULL)")
+    cl.exchange(0, 1)
+    cl.run(0, "DELETE FROM foo WHERE id=2")
+    cl.run(0, "INSERT INTO foo (id, a) VALUES (2, 'gen2')")
+    cl.run(1, "UPDATE foo SET b=99 WHERE id=2")
+    cl.exchange(0, 1)
+    cl.exchange(1, 0)
+    cl.assert_parity("resurrect")
+    assert cl.refs[0].data("foo") == cl.refs[1].data("foo")
+    cl.close()
+
+
+def test_delete_then_exchange_both_ways(tmp_path):
+    cl = DualCluster(2, tmp_path)
+    cl.run(0, "INSERT INTO foo VALUES (3, 'x', 1, NULL, NULL)")
+    cl.exchange(0, 1)
+    cl.run(1, "DELETE FROM foo WHERE id=3")
+    cl.exchange(1, 0)
+    cl.assert_parity("remote delete")
+    assert cl.live_pks(0) == set()
+    cl.close()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_random_ops_convergence_parity(tmp_path, seed):
+    """The main golden property test: 3 replicas, randomized interleaved
+    inserts/updates/deletes with randomized pairwise exchanges; parity is
+    asserted after every exchange and total convergence at the end."""
+    rng = random.Random(seed)
+    n = 3
+    cl = DualCluster(n, tmp_path)
+    cols = ("a", "b", "c", "v")
+
+    for step in range(120):
+        i = rng.randrange(n)
+        roll = rng.random()
+        live = sorted(cl.live_pks(i))
+        if roll < 0.12:
+            j = rng.choice([x for x in range(n) if x != i])
+            cl.exchange(i, j)
+            cl.assert_parity(f"seed {seed} step {step} exchange {i}->{j}")
+        elif roll < 0.5 or not live:
+            pk = rng.randrange(1, 6)
+            if pk in live:
+                continue
+            cl.run(
+                i,
+                "INSERT INTO foo (id, a, b, c, v) VALUES (?, ?, ?, ?, ?)",
+                (pk, rng.choice(VALUE_POOL), rng.choice(VALUE_POOL),
+                 rng.choice(VALUE_POOL), rng.choice(VALUE_POOL)),
+            )
+        elif roll < 0.85:
+            pk = rng.choice(live)
+            col = rng.choice(cols)
+            cl.run(
+                i,
+                f"UPDATE foo SET {col}=? WHERE id=?",
+                (rng.choice(VALUE_POOL), pk),
+            )
+        else:
+            pk = rng.choice(live)
+            cl.run(i, "DELETE FROM foo WHERE id=?", (pk,))
+
+    # full anti-entropy: two all-to-all rounds guarantee convergence
+    for _ in range(2):
+        for i in range(n):
+            for j in range(n):
+                if i != j:
+                    cl.exchange(i, j)
+    cl.assert_parity(f"seed {seed} final")
+    base = cl.refs[0].data("foo")
+    for idx in range(1, n):
+        assert cl.refs[idx].data("foo") == base, "cr-sqlite cluster diverged"
+    cl.close()

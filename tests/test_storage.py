@@ -31,11 +31,15 @@ def db(tmp_path):
 def test_pack_roundtrip_and_order():
     vals = [None, -5, 3.5, "abc", b"\x00\xff", True]
     assert unpack_values(pack_values(vals)) == [None, -5, 3.5, "abc", b"\x00\xff", 1]
+    # cr-sqlite tie-break order (pinned by tests/test_crsqlite_golden.py):
+    # NULL < BLOB < TEXT < REAL < INTEGER; numeric/bytes within one type
     assert value_cmp(None, 0) < 0
-    assert value_cmp(2, "a") < 0
-    assert value_cmp("z", b"\x00") < 0
+    assert value_cmp(2, "a") > 0
+    assert value_cmp("z", b"\x00") > 0
     assert value_cmp("b", "a") > 0
-    assert value_cmp(2, 2.5) < 0
+    assert value_cmp(2, 2.5) > 0
+    assert value_cmp(2, 3) < 0
+    assert value_cmp(2.5, 3.5) < 0
 
 
 def test_local_write_creates_clock_rows(db):
