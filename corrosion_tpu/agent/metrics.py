@@ -46,6 +46,12 @@ class Metrics:
     def timed(self, name: str, **labels):
         return _Timer(self, name, labels)
 
+    def get_counter(self, name: str, **labels) -> float:
+        """Current value of one counter series (0.0 if never incremented)."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._counters.get(name, {}).get(key, 0.0)
+
     # -- exposition ------------------------------------------------------
 
     def render(self, extra_gauges: Iterable[Tuple[str, float, dict]] = ()) -> str:

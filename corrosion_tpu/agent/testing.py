@@ -36,18 +36,21 @@ async def launch_test_agent(
     **overrides,
 ) -> Agent:
     d = tmpdir or tempfile.mkdtemp(prefix="corro-test-")
-    cfg = AgentConfig(
-        db_path=f"{d}/corrosion.db",
-        bootstrap=bootstrap or [],
-        schema_sql=schema,
-        # fast timers for tests
+    kwargs = dict(
+        # fast timers for tests (explicit overrides win)
         probe_interval=0.1,
         probe_timeout=0.15,
         suspect_timeout=0.6,
         rebroadcast_delay=0.05,
         sync_interval_min=0.15,
         sync_interval_max=0.4,
-        **overrides,
+    )
+    kwargs.update(overrides)
+    cfg = AgentConfig(
+        db_path=f"{d}/corrosion.db",
+        bootstrap=bootstrap or [],
+        schema_sql=schema,
+        **kwargs,
     )
     agent = Agent(cfg)
     await agent.start()

@@ -51,12 +51,18 @@ def find_crsqlite_so() -> Optional[str]:
 def crsqlite_available() -> bool:
     if find_crsqlite_so() is None:
         return False
+    # Broad catch: loading can fail with TypeError (< 3.12: no `entrypoint`
+    # kwarg), AttributeError (no loadable-extension support), or
+    # sqlite3.Error — all mean "skip the golden tests", not "crash".
+    conn = None
     try:
         conn = _connect(":memory:")
-        conn.close()
         return True
-    except sqlite3.Error:
+    except Exception:
         return False
+    finally:
+        if conn is not None:
+            conn.close()
 
 
 def _connect(path: str) -> sqlite3.Connection:

@@ -84,10 +84,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     import sys
 
     ap = argparse.ArgumentParser(prog="corro-devcluster")
-    ap.add_argument("topology", help="file of 'A -> B' edges")
+    ap.add_argument("topology", nargs="?", default=None,
+                    help="file of 'A -> B' edges (process runtime)")
     ap.add_argument("--schema", default=None, help="schema .sql file")
     ap.add_argument("--base-dir", default=None)
+    ap.add_argument("--runtime", choices=["process", "tpu-sim"],
+                    default="process",
+                    help="process: spawn agent subprocesses; tpu-sim: run "
+                         "the JAX simulator vs an in-process agent cluster "
+                         "and record the trace diff")
+    ap.add_argument("-n", "--nodes", type=int, default=64,
+                    help="cluster size for --runtime tpu-sim")
+    ap.add_argument("--out", default=None,
+                    help="tpu-sim: write the diff JSON here "
+                         "(default SIMDIFF_N{n}.json)")
     args = ap.parse_args(argv)
+
+    if args.runtime == "tpu-sim":
+        import asyncio as aio
+        import json
+
+        from corrosion_tpu.sim.simdiff import run_simdiff
+
+        if args.schema:
+            ap.error("--schema is not supported with --runtime tpu-sim "
+                     "(the diff uses the fixed test schema on both sides)")
+        out = args.out or f"SIMDIFF_N{args.nodes}.json"
+        result = aio.run(
+            run_simdiff(n=args.nodes, out_path=out, base_dir=args.base_dir)
+        )
+        print(json.dumps(result))
+        return 0
+
+    if args.topology is None:
+        ap.error("topology file required for --runtime process")
 
     with open(args.topology) as f:
         topo = Topology.parse(f.read())

@@ -1,0 +1,199 @@
+"""Sim-vs-agent trace diff: calibrate the TPU simulator against the real
+in-process agent cluster.
+
+The north-star metric path (BASELINE.json: "bit-match corro-devcluster at
+N≤256") needs a recorded comparison between the JAX epidemic simulator
+and a real cluster of our agents running the actual gossip protocol over
+loopback UDP/TCP.  This module runs both under matched parameters
+(fanout, max_transmissions, no loss) and diffs the convergence traces:
+
+* ``msgs_per_node`` — broadcast messages sent per node until the cluster
+  converged (sim counts scatter deliveries; agents count real UDP sends
+  via the ``corro_broadcast_sent_total`` metric);
+* ``ticks_to_converge`` — sim protocol rounds vs the agent cluster's
+  wall-clock divided by the rebroadcast delay (one "hop" ≈ one round);
+* ``converged_frac`` — both must reach 1.0.
+
+Used by ``corro-devcluster --runtime tpu-sim`` (one recorded diff JSON)
+and by tests at small N.
+
+Parity anchor: the reference measures the same path with
+``configurable_stress_test`` (corro-agent/src/agent/tests.rs:284-302)
+booting N real agents in-process; our sim side replaces the cluster with
+the vmapped kernel, which is the whole point of the TPU build.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import time
+from typing import Dict, Optional
+
+
+def sim_trace(
+    n: int,
+    fanout: int = 3,
+    max_transmissions: int = 5,
+    seeds: int = 8,
+    sync: bool = True,
+) -> Dict:
+    """Run the JAX epidemic sim at matched parameters; return trace stats."""
+    from corrosion_tpu.sim.epidemic import EpidemicConfig, run_epidemic_seeds
+
+    cfg = EpidemicConfig(
+        n_nodes=n,
+        n_rows=4,
+        fanout_ring0=0,
+        fanout_global=fanout,
+        ring0_size=1,  # agents sample uniformly: no ring0 tier
+        max_transmissions=max_transmissions,
+        loss=0.0,
+        sync_interval=8 if sync else 0,
+        sync_peers=1,
+        max_ticks=256,
+        chunk_ticks=8,
+    )
+    stats = run_epidemic_seeds(cfg, n_seeds=seeds, seed=0)
+    return {
+        "runtime": "tpu-sim",
+        "n_nodes": n,
+        "converged_frac": stats["converged_frac"],
+        "ticks_to_converge_p50": _finite(stats["ticks_p50"]),
+        "ticks_to_converge_p99": _finite(stats["ticks_p99"]),
+        "msgs_per_node": stats["msgs_per_node_mean"],
+        "wall_s": stats["wall_s"],
+    }
+
+
+def _finite(v: Optional[float]) -> Optional[float]:
+    """inf/nan (a seed never converged) → None so the JSON stays strict."""
+    if v is None or not math.isfinite(v):
+        return None
+    return v
+
+
+async def agent_trace(
+    n: int,
+    fanout: int = 3,
+    max_transmissions: int = 5,
+    rebroadcast_delay: float = 0.05,
+    timeout: float = 60.0,
+    base_dir: Optional[str] = None,
+) -> Dict:
+    """Boot n real agents on loopback, gossip one write to convergence.
+
+    Bootstrap is a star onto node 0; full membership is awaited before
+    the write so the epidemic runs over a complete member view (matching
+    the sim's uniform sampling over N nodes).
+    """
+    from corrosion_tpu.agent.testing import launch_test_agent, wait_for
+
+    agents = []
+    try:
+        first = await launch_test_agent(
+            tmpdir=None if base_dir is None else f"{base_dir}/n0",
+            fanout=fanout,
+            max_transmissions=max_transmissions,
+            rebroadcast_delay=rebroadcast_delay,
+        )
+        agents.append(first)
+        boot = [f"{first.gossip_addr[0]}:{first.gossip_addr[1]}"]
+        for i in range(1, n):
+            agents.append(
+                await launch_test_agent(
+                    bootstrap=boot,
+                    tmpdir=None if base_dir is None else f"{base_dir}/n{i}",
+                    fanout=fanout,
+                    max_transmissions=max_transmissions,
+                    rebroadcast_delay=rebroadcast_delay,
+                )
+            )
+
+        # full membership (SWIM dissemination), so fanout sampling sees N-1
+        await wait_for(
+            lambda: all(
+                len(a.members.alive()) >= n - 1 for a in agents
+            ),
+            timeout=timeout,
+        )
+
+        def sent_total() -> int:
+            return sum(
+                int(a.metrics.get_counter("corro_broadcast_sent_total") or 0)
+                for a in agents
+            )
+
+        base_sent = sent_total()
+        t0 = time.perf_counter()
+        agents[0].execute_transaction(
+            [("INSERT INTO tests (id, text) VALUES (?, ?)",
+              (4242, "simdiff"))]
+        )
+
+        def converged() -> bool:
+            for a in agents:
+                _, rows = a.storage.read_query(
+                    "SELECT text FROM tests WHERE id = 4242"
+                )
+                if not rows or rows[0][0] != "simdiff":
+                    return False
+            return True
+
+        await wait_for(converged, timeout=timeout, interval=0.02)
+        wall = time.perf_counter() - t0
+        msgs = sent_total() - base_sent
+        return {
+            "runtime": "agents",
+            "n_nodes": n,
+            "converged_frac": 1.0,
+            "wall_to_converge_s": round(wall, 4),
+            "ticks_to_converge_est": round(wall / rebroadcast_delay, 1),
+            "msgs_per_node": round(msgs / n, 2),
+        }
+    finally:
+        await asyncio.gather(*(a.stop() for a in agents), return_exceptions=True)
+
+
+def diff_traces(sim: Dict, agents: Dict) -> Dict:
+    """Join the two traces into one recorded diff."""
+    sim_ticks = sim["ticks_to_converge_p50"]
+    return {
+        "n_nodes": sim["n_nodes"],
+        "sim": sim,
+        "agents": agents,
+        "diff": {
+            "msgs_per_node_ratio": round(
+                sim["msgs_per_node"] / max(agents["msgs_per_node"], 1e-9), 3
+            ),
+            "ticks_ratio": (
+                None if sim_ticks is None else round(
+                    sim_ticks / max(agents["ticks_to_converge_est"], 1e-9), 3
+                )
+            ),
+            "both_converged": (
+                sim["converged_frac"] == 1.0
+                and agents["converged_frac"] == 1.0
+            ),
+        },
+    }
+
+
+async def run_simdiff(
+    n: int = 64,
+    fanout: int = 3,
+    max_transmissions: int = 5,
+    out_path: Optional[str] = None,
+    base_dir: Optional[str] = None,
+) -> Dict:
+    sim = sim_trace(n, fanout=fanout, max_transmissions=max_transmissions)
+    ag = await agent_trace(
+        n, fanout=fanout, max_transmissions=max_transmissions,
+        base_dir=base_dir,
+    )
+    result = diff_traces(sim, ag)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1, allow_nan=False)
+    return result

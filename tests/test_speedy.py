@@ -1,0 +1,248 @@
+"""speedy wire codec: round-trips + hand-derived golden byte vectors.
+
+The golden vectors are computed by hand from the speedy 0.8 layout rules
+(see corrosion_tpu/bridge/speedy.py docstring) so the byte format is
+pinned independently of the encoder — a bug symmetric in encode/decode
+cannot slip through.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import pytest
+
+from corrosion_tpu.bridge import speedy
+from corrosion_tpu.types.actor import ActorId, ClusterId
+from corrosion_tpu.types.base import CrsqlDbVersion, CrsqlSeq, Version
+from corrosion_tpu.types.change import Change
+from corrosion_tpu.types.changeset import Changeset, ChangeV1
+from corrosion_tpu.types.hlc import Timestamp
+from corrosion_tpu.types.payload import (
+    BiPayload,
+    BroadcastV1,
+    SyncNeedV1,
+    SyncStateV1,
+    UniPayload,
+)
+
+A1 = ActorId(bytes(range(16)))
+A2 = ActorId(bytes(range(16, 32)))
+SITE = bytes(range(32, 48))
+
+
+def mk_change(val=42, cid="x", seq=0):
+    return Change(
+        table="t",
+        pk=b"\x01\x09\x01",
+        cid=cid,
+        val=val,
+        col_version=1,
+        db_version=CrsqlDbVersion(7),
+        seq=CrsqlSeq(seq),
+        site_id=SITE,
+        cl=1,
+    )
+
+
+# ---------------------------------------------------------------------------
+# golden byte vectors
+# ---------------------------------------------------------------------------
+
+
+def test_golden_change_bytes():
+    data = speedy.Writer()
+    speedy._w_change(data, mk_change())
+    got = data.getvalue()
+    expect = (
+        struct.pack("<I", 1) + b"t"          # TableName: u32 len + utf8
+        + struct.pack("<I", 3) + b"\x01\x09\x01"  # pk: Vec<u8>
+        + struct.pack("<I", 1) + b"x"        # ColumnName
+        + b"\x01" + struct.pack("<q", 42)    # SqliteValue::Integer tag+i64
+        + struct.pack("<q", 1)               # col_version i64
+        + struct.pack("<Q", 7)               # db_version u64
+        + struct.pack("<Q", 0)               # seq u64
+        + SITE                               # [u8; 16] raw
+        + struct.pack("<q", 1)               # cl i64
+    )
+    assert got == expect
+
+
+def test_golden_sqlite_value_variants():
+    cases = [
+        (None, b"\x00"),
+        (5, b"\x01" + struct.pack("<q", 5)),
+        (0.5, b"\x02" + struct.pack("<d", 0.5)),
+        ("ab", b"\x03" + struct.pack("<I", 2) + b"ab"),
+        (b"\xff", b"\x04" + struct.pack("<I", 1) + b"\xff"),
+    ]
+    for val, expect in cases:
+        w = speedy.Writer()
+        speedy._w_value(w, val)
+        assert w.getvalue() == expect, val
+        r = speedy.Reader(expect)
+        assert speedy._r_value(r) == val
+
+
+def test_golden_uni_payload_full_changeset():
+    ts = Timestamp(123456789)
+    cs = Changeset.full(
+        Version(3), [mk_change()], (CrsqlSeq(0), CrsqlSeq(0)), CrsqlSeq(0), ts
+    )
+    payload = UniPayload(
+        broadcast=BroadcastV1(change=ChangeV1(actor_id=A1, changeset=cs)),
+        cluster_id=ClusterId(9),
+    )
+    got = speedy.encode_uni_payload(payload)
+
+    w = speedy.Writer()
+    speedy._w_change(w, mk_change())
+    change_bytes = w.getvalue()
+    expect = (
+        struct.pack("<I", 0) * 3             # V1 / Broadcast / Change tags
+        + A1.bytes                           # actor_id raw uuid
+        + struct.pack("<I", 1)               # Changeset::Full tag
+        + struct.pack("<Q", 3)               # version
+        + struct.pack("<I", 1) + change_bytes  # Vec<Change>
+        + struct.pack("<Q", 0) + struct.pack("<Q", 0)  # seqs range
+        + struct.pack("<Q", 0)               # last_seq
+        + struct.pack("<Q", 123456789)       # ts
+        + struct.pack("<H", 9)               # cluster_id u16 (default_on_eof)
+    )
+    assert got == expect
+    back = speedy.decode_uni_payload(got)
+    assert back == payload
+
+
+def test_golden_changeset_empty_with_optional_ts():
+    cs = Changeset.empty((Version(2), Version(5)), ts=None)
+    w = speedy.Writer()
+    speedy._w_changeset(w, cs)
+    assert w.getvalue() == (
+        struct.pack("<I", 0)                 # Changeset::Empty tag
+        + struct.pack("<Q", 2) + struct.pack("<Q", 5)
+        + b"\x00"                            # Option::None
+    )
+    # default_on_eof: ts entirely absent also decodes
+    r = speedy.Reader(struct.pack("<I", 0) + struct.pack("<Q", 2) + struct.pack("<Q", 5))
+    back = speedy._r_changeset(r)
+    assert back.versions == (Version(2), Version(5)) and back.ts is None
+
+
+def test_golden_sync_state_bytes():
+    st = SyncStateV1(
+        actor_id=A1,
+        heads={A2: Version(10)},
+        need={A2: [(2, 4)]},
+        partial_need={A2: {Version(5): [(0, 7)]}},
+        last_cleared_ts=Timestamp(77),
+    )
+    got = speedy.encode_sync_message(st)
+    expect = (
+        struct.pack("<I", 0)                 # SyncMessage::V1
+        + struct.pack("<I", 0)               # SyncMessageV1::State
+        + A1.bytes
+        + struct.pack("<I", 1) + A2.bytes + struct.pack("<Q", 10)   # heads
+        + struct.pack("<I", 1) + A2.bytes                           # need map
+        + struct.pack("<I", 1) + struct.pack("<Q", 2) + struct.pack("<Q", 4)
+        + struct.pack("<I", 1) + A2.bytes                           # partial_need
+        + struct.pack("<I", 1) + struct.pack("<Q", 5)
+        + struct.pack("<I", 1) + struct.pack("<Q", 0) + struct.pack("<Q", 7)
+        + b"\x01" + struct.pack("<Q", 77)    # Option<Timestamp>::Some
+    )
+    assert got == expect
+    back = speedy.decode_sync_message(got)
+    assert back == st
+
+
+def test_sync_state_default_on_eof_ts():
+    st = SyncStateV1(actor_id=A1, heads={}, need={}, partial_need={})
+    full = speedy.encode_sync_message(st)
+    # strip the trailing Option byte: still decodes, ts defaults to None
+    back = speedy.decode_sync_message(full[:-1])
+    assert back.last_cleared_ts is None and back.actor_id == A1
+
+
+# ---------------------------------------------------------------------------
+# round-trips
+# ---------------------------------------------------------------------------
+
+
+def test_roundtrip_changeset_variants():
+    ts = Timestamp(999)
+    variants = [
+        Changeset.empty((Version(1), Version(3)), ts),
+        Changeset.empty((Version(1), Version(3)), None),
+        Changeset.empty_set([(Version(1), Version(2)), (Version(9), Version(9))], ts),
+        Changeset.full(
+            Version(4),
+            [mk_change(v, c, s) for s, (v, c) in enumerate(
+                [(None, "a"), (1.25, "b"), ("txt", "c"), (b"\x00\x01", "d")]
+            )],
+            (CrsqlSeq(0), CrsqlSeq(3)),
+            CrsqlSeq(3),
+            ts,
+        ),
+    ]
+    for cs in variants:
+        cv = ChangeV1(actor_id=A2, changeset=cs)
+        data = speedy.encode_uni_payload(
+            UniPayload(broadcast=BroadcastV1(change=cv))
+        )
+        back = speedy.decode_uni_payload(data)
+        assert back.broadcast.change == cv
+
+
+def test_roundtrip_bi_payload():
+    for trace in (None, {"traceparent": "00-abc-def-01"},
+                  {"traceparent": "00-a-b-01", "tracestate": "x=y"}):
+        p = BiPayload(actor_id=A1, trace_ctx=trace)
+        data = speedy.encode_bi_payload(p, ClusterId(3))
+        back, cid = speedy.decode_bi_payload(data)
+        assert back == p and cid == ClusterId(3)
+
+
+def test_roundtrip_sync_messages():
+    msgs = [
+        Timestamp(123),
+        ("rejection", speedy.REJECTION_MAX_CONCURRENCY),
+        ("rejection", speedy.REJECTION_DIFFERENT_CLUSTER),
+        ("request", [
+            (A1, [SyncNeedV1.full(1, 5), SyncNeedV1.partial(3, [(0, 2), (5, 9)])]),
+            (A2, [SyncNeedV1.empty(Timestamp(4)), SyncNeedV1.empty(None)]),
+        ]),
+        ChangeV1(
+            actor_id=A1,
+            changeset=Changeset.full(
+                Version(1), [mk_change()], (CrsqlSeq(0), CrsqlSeq(0)),
+                CrsqlSeq(0), Timestamp(1),
+            ),
+        ),
+    ]
+    for msg in msgs:
+        back = speedy.decode_sync_message(speedy.encode_sync_message(msg))
+        assert back == msg
+
+
+def test_framing_roundtrip_and_partial():
+    payloads = [b"aaa", b"", b"x" * 1000]
+    buf = b"".join(speedy.frame(p) for p in payloads)
+    frames, rest = speedy.deframe(buf)
+    assert frames == payloads and rest == b""
+    # split mid-frame
+    frames1, rest1 = speedy.deframe(buf[:5])
+    assert frames1 == [] or frames1 == [b"aaa"]
+    frames2, rest2 = speedy.deframe(rest1 + buf[5:])
+    assert frames1 + frames2 == payloads and rest2 == b""
+
+
+def test_frame_length_guard():
+    bad = struct.pack(">I", speedy.MAX_FRAME_LEN + 1) + b"x"
+    with pytest.raises(speedy.SpeedyError):
+        speedy.deframe(bad)
+
+
+def test_decode_rejects_trailing_garbage():
+    data = speedy.encode_sync_message(Timestamp(5)) + b"\x00"
+    with pytest.raises(speedy.SpeedyError):
+        speedy.decode_sync_message(data)
