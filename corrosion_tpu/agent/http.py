@@ -162,6 +162,7 @@ def _make_handler(agent: "Agent"):
 
             with agent.storage._lock:
                 touched = apply_schema(agent.storage, sql)
+                agent._register_backfills()
             self._json(200, {"tables": touched})
 
         def _metrics(self):

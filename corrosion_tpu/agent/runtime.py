@@ -26,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import os
 import random
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -104,18 +105,25 @@ class Agent:
 
         self.metrics = Metrics()
         self._members_table()
-        if config.schema_sql:
-            apply_schema(self.storage, config.schema_sql)
         self.incarnation = 0
         self._seen: Dict[tuple, None] = {}
         self._acks: Dict[int, asyncio.Future] = {}
         self._suspects: Dict[bytes, float] = {}
         self._bcast_queue: asyncio.Queue = asyncio.Queue()
+        # guards the _loop-is-set check vs start()'s flush of deferred
+        # broadcasts (writes can come from any HTTP thread)
+        self._bcast_gate = threading.Lock()
+        self._pre_start_broadcasts: List[tuple] = []
         self._tasks: List[asyncio.Task] = []
         self._udp: Optional[asyncio.DatagramTransport] = None
         self._tcp: Optional[asyncio.AbstractServer] = None
         self._sync_sem: Optional[asyncio.Semaphore] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        if config.schema_sql:
+            apply_schema(self.storage, config.schema_sql)
+        # register any pending backfill versions — from this boot's schema
+        # apply OR left over from a crash before registration completed
+        self._register_backfills()
         self._rng = random.Random(int.from_bytes(self.actor_id[:4], "big"))
         self._http = None
         self.gossip_addr: Tuple[str, int] = (config.gossip_host, config.gossip_port)
@@ -131,7 +139,15 @@ class Agent:
     # ------------------------------------------------------------------
 
     async def start(self) -> None:
-        self._loop = asyncio.get_running_loop()
+        # publish the loop and drain deferred broadcasts atomically, so a
+        # concurrent writer either defers (and is flushed below) or sees
+        # the live loop — never a stranded append
+        with self._bcast_gate:
+            self._loop = asyncio.get_running_loop()
+            pending = self._pre_start_broadcasts
+            self._pre_start_broadcasts = []
+        for version, db_version, last_seq, ts in pending:
+            self._queue_local_broadcast(version, db_version, last_seq, ts)
         self._sync_sem = asyncio.Semaphore(self.config.max_sync_sessions)
         self._udp, _ = await self._loop.create_datagram_endpoint(
             lambda: _UdpProtocol(self),
@@ -368,35 +384,96 @@ class Agent:
     # local writes + broadcast
     # ------------------------------------------------------------------
 
+    def _register_backfills(self) -> None:
+        """Record as_crr-backfill versions in bookkeeping so pre-existing
+        rows replicate (sync serves them; see CrConn._backfill).
+
+        Transactional: bookkeeping rows persist atomically with deleting
+        the durable __corro_backfills records, all under the storage lock
+        — a crash at any point either leaves the records for the next
+        boot or has them fully registered.
+        """
+        with self.storage._lock:
+            pending = self.storage.peek_backfills()
+            if not pending:
+                return
+            booked = self.bookie.for_actor(self.actor_id)
+            regs = []
+            self.storage.conn.execute("BEGIN IMMEDIATE")
+            try:
+                last = booked.last()
+                for dbv, last_seq in pending:
+                    last += 1
+                    ts = self.clock.new_timestamp()
+                    self.bookie.persist_version(
+                        self.actor_id, last, dbv, last_seq, int(ts)
+                    )
+                    regs.append((last, dbv, last_seq, ts))
+                self.storage.clear_backfills()
+            except BaseException:
+                self.storage.conn.execute("ROLLBACK")
+                raise
+            self.storage.conn.execute("COMMIT")
+            for version, dbv, last_seq, ts in regs:
+                booked.apply_version(version, dbv, last_seq, ts)
+                self._queue_or_defer_broadcast(version, dbv, last_seq, ts)
+
     def execute_transaction(self, statements: Sequence) -> dict:
         """Run write statements in one tx; version + bookkeeping + queue
         the broadcast (``make_broadcastable_changes`` parity)."""
         results = []
         booked = self.bookie.for_actor(self.actor_id)
-        with self.storage.write_tx() as conn:
-            for stmt in statements:
-                if isinstance(stmt, str):
-                    sql, params = stmt, ()
+        # hold the storage lock across COMMIT *and* the in-memory bookie
+        # update: the version counter (booked.last()+1) must not be read
+        # by a second writer between our COMMIT and apply_version, and
+        # apply_version must not race generate_sync's locked snapshot
+        with self.storage._lock:
+            with self.storage.write_tx() as conn:
+                for stmt in statements:
+                    if isinstance(stmt, str):
+                        sql, params = stmt, ()
+                    else:
+                        sql, params = stmt[0], stmt[1] if len(stmt) > 1 else ()
+                    cur = conn.execute(sql, params)
+                    results.append({"rows_affected": cur.rowcount})
+                n_changes = self.storage._state("seq")
+                if n_changes > 0:
+                    version = booked.last() + 1
+                    db_version = self.storage._state("pending_db_version")
+                    ts = self.clock.new_timestamp()
+                    # persist inside the tx (atomic with the data); the
+                    # in-memory bookie commits only after COMMIT succeeds,
+                    # so a failed commit can't leave memory advertising a
+                    # version the DB never stored
+                    self.bookie.persist_version(
+                        self.actor_id, version, db_version,
+                        n_changes - 1, int(ts),
+                    )
                 else:
-                    sql, params = stmt[0], stmt[1] if len(stmt) > 1 else ()
-                cur = conn.execute(sql, params)
-                results.append({"rows_affected": cur.rowcount})
-            n_changes = self.storage._state("seq")
-            if n_changes > 0:
-                version = booked.last() + 1
-                db_version = self.storage._state("pending_db_version")
-                ts = self.clock.new_timestamp()
+                    version = None
+            if version is not None:
                 booked.apply_version(version, db_version, n_changes - 1, ts)
-                self.bookie.persist_version(
-                    self.actor_id, version, db_version, n_changes - 1, int(ts)
-                )
-            else:
-                version = None
-        if version is not None and self._loop is not None:
-            self._loop.call_soon_threadsafe(
-                self._queue_local_broadcast, version, db_version, n_changes - 1, ts
+        if version is not None:
+            self._queue_or_defer_broadcast(
+                version, db_version, n_changes - 1, ts
             )
         return {"results": results, "version": version}
+
+    def _queue_or_defer_broadcast(
+        self, version: int, db_version: int, last_seq: int, ts: Timestamp
+    ) -> None:
+        """Queue a local broadcast, or buffer it until start() when the
+        event loop isn't up yet (writes before start() must still gossip)."""
+        with self._bcast_gate:
+            if self._loop is None:
+                self._pre_start_broadcasts.append(
+                    (version, db_version, last_seq, ts)
+                )
+                return
+            loop = self._loop
+        loop.call_soon_threadsafe(
+            self._queue_local_broadcast, version, db_version, last_seq, ts
+        )
 
     def _queue_local_broadcast(
         self, version: int, db_version: int, last_seq: int, ts: Timestamp
@@ -545,6 +622,12 @@ class Agent:
     # ------------------------------------------------------------------
 
     def generate_sync(self) -> SyncStateV1:
+        # snapshot under the storage/bookie lock: RangeSet mutations are
+        # multi-step, so an unlocked reader could zip mismatched span lists
+        with self.storage._lock:
+            return self._generate_sync_locked()
+
+    def _generate_sync_locked(self) -> SyncStateV1:
         state = SyncStateV1(actor_id=ActorId(self.actor_id))
         for actor, bv in self.bookie.actors().items():
             last = bv.last()

@@ -133,6 +133,10 @@ class CrConn:
         c.execute(
             "CREATE TABLE IF NOT EXISTS __corro_crr_tables (name TEXT PRIMARY KEY)"
         )
+        c.execute(
+            "CREATE TABLE IF NOT EXISTS __corro_backfills "
+            "(db_version INTEGER PRIMARY KEY, last_seq INTEGER NOT NULL)"
+        )
         row = c.execute(
             "SELECT site_id FROM __corro_sites WHERE ordinal = 1"
         ).fetchone()
@@ -204,12 +208,25 @@ class CrConn:
             f'CREATE INDEX IF NOT EXISTS "{t}__corro_clock_dbv" '
             f'ON "{t}__corro_clock" (site_ordinal, db_version)'
         )
+        # `sentinel`: 1 when the row-level event must ship as a '-1'
+        # sentinel change (delete, resurrect, pk move, pk-only insert) —
+        # plain inserts of tables with cells carry the row via cell rows
+        # alone, matching cr-sqlite's clock contents exactly.
         c.execute(
             f'CREATE TABLE IF NOT EXISTS "{t}__corro_cl" ('
             " pk BLOB NOT NULL PRIMARY KEY, cl INTEGER NOT NULL,"
             " db_version INTEGER NOT NULL, seq INTEGER NOT NULL,"
-            " site_ordinal INTEGER NOT NULL)"
+            " site_ordinal INTEGER NOT NULL,"
+            " sentinel INTEGER NOT NULL DEFAULT 0)"
         )
+        have_cols = {
+            r[1] for r in c.execute(f'PRAGMA table_info("{t}__corro_cl")')
+        }
+        if "sentinel" not in have_cols:
+            c.execute(
+                f'ALTER TABLE "{t}__corro_cl" '
+                "ADD COLUMN sentinel INTEGER NOT NULL DEFAULT 0"
+            )
         c.execute(
             f'CREATE INDEX IF NOT EXISTS "{t}__corro_cl_dbv" '
             f'ON "{t}__corro_cl" (site_ordinal, db_version)'
@@ -217,6 +234,113 @@ class CrConn:
         self._create_triggers(info)
         c.execute("INSERT OR IGNORE INTO __corro_crr_tables VALUES (?)", (t,))
         self._tables[t] = info
+        self._backfill(info)
+
+    def _backfill(self, info: TableInfo) -> None:
+        """Stamp rows that predate as_crr (or a new column) into the clock
+        tables so they replicate.
+
+        Parity: cr-sqlite's ``crsql_as_crr`` backfills existing rows —
+        pinned by the golden probe: every pre-existing cell gets
+        col_version=1 stamped with one freshly allocated db_version and
+        sequential seqs.  Idempotent: only missing cl rows / clock cells
+        are filled, so re-running after ALTER TABLE ADD COLUMN backfills
+        just the new column.
+        """
+        t = info.name
+        d_pk = "corro_pack(" + ", ".join(f'd."{p}"' for p in info.pk_cols) + ")"
+        with self._lock:
+            missing_rows = [
+                bytes(r[0]) for r in self.conn.execute(
+                    f'SELECT {d_pk} FROM "{t}" d '
+                    f'LEFT JOIN "{t}__corro_cl" c ON c.pk = {d_pk} '
+                    "WHERE c.pk IS NULL"
+                )
+            ]
+            missing_cells = []  # (pk, cid)
+            for col in info.data_cols:
+                missing_cells.extend(
+                    (bytes(r[0]), col) for r in self.conn.execute(
+                        f'SELECT {d_pk} FROM "{t}" d '
+                        f'LEFT JOIN "{t}__corro_clock" k '
+                        f"ON k.pk = {d_pk} AND k.cid = ? "
+                        "WHERE k.pk IS NULL",
+                        (col,),
+                    )
+                )
+            if not missing_rows and not missing_cells:
+                return
+            self.conn.execute("BEGIN IMMEDIATE")
+            try:
+                pending = self._state("db_version") + 1
+                seq = 0
+                for pk in missing_rows:
+                    # pk-only rows replicate via sentinels (consume a seq
+                    # slot); cell-bearing rows ride their cells alone
+                    sentinel = 0 if info.data_cols else 1
+                    self.conn.execute(
+                        f'INSERT OR IGNORE INTO "{t}__corro_cl" '
+                        "(pk, cl, db_version, seq, site_ordinal, sentinel) "
+                        "VALUES (?, 1, ?, ?, 1, ?)",
+                        (pk, pending, seq if sentinel else 0, sentinel),
+                    )
+                    if sentinel:
+                        seq += 1
+                for pk, cid in missing_cells:
+                    self.conn.execute(
+                        f'INSERT OR IGNORE INTO "{t}__corro_clock" '
+                        "(pk, cid, col_version, db_version, seq, site_ordinal) "
+                        "VALUES (?, ?, 1, ?, ?, 1)",
+                        (pk, cid, pending, seq),
+                    )
+                    seq += 1
+                self._set_state("db_version", pending)
+                # durable pending-registration record: survives a crash
+                # between this COMMIT and the agent registering the
+                # version in its bookkeeping (drained transactionally)
+                self.conn.execute(
+                    "INSERT INTO __corro_backfills (db_version, last_seq) "
+                    "VALUES (?, ?)",
+                    (pending, seq - 1),
+                )
+            except BaseException:
+                self.conn.execute("ROLLBACK")
+                raise
+            self.conn.execute("COMMIT")
+
+    def drain_backfills(self) -> List[Tuple[int, int]]:
+        """(db_version, last_seq) pairs allocated by backfills and not yet
+        registered in bookkeeping.  Read-and-delete in one transaction;
+        the agent's caller registers them in the same critical section
+        (see Agent._register_backfills for the transactional variant)."""
+        with self._lock:
+            self.conn.execute("BEGIN IMMEDIATE")
+            try:
+                rows = [
+                    (r[0], r[1]) for r in self.conn.execute(
+                        "SELECT db_version, last_seq FROM __corro_backfills "
+                        "ORDER BY db_version"
+                    )
+                ]
+                self.conn.execute("DELETE FROM __corro_backfills")
+            except BaseException:
+                self.conn.execute("ROLLBACK")
+                raise
+            self.conn.execute("COMMIT")
+            return rows
+
+    def peek_backfills(self) -> List[Tuple[int, int]]:
+        with self._lock:
+            return [
+                (r[0], r[1]) for r in self.conn.execute(
+                    "SELECT db_version, last_seq FROM __corro_backfills "
+                    "ORDER BY db_version"
+                )
+            ]
+
+    def clear_backfills(self) -> None:
+        """Delete the pending-backfill records (inside the caller's tx)."""
+        self.conn.execute("DELETE FROM __corro_backfills")
 
     def _create_triggers(self, info: TableInfo) -> None:
         t = info.name
@@ -245,6 +369,60 @@ class CrConn:
             cell_upsert(new_pk, c, f' AND NEW."{c}" IS NOT OLD."{c}"')
             for c in info.data_cols
         )
+        cl_tbl = f'"{t}__corro_cl"'
+
+        # Sentinel lifecycle pinned against cr-sqlite's clock contents
+        # (tests/test_crsqlite_golden.py probes): a fresh insert of a
+        # table WITH cells creates a non-sentinel cl entry that consumes
+        # no seq slot (cells alone carry the row, seqs 0..n-1 exactly
+        # like the reference); deletes, resurrects, pk moves, and
+        # pk-only-table inserts produce sentinel entries that do consume
+        # a seq slot and ship as '-1' changes.
+        if info.data_cols:
+            ins_row = f"""
+  UPDATE __corro_state SET value = value + 1 WHERE key='seq'
+    AND EXISTS (SELECT 1 FROM {cl_tbl} WHERE pk = {new_pk} AND cl % 2 = 0);
+  UPDATE {cl_tbl} SET cl = cl + 1, db_version = {pending},
+      seq = {seq_now}, site_ordinal = 1, sentinel = 1
+    WHERE pk = {new_pk} AND cl % 2 = 0;
+  INSERT OR IGNORE INTO {cl_tbl}
+      (pk, cl, db_version, seq, site_ordinal, sentinel)
+    VALUES ({new_pk}, 1, {pending}, 0, 1, 0);"""
+        else:
+            ins_row = f"""
+  {bump_seq};
+  INSERT INTO {cl_tbl} (pk, cl, db_version, seq, site_ordinal, sentinel)
+    VALUES ({new_pk}, 1, {pending}, {seq_now}, 1, 1)
+    ON CONFLICT(pk) DO UPDATE SET
+      cl = CASE WHEN cl % 2 = 0 THEN cl + 1 ELSE cl END,
+      db_version = excluded.db_version,
+      seq = excluded.seq, site_ordinal = 1, sentinel = 1;"""
+
+        # Primary-key updates change the row's identity: the old pk gets a
+        # delete sentinel (even cl), the new pk an insert sentinel (odd
+        # cl), both in the current version, and existing cell clock rows
+        # are re-keyed in place keeping their original (db_version, seq)
+        # stamps — so a delta-only transfer of the new version carries
+        # just the sentinels (and heals fully via anti-entropy), exactly
+        # like the reference extension.
+        pk_moved = f"{new_pk} IS NOT {old_pk}"
+        pk_move = f"""
+  UPDATE __corro_state SET value = value + 1 WHERE key='seq' AND {pk_moved};
+  INSERT INTO {cl_tbl} (pk, cl, db_version, seq, site_ordinal, sentinel)
+    SELECT {old_pk}, 2, {pending}, {seq_now}, 1, 1 WHERE {pk_moved}
+    ON CONFLICT(pk) DO UPDATE SET
+      cl = CASE WHEN cl % 2 = 1 THEN cl + 1 ELSE cl END,
+      db_version = excluded.db_version,
+      seq = excluded.seq, site_ordinal = 1, sentinel = 1;
+  UPDATE __corro_state SET value = value + 1 WHERE key='seq' AND {pk_moved};
+  INSERT INTO {cl_tbl} (pk, cl, db_version, seq, site_ordinal, sentinel)
+    SELECT {new_pk}, 1, {pending}, {seq_now}, 1, 1 WHERE {pk_moved}
+    ON CONFLICT(pk) DO UPDATE SET
+      cl = CASE WHEN cl % 2 = 0 THEN cl + 1 ELSE cl END,
+      db_version = excluded.db_version,
+      seq = excluded.seq, site_ordinal = 1, sentinel = 1;
+  UPDATE OR REPLACE "{t}__corro_clock" SET pk = {new_pk}
+    WHERE pk = {old_pk} AND {pk_moved};"""
 
         self.conn.executescript(
             f"""
@@ -252,19 +430,14 @@ DROP TRIGGER IF EXISTS "{t}__corro_ins";
 CREATE TRIGGER "{t}__corro_ins" AFTER INSERT ON "{t}"
 WHEN {not_applying}
 BEGIN
-  {bump_seq};
-  INSERT INTO "{t}__corro_cl" (pk, cl, db_version, seq, site_ordinal)
-    VALUES ({new_pk}, 1, {pending}, {seq_now}, 1)
-    ON CONFLICT(pk) DO UPDATE SET
-      cl = CASE WHEN cl % 2 = 0 THEN cl + 1 ELSE cl END,
-      db_version = excluded.db_version,
-      seq = excluded.seq, site_ordinal = 1;
+  {ins_row}
   {ins_cells}
 END;
 DROP TRIGGER IF EXISTS "{t}__corro_upd";
 CREATE TRIGGER "{t}__corro_upd" AFTER UPDATE ON "{t}"
 WHEN {not_applying}
 BEGIN
+  {pk_move}
   {upd_cells}
 END;
 DROP TRIGGER IF EXISTS "{t}__corro_del";
@@ -272,12 +445,12 @@ CREATE TRIGGER "{t}__corro_del" AFTER DELETE ON "{t}"
 WHEN {not_applying}
 BEGIN
   {bump_seq};
-  INSERT INTO "{t}__corro_cl" (pk, cl, db_version, seq, site_ordinal)
-    VALUES ({old_pk}, 2, {pending}, {seq_now}, 1)
+  INSERT INTO {cl_tbl} (pk, cl, db_version, seq, site_ordinal, sentinel)
+    VALUES ({old_pk}, 2, {pending}, {seq_now}, 1, 1)
     ON CONFLICT(pk) DO UPDATE SET
       cl = CASE WHEN cl % 2 = 1 THEN cl + 1 ELSE cl END,
       db_version = excluded.db_version,
-      seq = excluded.seq, site_ordinal = 1;
+      seq = excluded.seq, site_ordinal = 1, sentinel = 1;
   DELETE FROM "{t}__corro_clock" WHERE pk = {old_pk};
 END;
 """
@@ -350,11 +523,15 @@ END;
             lo, hi = db_version_range
             out: List[Change] = []
             for t, info in self._tables.items():
-                # row-level causal-length rows (deletes/resurrects)
+                # row-level '-1' sentinel changes: exactly the cl entries
+                # flagged sentinel (deletes, resurrects, pk moves, pk-only
+                # inserts) — plain inserts of cell-bearing tables ride
+                # their cell rows alone, matching cr-sqlite's change
+                # streams (pinned in tests/test_crsqlite_golden.py).
                 for pk, cl, dbv, seq in self.conn.execute(
                     f'SELECT pk, cl, db_version, seq FROM "{t}__corro_cl" '
                     "WHERE site_ordinal=? AND db_version BETWEEN ? AND ? "
-                    "AND cl % 2 = 0",
+                    "AND sentinel = 1",
                     (ordinal, lo, hi),
                 ):
                     out.append(
@@ -370,6 +547,8 @@ END;
                             cl=cl,
                         )
                     )
+                if not info.data_cols:
+                    continue  # no cells to collect
                 # cell-level rows with current values, one JOIN per table:
                 # cl from the causal-length table, the live value picked out
                 # of the data row by a generated CASE over the column name
@@ -462,7 +641,9 @@ END;
             # row-level: delete (even cl) or bare resurrect marker
             if local_cl is not None and ch.cl <= local_cl[0]:
                 return 0
-            self._set_row_cl(t, ch.pk, ch.cl, ch.db_version, ch.seq, ordinal)
+            self._set_row_cl(
+                t, ch.pk, ch.cl, ch.db_version, ch.seq, ordinal, sentinel=1
+            )
             if ch.is_delete():
                 self._delete_row(info, ch.pk)
                 self.conn.execute(
@@ -532,14 +713,20 @@ END;
             f'SELECT cl FROM "{table}__corro_cl" WHERE pk=?', (pk,)
         ).fetchone()
 
-    def _set_row_cl(self, table, pk, cl, db_version, seq, ordinal) -> None:
+    def _set_row_cl(self, table, pk, cl, db_version, seq, ordinal,
+                    sentinel: int = 0) -> None:
+        # sentinel only ever upgrades: a row once shipped as a '-1'
+        # change keeps shipping its row-level state (cr-sqlite keeps the
+        # sentinel clock row alive the same way)
         self.conn.execute(
             f'INSERT INTO "{table}__corro_cl" '
-            "(pk, cl, db_version, seq, site_ordinal) VALUES (?, ?, ?, ?, ?) "
+            "(pk, cl, db_version, seq, site_ordinal, sentinel) "
+            "VALUES (?, ?, ?, ?, ?, ?) "
             "ON CONFLICT(pk) DO UPDATE SET cl=excluded.cl, "
             "db_version=excluded.db_version, seq=excluded.seq, "
-            "site_ordinal=excluded.site_ordinal",
-            (pk, cl, int(db_version), int(seq), ordinal),
+            "site_ordinal=excluded.site_ordinal, "
+            "sentinel=MAX(sentinel, excluded.sentinel)",
+            (pk, cl, int(db_version), int(seq), ordinal, sentinel),
         )
 
     def _reset_row(self, info: TableInfo, pk: bytes) -> None:

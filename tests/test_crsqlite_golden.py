@@ -31,11 +31,15 @@ pytestmark = pytest.mark.skipif(
 # `v` has no type name → no affinity: values keep their storage class, so
 # cross-type tie-breaks actually exercise cr-sqlite's type-enum ordering
 # (INTEGER > FLOAT > TEXT > BLOB > NULL) instead of being coerced first.
+# `bar` is PK-only: replication rides causal-length sentinel rows.
 SCHEMA = (
     "CREATE TABLE foo ("
     " id INTEGER NOT NULL PRIMARY KEY,"
-    " a TEXT, b INTEGER, c REAL, v)"
+    " a TEXT, b INTEGER, c REAL, v);"
+    "CREATE TABLE bar ("
+    " x INTEGER NOT NULL, y INTEGER NOT NULL, PRIMARY KEY (x, y))"
 )
+TABLES = ("foo", "bar")
 
 # Values spanning every SQLite storage class.
 VALUE_POOL = [
@@ -54,12 +58,14 @@ class DualCluster:
         for i in range(n):
             ref = CrsqliteRef(":memory:")
             ref.conn.executescript(SCHEMA)
-            ref.as_crr("foo")
+            for t in TABLES:
+                ref.as_crr(t)
             self.refs.append(ref)
 
             c = CrConn(str(tmp_path / f"mine_{i}.db"), site_id=ref.site_id)
             c.conn.executescript(SCHEMA)
-            c.as_crr("foo")
+            for t in TABLES:
+                c.as_crr(t)
             self.mine.append(c)
 
     def close(self):
@@ -81,15 +87,17 @@ class DualCluster:
 
     def assert_parity(self, label: str = ""):
         for idx, (r, m) in enumerate(zip(self.refs, self.mine)):
-            ref_rows = r.data("foo")
-            my_cols, my_raw = m.read_query("SELECT * FROM foo")
-            my_rows = sorted(
-                (tuple(row) for row in my_raw), key=_sort_key
-            )
-            assert my_rows == ref_rows, (
-                f"{label}: replica {idx} diverged from cr-sqlite:\n"
-                f"  crsqlite: {ref_rows}\n  ours:     {my_rows}"
-            )
+            for table in TABLES:
+                ref_rows = r.data(table)
+                my_cols, my_raw = m.read_query(f"SELECT * FROM {table}")
+                my_rows = sorted(
+                    (tuple(row) for row in my_raw), key=_sort_key
+                )
+                assert my_rows == ref_rows, (
+                    f"{label}: replica {idx} table {table} diverged from "
+                    f"cr-sqlite:\n"
+                    f"  crsqlite: {ref_rows}\n  ours:     {my_rows}"
+                )
 
     def live_pks(self, i: int):
         return {
@@ -245,4 +253,120 @@ def test_random_ops_convergence_parity(tmp_path, seed):
     base = cl.refs[0].data("foo")
     for idx in range(1, n):
         assert cl.refs[idx].data("foo") == base, "cr-sqlite cluster diverged"
+    cl.close()
+
+
+def test_pkonly_insert_delete_parity(tmp_path):
+    """PK-only tables replicate via '-1' sentinel rows (ADVICE round-1:
+    our engine used to generate invalid SQL for these)."""
+    cl = DualCluster(2, tmp_path)
+    cl.run(0, "INSERT INTO bar VALUES (1, 2)")
+    cl.run(0, "INSERT INTO bar VALUES (3, 4)")
+    cl.exchange(0, 1)
+    cl.assert_parity("pk-only insert")
+    assert cl.refs[1].data("bar") == [(1, 2), (3, 4)]
+    cl.run(1, "DELETE FROM bar WHERE x=1")
+    cl.exchange(1, 0)
+    cl.assert_parity("pk-only delete")
+    assert cl.refs[0].data("bar") == [(3, 4)]
+    # concurrent delete vs re-insert (resurrect) on a pk-only row
+    cl.run(0, "DELETE FROM bar WHERE x=3")
+    cl.run(1, "DELETE FROM bar WHERE x=3")
+    cl.run(1, "INSERT INTO bar VALUES (3, 4)")
+    cl.exchange(0, 1)
+    cl.exchange(1, 0)
+    cl.assert_parity("pk-only resurrect")
+    assert cl.refs[0].data("bar") == cl.refs[1].data("bar")
+    cl.close()
+
+
+def test_as_crr_backfill_parity(tmp_path):
+    """as_crr on a populated table must backfill clock entries so
+    pre-existing rows replicate (ADVICE round-1: ours silently never
+    replicated them)."""
+    ref = CrsqliteRef(":memory:")
+    ref.conn.executescript(SCHEMA)
+    ref.execute("INSERT INTO foo (id, a, b) VALUES (1, 'old', 10)")
+    ref.execute("INSERT INTO foo (id, a, b) VALUES (2, 'older', 20)")
+    for t in TABLES:
+        ref.as_crr(t)
+
+    mine = CrConn(str(tmp_path / "m.db"), site_id=ref.site_id)
+    mine.conn.executescript(SCHEMA)
+    mine.conn.execute("INSERT INTO foo (id, a, b) VALUES (1, 'old', 10)")
+    mine.conn.execute("INSERT INTO foo (id, a, b) VALUES (2, 'older', 20)")
+    for t in TABLES:
+        mine.as_crr(t)
+    assert mine.drain_backfills(), "backfill should allocate a version"
+
+    # fresh peers receive the backfilled rows through each engine's pipeline
+    peer_ref = CrsqliteRef(":memory:")
+    peer_ref.conn.executescript(SCHEMA)
+    for t in TABLES:
+        peer_ref.as_crr(t)
+    peer_ref.apply(ref.changes())
+
+    peer_mine = CrConn(str(tmp_path / "p.db"), site_id=peer_ref.site_id)
+    peer_mine.conn.executescript(SCHEMA)
+    for t in TABLES:
+        peer_mine.as_crr(t)
+    peer_mine.apply_changes(_my_all_changes(mine))
+
+    _, raw = peer_mine.read_query("SELECT * FROM foo")
+    got = sorted((tuple(r) for r in raw), key=_sort_key)
+    assert got == peer_ref.data("foo") == [
+        (1, "old", 10, None, None), (2, "older", 20, None, None)
+    ]
+    ref.close(); mine.close(); peer_ref.close(); peer_mine.close()
+
+
+def test_pk_update_parity(tmp_path):
+    """UPDATEs that change primary-key columns re-identify the row:
+    delete sentinel for the old pk, insert sentinel for the new pk,
+    cell clocks re-keyed in place (full-exchange converges; cr-sqlite's
+    own delta-only transfer diverges identically by design)."""
+    cl = DualCluster(2, tmp_path)
+    cl.run(0, "INSERT INTO foo (id, a, b) VALUES (1, 'x', 10)")
+    cl.exchange(0, 1)
+    cl.run(0, "UPDATE foo SET id=2 WHERE id=1")
+    cl.exchange(0, 1)
+    cl.exchange(1, 0)
+    cl.assert_parity("pk update")
+    assert cl.refs[0].data("foo") == cl.refs[1].data("foo")
+    # pk update combined with a data change in the same statement
+    cl.run(1, "UPDATE foo SET id=3, a='moved' WHERE id=2")
+    cl.exchange(1, 0)
+    cl.exchange(0, 1)
+    cl.assert_parity("pk+data update")
+    cl.close()
+
+
+def test_pkonly_pk_update_parity(tmp_path):
+    cl = DualCluster(2, tmp_path)
+    cl.run(0, "INSERT INTO bar VALUES (1, 2)")
+    cl.exchange(0, 1)
+    cl.run(0, "UPDATE bar SET y=3 WHERE x=1")
+    cl.exchange(0, 1)
+    cl.exchange(1, 0)
+    cl.assert_parity("pk-only pk update")
+    assert cl.refs[0].data("bar") == cl.refs[1].data("bar") == [(1, 3)]
+    cl.close()
+
+
+def test_change_stream_seq_alignment(tmp_path):
+    """The emitted change stream's (cid, col_version, cl, seq) tuples must
+    match cr-sqlite's exactly — fresh inserts number cells from seq 0,
+    deletes/resurrects consume a sentinel slot first."""
+    cl = DualCluster(1, tmp_path)
+    cl.run(0, "INSERT INTO foo (id, a, b) VALUES (1, 'x', 10)")
+    cl.run(0, "DELETE FROM foo WHERE id=1")
+    cl.run(0, "INSERT INTO foo (id, a) VALUES (1, 'z')")
+    ref_stream = [
+        (r[2], r[4], r[7], r[8]) for r in cl.refs[0].changes()
+    ]  # (cid, col_version, cl, seq) ordered by db_version, seq
+    my_stream = [
+        (c.cid, c.col_version, c.cl, int(c.seq))
+        for c in _my_all_changes(cl.mine[0])
+    ]
+    assert my_stream == ref_stream, (my_stream, ref_stream)
     cl.close()

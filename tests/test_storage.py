@@ -51,7 +51,10 @@ def test_local_write_creates_clock_rows(db):
     assert cids == ["name", "status"]
     assert all(int(ch.db_version) == 1 and ch.cl == 1 for ch in changes)
     seqs = sorted(int(ch.seq) for ch in changes)
-    assert seqs == [1, 2]  # seq 0 went to the causal-length row
+    # fresh inserts number cells from 0 (cr-sqlite alignment: the row's
+    # causal-length entry consumes no seq slot unless it ships as a
+    # sentinel — see tests/test_crsqlite_golden.py)
+    assert seqs == [0, 1]
 
     a.execute("INSERT INTO machines (id, name, status) VALUES (2, 'woof', 'created')")
     assert a.db_version() == 2
