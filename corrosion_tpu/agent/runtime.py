@@ -48,6 +48,7 @@ from corrosion_tpu.types import (
     Version,
 )
 from corrosion_tpu.types.change import ChunkedChanges, MAX_CHANGES_BYTE_SIZE
+from corrosion_tpu.utils.ranges import RangeSet
 
 
 @dataclass
@@ -114,6 +115,7 @@ class Agent:
         # broadcasts (writes can come from any HTTP thread)
         self._bcast_gate = threading.Lock()
         self._pre_start_broadcasts: List[tuple] = []
+        self._pre_start_cvs: List[ChangeV1] = []
         self._tasks: List[asyncio.Task] = []
         self._udp: Optional[asyncio.DatagramTransport] = None
         self._tcp: Optional[asyncio.AbstractServer] = None
@@ -146,8 +148,12 @@ class Agent:
             self._loop = asyncio.get_running_loop()
             pending = self._pre_start_broadcasts
             self._pre_start_broadcasts = []
+            pending_cvs = self._pre_start_cvs
+            self._pre_start_cvs = []
         for version, db_version, last_seq, ts in pending:
             self._queue_local_broadcast(version, db_version, last_seq, ts)
+        for cv in pending_cvs:
+            self._bcast_queue.put_nowait((cv, self.config.max_transmissions))
         self._sync_sem = asyncio.Semaphore(self.config.max_sync_sessions)
         self._udp, _ = await self._loop.create_datagram_endpoint(
             lambda: _UdpProtocol(self),
@@ -457,7 +463,77 @@ class Agent:
             self._queue_or_defer_broadcast(
                 version, db_version, n_changes - 1, ts
             )
+            self._compact_best_effort()
         return {"results": results, "version": version}
+
+    def _find_and_clear_overwritten(self) -> List[Tuple[int, int]]:
+        """Local compaction: versions whose change rows were all
+        overwritten become cleared ranges and gossip as empty changesets.
+
+        Parity: ``find_overwritten_versions`` + ``store_empty_changeset``
+        (agent.rs:1753-1812, change.rs:314-436) — runs after every local
+        write and remote apply; only the originating node clears its own
+        versions (impact triggers watch site_ordinal=1 rows only).
+        Returns the cleared (start, end) ranges.
+        """
+        cleared: List[Tuple[int, int]] = []
+        with self.storage._lock:
+            any_impacted, gone = self.storage.overwritten_local_db_versions()
+            if not any_impacted:
+                return []
+            booked = self.bookie.for_actor(self.actor_id)
+            gone_set = set(gone)
+            rs = RangeSet()
+            for v, (dbv, _seq) in booked.versions.items():
+                if dbv in gone_set:
+                    rs.insert(v, v)
+            ranges = rs.spans()
+            ts = self.clock.new_timestamp()
+            self.storage.conn.execute("BEGIN IMMEDIATE")
+            try:
+                self.storage.conn.execute(
+                    "DELETE FROM __corro_versions_impacted"
+                )
+                for s, e in ranges:
+                    self.bookie.persist_cleared(self.actor_id, s, e, int(ts))
+            except BaseException:
+                self.storage.conn.execute("ROLLBACK")
+                raise
+            self.storage.conn.execute("COMMIT")
+            for s, e in ranges:
+                booked.mark_cleared(s, e, ts)
+                cleared.append((s, e))
+        for s, e in cleared:
+            cv = ChangeV1(
+                actor_id=ActorId(self.actor_id),
+                changeset=Changeset.empty((Version(s), Version(e)), ts),
+            )
+            self._queue_or_defer_cv(cv)
+        if cleared:
+            self.metrics.counter(
+                "corro_compaction_cleared_versions_total",
+                sum(e - s + 1 for s, e in cleared),
+            )
+        return cleared
+
+    def _compact_best_effort(self) -> None:
+        """Post-commit compaction sweep on hot paths: the user's write is
+        already durable, so a sweep failure (e.g. busy DB) must not turn
+        a successful write into an error — maintenance retries it."""
+        try:
+            self._find_and_clear_overwritten()
+        except Exception:
+            self.metrics.counter("corro_compaction_sweep_errors_total")
+
+    def _queue_or_defer_cv(self, cv: ChangeV1) -> None:
+        with self._bcast_gate:
+            if self._loop is None:
+                self._pre_start_cvs.append(cv)
+                return
+            loop = self._loop
+        loop.call_soon_threadsafe(
+            self._bcast_queue.put_nowait, (cv, self.config.max_transmissions)
+        )
 
     def _queue_or_defer_broadcast(
         self, version: int, db_version: int, last_seq: int, ts: Timestamp
@@ -532,6 +608,9 @@ class Agent:
             except Exception:
                 pass
         news = self._process_changeset(cv)
+        if news and cv.changeset.is_full:
+            # a remote apply can overwrite our own rows' change entries
+            self._compact_best_effort()
         self.metrics.counter(
             "corro_changes_received_total",
             source=source.value,
@@ -647,10 +726,43 @@ class Agent:
                 state.last_cleared_ts = bv.last_cleared_ts
         return state
 
+    def _clear_buffered_meta(self, chunk: int = 1000) -> int:
+        """Delete buffered-change/seq bookkeeping rows for versions that
+        are now cleared, in bounded chunks (clear_buffered_meta_loop
+        parity, util.rs:425-480).  Returns rows deleted."""
+        deleted = 0
+        with self.storage._lock:
+            for actor, bv in self.bookie.actors().items():
+                for s, e in bv.cleared.spans():
+                    for table in ("__corro_seq_bookkeeping",
+                                  "__corro_buffered_changes"):
+                        while True:
+                            cur = self.storage.conn.execute(
+                                f"DELETE FROM {table} WHERE rowid IN ("
+                                f"SELECT rowid FROM {table} WHERE actor_id=? "
+                                "AND version BETWEEN ? AND ? LIMIT ?)",
+                                (actor, s, e, chunk),
+                            )
+                            deleted += cur.rowcount
+                            if cur.rowcount < chunk:
+                                break
+        if deleted:
+            self.metrics.counter(
+                "corro_buffered_meta_cleared_total", deleted
+            )
+        return deleted
+
     async def _maintenance_loop(self) -> None:
-        """WAL checkpoint + incremental vacuum (handlers.rs:394-534)."""
+        """WAL checkpoint + incremental vacuum + compaction leftovers +
+        buffered-meta clearing (handlers.rs:394-534, util.rs:425-480)."""
         while True:
             await asyncio.sleep(self.config.maintenance_interval)
+            try:
+                # crash-leftover impacted versions from before a restart
+                self._find_and_clear_overwritten()
+                self._clear_buffered_meta()
+            except Exception:
+                pass
             try:
                 with self.storage._lock:
                     (wal_pages, _) = self.storage.conn.execute(

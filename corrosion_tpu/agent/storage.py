@@ -137,6 +137,14 @@ class CrConn:
             "CREATE TABLE IF NOT EXISTS __corro_backfills "
             "(db_version INTEGER PRIMARY KEY, last_seq INTEGER NOT NULL)"
         )
+        # local versions whose clock rows were overwritten/deleted since
+        # the last compaction sweep (find_overwritten_versions parity,
+        # ref agent.rs:1753-1812; filled by the clock-change triggers)
+        c.execute(
+            "CREATE TABLE IF NOT EXISTS __corro_versions_impacted "
+            "(site_ordinal INTEGER NOT NULL, db_version INTEGER NOT NULL, "
+            " PRIMARY KEY (site_ordinal, db_version))"
+        )
         row = c.execute(
             "SELECT site_id FROM __corro_sites WHERE ordinal = 1"
         ).fetchone()
@@ -150,6 +158,9 @@ class CrConn:
     def _load_crr_tables(self) -> None:
         for (name,) in self.conn.execute("SELECT name FROM __corro_crr_tables"):
             self._tables[name] = self._introspect(name)
+            # idempotent: databases created before the compaction feature
+            # need the impact triggers installed on reopen
+            self._create_impact_triggers(name)
 
     def _introspect(self, table: str) -> TableInfo:
         info = self.conn.execute(f'PRAGMA table_info("{_ident(table)}")').fetchall()
@@ -232,9 +243,86 @@ class CrConn:
             f'ON "{t}__corro_cl" (site_ordinal, db_version)'
         )
         self._create_triggers(info)
+        self._create_impact_triggers(t)
         c.execute("INSERT OR IGNORE INTO __corro_crr_tables VALUES (?)", (t,))
         self._tables[t] = info
         self._backfill(info)
+
+    def _create_impact_triggers(self, t: str) -> None:
+        """Record local (site_ordinal=1) versions whose change rows get
+        overwritten or deleted, for compaction.
+
+        Parity: the reference's clock-change triggers
+        (``create_clock_change_trigger``, agent.rs:570-592) watch only
+        local rows; cl entries matter only when they ship as sentinels.
+        """
+        imp = ("INSERT INTO __corro_versions_impacted (site_ordinal, "
+               "db_version) VALUES (OLD.site_ordinal, OLD.db_version) "
+               "ON CONFLICT (site_ordinal, db_version) DO NOTHING;")
+        self.conn.executescript(f"""
+CREATE TRIGGER IF NOT EXISTS "{t}__corro_impact_clock_upd"
+AFTER UPDATE ON "{t}__corro_clock" FOR EACH ROW
+WHEN OLD.site_ordinal = 1 AND (OLD.site_ordinal != NEW.site_ordinal
+  OR OLD.db_version != NEW.db_version)
+BEGIN
+  {imp}
+END;
+CREATE TRIGGER IF NOT EXISTS "{t}__corro_impact_clock_del"
+AFTER DELETE ON "{t}__corro_clock" FOR EACH ROW
+WHEN OLD.site_ordinal = 1
+BEGIN
+  {imp}
+END;
+CREATE TRIGGER IF NOT EXISTS "{t}__corro_impact_cl_upd"
+AFTER UPDATE ON "{t}__corro_cl" FOR EACH ROW
+WHEN OLD.site_ordinal = 1 AND OLD.sentinel = 1
+  AND (OLD.site_ordinal != NEW.site_ordinal
+       OR OLD.db_version != NEW.db_version)
+BEGIN
+  {imp}
+END;
+CREATE TRIGGER IF NOT EXISTS "{t}__corro_impact_cl_del"
+AFTER DELETE ON "{t}__corro_cl" FOR EACH ROW
+WHEN OLD.site_ordinal = 1 AND OLD.sentinel = 1
+BEGIN
+  {imp}
+END;
+""")
+
+    def overwritten_local_db_versions(self) -> Tuple[bool, List[int]]:
+        """(any_impacted, gone): impacted local db_versions that no longer
+        have ANY change row (cell clock or sentinel cl) — fully
+        overwritten, compactable.  Read-only; the caller deletes
+        __corro_versions_impacted in its transaction
+        (``find_overwritten_versions`` parity)."""
+        with self._lock:
+            impacted = [
+                r[0] for r in self.conn.execute(
+                    "SELECT db_version FROM __corro_versions_impacted "
+                    "WHERE site_ordinal = 1"
+                )
+            ]
+            if not impacted:
+                return False, []
+            gone = []
+            for dbv in impacted:
+                exists = False
+                for t in self._tables:
+                    if self.conn.execute(
+                        f'SELECT 1 FROM "{t}__corro_clock" '
+                        "WHERE site_ordinal = 1 AND db_version = ? LIMIT 1",
+                        (dbv,),
+                    ).fetchone() or self.conn.execute(
+                        f'SELECT 1 FROM "{t}__corro_cl" '
+                        "WHERE site_ordinal = 1 AND sentinel = 1 "
+                        "AND db_version = ? LIMIT 1",
+                        (dbv,),
+                    ).fetchone():
+                        exists = True
+                        break
+                if not exists:
+                    gone.append(dbv)
+            return True, gone
 
     def _backfill(self, info: TableInfo) -> None:
         """Stamp rows that predate as_crr (or a new column) into the clock
@@ -404,7 +492,10 @@ class CrConn:
         # are re-keyed in place keeping their original (db_version, seq)
         # stamps — so a delta-only transfer of the new version carries
         # just the sentinels (and heals fully via anti-entropy), exactly
-        # like the reference extension.
+        # like the reference extension.  The re-key uses an explicit
+        # DELETE of conflicting target rows (NOT `UPDATE OR REPLACE`):
+        # REPLACE conflict-deletes skip AFTER DELETE triggers, which
+        # would lose the compaction impact record for the displaced rows.
         pk_moved = f"{new_pk} IS NOT {old_pk}"
         pk_move = f"""
   UPDATE __corro_state SET value = value + 1 WHERE key='seq' AND {pk_moved};
@@ -421,7 +512,8 @@ class CrConn:
       cl = CASE WHEN cl % 2 = 0 THEN cl + 1 ELSE cl END,
       db_version = excluded.db_version,
       seq = excluded.seq, site_ordinal = 1, sentinel = 1;
-  UPDATE OR REPLACE "{t}__corro_clock" SET pk = {new_pk}
+  DELETE FROM "{t}__corro_clock" WHERE pk = {new_pk} AND {pk_moved};
+  UPDATE "{t}__corro_clock" SET pk = {new_pk}
     WHERE pk = {old_pk} AND {pk_moved};"""
 
         self.conn.executescript(

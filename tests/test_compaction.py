@@ -1,0 +1,171 @@
+"""Local compaction: automatic bookkeeping clearing of overwritten
+versions.
+
+Port of the reference's ``test_automatic_bookkeeping_clearing``
+(corro-agent/src/agent/tests.rs:2187) plus the O(1)-history property the
+compaction exists for: after N overwrites of one row, bookkeeping holds
+one cleared range + one concrete version, and a fresh node receives O(1)
+versions' worth of changes via sync.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from corrosion_tpu.agent.runtime import Agent, AgentConfig
+from corrosion_tpu.agent.testing import TEST_SCHEMA, launch_test_agent, wait_for
+from corrosion_tpu.types import ActorId, ChangeSource, ChangeV1, Changeset
+from corrosion_tpu.types.base import CrsqlSeq, Version
+
+
+def _bookkeeping(agent):
+    return agent.storage.conn.execute(
+        "SELECT start_version, end_version, db_version "
+        "FROM __corro_bookkeeping WHERE actor_id=? ORDER BY start_version",
+        (agent.actor_id,),
+    ).fetchall()
+
+
+def _full_changeset(agent, version: int, db_version: int) -> ChangeV1:
+    changes = agent.storage.collect_changes((db_version, db_version))
+    last_seq = len(changes) - 1
+    return ChangeV1(
+        actor_id=ActorId(agent.actor_id),
+        changeset=Changeset.full(
+            Version(version), changes,
+            (CrsqlSeq(0), CrsqlSeq(last_seq)), CrsqlSeq(last_seq),
+            agent.clock.new_timestamp(),
+        ),
+    )
+
+
+def _offline_agent(tmp_path, name) -> Agent:
+    return Agent(AgentConfig(
+        db_path=str(tmp_path / f"{name}.db"), schema_sql=TEST_SCHEMA
+    ))
+
+
+def test_automatic_bookkeeping_clearing(tmp_path):
+    """Named twin of corro-agent/src/agent/tests.rs:2187."""
+    a1 = _offline_agent(tmp_path, "a1")
+    a2 = _offline_agent(tmp_path, "a2")
+
+    r = a1.execute_transaction(
+        [("INSERT INTO tests (id, text) VALUES (?, ?)",
+          (9001, "service-name"))]
+    )
+    assert r["version"] == 1
+    # one concrete version
+    assert _bookkeeping(a1) == [(1, None, 1)]
+
+    cv1 = _full_changeset(a1, 1, 1)
+    assert a2.handle_change(cv1, ChangeSource.BROADCAST)
+
+    # overwrite the whole row -> version 1 is fully overwritten locally
+    r = a1.execute_transaction(
+        [("INSERT OR REPLACE INTO tests (id, text) VALUES (?, ?)",
+          (9001, "service-name-overwrite"))]
+    )
+    assert r["version"] == 2
+    # version 1 became a cleared range; version 2 is concrete (tests.rs
+    # asserts exactly this bookkeeping shape)
+    assert _bookkeeping(a1) == [(1, 1, None), (2, None, 2)]
+
+    # the receiving node does NOT clear: only the originating node
+    # compacts its own versions (impact triggers watch local rows only)
+    cv2 = _full_changeset(a1, 2, 2)
+    a1_rows_in_a2 = a2.bookie.for_actor(a1.actor_id)
+    assert a2.handle_change(cv2, ChangeSource.BROADCAST)
+    a2_bk = a2.storage.conn.execute(
+        "SELECT start_version, end_version FROM __corro_bookkeeping "
+        "WHERE actor_id=? ORDER BY start_version",
+        (a1.actor_id,),
+    ).fetchall()
+    assert a2_bk == [(1, None), (2, None)]
+    assert a1_rows_in_a2.contains_version(1)
+    a1.storage.close()
+    a2.storage.close()
+
+
+def test_overwrites_collapse_to_one_cleared_range(tmp_path):
+    a1 = _offline_agent(tmp_path, "a1")
+    n = 20
+    for i in range(n):
+        a1.execute_transaction(
+            [("INSERT OR REPLACE INTO tests (id, text) VALUES (1, ?)",
+              (f"value-{i}",))]
+        )
+    # all overwritten versions merged into ONE cleared range + the live one
+    assert _bookkeeping(a1) == [(1, n - 1, None), (n, None, n)]
+    booked = a1.bookie.for_actor(a1.actor_id)
+    assert booked.cleared.spans() == [(1, n - 1)]
+    # cleared ranges still count as "contained" for dedupe/sync algebra
+    assert booked.contains_version(5)
+    a1.storage.close()
+
+
+def test_empty_changeset_gossips_to_peers(tmp_path):
+    """The originating node's cleared range reaches peers as a
+    Changeset::Empty and clears their bookkeeping for that actor."""
+    a1 = _offline_agent(tmp_path, "a1")
+    a2 = _offline_agent(tmp_path, "a2")
+    a1.execute_transaction(
+        [("INSERT INTO tests (id, text) VALUES (1, 'v1')",)]
+    )
+    assert a2.handle_change(_full_changeset(a1, 1, 1), ChangeSource.BROADCAST)
+    a1.execute_transaction(
+        [("INSERT OR REPLACE INTO tests (id, text) VALUES (1, 'v2')",)]
+    )
+    # simulate gossip of the empty changeset a1 produced
+    booked1 = a1.bookie.for_actor(a1.actor_id)
+    assert booked1.cleared.spans() == [(1, 1)]
+    empty = ChangeV1(
+        actor_id=ActorId(a1.actor_id),
+        changeset=Changeset.empty(
+            (Version(1), Version(1)), a1.clock.new_timestamp()
+        ),
+    )
+    assert a2.handle_change(empty, ChangeSource.BROADCAST)
+    a2_view = a2.bookie.for_actor(a1.actor_id)
+    assert a2_view.cleared.contains(1)
+    a1.storage.close()
+    a2.storage.close()
+
+
+def test_fresh_node_sync_transfers_o1_versions(tmp_path):
+    """End-to-end: after N overwrites, a freshly bootstrapped node
+    converges having received only O(1) versions' changes via sync."""
+    async def main():
+        (tmp_path / "n1").mkdir()
+        (tmp_path / "n2").mkdir()
+        a1 = await launch_test_agent(tmpdir=str(tmp_path / "n1"))
+        n = 30
+        for i in range(n):
+            a1.execute_transaction(
+                [("INSERT OR REPLACE INTO tests (id, text) VALUES (1, ?)",
+                  (f"v{i}",))]
+            )
+        assert _bookkeeping(a1) == [(1, n - 1, None), (n, None, n)]
+        a2 = await launch_test_agent(
+            bootstrap=[f"{a1.gossip_addr[0]}:{a1.gossip_addr[1]}"],
+            tmpdir=str(tmp_path / "n2"),
+        )
+
+        def converged():
+            _, rows = a2.storage.read_query(
+                "SELECT text FROM tests WHERE id = 1"
+            )
+            return rows and rows[0][0] == f"v{n - 1}"
+
+        await wait_for(converged, timeout=20)
+        # a2 knows the cleared range (no gaps to request) and received
+        # only the live version's changes
+        a2_view = a2.bookie.for_actor(a1.actor_id)
+        assert a2_view.cleared.contains_span(1, n - 1)
+        assert a2_view.needed_spans() == []
+        received = a2.metrics.get_counter("corro_sync_changes_received_total")
+        assert received <= 4, f"expected O(1) changes, got {received}"
+        await a1.stop()
+        await a2.stop()
+
+    asyncio.run(main())
