@@ -136,12 +136,17 @@ class Members:
     def ring0(self) -> List[Member]:
         return [m for m in self.alive() if m.is_ring0]
 
-    def sample(self, k: int, rng: Optional[random.Random] = None) -> List[Member]:
-        """Broadcast fanout choice: ring0 first, then a global sample."""
+    def sample(self, k: int, rng: Optional[random.Random] = None,
+               ring0_first: bool = True) -> List[Member]:
+        """Broadcast fanout choice: ring0 first (for our own changes, the
+        reference prioritizes the <6 ms RTT tier — broadcast/mod.rs:586-643),
+        else a uniform global sample."""
         rng = rng or random
         alive = self.alive()
         if len(alive) <= k:
             return alive
+        if not ring0_first:
+            return rng.sample(alive, k)
         ring0 = [m for m in alive if m.is_ring0]
         rest = [m for m in alive if not m.is_ring0]
         take0 = min(len(ring0), max(1, k // 2)) if ring0 else 0

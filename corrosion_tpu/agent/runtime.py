@@ -48,6 +48,7 @@ from corrosion_tpu.types import (
     Version,
 )
 from corrosion_tpu.types.change import ChunkedChanges, MAX_CHANGES_BYTE_SIZE
+from corrosion_tpu.agent.transport import MAX_UDP_PAYLOAD
 from corrosion_tpu.utils.ranges import RangeSet
 
 
@@ -74,6 +75,16 @@ class AgentConfig:
     sync_peers: int = 3
     max_sync_sessions: int = 3
     seen_cache_size: int = 65536
+    # ingest pipeline (handlers.rs:742-956 / config.rs:10-45 defaults)
+    processing_queue_len: int = 20_000  # bounded, drop-oldest
+    apply_queue_len: int = 50           # cost-based batch target
+    apply_queue_timeout: float = 0.01   # batching tick
+    max_concurrent_applies: int = 5     # apply worker threads
+    # broadcast buffering + governor (broadcast/mod.rs:399-458,745-801)
+    bcast_buffer_cutoff: int = 64 * 1024
+    bcast_flush_interval: float = 0.5
+    bcast_rate_limit: float = 10 * 1024 * 1024  # bytes/s
+    bcast_max_pending: int = 500        # drop-oldest-most-sent beyond this
     api_authz: Optional[str] = None
     subs_enabled: bool = True
     subs_path: Optional[str] = None
@@ -116,6 +127,15 @@ class Agent:
         self._bcast_gate = threading.Lock()
         self._pre_start_broadcasts: List[tuple] = []
         self._pre_start_cvs: List[ChangeV1] = []
+        # bounded ingest queue (processing_queue_len, drop-oldest) drained
+        # by the change loop in cost-based batches off the event loop
+        from collections import deque
+
+        self._ingest: "deque" = deque()
+        self._ingest_event: Optional[asyncio.Event] = None
+        self._apply_pool = None  # ThreadPoolExecutor, created on start
+        self.transport = None  # Transport, created on start
+        self._conn_tasks: set = set()  # live inbound connection handlers
         self._tasks: List[asyncio.Task] = []
         self._udp: Optional[asyncio.DatagramTransport] = None
         self._tcp: Optional[asyncio.AbstractServer] = None
@@ -155,13 +175,25 @@ class Agent:
         for cv in pending_cvs:
             self._bcast_queue.put_nowait((cv, self.config.max_transmissions))
         self._sync_sem = asyncio.Semaphore(self.config.max_sync_sessions)
+        self._ingest_event = asyncio.Event()
+        from concurrent.futures import ThreadPoolExecutor
+
+        from corrosion_tpu.agent.transport import Transport
+
+        self._apply_pool = ThreadPoolExecutor(
+            max_workers=self.config.max_concurrent_applies,
+            thread_name_prefix="corro-apply",
+        )
+        self.transport = Transport(
+            metrics=self.metrics, on_rtt=self._record_rtt
+        )
         self._udp, _ = await self._loop.create_datagram_endpoint(
             lambda: _UdpProtocol(self),
             local_addr=(self.config.gossip_host, self.config.gossip_port),
         )
         self.gossip_addr = self._udp.get_extra_info("sockname")[:2]
         self._tcp = await asyncio.start_server(
-            self._serve_sync, self.config.gossip_host, self.gossip_addr[1]
+            self._serve_tcp, self.config.gossip_host, self.gossip_addr[1]
         )
         self._load_members()
         if self.config.subs_enabled:
@@ -173,6 +205,7 @@ class Agent:
             asyncio.create_task(self._probe_loop()),
             asyncio.create_task(self._suspect_reaper()),
             asyncio.create_task(self._broadcast_loop()),
+            asyncio.create_task(self._change_loop()),
             asyncio.create_task(self._sync_loop()),
             asyncio.create_task(self._maintenance_loop()),
         ]
@@ -198,6 +231,14 @@ class Agent:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks = []
+        if self.transport is not None:
+            self.transport.close()
+        for t in list(self._conn_tasks):
+            t.cancel()
+        if self._conn_tasks:
+            await asyncio.gather(*self._conn_tasks, return_exceptions=True)
+        if self._apply_pool is not None:
+            self._apply_pool.shutdown(wait=False)
         if self._udp:
             self._udp.close()
         if self._tcp:
@@ -287,7 +328,13 @@ class Agent:
 
     def _send_udp(self, addr: Tuple[str, int], msg: dict) -> None:
         if self._udp:
-            self._udp.sendto(wire.encode_datagram(msg), tuple(addr))
+            data = wire.encode_datagram(msg)
+            if len(data) > MAX_UDP_PAYLOAD:
+                # foca caps SWIM packets at 1178 B (broadcast/mod.rs:943);
+                # anything bigger belongs on a uni-stream
+                self.metrics.counter("corro_udp_oversize_dropped_total")
+                return
+            self._udp.sendto(data, tuple(addr))
 
     async def _announce_loop(self) -> None:
         delay = 0.1
@@ -564,20 +611,170 @@ class Agent:
                 self.on_change(cv)
             self._bcast_queue.put_nowait((cv, self.config.max_transmissions))
 
+    def _record_rtt(self, addr, rtt_s: float) -> None:
+        for m in self.members.alive():
+            if tuple(m.addr) == tuple(addr):
+                self.members.record_rtt(m.actor_id, rtt_s * 1000.0)
+                break
+
     async def _broadcast_loop(self) -> None:
-        while True:
-            cv, remaining = await self._bcast_queue.get()
-            targets = self.members.sample(self.config.fanout, self._rng)
-            msg = {"k": "change", "cv": wire.change_v1_to_dict(cv)}
-            for m in targets:
-                self._send_udp(m.addr, msg)
-            self.metrics.counter("corro_broadcast_sent_total", len(targets))
-            if remaining > 1:
-                self._loop.call_later(
-                    self.config.rebroadcast_delay,
-                    self._bcast_queue.put_nowait,
-                    (cv, remaining - 1),
+        """Buffered, rate-limited dissemination over uni-streams.
+
+        Parity (broadcast/mod.rs:399-801): payloads accumulate until the
+        64 KiB cutoff or the flush tick; sends ride cached TCP
+        uni-streams under the 10 MiB/s governor; retransmissions requeue
+        with a send-count-scaled backoff; when the pending set overflows
+        the most-transmitted payloads are dropped first.
+        """
+        from corrosion_tpu.agent.transport import TokenBucket
+
+        cfg = self.config
+        bucket = TokenBucket(cfg.bcast_rate_limit)
+        pending: List[tuple] = []  # (due_time, frame, cv, remaining)
+        buffer: List[tuple] = []  # (frame, cv, remaining)
+        buf_bytes = 0
+        last_flush = time.monotonic()
+
+        async def flush():
+            nonlocal buffer, buf_bytes, last_flush
+            batch, buffer, buf_bytes = buffer, [], 0
+            last_flush = time.monotonic()
+            if not batch:
+                return
+            # per-destination frame groups: each payload picks its own
+            # fanout targets (ring0-first for our own changes)
+            by_dest: Dict[Tuple[str, int], List[bytes]] = {}
+            sends = 0
+            for frame, cv, remaining in batch:
+                local = cv.actor_id.bytes == self.actor_id
+                targets = self.members.sample(
+                    cfg.fanout, self._rng, ring0_first=local
                 )
+                for m in targets:
+                    by_dest.setdefault(tuple(m.addr), []).append(frame)
+                    sends += 1
+                if remaining > 1:
+                    due = time.monotonic() + cfg.rebroadcast_delay * (
+                        cfg.max_transmissions - remaining + 1
+                    )
+                    pending.append((due, frame, cv, remaining - 1))
+            if sends:
+                self.metrics.counter("corro_broadcast_sent_total", sends)
+            for dest, frames in by_dest.items():
+                blob = b"".join(frames)
+                await bucket.consume(len(blob))
+                ok = await self.transport.send_uni(
+                    dest, blob, header=wire.encode_msg({"k": "uni"})
+                )
+                if not ok:
+                    self.metrics.counter("corro_broadcast_send_failures_total")
+            # overflow: drop the payloads that were transmitted the most
+            if len(pending) > cfg.bcast_max_pending:
+                pending.sort(key=lambda p: p[3], reverse=True)
+                dropped = len(pending) - cfg.bcast_max_pending
+                del pending[:dropped]
+                self.metrics.counter(
+                    "corro_broadcast_pending_dropped_total", dropped
+                )
+
+        while True:
+            now = time.monotonic()
+            # requeued retransmissions that are due
+            due_now = [p for p in pending if p[0] <= now]
+            if due_now:
+                pending[:] = [p for p in pending if p[0] > now]
+                for _, frame, cv, remaining in due_now:
+                    buffer.append((frame, cv, remaining))
+                    buf_bytes += len(frame)
+            timeout = max(
+                0.0, cfg.bcast_flush_interval - (now - last_flush)
+            )
+            try:
+                cv, remaining = await asyncio.wait_for(
+                    self._bcast_queue.get(), timeout=max(timeout, 0.001)
+                )
+                frame = wire.encode_msg(
+                    {"k": "change", "cv": wire.change_v1_to_dict(cv)}
+                )
+                buffer.append((frame, cv, remaining))
+                buf_bytes += len(frame)
+            except asyncio.TimeoutError:
+                pass
+            if buf_bytes >= cfg.bcast_buffer_cutoff or (
+                buffer
+                and time.monotonic() - last_flush >= cfg.bcast_flush_interval
+            ):
+                await flush()
+
+    # ------------------------------------------------------------------
+    # ingest pipeline (handle_changes parity: bounded queue, batching,
+    # apply workers off the event loop)
+    # ------------------------------------------------------------------
+
+    def enqueue_change(self, cv: ChangeV1, source: ChangeSource) -> None:
+        """Queue an incoming changeset; oldest entries drop on overflow
+        (handlers.rs:904-923 drop-oldest policy)."""
+        if len(self._ingest) >= self.config.processing_queue_len:
+            self._ingest.popleft()
+            self.metrics.counter("corro_changes_dropped_total")
+        self._ingest.append((cv, source))
+        if source is ChangeSource.SYNC:
+            n = len(cv.changeset.changes) if cv.changeset.is_full else 0
+            self.metrics.counter("corro_sync_changes_received_total", n)
+        if self._ingest_event is not None:
+            self._ingest_event.set()
+
+    async def _change_loop(self) -> None:
+        cfg = self.config
+        while True:
+            if not self._ingest:
+                self._ingest_event.clear()
+                await self._ingest_event.wait()
+            # cost-based batch: drain until the summed change count hits
+            # apply_queue_len or a short tick passes (handlers.rs:755)
+            batch: List[tuple] = []
+            cost = 0
+            deadline = self._loop.time() + cfg.apply_queue_timeout
+            while cost < cfg.apply_queue_len:
+                if not self._ingest:
+                    remaining = deadline - self._loop.time()
+                    if remaining <= 0 or batch:
+                        break
+                    try:
+                        await asyncio.wait_for(
+                            self._ingest_event.wait(), timeout=remaining
+                        )
+                    except asyncio.TimeoutError:
+                        break
+                    continue
+                cv, source = self._ingest.popleft()
+                batch.append((cv, source))
+                cost += max(
+                    1,
+                    len(cv.changeset.changes) if cv.changeset.is_full else 1,
+                )
+            if not batch:
+                continue
+            results = await self._loop.run_in_executor(
+                self._apply_pool, self._apply_batch, batch
+            )
+            for cv, source, news in results:
+                if news and source is ChangeSource.BROADCAST:
+                    self._bcast_queue.put_nowait(
+                        (cv, self.config.max_transmissions)
+                    )
+
+    def _apply_batch(self, batch: List[tuple]) -> List[tuple]:
+        """Apply a batch on a worker thread; returns (cv, source, news)."""
+        out = []
+        for cv, source in batch:
+            try:
+                news = self.handle_change(cv, source, rebroadcast=False)
+            except Exception:
+                self.metrics.counter("corro_changes_apply_errors_total")
+                news = False
+            out.append((cv, source, news))
+        return out
 
     # ------------------------------------------------------------------
     # change ingestion (handle_changes parity)
@@ -591,8 +788,13 @@ class Agent:
             return (cv.actor_id.bytes, "empty", cs.versions)
         return (cv.actor_id.bytes, "empty_set", cs.ranges)
 
-    def handle_change(self, cv: ChangeV1, source: ChangeSource) -> bool:
-        """Process one incoming changeset; returns True if it was news."""
+    def handle_change(self, cv: ChangeV1, source: ChangeSource,
+                      rebroadcast: bool = True) -> bool:
+        """Process one incoming changeset; returns True if it was news.
+
+        ``rebroadcast=False`` when called from the change loop's worker
+        thread — the loop requeues news itself on the event loop.
+        """
         if cv.actor_id.bytes == self.actor_id:
             return False
         key = self._seen_key(cv)
@@ -616,7 +818,8 @@ class Agent:
             source=source.value,
             news=str(news).lower(),
         )
-        if news and source is ChangeSource.BROADCAST and self._loop:
+        if (rebroadcast and news and source is ChangeSource.BROADCAST
+                and self._loop):
             self._bcast_queue.put_nowait((cv, self.config.max_transmissions))
         if news and self.on_change is not None:
             self.on_change(cv)
@@ -853,21 +1056,80 @@ class Agent:
                             done = True
                     elif kind == "sync_change":
                         cv = wire.change_v1_from_dict(msg["cv"])
-                        if self.handle_change(cv, ChangeSource.SYNC):
-                            count += 1
+                        self.enqueue_change(cv, ChangeSource.SYNC)
+                        count += 1
                     elif kind == "sync_done":
                         done = True
             self.members.update_sync_ts(m.actor_id, time.time())
             self.metrics.counter("corro_sync_client_rounds_total")
-            self.metrics.counter("corro_sync_changes_received_total", count)
+            # per-change accounting happens at enqueue_change
             return count
         except (asyncio.TimeoutError, OSError, ConnectionError):
             return count
         finally:
             writer.close()
 
+    async def _serve_tcp(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        """Dispatch an inbound TCP connection: a `uni` header frame means
+        a broadcast uni-stream; anything else is a sync session (the TCP
+        analogue of QUIC accept_uni/accept_bi)."""
+        task = asyncio.current_task()
+        self._conn_tasks.add(task)
+        try:
+            frames = wire.FrameReader()
+            first: List[dict] = []
+            try:
+                while not first:
+                    data = await asyncio.wait_for(
+                        reader.read(65536), timeout=10.0
+                    )
+                    if not data:
+                        writer.close()
+                        return
+                    first = frames.feed(data)
+            except (asyncio.TimeoutError, OSError, ConnectionError,
+                    ValueError):
+                writer.close()
+                return
+            if first[0].get("k") == "uni":
+                await self._serve_uni(reader, writer, frames, first[1:])
+            else:
+                await self._serve_sync(reader, writer, frames, first)
+        except asyncio.CancelledError:
+            writer.close()
+            raise
+        finally:
+            self._conn_tasks.discard(task)
+
+    async def _serve_uni(self, reader, writer, frames, backlog) -> None:
+        """Long-lived inbound broadcast stream: change frames → ingest."""
+        def ingest(msgs):
+            for msg in msgs:
+                if msg.get("k") != "change":
+                    continue
+                try:
+                    cv = wire.change_v1_from_dict(msg["cv"])
+                except (KeyError, ValueError, TypeError):
+                    continue
+                self.enqueue_change(cv, ChangeSource.BROADCAST)
+
+        ingest(backlog)
+        try:
+            while True:
+                data = await reader.read(65536)
+                if not data:
+                    return
+                ingest(frames.feed(data))
+        except (OSError, ConnectionError, ValueError):
+            return
+        finally:
+            writer.close()
+
     async def _serve_sync(self, reader: asyncio.StreamReader,
-                          writer: asyncio.StreamWriter) -> None:
+                          writer: asyncio.StreamWriter,
+                          frames: Optional[wire.FrameReader] = None,
+                          backlog: Optional[List[dict]] = None) -> None:
         if self._sync_sem.locked():
             writer.write(wire.encode_msg({"k": "sync_reject", "reason": "busy"}))
             await writer.drain()
@@ -875,13 +1137,21 @@ class Agent:
             return
         async with self._sync_sem:
             try:
-                frames = wire.FrameReader()
+                if frames is None:
+                    frames = wire.FrameReader()
+                queued: List[dict] = list(backlog or [])
                 their_state: Optional[SyncStateV1] = None
                 while True:
-                    data = await asyncio.wait_for(reader.read(65536), timeout=10.0)
-                    if not data:
-                        return
-                    for msg in frames.feed(data):
+                    if queued:
+                        msgs, queued = queued, []
+                    else:
+                        data = await asyncio.wait_for(
+                            reader.read(65536), timeout=10.0
+                        )
+                        if not data:
+                            return
+                        msgs = frames.feed(data)
+                    for msg in msgs:
                         kind = msg.get("k")
                         if kind == "sync_start":
                             if msg.get("cluster", 0) != self.config.cluster_id:
@@ -1056,11 +1326,13 @@ class _UdpProtocol(asyncio.DatagramProtocol):
                 {"k": "ack", "n": msg["n"], "pb": a._piggyback()},
             )
         elif kind == "change":
+            # legacy datagram path (changesets normally ride uni-streams
+            # now); still accepted, routed through the bounded queue
             try:
                 cv = wire.change_v1_from_dict(msg["cv"])
             except (KeyError, ValueError):
                 return
-            a.handle_change(cv, ChangeSource.BROADCAST)
+            a.enqueue_change(cv, ChangeSource.BROADCAST)
 
 
 # ---------------------------------------------------------------------------

@@ -44,6 +44,7 @@ async def launch_test_agent(
         rebroadcast_delay=0.05,
         sync_interval_min=0.15,
         sync_interval_max=0.4,
+        bcast_flush_interval=0.02,
     )
     kwargs.update(overrides)
     cfg = AgentConfig(

@@ -1,0 +1,145 @@
+"""Outbound transport: datagrams for SWIM, cached uni-streams for
+changesets, bi-streams for sync.
+
+Parity: the reference multiplexes three channel classes over one QUIC
+connection (``crates/corro-agent/src/transport.rs``, ``api/peer.rs:97-342``):
+unreliable datagrams for SWIM packets (≤1178 B, foca's max packet),
+uni-directional streams for broadcast changesets, bi-directional streams
+for sync sessions — with a connection cache keyed by address, a liveness
+test + single retry, and RTT samples pushed into the member rings.
+
+Ours maps datagrams onto the agent's UDP endpoint (with the same 1178 B
+hard cap — oversize SWIM payloads are a bug, not a fragmentation
+exercise) and uni/bi streams onto TCP connections to the peer's gossip
+port.  Uni connections are cached and reused; a dead cached connection
+is dropped and reopened once (transport.rs:213-233 retry semantics).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Tuple
+
+Addr = Tuple[str, int]
+
+# foca's max packet size (broadcast/mod.rs:943); SWIM messages must fit
+MAX_UDP_PAYLOAD = 1178
+
+
+class TokenBucket:
+    """Byte-rate limiter (the 10 MiB/s broadcast governor,
+    broadcast/mod.rs:455-458)."""
+
+    def __init__(self, rate: float, burst: Optional[float] = None):
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None else rate)
+        self._tokens = self.burst
+        self._last = time.monotonic()
+
+    def _refill(self) -> None:
+        now = time.monotonic()
+        self._tokens = min(
+            self.burst, self._tokens + (now - self._last) * self.rate
+        )
+        self._last = now
+
+    async def consume(self, n: float) -> None:
+        while True:
+            self._refill()
+            if self._tokens >= n:
+                self._tokens -= n
+                return
+            need = (n - self._tokens) / self.rate
+            await asyncio.sleep(min(need, 1.0))
+
+
+class UniConnection:
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self.lock = asyncio.Lock()
+
+    def close(self) -> None:
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+class Transport:
+    """Connection-caching sender.  All methods are loop-affine."""
+
+    def __init__(self, metrics=None, connect_timeout: float = 2.0,
+                 on_rtt=None):
+        self._uni: Dict[Addr, UniConnection] = {}
+        self.metrics = metrics
+        self.connect_timeout = connect_timeout
+        self.on_rtt = on_rtt  # callback(addr, rtt_seconds)
+
+    async def _open(self, addr: Addr, header: bytes) -> UniConnection:
+        t0 = time.monotonic()
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(addr[0], addr[1]),
+            timeout=self.connect_timeout,
+        )
+        rtt = time.monotonic() - t0
+        if self.on_rtt is not None:
+            self.on_rtt(addr, rtt)
+        if self.metrics is not None:
+            self.metrics.histogram("corro_transport_connect_seconds", rtt)
+        writer.write(header)
+        await writer.drain()
+        return UniConnection(reader, writer)
+
+    async def send_uni(self, addr: Addr, frames: bytes,
+                       header: bytes) -> bool:
+        """Write pre-framed bytes on the cached uni connection to addr;
+        reopen once if the cached connection is dead."""
+        for attempt in (0, 1):
+            conn = self._uni.get(addr)
+            try:
+                if conn is None:
+                    conn = await self._open(addr, header)
+                    self._uni[addr] = conn
+                async with conn.lock:
+                    conn.writer.write(frames)
+                    await conn.writer.drain()
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        "corro_transport_uni_bytes_total", len(frames)
+                    )
+                return True
+            except (OSError, ConnectionError, asyncio.TimeoutError):
+                if addr in self._uni:
+                    self._uni.pop(addr).close()
+                if attempt == 1:
+                    if self.metrics is not None:
+                        self.metrics.counter(
+                            "corro_transport_uni_failures_total"
+                        )
+                    return False
+        return False
+
+    async def open_bi(self, addr: Addr):
+        """Fresh (reader, writer) for a sync session — bi-streams are
+        per-session like the reference's open_bi."""
+        t0 = time.monotonic()
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(addr[0], addr[1]),
+            timeout=self.connect_timeout,
+        )
+        if self.on_rtt is not None:
+            self.on_rtt(addr, time.monotonic() - t0)
+        return reader, writer
+
+    def drop(self, addr: Addr) -> None:
+        conn = self._uni.pop(addr, None)
+        if conn is not None:
+            conn.close()
+
+    def close(self) -> None:
+        for conn in self._uni.values():
+            conn.close()
+        self._uni.clear()
