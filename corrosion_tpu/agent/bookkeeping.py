@@ -328,6 +328,25 @@ CREATE TABLE IF NOT EXISTS __corro_bookkeeping_gaps (
         )
         self._persisted_gaps[actor_id] = new
 
+    def cleared_since(
+        self, actor_id: bytes, since_ts: Optional[int] = None
+    ) -> List[Tuple[int, int]]:
+        """Cleared ranges newer than ``since_ts`` (the sync Empty-need
+        filter — the reference serves cleared-ranges-since-ts, not the
+        whole history, ``peer.rs:350-762`` emptyset path)."""
+        with self._lock:
+            sql = (
+                "SELECT start_version, end_version FROM __corro_bookkeeping "
+                "WHERE actor_id=? AND end_version IS NOT NULL"
+            )
+            args: List = [actor_id]
+            if since_ts is not None:
+                sql += " AND ts > ?"
+                args.append(int(since_ts))
+            return [
+                (s, e) for s, e in self.conn.execute(sql, args).fetchall()
+            ]
+
     # -- buffered changes (partial version assembly) ---------------------
 
     def buffer_change(self, actor_id: bytes, version: int, seq: int,

@@ -137,19 +137,25 @@ class Members:
         return [m for m in self.alive() if m.is_ring0]
 
     def sample(self, k: int, rng: Optional[random.Random] = None,
-               ring0_first: bool = True) -> List[Member]:
-        """Broadcast fanout choice: ring0 first (for our own changes, the
-        reference prioritizes the <6 ms RTT tier — broadcast/mod.rs:586-643),
-        else a uniform global sample."""
+               ring0_first: bool = True,
+               exclude: Optional[set] = None) -> List[Member]:
+        """Broadcast fanout choice.
+
+        Parity (``broadcast/mod.rs:586-702``): a *local* broadcast
+        (``ring0_first=True``) goes to ALL ring0 members (<6 ms RTT tier,
+        uncapped) plus a random sample of k non-ring0 peers; a rebroadcast
+        is a uniform sample of k peers.  ``exclude`` mirrors the
+        reference's per-payload ``sent_to`` set — a payload is never sent
+        to the same peer twice across retransmission rounds."""
         rng = rng or random
-        alive = self.alive()
-        if len(alive) <= k:
-            return alive
+        exclude = exclude or set()
+        alive = [m for m in self.alive() if m.actor_id not in exclude]
         if not ring0_first:
+            if len(alive) <= k:
+                return alive
             return rng.sample(alive, k)
         ring0 = [m for m in alive if m.is_ring0]
         rest = [m for m in alive if not m.is_ring0]
-        take0 = min(len(ring0), max(1, k // 2)) if ring0 else 0
-        picked = rng.sample(ring0, take0) if take0 else []
-        picked += rng.sample(rest, min(len(rest), k - len(picked)))
+        picked = list(ring0)
+        picked += rng.sample(rest, min(len(rest), k))
         return picked

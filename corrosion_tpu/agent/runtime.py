@@ -134,6 +134,11 @@ class Agent:
         self._ingest: "deque" = deque()
         self._ingest_event: Optional[asyncio.Event] = None
         self._apply_pool = None  # ThreadPoolExecutor, created on start
+        self._apply_inflight: set = set()  # up to max_concurrent_applies
+        self._apply_gauge_lock = threading.Lock()
+        self._apply_active = 0  # batches currently executing (threads)
+        self._apply_max_overlap = 0  # high-water mark, for tests/metrics
+        self._bcast_wakeups = 0  # broadcast-loop iterations (idle = 0/s)
         self.transport = None  # Transport, created on start
         self._conn_tasks: set = set()  # live inbound connection handlers
         self._tasks: List[asyncio.Task] = []
@@ -231,14 +236,21 @@ class Agent:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks = []
+        # drain in-flight apply batches before tearing down connections /
+        # storage — a worker must never touch a closed resource
+        if self._apply_inflight:
+            await asyncio.gather(
+                *self._apply_inflight, return_exceptions=True
+            )
+            self._apply_inflight.clear()
+        if self._apply_pool is not None:
+            self._apply_pool.shutdown(wait=True)
         if self.transport is not None:
-            self.transport.close()
+            await self.transport.aclose()
         for t in list(self._conn_tasks):
             t.cancel()
         if self._conn_tasks:
             await asyncio.gather(*self._conn_tasks, return_exceptions=True)
-        if self._apply_pool is not None:
-            self._apply_pool.shutdown(wait=False)
         if self._udp:
             self._udp.close()
         if self._tcp:
@@ -630,8 +642,11 @@ class Agent:
 
         cfg = self.config
         bucket = TokenBucket(cfg.bcast_rate_limit)
-        pending: List[tuple] = []  # (due_time, frame, cv, remaining)
-        buffer: List[tuple] = []  # (frame, cv, remaining)
+        # (due_time, frame, cv, remaining, sent_to) — sent_to mirrors the
+        # reference's per-payload sent_to set (broadcast/mod.rs:683-690):
+        # a payload is never retransmitted to a peer that already got it
+        pending: List[tuple] = []
+        buffer: List[tuple] = []  # (frame, cv, remaining, sent_to)
         buf_bytes = 0
         last_flush = time.monotonic()
 
@@ -642,22 +657,26 @@ class Agent:
             if not batch:
                 return
             # per-destination frame groups: each payload picks its own
-            # fanout targets (ring0-first for our own changes)
+            # fanout targets (all-ring0 + global sample for our own
+            # changes' first transmission; random sample after)
             by_dest: Dict[Tuple[str, int], List[bytes]] = {}
             sends = 0
-            for frame, cv, remaining in batch:
+            for frame, cv, remaining, sent_to in batch:
                 local = cv.actor_id.bytes == self.actor_id
                 targets = self.members.sample(
-                    cfg.fanout, self._rng, ring0_first=local
+                    cfg.fanout, self._rng,
+                    ring0_first=local and not sent_to,
+                    exclude=sent_to,
                 )
                 for m in targets:
                     by_dest.setdefault(tuple(m.addr), []).append(frame)
+                    sent_to.add(m.actor_id)
                     sends += 1
-                if remaining > 1:
+                if remaining > 1 and targets:
                     due = time.monotonic() + cfg.rebroadcast_delay * (
                         cfg.max_transmissions - remaining + 1
                     )
-                    pending.append((due, frame, cv, remaining - 1))
+                    pending.append((due, frame, cv, remaining - 1, sent_to))
             if sends:
                 self.metrics.counter("corro_broadcast_sent_total", sends)
             for dest, frames in by_dest.items():
@@ -668,35 +687,41 @@ class Agent:
                 )
                 if not ok:
                     self.metrics.counter("corro_broadcast_send_failures_total")
-            # overflow: drop the payloads that were transmitted the most
-            if len(pending) > cfg.bcast_max_pending:
-                pending.sort(key=lambda p: p[3], reverse=True)
-                dropped = len(pending) - cfg.bcast_max_pending
-                del pending[:dropped]
+            dropped = _drop_most_transmitted(pending, cfg.bcast_max_pending)
+            if dropped:
                 self.metrics.counter(
                     "corro_broadcast_pending_dropped_total", dropped
                 )
 
         while True:
+            self._bcast_wakeups += 1
             now = time.monotonic()
             # requeued retransmissions that are due
             due_now = [p for p in pending if p[0] <= now]
             if due_now:
                 pending[:] = [p for p in pending if p[0] > now]
-                for _, frame, cv, remaining in due_now:
-                    buffer.append((frame, cv, remaining))
+                for _, frame, cv, remaining, sent_to in due_now:
+                    buffer.append((frame, cv, remaining, sent_to))
                     buf_bytes += len(frame)
-            timeout = max(
-                0.0, cfg.bcast_flush_interval - (now - last_flush)
-            )
+            # idle agents block on the queue (or the next retransmission
+            # due time) instead of polling — zero wakeups when nothing is
+            # in flight
+            if buffer:
+                timeout = max(
+                    0.001, cfg.bcast_flush_interval - (now - last_flush)
+                )
+            elif pending:
+                timeout = max(0.001, min(p[0] for p in pending) - now)
+            else:
+                timeout = None
             try:
                 cv, remaining = await asyncio.wait_for(
-                    self._bcast_queue.get(), timeout=max(timeout, 0.001)
+                    self._bcast_queue.get(), timeout=timeout
                 )
                 frame = wire.encode_msg(
                     {"k": "change", "cv": wire.change_v1_to_dict(cv)}
                 )
-                buffer.append((frame, cv, remaining))
+                buffer.append((frame, cv, remaining, set()))
                 buf_bytes += len(frame)
             except asyncio.TimeoutError:
                 pass
@@ -725,11 +750,31 @@ class Agent:
             self._ingest_event.set()
 
     async def _change_loop(self) -> None:
+        """Batch + dispatch loop: up to ``max_concurrent_applies`` batches
+        in flight on the worker pool at once (handlers.rs:742-956 runs ≤5
+        concurrent ``process_multiple_changes``).  Out-of-order completion
+        is safe: version/seq bookkeeping is idempotent and every apply
+        transaction serializes on the storage lock."""
         cfg = self.config
+        inflight = self._apply_inflight
         while True:
             if not self._ingest:
                 self._ingest_event.clear()
-                await self._ingest_event.wait()
+                if inflight:
+                    # wake on new work OR a completed apply
+                    ev = asyncio.ensure_future(self._ingest_event.wait())
+                    done, _ = await asyncio.wait(
+                        inflight | {ev}, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    if ev not in done:
+                        ev.cancel()
+                    for fut in done - {ev}:
+                        inflight.discard(fut)
+                        self._finish_apply(fut)
+                    if not self._ingest:
+                        continue
+                else:
+                    await self._ingest_event.wait()
             # cost-based batch: drain until the summed change count hits
             # apply_queue_len or a short tick passes (handlers.rs:755)
             batch: List[tuple] = []
@@ -755,25 +800,52 @@ class Agent:
                 )
             if not batch:
                 continue
-            results = await self._loop.run_in_executor(
-                self._apply_pool, self._apply_batch, batch
+            while len(inflight) >= cfg.max_concurrent_applies:
+                done, rest = await asyncio.wait(
+                    inflight, return_when=asyncio.FIRST_COMPLETED
+                )
+                inflight.clear()
+                inflight.update(rest)
+                for fut in done:
+                    self._finish_apply(fut)
+            fut = asyncio.ensure_future(
+                self._loop.run_in_executor(
+                    self._apply_pool, self._apply_batch, batch
+                )
             )
-            for cv, source, news in results:
-                if news and source is ChangeSource.BROADCAST:
-                    self._bcast_queue.put_nowait(
-                        (cv, self.config.max_transmissions)
-                    )
+            inflight.add(fut)
+
+    def _finish_apply(self, fut) -> None:
+        try:
+            results = fut.result()
+        except (asyncio.CancelledError, Exception):
+            self.metrics.counter("corro_changes_apply_errors_total")
+            return
+        for cv, source, news in results:
+            if news and source is ChangeSource.BROADCAST:
+                self._bcast_queue.put_nowait(
+                    (cv, self.config.max_transmissions)
+                )
 
     def _apply_batch(self, batch: List[tuple]) -> List[tuple]:
         """Apply a batch on a worker thread; returns (cv, source, news)."""
+        with self._apply_gauge_lock:
+            self._apply_active += 1
+            self._apply_max_overlap = max(
+                self._apply_max_overlap, self._apply_active
+            )
         out = []
-        for cv, source in batch:
-            try:
-                news = self.handle_change(cv, source, rebroadcast=False)
-            except Exception:
-                self.metrics.counter("corro_changes_apply_errors_total")
-                news = False
-            out.append((cv, source, news))
+        try:
+            for cv, source in batch:
+                try:
+                    news = self.handle_change(cv, source, rebroadcast=False)
+                except Exception:
+                    self.metrics.counter("corro_changes_apply_errors_total")
+                    news = False
+                out.append((cv, source, news))
+        finally:
+            with self._apply_gauge_lock:
+                self._apply_active -= 1
         return out
 
     # ------------------------------------------------------------------
@@ -1011,8 +1083,10 @@ class Agent:
 
     async def _sync_with(self, m: Member) -> int:
         try:
-            reader, writer = await asyncio.open_connection(m.addr[0], m.addr[1])
-        except OSError:
+            # through the transport so connects share the timeout and feed
+            # RTT samples into the member rings (ring0 classification)
+            reader, writer = await self.transport.open_bi(tuple(m.addr))
+        except (OSError, asyncio.TimeoutError):
             return 0
         count = 0
         try:
@@ -1043,6 +1117,18 @@ class Agent:
                     if kind == "sync_state":
                         theirs = _sync_state_from_dict(msg["state"])
                         needs = ours.compute_available_needs(theirs)
+                        # peer cleared versions since we last heard: ask for
+                        # cleared-ranges-since-ts (peer.rs:1132-1145)
+                        if theirs.last_cleared_ts is not None:
+                            known = self.bookie.for_actor(
+                                theirs.actor_id.bytes
+                            ).last_cleared_ts
+                            if known is None or int(known) < int(
+                                theirs.last_cleared_ts
+                            ):
+                                needs.setdefault(theirs.actor_id, []).append(
+                                    SyncNeedV1.empty(known)
+                                )
                         writer.write(
                             wire.encode_msg(
                                 {
@@ -1206,7 +1292,9 @@ class Agent:
                 seq_spans=[tuple(sp) for sp in need["seqs"]],
             )
         elif kind == "empty":
-            spans = bv.cleared.spans()
+            # only cleared ranges NEWER than the requester's last-seen ts
+            # (weak spot in r2: the whole history was re-served every round)
+            spans = self.bookie.cleared_since(actor, need.get("ts"))
             if spans:
                 cs = Changeset.empty_set(spans, bv.last_cleared_ts or Timestamp(0))
                 await self._send_sync_change(writer, actor, cs)
@@ -1408,3 +1496,17 @@ def _needs_to_dict(needs: Dict[ActorId, List[SyncNeedV1]]) -> list:
 def _parse_addr(s: str) -> Tuple[str, int]:
     host, _, port = s.rpartition(":")
     return (host or "127.0.0.1", int(port))
+
+
+def _drop_most_transmitted(pending: List[tuple], cap: int) -> int:
+    """Overflow policy for the retransmission set: drop the payloads with
+    the MOST sends so far (smallest ``remaining``), keeping fresh changes'
+    retransmissions alive.  Parity: ``drop_oldest_broadcast`` drops max
+    send_count (``broadcast/mod.rs:782-801``).  Entries are
+    ``(due, frame, cv, remaining, sent_to)``; returns the drop count."""
+    if len(pending) <= cap:
+        return 0
+    pending.sort(key=lambda p: p[3])
+    dropped = len(pending) - cap
+    del pending[:dropped]
+    return dropped

@@ -396,27 +396,6 @@ END;
                 raise
             self.conn.execute("COMMIT")
 
-    def drain_backfills(self) -> List[Tuple[int, int]]:
-        """(db_version, last_seq) pairs allocated by backfills and not yet
-        registered in bookkeeping.  Read-and-delete in one transaction;
-        the agent's caller registers them in the same critical section
-        (see Agent._register_backfills for the transactional variant)."""
-        with self._lock:
-            self.conn.execute("BEGIN IMMEDIATE")
-            try:
-                rows = [
-                    (r[0], r[1]) for r in self.conn.execute(
-                        "SELECT db_version, last_seq FROM __corro_backfills "
-                        "ORDER BY db_version"
-                    )
-                ]
-                self.conn.execute("DELETE FROM __corro_backfills")
-            except BaseException:
-                self.conn.execute("ROLLBACK")
-                raise
-            self.conn.execute("COMMIT")
-            return rows
-
     def peek_backfills(self) -> List[Tuple[int, int]]:
         with self._lock:
             return [

@@ -134,6 +134,19 @@ class Transport:
             self.on_rtt(addr, time.monotonic() - t0)
         return reader, writer
 
+    async def aclose(self) -> None:
+        """Graceful close: waits for cached connections to fully close so
+        no worker touches a half-torn-down socket during agent stop."""
+        conns = list(self._uni.values())
+        self._uni.clear()
+        for conn in conns:
+            conn.close()
+        for conn in conns:
+            try:
+                await conn.writer.wait_closed()
+            except (OSError, ConnectionError):
+                pass
+
     def drop(self, addr: Addr) -> None:
         conn = self._uni.pop(addr, None)
         if conn is not None:

@@ -1,0 +1,148 @@
+"""Broadcast/apply policy pins.
+
+Parity targets: drop_oldest_broadcast drops the MOST-transmitted payloads
+(broadcast/mod.rs:782-801), local broadcasts go to ALL ring0 members plus
+a global sample (broadcast/mod.rs:586-702) with per-payload sent_to
+exclusion, idle agents make no broadcast-loop wakeups, and change applies
+run concurrently (≤5 batches in flight, handlers.rs:742-956).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+from corrosion_tpu.agent.members import Member, Members, MemberState
+from corrosion_tpu.agent.runtime import _drop_most_transmitted
+from corrosion_tpu.agent.testing import launch_test_agent, wait_for
+from corrosion_tpu.types import ActorId, ChangeSource, ChangeV1, Changeset
+from corrosion_tpu.types.base import CrsqlSeq, Version
+
+
+def test_drop_policy_drops_most_transmitted_first():
+    # entries: (due, frame, cv, remaining, sent_to); smaller remaining =
+    # more sends so far.  With cap 2, the two entries with the SMALLEST
+    # remaining must go.
+    pending = [
+        (0.0, b"", "fresh", 5, set()),
+        (0.0, b"", "stale", 1, set()),
+        (0.0, b"", "mid", 3, set()),
+        (0.0, b"", "stale2", 2, set()),
+    ]
+    dropped = _drop_most_transmitted(pending, 2)
+    assert dropped == 2
+    assert sorted(p[2] for p in pending) == ["fresh", "mid"]
+
+
+def test_drop_policy_noop_under_cap():
+    pending = [(0.0, b"", "a", 1, set())]
+    assert _drop_most_transmitted(pending, 5) == 0
+    assert len(pending) == 1
+
+
+def _member(i: int, rtt: float) -> Member:
+    m = Member(actor_id=bytes([i]) * 16, addr=("127.0.0.1", 10000 + i))
+    m.rtts.append(rtt)
+    return m
+
+
+def test_local_fanout_sends_to_all_ring0():
+    """On loopback every peer is ring0: a local change's first
+    transmission reaches every one of them (the r2 cap of k//2 starved
+    local fanout)."""
+    members = Members(b"\x00" * 16)
+    for i in range(1, 9):
+        m = _member(i, rtt=1.0)  # all under the 6 ms ring0 bar
+        members.upsert(m.actor_id, m.addr)
+        members.record_rtt(m.actor_id, 1.0)
+    picked = members.sample(3, random.Random(0), ring0_first=True)
+    assert len(picked) == 8  # all ring0, uncapped
+
+
+def test_fanout_mixes_ring0_and_global_sample():
+    members = Members(b"\x00" * 16)
+    for i in range(1, 4):
+        members.upsert(bytes([i]) * 16, ("127.0.0.1", 10000 + i))
+        members.record_rtt(bytes([i]) * 16, 1.0)  # ring0
+    for i in range(4, 10):
+        members.upsert(bytes([i]) * 16, ("127.0.0.1", 10000 + i))
+        members.record_rtt(bytes([i]) * 16, 50.0)  # not ring0
+    picked = members.sample(2, random.Random(0), ring0_first=True)
+    ring0_picked = [m for m in picked if m.is_ring0]
+    far_picked = [m for m in picked if not m.is_ring0]
+    assert len(ring0_picked) == 3  # all of ring0
+    assert len(far_picked) == 2  # plus k sampled from the rest
+
+
+def test_sample_excludes_already_sent():
+    members = Members(b"\x00" * 16)
+    for i in range(1, 6):
+        members.upsert(bytes([i]) * 16, ("127.0.0.1", 10000 + i))
+    sent = {bytes([1]) * 16, bytes([2]) * 16}
+    picked = members.sample(10, random.Random(0), ring0_first=False,
+                            exclude=sent)
+    assert {m.actor_id for m in picked}.isdisjoint(sent)
+    assert len(picked) == 3
+
+
+def test_idle_agent_makes_no_broadcast_wakeups():
+    async def main():
+        a = await launch_test_agent()
+        await asyncio.sleep(0.3)  # settle any startup flushes
+        before = a._bcast_wakeups
+        await asyncio.sleep(1.0)
+        assert a._bcast_wakeups - before <= 1, (
+            "idle broadcast loop must block, not poll"
+        )
+        await a.stop()
+
+    asyncio.run(main())
+
+
+def test_apply_batches_overlap(tmp_path):
+    """With the apply path briefly blocked and the queue loaded, the
+    change loop keeps up to max_concurrent_applies batches in flight —
+    observed as ≥2 concurrently-executing _apply_batch calls."""
+    async def main():
+        a = await launch_test_agent(tmpdir=str(tmp_path))
+        fake_actor = b"\x42" * 16
+        # many single-change changesets from a fake remote actor
+        a.storage._lock.acquire()
+        try:
+            for v in range(1, 60):
+                cs = Changeset.empty((Version(v), Version(v)),
+                                     a.clock.new_timestamp())
+                a.enqueue_change(
+                    ChangeV1(actor_id=ActorId(fake_actor), changeset=cs),
+                    ChangeSource.BROADCAST,
+                )
+                # let the change loop batch + dispatch while the storage
+                # lock stays held, stacking workers
+                await asyncio.sleep(0.005)
+                if a._apply_max_overlap >= 2:
+                    break
+        finally:
+            a.storage._lock.release()
+        await wait_for(lambda: not a._ingest, timeout=10)
+        assert a._apply_max_overlap >= 2
+        assert (
+            a._apply_max_overlap <= a.config.max_concurrent_applies
+        )
+        await a.stop()
+
+    asyncio.run(main())
+
+
+def test_cleared_since_filters_by_ts(tmp_path):
+    async def main():
+        a = await launch_test_agent(tmpdir=str(tmp_path))
+        actor = a.actor_id
+        with a.storage._lock:
+            a.bookie.persist_cleared(actor, 1, 3, ts=100)
+            a.bookie.persist_cleared(actor, 10, 12, ts=200)
+        assert set(a.bookie.cleared_since(actor)) == {(1, 3), (10, 12)}
+        assert a.bookie.cleared_since(actor, 150) == [(10, 12)]
+        assert a.bookie.cleared_since(actor, 250) == []
+        await a.stop()
+
+    asyncio.run(main())
