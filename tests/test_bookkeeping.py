@@ -126,6 +126,148 @@ def test_bookie_cleared_swallows_concrete_rows(conn):
     assert rows == [(1, 4, None)]
 
 
+# ---------------------------------------------------------------------------
+# Golden port of the reference's gap-collapse scenario test
+# (``crates/corro-types/src/agent.rs:1814-2083`` — ``test_booked_insert_db``).
+# Every insert/expect step below mirrors one step of the reference test, in
+# the same order, including the persisted ``__corro_bookkeeping_gaps`` check
+# and the reload-equality check at the end.
+# ---------------------------------------------------------------------------
+
+
+def _insert_everywhere(bookie, bv, all_versions, spans, dbv_counter):
+    """Twin of the reference's ``insert_everywhere`` helper: applies the
+    version ranges both in memory and through the persistence layer."""
+    for start, end in spans:
+        all_versions.insert(start, end)
+        for v in range(start, end + 1):
+            dbv_counter[0] += 1
+            bv.apply_version(v, dbv_counter[0], 0)
+            bookie.persist_version(bv.actor_id, v, dbv_counter[0], 0)
+
+
+def _expect_gaps(bookie, bv, all_versions, expected):
+    """Twin of the reference's ``expect_gaps`` helper: checks the persisted
+    gap rows, in-memory needed set, containment, and max-version invariants."""
+    rows = bookie.conn.execute(
+        "SELECT start, end FROM __corro_bookkeeping_gaps WHERE actor_id=?"
+        " ORDER BY start",
+        (bv.actor_id,),
+    ).fetchall()
+    assert [tuple(r) for r in rows] == expected
+
+    for start, end in all_versions.spans():
+        assert bv.contains_range(start, end)
+
+    for start, end in expected:
+        for v in range(start, end + 1):
+            assert not bv.contains_version(v), f"expected not to contain {v}"
+            assert bv.needed.contains(v), f"expected needed to contain {v}"
+
+    spans = all_versions.spans()
+    assert bv.last() == (spans[-1][1] if spans else 0), (
+        "expected last version not to increment"
+    )
+
+
+def test_booked_insert_db_full_then_subset(conn):
+    """agent.rs test_booked_insert_db, first fresh state: a full range then
+    an ineffective subset re-insert leave no gaps."""
+    from corrosion_tpu.utils.ranges import RangeSet
+
+    bookie = Bookie(conn)
+    bv = bookie.for_actor(A)
+    all_v, dbv = RangeSet(), [0]
+    _insert_everywhere(bookie, bv, all_v, [(1, 20)], dbv)
+    _expect_gaps(bookie, bv, all_v, [])
+    _insert_everywhere(bookie, bv, all_v, [(1, 10)], dbv)
+    _expect_gaps(bookie, bv, all_v, [])
+
+
+def test_booked_insert_db_gap_create_fill(conn):
+    """agent.rs test_booked_insert_db, second fresh state: create the 2..=3
+    gap then fill it out of order."""
+    from corrosion_tpu.utils.ranges import RangeSet
+
+    bookie = Bookie(conn)
+    bv = bookie.for_actor(A)
+    all_v, dbv = RangeSet(), [0]
+    _insert_everywhere(bookie, bv, all_v, [(1, 1), (4, 4)], dbv)
+    _expect_gaps(bookie, bv, all_v, [(2, 3)])
+    _insert_everywhere(bookie, bv, all_v, [(3, 3), (2, 2)], dbv)
+    _expect_gaps(bookie, bv, all_v, [])
+
+
+def test_booked_insert_db_reference_sequence(conn):
+    """agent.rs test_booked_insert_db, third fresh state: the long scenario
+    sequence — non-1 first version, partial overlaps from both ends,
+    two-range bridging, ineffective re-inserts, full-range encompassing,
+    multi-range partial touches — then reload equality."""
+    from corrosion_tpu.utils.ranges import RangeSet
+
+    bookie = Bookie(conn)
+    bv = bookie.for_actor(A)
+    all_v, dbv = RangeSet(), [0]
+
+    # insert a non-1 first version
+    _insert_everywhere(bookie, bv, all_v, [(5, 20)], dbv)
+    _expect_gaps(bookie, bv, all_v, [(1, 4)])
+
+    # a further change that does not overlap a gap
+    _insert_everywhere(bookie, bv, all_v, [(6, 7)], dbv)
+    _expect_gaps(bookie, bv, all_v, [(1, 4)])
+
+    # a further change that does overlap a gap
+    _insert_everywhere(bookie, bv, all_v, [(3, 7)], dbv)
+    _expect_gaps(bookie, bv, all_v, [(1, 2)])
+
+    _insert_everywhere(bookie, bv, all_v, [(1, 2)], dbv)
+    _expect_gaps(bookie, bv, all_v, [])
+
+    _insert_everywhere(bookie, bv, all_v, [(25, 25)], dbv)
+    _expect_gaps(bookie, bv, all_v, [(21, 24)])
+
+    _insert_everywhere(bookie, bv, all_v, [(30, 35)], dbv)
+    _expect_gaps(bookie, bv, all_v, [(21, 24), (26, 29)])
+
+    # overlapping partially from the end
+    _insert_everywhere(bookie, bv, all_v, [(19, 22)], dbv)
+    _expect_gaps(bookie, bv, all_v, [(23, 24), (26, 29)])
+
+    # overlapping partially from the start
+    _insert_everywhere(bookie, bv, all_v, [(24, 25)], dbv)
+    _expect_gaps(bookie, bv, all_v, [(23, 23), (26, 29)])
+
+    # overlapping 2 ranges
+    _insert_everywhere(bookie, bv, all_v, [(23, 27)], dbv)
+    _expect_gaps(bookie, bv, all_v, [(28, 29)])
+
+    # ineffective insert of already known ranges
+    _insert_everywhere(bookie, bv, all_v, [(1, 20)], dbv)
+    _expect_gaps(bookie, bv, all_v, [(28, 29)])
+
+    # overlapping no ranges, but encompassing a full range
+    _insert_everywhere(bookie, bv, all_v, [(27, 30)], dbv)
+    _expect_gaps(bookie, bv, all_v, [])
+
+    # touching multiple ranges, partially: create gaps 36..=39 and 46..=49
+    _insert_everywhere(bookie, bv, all_v, [(40, 45)], dbv)
+    _insert_everywhere(bookie, bv, all_v, [(50, 55)], dbv)
+    _insert_everywhere(bookie, bv, all_v, [(38, 47)], dbv)
+    _expect_gaps(bookie, bv, all_v, [(36, 37), (48, 49)])
+
+    # loading a fresh Bookie from the conn must reproduce identical state
+    reborn = Bookie(conn)
+    bv2 = reborn.for_actor(A)
+    assert bv2.needed_spans() == bv.needed_spans()
+    assert bv2.last() == bv.last()
+    for start, end in all_v.spans():
+        assert bv2.contains_range(start, end)
+    for start, end in bv.needed_spans():
+        for v in range(start, end + 1):
+            assert not bv2.contains_version(v)
+
+
 def test_buffered_changes_roundtrip(conn):
     bookie = Bookie(conn)
     bookie.buffer_change(A, 3, 0, b"zero")
