@@ -102,8 +102,16 @@ class BookedVersions:
         self.partials.pop(version, None)
         self.versions[version] = (db_version, last_seq)
 
-    def mark_cleared(self, start: int, end: int, ts: Optional[Timestamp] = None) -> None:
-        """Versions [start, end] are empty (overwritten or compacted)."""
+    def mark_cleared(self, start: int, end: int) -> None:
+        """Versions [start, end] are empty (overwritten or compacted).
+
+        Does NOT advance ``last_cleared_ts``: the watermark moves only on
+        *complete* information — our own compaction, or a whole sync
+        EmptySet group — via :meth:`update_cleared_ts`.  A single
+        broadcast empty changeset may be one of several ranges stamped
+        with the same ts, so advancing here would make the sync
+        Empty-need gate skip the rest forever (ref ``agent.rs:1541-1545``
+        — the reference's watermark is likewise separate from clearing)."""
         self._extend_max(end)
         self.needed.remove(start, end)
         # iterate entries present, never the (remote-supplied) span width
@@ -112,6 +120,9 @@ class BookedVersions:
         for v in [v for v in self.versions if start <= v <= end]:
             del self.versions[v]
         self.cleared.insert(start, end)
+
+    def update_cleared_ts(self, ts: Timestamp) -> None:
+        """Advance the cleared watermark (``agent.rs:1541-1545``)."""
         if ts is not None and (
             self.last_cleared_ts is None or int(ts) > int(self.last_cleared_ts)
         ):
@@ -185,6 +196,10 @@ CREATE TABLE IF NOT EXISTS __corro_bookkeeping_gaps (
   end INTEGER NOT NULL,
   PRIMARY KEY (actor_id, start)
 );
+CREATE TABLE IF NOT EXISTS __corro_sync_state (
+  actor_id BLOB PRIMARY KEY NOT NULL,
+  last_cleared_ts INTEGER
+);
 """
 
     def __init__(self, conn, lock: Optional[threading.RLock] = None):
@@ -208,7 +223,7 @@ CREATE TABLE IF NOT EXISTS __corro_bookkeeping_gaps (
             ):
                 bv = self.for_actor(bytes(actor))
                 if end is not None:
-                    bv.mark_cleared(start, end, Timestamp(ts) if ts else None)
+                    bv.mark_cleared(start, end)
                 else:
                     bv.apply_version(
                         start, dbv or 0, last_seq or 0,
@@ -228,6 +243,13 @@ CREATE TABLE IF NOT EXISTS __corro_bookkeeping_gaps (
                 bv = self.for_actor(bytes(actor))
                 bv.needed.insert(start, end)
                 bv.max_version = max(bv.max_version, end)
+            for actor, ts in self.conn.execute(
+                "SELECT actor_id, last_cleared_ts FROM __corro_sync_state"
+            ):
+                if ts is not None:
+                    self.for_actor(bytes(actor)).update_cleared_ts(
+                        Timestamp(ts)
+                    )
 
     def persist_version(
         self, actor_id: bytes, version: int, db_version: int, last_seq: int,
@@ -328,24 +350,45 @@ CREATE TABLE IF NOT EXISTS __corro_bookkeeping_gaps (
         )
         self._persisted_gaps[actor_id] = new
 
+    def persist_sync_state(self, actor_id: bytes, ts: int) -> None:
+        """Write-through for ``update_cleared_ts`` (``agent.rs:1292-1300``
+        — the watermark lives in its own ``__corro_sync_state`` table, it
+        is never inferred from cleared-range row timestamps)."""
+        self.conn.execute(
+            "INSERT INTO __corro_sync_state (actor_id, last_cleared_ts) "
+            "VALUES (?, ?) ON CONFLICT (actor_id) DO UPDATE SET "
+            "last_cleared_ts = MAX(COALESCE(last_cleared_ts, 0),"
+            " excluded.last_cleared_ts)",
+            (actor_id, int(ts)),
+        )
+
     def cleared_since(
         self, actor_id: bytes, since_ts: Optional[int] = None
-    ) -> List[Tuple[int, int]]:
-        """Cleared ranges newer than ``since_ts`` (the sync Empty-need
-        filter — the reference serves cleared-ranges-since-ts, not the
-        whole history, ``peer.rs:350-762`` emptyset path)."""
+    ) -> List[Tuple[int, List[Tuple[int, int]]]]:
+        """Cleared ranges strictly newer than ``since_ts``, grouped by
+        the timestamp that stamped them, oldest group first (the sync
+        Empty-need serving shape — ``peer.rs:715-762`` sends one EmptySet
+        per distinct ts so the requester can advance its watermark one
+        *complete* group at a time)."""
         with self._lock:
             sql = (
-                "SELECT start_version, end_version FROM __corro_bookkeeping "
+                "SELECT ts, start_version, end_version "
+                "FROM __corro_bookkeeping "
                 "WHERE actor_id=? AND end_version IS NOT NULL"
             )
             args: List = [actor_id]
             if since_ts is not None:
                 sql += " AND ts > ?"
                 args.append(int(since_ts))
-            return [
-                (s, e) for s, e in self.conn.execute(sql, args).fetchall()
-            ]
+            sql += " ORDER BY ts"
+            groups: List[Tuple[int, List[Tuple[int, int]]]] = []
+            for ts, s, e in self.conn.execute(sql, args).fetchall():
+                ts = ts or 0
+                if groups and groups[-1][0] == ts:
+                    groups[-1][1].append((s, e))
+                else:
+                    groups.append((ts, [(s, e)]))
+            return groups
 
     # -- buffered changes (partial version assembly) ---------------------
 

@@ -119,6 +119,9 @@ class Agent:
         self._members_table()
         self.incarnation = 0
         self._seen: Dict[tuple, None] = {}
+        # apply workers call handle_change concurrently; the seen cache's
+        # check/insert/evict must be atomic across them
+        self._seen_lock = threading.Lock()
         self._acks: Dict[int, asyncio.Future] = {}
         self._suspects: Dict[bytes, float] = {}
         self._bcast_queue: asyncio.Queue = asyncio.Queue()
@@ -555,13 +558,19 @@ class Agent:
                 )
                 for s, e in ranges:
                     self.bookie.persist_cleared(self.actor_id, s, e, int(ts))
+                if ranges:
+                    # our own compaction is complete information: advance
+                    # our advertised cleared watermark
+                    self.bookie.persist_sync_state(self.actor_id, int(ts))
             except BaseException:
                 self.storage.conn.execute("ROLLBACK")
                 raise
             self.storage.conn.execute("COMMIT")
             for s, e in ranges:
-                booked.mark_cleared(s, e, ts)
+                booked.mark_cleared(s, e)
                 cleared.append((s, e))
+            if ranges:
+                booked.update_cleared_ts(ts)
         for s, e in cleared:
             cv = ChangeV1(
                 actor_id=ActorId(self.actor_id),
@@ -659,8 +668,7 @@ class Agent:
             # per-destination frame groups: each payload picks its own
             # fanout targets (all-ring0 + global sample for our own
             # changes' first transmission; random sample after)
-            by_dest: Dict[Tuple[str, int], List[bytes]] = {}
-            sends = 0
+            by_dest: Dict[Tuple[str, int], List[tuple]] = {}
             for frame, cv, remaining, sent_to in batch:
                 local = cv.actor_id.bytes == self.actor_id
                 targets = self.members.sample(
@@ -669,24 +677,36 @@ class Agent:
                     exclude=sent_to,
                 )
                 for m in targets:
-                    by_dest.setdefault(tuple(m.addr), []).append(frame)
-                    sent_to.add(m.actor_id)
-                    sends += 1
+                    by_dest.setdefault(tuple(m.addr), []).append(
+                        (frame, sent_to, m.actor_id)
+                    )
+                # requeue while transmissions remain and coverage is not
+                # exhausted; sent_to only records SUCCESSFUL deliveries,
+                # so a peer that missed a transient send stays eligible
+                # and keeps the entry alive (empty targets = every alive
+                # member already got it)
                 if remaining > 1 and targets:
                     due = time.monotonic() + cfg.rebroadcast_delay * (
                         cfg.max_transmissions - remaining + 1
                     )
                     pending.append((due, frame, cv, remaining - 1, sent_to))
-            if sends:
-                self.metrics.counter("corro_broadcast_sent_total", sends)
-            for dest, frames in by_dest.items():
-                blob = b"".join(frames)
+            sends = 0
+            for dest, entries in by_dest.items():
+                blob = b"".join(frame for frame, _, _ in entries)
                 await bucket.consume(len(blob))
                 ok = await self.transport.send_uni(
                     dest, blob, header=wire.encode_msg({"k": "uni"})
                 )
-                if not ok:
+                if ok:
+                    # mark delivered only on success so a failed send's
+                    # peers stay eligible for retransmission
+                    for _, sent_to, actor_id in entries:
+                        sent_to.add(actor_id)
+                    sends += len(entries)
+                else:
                     self.metrics.counter("corro_broadcast_send_failures_total")
+            if sends:
+                self.metrics.counter("corro_broadcast_sent_total", sends)
             dropped = _drop_most_transmitted(pending, cfg.bcast_max_pending)
             if dropped:
                 self.metrics.counter(
@@ -763,11 +783,14 @@ class Agent:
                 if inflight:
                     # wake on new work OR a completed apply
                     ev = asyncio.ensure_future(self._ingest_event.wait())
-                    done, _ = await asyncio.wait(
-                        inflight | {ev}, return_when=asyncio.FIRST_COMPLETED
-                    )
-                    if ev not in done:
-                        ev.cancel()
+                    try:
+                        done, _ = await asyncio.wait(
+                            inflight | {ev},
+                            return_when=asyncio.FIRST_COMPLETED,
+                        )
+                    finally:
+                        if not ev.done():
+                            ev.cancel()
                     for fut in done - {ev}:
                         inflight.discard(fut)
                         self._finish_apply(fut)
@@ -871,11 +894,12 @@ class Agent:
             return False
         key = self._seen_key(cv)
         if source is ChangeSource.BROADCAST:
-            if key in self._seen:
-                return False
-            self._seen[key] = None
-            if len(self._seen) > self.config.seen_cache_size:
-                self._seen.pop(next(iter(self._seen)))
+            with self._seen_lock:
+                if key in self._seen:
+                    return False
+                self._seen[key] = None
+                if len(self._seen) > self.config.seen_cache_size:
+                    self._seen.pop(next(iter(self._seen)))
         if cv.changeset.ts is not None:
             try:
                 self.clock.update_with_timestamp(cv.changeset.ts)
@@ -898,6 +922,13 @@ class Agent:
         return news
 
     def _process_changeset(self, cv: ChangeV1) -> bool:
+        # hold the storage lock across the have-it-already checks AND the
+        # apply transaction: concurrent apply workers mutate the same
+        # booked RangeSets, and those mutations are multi-step
+        with self.storage._lock:
+            return self._process_changeset_locked(cv)
+
+    def _process_changeset_locked(self, cv: ChangeV1) -> bool:
         actor = cv.actor_id.bytes
         cs = cv.changeset
         booked = self.bookie.for_actor(actor)
@@ -908,19 +939,26 @@ class Agent:
             if booked.cleared.contains_span(s, e):
                 return False
             with self.storage.apply_tx():
-                booked.mark_cleared(s, e, cs.ts)
+                booked.mark_cleared(s, e)
                 self.bookie.persist_cleared(actor, s, e, ts)
             return True
 
         if cs.is_empty_set:
+            # a sync EmptySet is one COMPLETE per-ts group of the
+            # server's cleared ranges, so processing it justifies
+            # advancing the watermark even when every range was already
+            # held; marking is idempotent, so out-of-order groups are
+            # safe (handlers.rs:539-734, peer.rs:715-762)
             new = False
             with self.storage.apply_tx():
                 for s, e in cs.ranges:
-                    if booked.cleared.contains_span(int(s), int(e)):
-                        continue
-                    booked.mark_cleared(int(s), int(e), cs.ts)
-                    self.bookie.persist_cleared(actor, int(s), int(e), ts)
-                    new = True
+                    if not booked.cleared.contains_span(int(s), int(e)):
+                        booked.mark_cleared(int(s), int(e))
+                        self.bookie.persist_cleared(actor, int(s), int(e), ts)
+                        new = True
+                if ts is not None:
+                    booked.update_cleared_ts(cs.ts)
+                    self.bookie.persist_sync_state(actor, ts)
             return new
 
         v = int(cs.version)
@@ -1142,7 +1180,19 @@ class Agent:
                             done = True
                     elif kind == "sync_change":
                         cv = wire.change_v1_from_dict(msg["cv"])
-                        self.enqueue_change(cv, ChangeSource.SYNC)
+                        if cv.changeset.is_empty_set:
+                            # EmptySet groups advance the cleared
+                            # watermark per group, so they must apply in
+                            # served order and must never be dropped —
+                            # bypass the drop-oldest ingest queue (the
+                            # reference likewise gives emptysets their
+                            # own ordered channel, handlers.rs:539-734)
+                            await self._loop.run_in_executor(
+                                self._apply_pool, self.handle_change,
+                                cv, ChangeSource.SYNC,
+                            )
+                        else:
+                            self.enqueue_change(cv, ChangeSource.SYNC)
                         count += 1
                     elif kind == "sync_done":
                         done = True
@@ -1292,11 +1342,17 @@ class Agent:
                 seq_spans=[tuple(sp) for sp in need["seqs"]],
             )
         elif kind == "empty":
-            # only cleared ranges NEWER than the requester's last-seen ts
-            # (weak spot in r2: the whole history was re-served every round)
-            spans = self.bookie.cleared_since(actor, need.get("ts"))
-            if spans:
-                cs = Changeset.empty_set(spans, bv.last_cleared_ts or Timestamp(0))
+            # only cleared ranges strictly NEWER than the requester's
+            # last-seen ts, one EmptySet per distinct stamping ts oldest
+            # first (peer.rs:715-762): each message is a complete per-ts
+            # group, so the requester can advance its watermark per
+            # message without ever missing a sibling range
+            if bv.last_cleared_ts is None:
+                return
+            for group_ts, spans in self.bookie.cleared_since(
+                actor, need.get("ts")
+            ):
+                cs = Changeset.empty_set(spans, Timestamp(group_ts))
                 await self._send_sync_change(writer, actor, cs)
 
     async def _serve_version(

@@ -141,11 +141,17 @@ class Transport:
         self._uni.clear()
         for conn in conns:
             conn.close()
-        for conn in conns:
+        async def _wait(conn):
             try:
-                await conn.writer.wait_closed()
-            except (OSError, ConnectionError):
+                # a dead peer's unflushed send buffer can defer teardown
+                # until the kernel's TCP retransmission timeout; don't let
+                # that hold up agent shutdown
+                await asyncio.wait_for(conn.writer.wait_closed(), timeout=2.0)
+            except (OSError, ConnectionError, asyncio.TimeoutError):
                 pass
+
+        if conns:
+            await asyncio.gather(*(_wait(c) for c in conns))
 
     def drop(self, addr: Addr) -> None:
         conn = self._uni.pop(addr, None)

@@ -30,10 +30,16 @@ def test_cleared_ranges_absorb_needs_and_partials():
     bv.apply_version(10, 1, 0)  # gaps 1..9
     bv.insert_partial(7, (0, 3), 10)
     assert 7 in bv.partials
-    bv.mark_cleared(1, 9, Timestamp(5))
+    bv.mark_cleared(1, 9)
     assert bv.needed_spans() == []
     assert bv.partials == {}
     assert bv.contains_range(1, 10)
+    # clearing alone does NOT advance the watermark (only complete
+    # information does — own compaction or a whole sync EmptySet group)
+    assert bv.last_cleared_ts is None
+    bv.update_cleared_ts(Timestamp(5))
+    assert bv.last_cleared_ts == Timestamp(5)
+    bv.update_cleared_ts(Timestamp(3))  # never moves backwards
     assert bv.last_cleared_ts == Timestamp(5)
 
 
@@ -75,7 +81,7 @@ def test_bookie_persistence_roundtrip(conn):
     bookie.persist_version(A, 5, 101, 0)
     bv.insert_partial(8, (0, 3), 50, Timestamp(7))
     bookie.persist_partial(A, 8, (0, 3), 50, ts=7)
-    bv.mark_cleared(2, 3, Timestamp(9))
+    bv.mark_cleared(2, 3)
     bookie.persist_cleared(A, 2, 3, ts=9)
 
     # boot a fresh bookie from the same db: state must match

@@ -140,8 +140,14 @@ def test_cleared_since_filters_by_ts(tmp_path):
         with a.storage._lock:
             a.bookie.persist_cleared(actor, 1, 3, ts=100)
             a.bookie.persist_cleared(actor, 10, 12, ts=200)
-        assert set(a.bookie.cleared_since(actor)) == {(1, 3), (10, 12)}
-        assert a.bookie.cleared_since(actor, 150) == [(10, 12)]
+        # grouped by stamping ts, oldest group first; strictly newer
+        # than the requester's watermark
+        assert a.bookie.cleared_since(actor) == [
+            (100, [(1, 3)]),
+            (200, [(10, 12)]),
+        ]
+        assert a.bookie.cleared_since(actor, 150) == [(200, [(10, 12)])]
+        assert a.bookie.cleared_since(actor, 200) == []
         assert a.bookie.cleared_since(actor, 250) == []
         await a.stop()
 

@@ -132,6 +132,51 @@ def test_empty_changeset_gossips_to_peers(tmp_path):
     a2.storage.close()
 
 
+def test_cleared_watermark_heals_partial_broadcast(tmp_path):
+    """A peer that saw only a SUBSET of the ranges stamped with one
+    compaction ts must still learn the rest via the sync Empty-need
+    exchange, and its watermark must then match the originator's so
+    steady-state sync rounds stop re-serving cleared history."""
+    async def main():
+        (tmp_path / "n1").mkdir()
+        (tmp_path / "n2").mkdir()
+        a1 = await launch_test_agent(tmpdir=str(tmp_path / "n1"))
+        # two separate compactions => two cleared groups w/ distinct ts
+        for i in range(6):
+            a1.execute_transaction(
+                [("INSERT OR REPLACE INTO tests (id, text) VALUES (1, ?)",
+                  (f"x{i}",))]
+            )
+        for i in range(6):
+            a1.execute_transaction(
+                [("INSERT OR REPLACE INTO tests (id, text) VALUES (2, ?)",
+                  (f"y{i}",))]
+            )
+        booked1 = a1.bookie.for_actor(a1.actor_id)
+        assert booked1.last_cleared_ts is not None
+        a2 = await launch_test_agent(
+            bootstrap=[f"{a1.gossip_addr[0]}:{a1.gossip_addr[1]}"],
+            tmpdir=str(tmp_path / "n2"),
+        )
+        a2_view = lambda: a2.bookie.for_actor(a1.actor_id)
+        await wait_for(
+            lambda: a2_view().last_cleared_ts is not None
+            and int(a2_view().last_cleared_ts)
+            == int(booked1.last_cleared_ts),
+            timeout=20,
+        )
+        # all cleared ranges present, not just the latest group
+        assert a2_view().cleared.spans() == booked1.cleared.spans()
+        # steady state: the server has nothing newer than a2's watermark
+        assert a1.bookie.cleared_since(
+            a1.actor_id, int(a2_view().last_cleared_ts)
+        ) == []
+        await a1.stop()
+        await a2.stop()
+
+    asyncio.run(main())
+
+
 def test_fresh_node_sync_transfers_o1_versions(tmp_path):
     """End-to-end: after N overwrites, a freshly bootstrapped node
     converges having received only O(1) versions' changes via sync."""
