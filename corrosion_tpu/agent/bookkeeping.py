@@ -123,9 +123,7 @@ class BookedVersions:
 
     def update_cleared_ts(self, ts: Timestamp) -> None:
         """Advance the cleared watermark (``agent.rs:1541-1545``)."""
-        if ts is not None and (
-            self.last_cleared_ts is None or int(ts) > int(self.last_cleared_ts)
-        ):
+        if self.last_cleared_ts is None or int(ts) > int(self.last_cleared_ts):
             self.last_cleared_ts = ts
 
     def insert_partial(
@@ -251,6 +249,25 @@ CREATE TABLE IF NOT EXISTS __corro_sync_state (
                         Timestamp(ts)
                     )
 
+    def backfill_own_sync_state(self, actor_id: bytes) -> None:
+        """Restore OUR OWN cleared watermark from cleared-row timestamps
+        when ``__corro_sync_state`` has no row (a DB written before the
+        table existed).  Sound only for our own actor: our persisted
+        cleared set is always complete information, while a remote
+        actor's rows may be a subset of a ts group."""
+        bv = self.for_actor(actor_id)
+        if bv.last_cleared_ts is not None:
+            return
+        with self._lock:
+            row = self.conn.execute(
+                "SELECT MAX(ts) FROM __corro_bookkeeping "
+                "WHERE actor_id=? AND end_version IS NOT NULL",
+                (actor_id,),
+            ).fetchone()
+            if row and row[0] is not None:
+                bv.update_cleared_ts(Timestamp(row[0]))
+                self.persist_sync_state(actor_id, int(row[0]))
+
     def persist_version(
         self, actor_id: bytes, version: int, db_version: int, last_seq: int,
         ts: Optional[int] = None,
@@ -361,6 +378,18 @@ CREATE TABLE IF NOT EXISTS __corro_sync_state (
             " excluded.last_cleared_ts)",
             (actor_id, int(ts)),
         )
+
+    def version_ts(self, actor_id: bytes, version: int) -> Optional[int]:
+        """The HLC ts recorded when ``version`` was applied (the sync
+        server stamps re-served Full changesets with it, like the
+        reference reads ts back from ``__corro_bookkeeping``)."""
+        with self._lock:
+            row = self.conn.execute(
+                "SELECT ts FROM __corro_bookkeeping WHERE actor_id=? "
+                "AND start_version=? AND end_version IS NULL",
+                (actor_id, version),
+            ).fetchone()
+        return row[0] if row else None
 
     def cleared_since(
         self, actor_id: bytes, since_ts: Optional[int] = None

@@ -48,8 +48,17 @@ from corrosion_tpu.types import (
     Version,
 )
 from corrosion_tpu.types.change import ChunkedChanges, MAX_CHANGES_BYTE_SIZE
+from corrosion_tpu.types.actor import ClusterId
+from corrosion_tpu.types.payload import BiPayload, BroadcastV1, UniPayload
 from corrosion_tpu.agent.transport import MAX_UDP_PAYLOAD
+from corrosion_tpu.bridge import speedy
 from corrosion_tpu.utils.ranges import RangeSet
+
+# TCP stream preludes: one byte standing in for QUIC's uni/bi stream
+# types; every byte after it is exactly the reference's stream content
+# (u32-BE LengthDelimited speedy frames).
+STREAM_UNI = b"U"
+STREAM_BI = b"B"
 
 
 @dataclass
@@ -93,6 +102,10 @@ class AgentConfig:
     maintenance_interval: float = 60.0
     wal_truncate_pages: int = 250_000  # ~1 GB at 4 KiB pages
     vacuum_free_pages: int = 10_000
+    # test-only instrumentation: prefix every uni frame with a 1-byte
+    # hop count so a harness can measure real dissemination depth.
+    # MUST stay off for reference-byte-exact wire compatibility.
+    debug_hops: bool = False
 
 
 class Agent:
@@ -110,6 +123,8 @@ class Agent:
             lock_registry=self.lock_registry if config.admin_path else None,
         )
         self.bookie = Bookie(self.storage.conn, lock=self.storage._lock)
+        # restart = resume: an older DB may predate __corro_sync_state
+        self.bookie.backfill_own_sync_state(self.storage.site_id)
         self.clock = HLClock()
         self.actor_id = self.storage.site_id
         self.members = Members(self.actor_id)
@@ -122,6 +137,9 @@ class Agent:
         # apply workers call handle_change concurrently; the seen cache's
         # check/insert/evict must be atomic across them
         self._seen_lock = threading.Lock()
+        # debug_hops: seen-key -> hop depth at first receipt (harness
+        # reads this to measure real dissemination depth)
+        self._recv_hops: Dict[tuple, int] = {}
         self._acks: Dict[int, asyncio.Future] = {}
         self._suspects: Dict[bytes, float] = {}
         self._bcast_queue: asyncio.Queue = asyncio.Queue()
@@ -181,7 +199,9 @@ class Agent:
         for version, db_version, last_seq, ts in pending:
             self._queue_local_broadcast(version, db_version, last_seq, ts)
         for cv in pending_cvs:
-            self._bcast_queue.put_nowait((cv, self.config.max_transmissions))
+            self._bcast_queue.put_nowait(
+                (cv, self.config.max_transmissions, 0)
+            )
         self._sync_sem = asyncio.Semaphore(self.config.max_sync_sessions)
         self._ingest_event = asyncio.Event()
         from concurrent.futures import ThreadPoolExecutor
@@ -600,7 +620,8 @@ class Agent:
                 return
             loop = self._loop
         loop.call_soon_threadsafe(
-            self._bcast_queue.put_nowait, (cv, self.config.max_transmissions)
+            self._bcast_queue.put_nowait,
+            (cv, self.config.max_transmissions, 0),
         )
 
     def _queue_or_defer_broadcast(
@@ -630,7 +651,9 @@ class Agent:
             cv = ChangeV1(actor_id=ActorId(self.actor_id), changeset=cs)
             if self.on_change is not None:
                 self.on_change(cv)
-            self._bcast_queue.put_nowait((cv, self.config.max_transmissions))
+            self._bcast_queue.put_nowait(
+                (cv, self.config.max_transmissions, 0)
+            )
 
     def _record_rtt(self, addr, rtt_s: float) -> None:
         for m in self.members.alive():
@@ -695,7 +718,7 @@ class Agent:
                 blob = b"".join(frame for frame, _, _ in entries)
                 await bucket.consume(len(blob))
                 ok = await self.transport.send_uni(
-                    dest, blob, header=wire.encode_msg({"k": "uni"})
+                    dest, blob, header=STREAM_UNI
                 )
                 if ok:
                     # mark delivered only on success so a failed send's
@@ -735,12 +758,18 @@ class Agent:
             else:
                 timeout = None
             try:
-                cv, remaining = await asyncio.wait_for(
+                cv, remaining, hop = await asyncio.wait_for(
                     self._bcast_queue.get(), timeout=timeout
                 )
-                frame = wire.encode_msg(
-                    {"k": "change", "cv": wire.change_v1_to_dict(cv)}
+                payload = speedy.encode_uni_payload(
+                    UniPayload(
+                        broadcast=BroadcastV1(change=cv),
+                        cluster_id=ClusterId(cfg.cluster_id),
+                    )
                 )
+                if cfg.debug_hops:
+                    payload = bytes([min(hop, 255)]) + payload
+                frame = speedy.frame(payload)
                 buffer.append((frame, cv, remaining, set()))
                 buf_bytes += len(frame)
             except asyncio.TimeoutError:
@@ -847,7 +876,8 @@ class Agent:
         for cv, source, news in results:
             if news and source is ChangeSource.BROADCAST:
                 self._bcast_queue.put_nowait(
-                    (cv, self.config.max_transmissions)
+                    (cv, self.config.max_transmissions,
+                     self._rebroadcast_hop(cv))
                 )
 
     def _apply_batch(self, batch: List[tuple]) -> List[tuple]:
@@ -883,6 +913,14 @@ class Agent:
             return (cv.actor_id.bytes, "empty", cs.versions)
         return (cv.actor_id.bytes, "empty_set", cs.ranges)
 
+    def _rebroadcast_hop(self, cv: ChangeV1) -> int:
+        """Hop count for re-gossiping a received payload (debug_hops
+        instrumentation only; 0 when off)."""
+        if not self.config.debug_hops:
+            return 0
+        with self._seen_lock:
+            return self._recv_hops.get(self._seen_key(cv), 0) + 1
+
     def handle_change(self, cv: ChangeV1, source: ChangeSource,
                       rebroadcast: bool = True) -> bool:
         """Process one incoming changeset; returns True if it was news.
@@ -899,7 +937,9 @@ class Agent:
                     return False
                 self._seen[key] = None
                 if len(self._seen) > self.config.seen_cache_size:
-                    self._seen.pop(next(iter(self._seen)))
+                    evicted = next(iter(self._seen))
+                    self._seen.pop(evicted)
+                    self._recv_hops.pop(evicted, None)
         if cv.changeset.ts is not None:
             try:
                 self.clock.update_with_timestamp(cv.changeset.ts)
@@ -916,7 +956,10 @@ class Agent:
         )
         if (rebroadcast and news and source is ChangeSource.BROADCAST
                 and self._loop):
-            self._bcast_queue.put_nowait((cv, self.config.max_transmissions))
+            self._bcast_queue.put_nowait(
+                (cv, self.config.max_transmissions,
+                 self._rebroadcast_hop(cv))
+            )
         if news and self.on_change is not None:
             self.on_change(cv)
         return news
@@ -1119,7 +1162,42 @@ class Agent:
                 *(self._sync_with(m) for m in chosen), return_exceptions=True
             )
 
+    @staticmethod
+    def _request_batches(
+        needs: Dict[ActorId, List[SyncNeedV1]],
+        per_request: int = 10,
+        full_chunk: int = 10,
+    ):
+        """Split a needs map into Request frames the way the reference's
+        client drains them (peer.rs:1240-1371): Full ranges chunked into
+        ≤``full_chunk``-version sub-ranges, ≤``per_request`` needs per
+        Request message."""
+        flat: List[Tuple[ActorId, SyncNeedV1]] = []
+        for actor, actor_needs in needs.items():
+            for n in actor_needs:
+                if n.kind == "full":
+                    s, e = n.versions
+                    while s <= e:
+                        hi = min(s + full_chunk - 1, e)
+                        flat.append((actor, SyncNeedV1.full(s, hi)))
+                        s = hi + 1
+                else:
+                    flat.append((actor, n))
+        for i in range(0, len(flat), per_request):
+            batch = flat[i : i + per_request]
+            grouped: List[Tuple[ActorId, List[SyncNeedV1]]] = []
+            for actor, n in batch:
+                if grouped and grouped[-1][0] == actor:
+                    grouped[-1][1].append(n)
+                else:
+                    grouped.append((actor, [n]))
+            yield grouped
+
     async def _sync_with(self, m: Member) -> int:
+        """Pull-only sync client (parallel_sync one-peer leg,
+        peer.rs:1039-1466): send SyncStart + Clock, read the server's
+        State + Clock, request what they can serve, ingest changesets
+        until the server closes its side."""
         try:
             # through the transport so connects share the timeout and feed
             # RTT samples into the member rings (ring0 classification)
@@ -1128,35 +1206,44 @@ class Agent:
             return 0
         count = 0
         try:
-            ours = self.generate_sync()
+            writer.write(STREAM_BI)
             writer.write(
-                wire.encode_msg(
-                    {
-                        "k": "sync_start",
-                        "actor": wire._b64(self.actor_id),
-                        "cluster": self.config.cluster_id,
-                        "state": _sync_state_to_dict(ours),
-                        "clock": int(self.clock.new_timestamp()),
-                    }
+                speedy.frame(
+                    speedy.encode_bi_payload(
+                        BiPayload(actor_id=ActorId(self.actor_id)),
+                        ClusterId(self.config.cluster_id),
+                    )
+                )
+            )
+            writer.write(
+                speedy.frame(
+                    speedy.encode_sync_message(self.clock.new_timestamp())
                 )
             )
             await writer.drain()
-            frames = wire.FrameReader()
-            theirs: Optional[SyncStateV1] = None
-            done = False
-            while not done:
+            ours = self.generate_sync()
+            frames = speedy.FrameReader()
+            requested = False
+            while True:
                 data = await asyncio.wait_for(reader.read(65536), timeout=10.0)
                 if not data:
-                    break
-                for msg in frames.feed(data):
-                    kind = msg.get("k")
-                    if kind == "sync_reject":
+                    break  # server closed: session complete
+                for payload in frames.feed(data):
+                    msg = speedy.decode_sync_message(payload)
+                    if isinstance(msg, tuple) and msg[0] == "rejection":
+                        self.metrics.counter("corro_sync_rejected_total")
                         return 0
-                    if kind == "sync_state":
-                        theirs = _sync_state_from_dict(msg["state"])
+                    if isinstance(msg, Timestamp):
+                        try:
+                            self.clock.update_with_timestamp(msg)
+                        except Exception:
+                            pass
+                    elif isinstance(msg, SyncStateV1) and not requested:
+                        requested = True
+                        theirs = msg
                         needs = ours.compute_available_needs(theirs)
-                        # peer cleared versions since we last heard: ask for
-                        # cleared-ranges-since-ts (peer.rs:1132-1145)
+                        # peer cleared versions since we last heard: ask
+                        # for cleared-ranges-since-ts (peer.rs:1132-1145)
                         if theirs.last_cleared_ts is not None:
                             known = self.bookie.for_actor(
                                 theirs.actor_id.bytes
@@ -1167,20 +1254,21 @@ class Agent:
                                 needs.setdefault(theirs.actor_id, []).append(
                                     SyncNeedV1.empty(known)
                                 )
-                        writer.write(
-                            wire.encode_msg(
-                                {
-                                    "k": "sync_request",
-                                    "needs": _needs_to_dict(needs),
-                                }
+                        for batch in self._request_batches(needs):
+                            writer.write(
+                                speedy.frame(
+                                    speedy.encode_sync_message(
+                                        ("request", batch)
+                                    )
+                                )
                             )
-                        )
                         await writer.drain()
-                        if not needs:
-                            done = True
-                    elif kind == "sync_change":
-                        cv = wire.change_v1_from_dict(msg["cv"])
-                        if cv.changeset.is_empty_set:
+                        # half-close: no more requests; the server serves
+                        # then closes (EOF-terminated like the reference)
+                        if writer.can_write_eof():
+                            writer.write_eof()
+                    elif isinstance(msg, ChangeV1):
+                        if msg.changeset.is_empty_set:
                             # EmptySet groups advance the cleared
                             # watermark per group, so they must apply in
                             # served order and must never be dropped —
@@ -1189,94 +1277,122 @@ class Agent:
                             # own ordered channel, handlers.rs:539-734)
                             await self._loop.run_in_executor(
                                 self._apply_pool, self.handle_change,
-                                cv, ChangeSource.SYNC,
+                                msg, ChangeSource.SYNC,
                             )
                         else:
-                            self.enqueue_change(cv, ChangeSource.SYNC)
+                            self.enqueue_change(msg, ChangeSource.SYNC)
                         count += 1
-                    elif kind == "sync_done":
-                        done = True
             self.members.update_sync_ts(m.actor_id, time.time())
             self.metrics.counter("corro_sync_client_rounds_total")
             # per-change accounting happens at enqueue_change
             return count
-        except (asyncio.TimeoutError, OSError, ConnectionError):
+        except (asyncio.TimeoutError, OSError, ConnectionError,
+                speedy.SpeedyError):
             return count
         finally:
             writer.close()
 
     async def _serve_tcp(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
-        """Dispatch an inbound TCP connection: a `uni` header frame means
-        a broadcast uni-stream; anything else is a sync session (the TCP
-        analogue of QUIC accept_uni/accept_bi)."""
+        """Dispatch an inbound TCP connection by its one-byte stream
+        prelude (the TCP analogue of QUIC accept_uni/accept_bi); all
+        bytes after it are LengthDelimited speedy frames — the
+        reference's exact stream content."""
         task = asyncio.current_task()
         self._conn_tasks.add(task)
         try:
-            frames = wire.FrameReader()
-            first: List[dict] = []
             try:
-                while not first:
-                    data = await asyncio.wait_for(
-                        reader.read(65536), timeout=10.0
-                    )
-                    if not data:
-                        writer.close()
-                        return
-                    first = frames.feed(data)
-            except (asyncio.TimeoutError, OSError, ConnectionError,
-                    ValueError):
+                prelude = await asyncio.wait_for(
+                    reader.readexactly(1), timeout=10.0
+                )
+            except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                    OSError, ConnectionError):
                 writer.close()
                 return
-            if first[0].get("k") == "uni":
-                await self._serve_uni(reader, writer, frames, first[1:])
+            if prelude == STREAM_UNI:
+                await self._serve_uni(reader, writer)
+            elif prelude == STREAM_BI:
+                await self._serve_sync(reader, writer)
             else:
-                await self._serve_sync(reader, writer, frames, first)
+                writer.close()
         except asyncio.CancelledError:
             writer.close()
             raise
         finally:
             self._conn_tasks.discard(task)
 
-    async def _serve_uni(self, reader, writer, frames, backlog) -> None:
-        """Long-lived inbound broadcast stream: change frames → ingest."""
-        def ingest(msgs):
-            for msg in msgs:
-                if msg.get("k") != "change":
-                    continue
+    async def _serve_uni(self, reader, writer) -> None:
+        """Long-lived inbound broadcast stream: speedy UniPayload frames
+        (broadcast.rs:37-55) → ingest queue."""
+        frames = speedy.FrameReader()
+
+        def ingest(payloads):
+            for payload in payloads:
+                hop = 0
+                if self.config.debug_hops and payload:
+                    hop, payload = payload[0], payload[1:]
                 try:
-                    cv = wire.change_v1_from_dict(msg["cv"])
-                except (KeyError, ValueError, TypeError):
+                    up = speedy.decode_uni_payload(payload)
+                except speedy.SpeedyError:
+                    self.metrics.counter("corro_wire_decode_errors_total")
                     continue
+                if int(up.cluster_id) != self.config.cluster_id:
+                    continue
+                cv = up.broadcast.change
+                if self.config.debug_hops:
+                    key = self._seen_key(cv)
+                    with self._seen_lock:
+                        self._recv_hops.setdefault(key, hop)
                 self.enqueue_change(cv, ChangeSource.BROADCAST)
 
-        ingest(backlog)
         try:
             while True:
                 data = await reader.read(65536)
                 if not data:
                     return
                 ingest(frames.feed(data))
-        except (OSError, ConnectionError, ValueError):
+        except (OSError, ConnectionError, speedy.SpeedyError):
             return
         finally:
             writer.close()
 
+    async def _send_sync_msg(self, writer, msg) -> None:
+        writer.write(speedy.frame(speedy.encode_sync_message(msg)))
+        await writer.drain()
+
     async def _serve_sync(self, reader: asyncio.StreamReader,
-                          writer: asyncio.StreamWriter,
-                          frames: Optional[wire.FrameReader] = None,
-                          backlog: Optional[List[dict]] = None) -> None:
+                          writer: asyncio.StreamWriter) -> None:
+        """Sync server (serve_sync, peer.rs:1469): read the SyncStart
+        BiPayload, reject if over capacity or cross-cluster, send our
+        State + Clock, then serve Request needs until the client
+        half-closes; closing our side ends the session."""
         if self._sync_sem.locked():
-            writer.write(wire.encode_msg({"k": "sync_reject", "reason": "busy"}))
-            await writer.drain()
+            await self._send_sync_msg(
+                writer, ("rejection", speedy.REJECTION_MAX_CONCURRENCY)
+            )
             writer.close()
             return
         async with self._sync_sem:
             try:
-                if frames is None:
-                    frames = wire.FrameReader()
-                queued: List[dict] = list(backlog or [])
-                their_state: Optional[SyncStateV1] = None
+                frames = speedy.FrameReader()
+                payloads: List[bytes] = []
+                while not payloads:
+                    data = await asyncio.wait_for(
+                        reader.read(65536), timeout=10.0
+                    )
+                    if not data:
+                        return
+                    payloads = frames.feed(data)
+                _bi, cluster = speedy.decode_bi_payload(payloads[0])
+                if int(cluster) != self.config.cluster_id:
+                    await self._send_sync_msg(
+                        writer,
+                        ("rejection", speedy.REJECTION_DIFFERENT_CLUSTER),
+                    )
+                    return
+                await self._send_sync_msg(writer, self.generate_sync())
+                await self._send_sync_msg(writer, self.clock.new_timestamp())
+                queued = payloads[1:]
                 while True:
                     if queued:
                         msgs, queued = queued, []
@@ -1285,50 +1401,33 @@ class Agent:
                             reader.read(65536), timeout=10.0
                         )
                         if not data:
-                            return
+                            return  # client half-closed: all needs served
                         msgs = frames.feed(data)
-                    for msg in msgs:
-                        kind = msg.get("k")
-                        if kind == "sync_start":
-                            if msg.get("cluster", 0) != self.config.cluster_id:
-                                writer.write(
-                                    wire.encode_msg(
-                                        {"k": "sync_reject", "reason": "cluster"}
-                                    )
-                                )
-                                await writer.drain()
-                                return
-                            their_state = _sync_state_from_dict(msg["state"])
-                            writer.write(
-                                wire.encode_msg(
-                                    {
-                                        "k": "sync_state",
-                                        "state": _sync_state_to_dict(
-                                            self.generate_sync()
-                                        ),
-                                    }
-                                )
-                            )
-                            await writer.drain()
-                        elif kind == "sync_request":
-                            for actor_b64, needs in msg["needs"]:
-                                actor = wire._unb64(actor_b64)
+                    for payload in msgs:
+                        msg = speedy.decode_sync_message(payload)
+                        if isinstance(msg, Timestamp):
+                            try:
+                                self.clock.update_with_timestamp(msg)
+                            except Exception:
+                                pass
+                        elif isinstance(msg, tuple) and msg[0] == "request":
+                            for actor, needs in msg[1]:
                                 for need in needs:
-                                    await self._serve_need(writer, actor, need)
-                            writer.write(wire.encode_msg({"k": "sync_done"}))
-                            await writer.drain()
-                            return
-            except (asyncio.TimeoutError, OSError, ConnectionError):
+                                    await self._serve_need(
+                                        writer, actor.bytes, need
+                                    )
+            except (asyncio.TimeoutError, OSError, ConnectionError,
+                    speedy.SpeedyError):
                 return
             finally:
                 writer.close()
 
     async def _serve_need(self, writer: asyncio.StreamWriter, actor: bytes,
-                          need: dict) -> None:
+                          need: SyncNeedV1) -> None:
         bv = self.bookie.for_actor(actor)
-        kind = need["kind"]
+        kind = need.kind
         if kind == "full":
-            s, e = need["versions"]
+            s, e = need.versions
             # clamp hostile/stale ranges to what we can possibly serve
             s, e = max(1, int(s)), min(int(e), bv.last())
             for i, v in enumerate(range(s, e + 1)):
@@ -1336,10 +1435,10 @@ class Agent:
                 if i % 64 == 63:
                     await asyncio.sleep(0)  # don't starve the event loop
         elif kind == "partial":
-            v = need["version"]
+            v = int(need.version)
             await self._serve_version(
                 writer, actor, bv, v,
-                seq_spans=[tuple(sp) for sp in need["seqs"]],
+                seq_spans=[tuple(sp) for sp in need.seqs],
             )
         elif kind == "empty":
             # only cleared ranges strictly NEWER than the requester's
@@ -1349,9 +1448,8 @@ class Agent:
             # message without ever missing a sibling range
             if bv.last_cleared_ts is None:
                 return
-            for group_ts, spans in self.bookie.cleared_since(
-                actor, need.get("ts")
-            ):
+            since = int(need.ts) if need.ts is not None else None
+            for group_ts, spans in self.bookie.cleared_since(actor, since):
                 cs = Changeset.empty_set(spans, Timestamp(group_ts))
                 await self._send_sync_change(writer, actor, cs)
 
@@ -1390,13 +1488,18 @@ class Agent:
             for s, e in have:
                 chunk = [buffered[q] for q in range(s, e + 1) if q in buffered]
                 cs = Changeset.full(
-                    Version(v), chunk, (s, e), partial.last_seq, partial.ts
+                    Version(v), chunk, (s, e), partial.last_seq,
+                    partial.ts or Timestamp(0),
                 )
                 await self._send_sync_change(writer, actor, cs)
             return
         db_version, last_seq = entry
         site = None if actor == self.actor_id else actor
         changes = self.storage.collect_changes((db_version, db_version), site)
+        # Full changesets carry a non-optional ts on the wire
+        # (broadcast.rs:118): re-serve with the ts recorded at apply time
+        row_ts = self.bookie.version_ts(actor, v)
+        ts = Timestamp(row_ts) if row_ts is not None else Timestamp(0)
         if seq_spans is not None:
             changes = [
                 c
@@ -1407,19 +1510,17 @@ class Agent:
                 span_changes = [c for c in changes if s <= int(c.seq) <= e]
                 cs = Changeset.full(
                     Version(v), span_changes, (s, e), last_seq,
-                    bv.partials.get(v).ts if v in bv.partials else None,
+                    (bv.partials[v].ts or ts) if v in bv.partials else ts,
                 )
                 await self._send_sync_change(writer, actor, cs)
             return
         for chunk, seqs in ChunkedChanges(changes, 0, last_seq):
-            cs = Changeset.full(Version(v), chunk, seqs, last_seq, None)
+            cs = Changeset.full(Version(v), chunk, seqs, last_seq, ts)
             await self._send_sync_change(writer, actor, cs)
 
     async def _send_sync_change(self, writer, actor: bytes, cs: Changeset) -> None:
         cv = ChangeV1(actor_id=ActorId(actor), changeset=cs)
-        writer.write(
-            wire.encode_msg({"k": "sync_change", "cv": wire.change_v1_to_dict(cv)})
-        )
+        writer.write(speedy.frame(speedy.encode_sync_message(cv)))
         await writer.drain()
 
 
@@ -1503,50 +1604,6 @@ def _sync_state_to_dict(st: SyncStateV1) -> dict:
             int(st.last_cleared_ts) if st.last_cleared_ts is not None else None
         ),
     }
-
-
-def _sync_state_from_dict(d: dict) -> SyncStateV1:
-    st = SyncStateV1(actor_id=ActorId(wire._unb64(d["actor"])))
-    st.heads = {
-        ActorId(wire._unb64(a)): Version(v) for a, v in d.get("heads", {}).items()
-    }
-    st.need = {
-        ActorId(wire._unb64(a)): [tuple(sp) for sp in spans]
-        for a, spans in d.get("need", {}).items()
-    }
-    st.partial_need = {
-        ActorId(wire._unb64(a)): {
-            Version(int(v)): [tuple(sp) for sp in spans]
-            for v, spans in partials.items()
-        }
-        for a, partials in d.get("partial_need", {}).items()
-    }
-    ts = d.get("last_cleared_ts")
-    st.last_cleared_ts = Timestamp(ts) if ts is not None else None
-    return st
-
-
-def _needs_to_dict(needs: Dict[ActorId, List[SyncNeedV1]]) -> list:
-    out = []
-    for actor, lst in needs.items():
-        entries = []
-        for n in lst:
-            if n.kind == "full":
-                entries.append({"kind": "full", "versions": list(n.versions)})
-            elif n.kind == "partial":
-                entries.append(
-                    {
-                        "kind": "partial",
-                        "version": int(n.version),
-                        "seqs": [list(sp) for sp in n.seqs],
-                    }
-                )
-            else:
-                entries.append(
-                    {"kind": "empty", "ts": int(n.ts) if n.ts else None}
-                )
-        out.append([wire._b64(actor.bytes), entries])
-    return out
 
 
 def _parse_addr(s: str) -> Tuple[str, int]:

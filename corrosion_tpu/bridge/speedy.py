@@ -555,3 +555,17 @@ def deframe(buf: bytes) -> Tuple[List[bytes], bytes]:
         frames.append(buf[pos + 4 : pos + 4 + n])
         pos += 4 + n
     return frames, buf[pos:]
+
+
+class FrameReader:
+    """Incremental LengthDelimited deframer for stream transports:
+    feed() raw bytes, get back complete frame payloads."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[bytes]:
+        self._buf += data
+        frames, rest = deframe(bytes(self._buf))
+        self._buf = bytearray(rest)
+        return frames
