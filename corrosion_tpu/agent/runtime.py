@@ -106,6 +106,12 @@ class AgentConfig:
     # hop count so a harness can measure real dissemination depth.
     # MUST stay off for reference-byte-exact wire compatibility.
     debug_hops: bool = False
+    # ring0-first fanout for local changes (broadcast/mod.rs:586-643).
+    # Calibration harnesses disable it so agents match the simulator's
+    # uniform-sampling model (on loopback EVERY peer is ring0).
+    ring0_enabled: bool = True
+    # LRU cap on cached outbound uni connections (fd budget)
+    uni_cache_size: int = 512
 
 
 class Agent:
@@ -213,16 +219,29 @@ class Agent:
             thread_name_prefix="corro-apply",
         )
         self.transport = Transport(
-            metrics=self.metrics, on_rtt=self._record_rtt
+            metrics=self.metrics, on_rtt=self._record_rtt,
+            max_cached=self.config.uni_cache_size,
         )
-        self._udp, _ = await self._loop.create_datagram_endpoint(
-            lambda: _UdpProtocol(self),
-            local_addr=(self.config.gossip_host, self.config.gossip_port),
-        )
-        self.gossip_addr = self._udp.get_extra_info("sockname")[:2]
-        self._tcp = await asyncio.start_server(
-            self._serve_tcp, self.config.gossip_host, self.gossip_addr[1]
-        )
+        # one gossip port for both datagrams (SWIM) and streams, like the
+        # reference's single QUIC/UDP endpoint; with an ephemeral port the
+        # TCP side of the pair may be taken by someone else — rebind
+        for attempt in range(16):
+            self._udp, _ = await self._loop.create_datagram_endpoint(
+                lambda: _UdpProtocol(self),
+                local_addr=(self.config.gossip_host, self.config.gossip_port),
+            )
+            self.gossip_addr = self._udp.get_extra_info("sockname")[:2]
+            try:
+                self._tcp = await asyncio.start_server(
+                    self._serve_tcp, self.config.gossip_host,
+                    self.gossip_addr[1],
+                )
+                break
+            except OSError:
+                self._udp.close()
+                self._udp = None
+                if self.config.gossip_port != 0 or attempt == 15:
+                    raise
         self._load_members()
         if self.config.subs_enabled:
             from corrosion_tpu.agent.pubsub import SubsManager
@@ -696,7 +715,7 @@ class Agent:
                 local = cv.actor_id.bytes == self.actor_id
                 targets = self.members.sample(
                     cfg.fanout, self._rng,
-                    ring0_first=local and not sent_to,
+                    ring0_first=cfg.ring0_enabled and local and not sent_to,
                     exclude=sent_to,
                 )
                 for m in targets:

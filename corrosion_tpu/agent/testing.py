@@ -58,6 +58,20 @@ async def launch_test_agent(
     return agent
 
 
+def seed_full_membership(agents) -> None:
+    """Give every agent a complete ALIVE member view of the others.
+
+    Harness shortcut for large static-membership experiments (e.g. the
+    sim-vs-agent calibration at N=256): the epidemic under measurement is
+    the broadcast, and full membership is its precondition — SWIM's own
+    dissemination is measured separately (BASELINE config #2)."""
+    for a in agents:
+        for b in agents:
+            if a is b:
+                continue
+            a.members.upsert(b.actor_id, tuple(b.gossip_addr))
+
+
 async def wait_for(predicate, timeout: float = 10.0, interval: float = 0.05):
     """Poll until predicate() is truthy or raise TimeoutError."""
     loop = asyncio.get_running_loop()

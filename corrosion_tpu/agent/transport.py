@@ -72,11 +72,15 @@ class Transport:
     """Connection-caching sender.  All methods are loop-affine."""
 
     def __init__(self, metrics=None, connect_timeout: float = 2.0,
-                 on_rtt=None):
+                 on_rtt=None, max_cached: int = 512):
         self._uni: Dict[Addr, UniConnection] = {}
         self.metrics = metrics
         self.connect_timeout = connect_timeout
         self.on_rtt = on_rtt  # callback(addr, rtt_seconds)
+        # LRU cap on cached uni connections (the reference's QUIC conns
+        # close on idle timeout; an unbounded TCP cache leaks fds in
+        # large in-process clusters)
+        self.max_cached = max_cached
 
     async def _open(self, addr: Addr, header: bytes) -> UniConnection:
         t0 = time.monotonic()
@@ -102,6 +106,22 @@ class Transport:
             try:
                 if conn is None:
                     conn = await self._open(addr, header)
+                    self._uni[addr] = conn
+                    excess = len(self._uni) - self.max_cached
+                    for old_addr in list(self._uni):
+                        if excess <= 0:
+                            break
+                        old = self._uni[old_addr]
+                        # never close a connection a concurrent sender
+                        # holds (its write would die mid-frame)
+                        if old is conn or old.lock.locked():
+                            continue
+                        self._uni.pop(old_addr)
+                        old.close()
+                        excess -= 1
+                else:
+                    # LRU touch
+                    self._uni.pop(addr, None)
                     self._uni[addr] = conn
                 async with conn.lock:
                     conn.writer.write(frames)

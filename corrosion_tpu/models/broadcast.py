@@ -38,6 +38,13 @@ class BroadcastParams:
     ring0_size: int = 256  # ring0 block width (RTT<6ms tier stand-in)
     max_transmissions: int = 8  # retransmit decay budget per payload
     loss: float = 0.0  # per-message drop probability
+    # retransmission backoff in ticks: the nth retransmission waits
+    # backoff_ticks*n after the previous send (the reference requeues
+    # with 100ms*send_count, broadcast/mod.rs:745-765, while FRESH
+    # payloads forward within one flush interval — so infection trees
+    # run deeper than synchronous-round models predict).  0 = send
+    # every tick (legacy synchronous-rounds behavior).
+    backoff_ticks: float = 0.0
 
     @property
     def fanout(self) -> int:
@@ -55,9 +62,14 @@ def _draw_targets(key, params: BroadcastParams):
     return jnp.concatenate([ring0_targets, global_targets], axis=1)
 
 
+# sentinel hop depth for "not yet infected" (far above any real depth)
+HOP_UNSET = jnp.int32(2**30)
+
+
 @partial(jax.jit, static_argnames=("params",))
 def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
-                   partition_id=None, partition_active=False):
+                   partition_id=None, partition_active=False, hops=None,
+                   tick=None, next_send=None):
     """One gossip tick for every node at once.
 
     rows:         [N, R] packed CRDT keys (the node's table state)
@@ -67,13 +79,22 @@ def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
     key:          PRNG key for this tick
     partition_id: [N] int32 block id; messages crossing blocks are dropped
                   while ``partition_active`` (pass a traced bool)
+    hops:         optional [N] int32 infection-tree depth (HOP_UNSET =
+                  not infected); maintained by scatter-min of
+                  sender_hop+1 over delivering messages — directly
+                  comparable to the live agent's debug_hops counter
 
-    Returns (rows', tx_remaining', msgs_sent').
+    Returns (rows', tx_remaining', msgs_sent') or, with hops,
+    (rows', tx_remaining', msgs_sent', hops').
     """
     n, k = params.n_nodes, params.fanout
     key_t, key_l = jax.random.split(key)
 
     active = tx_remaining > 0  # [N]
+    if next_send is not None:
+        if tick is None:
+            raise ValueError("next_send requires tick")
+        active &= next_send <= tick
     targets = _draw_targets(key_t, params)  # [N, K]
 
     # message viability: sender active, not lost, not across a partition
@@ -94,4 +115,31 @@ def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
     tx = jnp.where(learned, params.max_transmissions, tx)
 
     msgs = msgs_sent + jnp.where(active, k, 0).astype(msgs_sent.dtype)
-    return new_rows, tx, msgs
+    if next_send is not None:
+        # nth retransmission waits backoff*n ticks; a fresh payload
+        # (learner) forwards on the very next tick
+        send_count = params.max_transmissions - tx  # nth send just made
+        gap = jnp.maximum(
+            1,
+            jnp.round(params.backoff_ticks * send_count).astype(jnp.int32),
+        )
+        nxt = jnp.where(active, tick + gap, next_send)
+        nxt = jnp.where(learned, tick + 1, nxt)
+    if hops is None:
+        if next_send is not None:
+            return new_rows, tx, msgs, nxt
+        return new_rows, tx, msgs
+
+    # first-infection depth: min over this tick's delivering senders
+    sender_hops = jnp.repeat(
+        jnp.minimum(hops, HOP_UNSET) + 1, k
+    )  # [N*K]
+    cand = (
+        jnp.full((n + 1,), HOP_UNSET, jnp.int32)
+        .at[flat_targets]
+        .min(sender_hops)[:n]
+    )
+    new_hops = jnp.where(learned, jnp.minimum(hops, cand), hops)
+    if next_send is not None:
+        return new_rows, tx, msgs, new_hops, nxt
+    return new_rows, tx, msgs, new_hops

@@ -33,7 +33,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from corrosion_tpu.models.broadcast import BroadcastParams, broadcast_step
+from corrosion_tpu.models.broadcast import (
+    HOP_UNSET,
+    BroadcastParams,
+    broadcast_step,
+)
 from corrosion_tpu.models.sync import SyncParams, sync_step
 from corrosion_tpu.ops.keys import DEFAULT_CODEC
 
@@ -51,6 +55,9 @@ class EpidemicConfig:
     # cross-traffic is dropped until `heal_tick`
     partition_blocks: int = 1
     heal_tick: int = 0
+    # nth retransmission waits backoff_ticks*n (reference 100ms*n);
+    # 0 = send every tick (synchronous rounds)
+    backoff_ticks: float = 0.0
     # anti-entropy cadence (0 = disabled)
     sync_interval: int = 8
     sync_peers: int = 1
@@ -67,6 +74,7 @@ class EpidemicConfig:
             ring0_size=min(self.ring0_size, self.n_nodes),
             max_transmissions=self.max_transmissions,
             loss=self.loss,
+            backoff_ticks=self.backoff_ticks,
         )
 
     @property
@@ -83,6 +91,8 @@ class EpidemicState(NamedTuple):
     tx_remaining: jnp.ndarray  # [N] int32
     msgs: jnp.ndarray  # [N] int32
     tick: jnp.ndarray  # scalar int32
+    hops: jnp.ndarray  # [N] int32 infection depth (HOP_UNSET = not yet)
+    next_send: jnp.ndarray  # [N] int32 earliest tick of the next send
 
 
 def epidemic_init(cfg: EpidemicConfig, writer: int = 0) -> EpidemicState:
@@ -107,6 +117,8 @@ def epidemic_init(cfg: EpidemicConfig, writer: int = 0) -> EpidemicState:
         tx_remaining=tx,
         msgs=jnp.zeros((n,), jnp.int32),
         tick=jnp.zeros((), jnp.int32),
+        hops=jnp.full((n,), HOP_UNSET, jnp.int32).at[writer].set(0),
+        next_send=jnp.zeros((n,), jnp.int32),
     )
 
 
@@ -126,7 +138,7 @@ def epidemic_tick(state: EpidemicState, key, cfg: EpidemicConfig) -> EpidemicSta
     part_active = state.tick < cfg.heal_tick
     k_b, k_s = jax.random.split(key)
 
-    rows, tx, msgs = broadcast_step(
+    rows, tx, msgs, hops, next_send = broadcast_step(
         state.rows,
         state.tx_remaining,
         state.msgs,
@@ -134,6 +146,9 @@ def epidemic_tick(state: EpidemicState, key, cfg: EpidemicConfig) -> EpidemicSta
         cfg.broadcast_params,
         partition_id=part,
         partition_active=part_active,
+        hops=state.hops,
+        tick=state.tick,
+        next_send=state.next_send,
     )
 
     if cfg.sync_interval > 0:
@@ -151,7 +166,7 @@ def epidemic_tick(state: EpidemicState, key, cfg: EpidemicConfig) -> EpidemicSta
             (rows, msgs),
         )
 
-    return EpidemicState(rows, tx, msgs, state.tick + 1)
+    return EpidemicState(rows, tx, msgs, state.tick + 1, hops, next_send)
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -165,7 +180,18 @@ def _scan_chunk(state: EpidemicState, seed_key, target_row, cfg: EpidemicConfig)
         # per-tick message aggregates so per-seed stats can be read at the
         # seed's OWN convergence tick, not at global loop stop
         msgs_f = nxt.msgs.astype(jnp.float32)
-        return nxt, (converged, jnp.mean(msgs_f), jnp.percentile(msgs_f, 99))
+        # infection depth; nodes healed by sync (never infected via
+        # broadcast) report as max_ticks so loss shows up, not hides
+        hops_f = jnp.where(
+            nxt.hops >= HOP_UNSET, jnp.int32(cfg.max_ticks), nxt.hops
+        ).astype(jnp.float32)
+        return nxt, (
+            converged,
+            jnp.mean(msgs_f),
+            jnp.percentile(msgs_f, 99),
+            jnp.percentile(hops_f, 50),
+            jnp.percentile(hops_f, 99),
+        )
 
     return jax.lax.scan(body, state, xs=None, length=cfg.chunk_ticks)
 
@@ -198,13 +224,18 @@ def run_epidemic_seeds(cfg: EpidemicConfig, n_seeds: int = 16, seed: int = 0):
 
     t0 = time.perf_counter()
     flags, means, p99s = [], [], []  # each: list of [S, C] arrays
+    h50s, h99s = [], []
     ticks_done = 0
     while ticks_done < cfg.max_ticks:
-        states, (conv, m_mean, m_p99) = chunk(states, keys, target)
+        states, (conv, m_mean, m_p99, h_p50, h_p99) = chunk(
+            states, keys, target
+        )
         conv = np.asarray(conv)  # [S, C] (vmap leads with the seed axis)
         flags.append(conv)
         means.append(np.asarray(m_mean))
         p99s.append(np.asarray(m_p99))
+        h50s.append(np.asarray(h_p50))
+        h99s.append(np.asarray(h_p99))
         ticks_done += cfg.chunk_ticks
         if conv[:, -1].all():
             break
@@ -213,6 +244,8 @@ def run_epidemic_seeds(cfg: EpidemicConfig, n_seeds: int = 16, seed: int = 0):
     allflags = np.concatenate(flags, axis=1)  # [S, T]
     allmeans = np.concatenate(means, axis=1)
     allp99s = np.concatenate(p99s, axis=1)
+    allh50s = np.concatenate(h50s, axis=1)
+    allh99s = np.concatenate(h99s, axis=1)
     converged = allflags.any(axis=1)
     # per-seed stats taken at that seed's own convergence tick (last tick
     # run if it never converged)
@@ -227,6 +260,8 @@ def run_epidemic_seeds(cfg: EpidemicConfig, n_seeds: int = 16, seed: int = 0):
         "ticks_p99": float(np.percentile(first, 99)),
         "msgs_per_node_mean": float(allmeans[rows, first_idx].mean()),
         "msgs_per_node_p99": float(allp99s[rows, first_idx].mean()),
+        "hops_p50": float(allh50s[rows, first_idx].mean()),
+        "hops_p99": float(allh99s[rows, first_idx].mean()),
         "wall_s": wall,
         "ticks_run": ticks_done,
     }

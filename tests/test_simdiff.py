@@ -13,13 +13,20 @@ def test_sim_trace_converges():
 
 
 def test_agent_vs_sim_diff_small():
-    """Boot a real 8-agent cluster and diff its convergence trace against
-    the simulator under matched fanout/max_transmissions."""
-    sim = sim_trace(8, fanout=3, max_transmissions=5, seeds=4)
-    ag = asyncio.run(agent_trace(8, fanout=3, max_transmissions=5, timeout=30.0))
+    """Boot a real 16-agent cluster and diff its convergence trace against
+    the simulator under matched fanout/max_transmissions, comparing
+    MEASURED hop depths (on-wire hop counter) and msgs/node."""
+    sim = sim_trace(16, fanout=3, max_transmissions=5, seeds=4)
+    ag = asyncio.run(
+        agent_trace(16, fanout=3, max_transmissions=5, writes=3, timeout=30.0)
+    )
     d = diff_traces(sim, ag)
     assert d["diff"]["both_converged"]
-    # same protocol, same parameters: message counts land in the same
-    # regime (the sim models rounds, agents real time — allow slack)
-    assert 0.1 < d["diff"]["msgs_per_node_ratio"] < 10.0
+    # every node must have a measured hop depth (origin = synthetic 0)
+    assert ag["hops_measured"] == 3 * 16
+    assert ag["hops_p50"] >= 1
+    # same protocol, same parameters: measured quantities land in the
+    # same regime (sent_to residual allows slack at small N)
+    assert 0.3 < d["diff"]["msgs_per_node_ratio"] < 3.5
+    assert 0.3 < d["diff"]["hops_p50_ratio"] < 3.5
     assert d["agents"]["msgs_per_node"] > 0
