@@ -18,16 +18,26 @@ import sqlite3
 from typing import Optional
 
 
+def _connect(path: str) -> sqlite3.Connection:
+    """Open an agent database with the CRR layer's SQL functions
+    registered (expression indexes reference them)."""
+    from corrosion_tpu.agent.storage import register_udfs
+
+    conn = sqlite3.connect(path)
+    register_udfs(conn)
+    return conn
+
+
 def backup(db_path: str, out_path: str) -> None:
     """Write a consistent, scrubbed snapshot of the database."""
     if os.path.exists(out_path):
         raise FileExistsError(out_path)
-    src = sqlite3.connect(db_path)
+    src = _connect(db_path)
     try:
         src.execute("VACUUM INTO ?", (out_path,))
     finally:
         src.close()
-    snap = sqlite3.connect(out_path)
+    snap = _connect(out_path)
     try:
         # scrub node-local state: membership and gossip runtime tables are
         # not part of the data being backed up
@@ -60,8 +70,8 @@ def restore(backup_path: str, db_path: str,
     """
     import uuid
 
-    src = sqlite3.connect(backup_path)
-    dst = sqlite3.connect(db_path)
+    src = _connect(backup_path)
+    dst = _connect(db_path)
     try:
         src.backup(dst)
         new_site = site_id or uuid.uuid4().bytes

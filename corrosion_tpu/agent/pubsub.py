@@ -356,7 +356,10 @@ class SubsManager:
         return handle
 
     def _create(self, sub_id: str, nsql: str) -> SubscriptionHandle:
+        from corrosion_tpu.agent.storage import register_udfs
+
         scratch = sqlite3.connect(self.agent.config.db_path)
+        register_udfs(scratch)
         try:
             tables = tables_of_query(scratch, nsql)
         finally:

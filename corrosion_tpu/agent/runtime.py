@@ -61,6 +61,10 @@ STREAM_UNI = b"U"
 STREAM_BI = b"B"
 
 
+class _SlowPeer(Exception):
+    """Sync serving aborted: the peer cannot keep up (peer.rs:796-811)."""
+
+
 @dataclass
 class AgentConfig:
     db_path: str
@@ -297,7 +301,13 @@ class Agent:
             self._udp.close()
         if self._tcp:
             self._tcp.close()
-            await self._tcp.wait_closed()
+            try:
+                # wait_closed waits for every handler's transport to
+                # flush; a peer that stopped reading would hold shutdown
+                # hostage
+                await asyncio.wait_for(self._tcp.wait_closed(), timeout=2.0)
+            except asyncio.TimeoutError:
+                pass
         if self._http:
             self._http.shutdown()
             self._http.server_close()
@@ -1169,17 +1179,208 @@ class Agent:
         )
         while True:
             await asyncio.sleep(next(delays))
-            peers = [
-                m for m in self.members.alive() if m.state is MemberState.ALIVE
-            ]
-            if not peers:
-                continue
-            chosen = self._rng.sample(
-                peers, min(self.config.sync_peers, len(peers))
+            try:
+                await self.sync_round()
+            except Exception:
+                self.metrics.counter("corro_sync_round_errors_total")
+
+    def _choose_sync_peers(self, ours: SyncStateV1) -> List[Member]:
+        """Peer choice heuristic (handlers.rs:963-1074): sample 2x the
+        desired count uniformly, then keep the best by (most needed
+        from them, longest since last sync, lowest RTT)."""
+        peers = [
+            m for m in self.members.alive() if m.state is MemberState.ALIVE
+        ]
+        if not peers:
+            return []
+        desired = max(min(len(peers) // 100, 10), min(3, len(peers)))
+        desired = min(desired, self.config.sync_peers)
+        cands = self._rng.sample(peers, min(desired * 2, len(peers)))
+        cands.sort(
+            key=lambda m: (
+                -ours.need_len_for_actor(ActorId(m.actor_id)),
+                m.last_sync_ts,
+                m.rtt_ms if m.rtt_ms is not None else float("inf"),
             )
-            await asyncio.gather(
-                *(self._sync_with(m) for m in chosen), return_exceptions=True
+        )
+        return cands[:desired]
+
+    async def sync_round(self) -> int:
+        """One full client round: choose peers, parallel_sync them."""
+        ours = self.generate_sync()
+        chosen = self._choose_sync_peers(ours)
+        if not chosen:
+            return 0
+        return await self.parallel_sync(chosen, ours)
+
+    async def parallel_sync(
+        self, members: Sequence[Member], ours: Optional[SyncStateV1] = None
+    ) -> int:
+        """Sync with several peers at once, deduping needs across them
+        (peer.rs:1039-1466): handshake everyone, then allocate each need
+        to exactly one server — two peers serving disjoint halves of a
+        node's gaps is the healthy case, not a coincidence."""
+        if ours is None:
+            ours = self.generate_sync()
+        sessions = [
+            s
+            for s in await asyncio.gather(
+                *(self._sync_handshake(m) for m in members),
+                return_exceptions=True,
             )
+            if isinstance(s, dict)
+        ]
+        if not sessions:
+            return 0
+        try:
+            self._allocate_needs(sessions, ours)
+        except BaseException:
+            # one malformed peer state must not leak the other sessions
+            for s in sessions:
+                s["writer"].close()
+            raise
+        counts = await asyncio.gather(
+            *(self._sync_session(s) for s in sessions),
+            return_exceptions=True,
+        )
+        return sum(c for c in counts if isinstance(c, int))
+
+    def _allocate_needs(
+        self, sessions: List[dict], ours: SyncStateV1
+    ) -> None:
+        # cross-peer dedup with round-robin allocation: servers take
+        # turns draining ≤10 needs from their own advertised queue while
+        # a shared requested-set skips what another server already got —
+        # so N servers holding the same data end up serving disjoint
+        # slices of it (peer.rs:1240-1371)
+        from collections import deque
+
+        req_full: set = set()  # (actor_bytes, version)
+        req_partial: Dict[tuple, RangeSet] = {}  # (actor, version) -> seqs
+        queues: List = []
+        for s in sessions:
+            theirs = s["theirs"]
+            needs = ours.compute_available_needs(theirs)
+            if theirs.last_cleared_ts is not None:
+                known = self.bookie.for_actor(
+                    theirs.actor_id.bytes
+                ).last_cleared_ts
+                if known is None or int(known) < int(theirs.last_cleared_ts):
+                    needs.setdefault(theirs.actor_id, []).append(
+                        SyncNeedV1.empty(known)
+                    )
+            q = deque()
+            for actor, actor_needs in needs.items():
+                for n in actor_needs:
+                    if n.kind == "full":
+                        lo, hi = n.versions
+                        while lo <= hi:  # 10-version chunks (peer.rs:1285)
+                            q.append(
+                                (actor, SyncNeedV1.full(lo, min(lo + 9, hi)))
+                            )
+                            lo += 10
+                    else:
+                        q.append((actor, n))
+            queues.append(q)
+            s["needs"] = {}
+        while any(queues):
+            for s, q in zip(sessions, queues):
+                taken = 0
+                while q and taken < 10:
+                    actor, n = q.popleft()
+                    ab = actor.bytes
+                    out: List[SyncNeedV1] = []
+                    if n.kind == "full":
+                        span = RangeSet()
+                        for v in range(n.versions[0], n.versions[1] + 1):
+                            if (ab, v) not in req_full:
+                                req_full.add((ab, v))
+                                span.insert(v, v)
+                        out.extend(
+                            SyncNeedV1.full(a, b) for a, b in span.spans()
+                        )
+                    elif n.kind == "partial":
+                        key = (ab, int(n.version))
+                        got = req_partial.setdefault(key, RangeSet())
+                        fresh = []
+                        for s0, e0 in n.seqs:
+                            for a, b in got.gaps(s0, e0):
+                                fresh.append((a, b))
+                                got.insert(a, b)
+                        if fresh:
+                            out.append(SyncNeedV1.partial(n.version, fresh))
+                    else:
+                        out.append(n)  # empty-need is per-server
+                    if out:
+                        s["needs"].setdefault(actor, []).extend(out)
+                        taken += 1
+
+    async def _sync_handshake(self, m: Member) -> Optional[dict]:
+        """Open a bi-stream, send SyncStart + Clock, read the server's
+        State (+Clock); returns a session dict or None on reject."""
+        try:
+            # through the transport so connects share the timeout and feed
+            # RTT samples into the member rings (ring0 classification)
+            reader, writer = await self.transport.open_bi(tuple(m.addr))
+        except (OSError, asyncio.TimeoutError):
+            return None
+        try:
+            writer.write(STREAM_BI)
+            writer.write(
+                speedy.frame(
+                    speedy.encode_bi_payload(
+                        BiPayload(actor_id=ActorId(self.actor_id)),
+                        ClusterId(self.config.cluster_id),
+                    )
+                )
+            )
+            writer.write(
+                speedy.frame(
+                    speedy.encode_sync_message(self.clock.new_timestamp())
+                )
+            )
+            await writer.drain()
+            frames = speedy.FrameReader()
+            backlog: List = []
+            while True:
+                data = await asyncio.wait_for(reader.read(65536), timeout=10.0)
+                if not data:
+                    writer.close()
+                    return None
+                batch = frames.feed(data)
+                for i, payload in enumerate(batch):
+                    msg = speedy.decode_sync_message(payload)
+                    if isinstance(msg, tuple) and msg[0] == "rejection":
+                        self.metrics.counter("corro_sync_rejected_total")
+                        writer.close()
+                        return None
+                    if isinstance(msg, Timestamp):
+                        try:
+                            self.clock.update_with_timestamp(msg)
+                        except Exception:
+                            pass
+                    elif isinstance(msg, SyncStateV1):
+                        # frames decoded after State in the same read
+                        # (routinely the server's Clock) carry over to
+                        # the session instead of being dropped
+                        backlog.extend(
+                            speedy.decode_sync_message(p)
+                            for p in batch[i + 1 :]
+                        )
+                        return {
+                            "member": m,
+                            "reader": reader,
+                            "writer": writer,
+                            "frames": frames,
+                            "theirs": msg,
+                            "backlog": backlog,
+                        }
+                    else:
+                        backlog.append(msg)
+        except (asyncio.TimeoutError, OSError, ConnectionError,
+                speedy.SpeedyError):
+            writer.close()
+            return None
 
     @staticmethod
     def _request_batches(
@@ -1212,94 +1413,57 @@ class Agent:
                     grouped.append((actor, [n]))
             yield grouped
 
-    async def _sync_with(self, m: Member) -> int:
-        """Pull-only sync client (parallel_sync one-peer leg,
-        peer.rs:1039-1466): send SyncStart + Clock, read the server's
-        State + Clock, request what they can serve, ingest changesets
-        until the server closes its side."""
-        try:
-            # through the transport so connects share the timeout and feed
-            # RTT samples into the member rings (ring0 classification)
-            reader, writer = await self.transport.open_bi(tuple(m.addr))
-        except (OSError, asyncio.TimeoutError):
-            return 0
+    async def _ingest_sync_change(self, cv: ChangeV1) -> None:
+        if cv.changeset.is_empty_set:
+            # EmptySet groups advance the cleared watermark per group,
+            # so they must apply in served order and must never be
+            # dropped — bypass the drop-oldest ingest queue (the
+            # reference likewise gives emptysets their own ordered
+            # channel, handlers.rs:539-734)
+            await self._loop.run_in_executor(
+                self._apply_pool, self.handle_change, cv, ChangeSource.SYNC,
+            )
+        else:
+            self.enqueue_change(cv, ChangeSource.SYNC)
+
+    async def _sync_session(self, s: dict) -> int:
+        """Send this session's allocated requests, then ingest served
+        changesets until the server closes its side."""
+        m, reader, writer = s["member"], s["reader"], s["writer"]
+        frames = s["frames"]
         count = 0
         try:
-            writer.write(STREAM_BI)
-            writer.write(
-                speedy.frame(
-                    speedy.encode_bi_payload(
-                        BiPayload(actor_id=ActorId(self.actor_id)),
-                        ClusterId(self.config.cluster_id),
-                    )
+            for msg in s["backlog"]:
+                if isinstance(msg, ChangeV1):
+                    await self._ingest_sync_change(msg)
+                    count += 1
+                elif isinstance(msg, Timestamp):
+                    try:
+                        self.clock.update_with_timestamp(msg)
+                    except Exception:
+                        pass
+            for batch in self._request_batches(s["needs"]):
+                writer.write(
+                    speedy.frame(speedy.encode_sync_message(("request", batch)))
                 )
-            )
-            writer.write(
-                speedy.frame(
-                    speedy.encode_sync_message(self.clock.new_timestamp())
-                )
-            )
             await writer.drain()
-            ours = self.generate_sync()
-            frames = speedy.FrameReader()
-            requested = False
+            # half-close: no more requests; the server serves then
+            # closes (EOF-terminated like the reference)
+            if writer.can_write_eof():
+                writer.write_eof()
             while True:
                 data = await asyncio.wait_for(reader.read(65536), timeout=10.0)
                 if not data:
                     break  # server closed: session complete
                 for payload in frames.feed(data):
                     msg = speedy.decode_sync_message(payload)
-                    if isinstance(msg, tuple) and msg[0] == "rejection":
-                        self.metrics.counter("corro_sync_rejected_total")
-                        return 0
                     if isinstance(msg, Timestamp):
                         try:
                             self.clock.update_with_timestamp(msg)
                         except Exception:
                             pass
-                    elif isinstance(msg, SyncStateV1) and not requested:
-                        requested = True
-                        theirs = msg
-                        needs = ours.compute_available_needs(theirs)
-                        # peer cleared versions since we last heard: ask
-                        # for cleared-ranges-since-ts (peer.rs:1132-1145)
-                        if theirs.last_cleared_ts is not None:
-                            known = self.bookie.for_actor(
-                                theirs.actor_id.bytes
-                            ).last_cleared_ts
-                            if known is None or int(known) < int(
-                                theirs.last_cleared_ts
-                            ):
-                                needs.setdefault(theirs.actor_id, []).append(
-                                    SyncNeedV1.empty(known)
-                                )
-                        for batch in self._request_batches(needs):
-                            writer.write(
-                                speedy.frame(
-                                    speedy.encode_sync_message(
-                                        ("request", batch)
-                                    )
-                                )
-                            )
-                        await writer.drain()
-                        # half-close: no more requests; the server serves
-                        # then closes (EOF-terminated like the reference)
-                        if writer.can_write_eof():
-                            writer.write_eof()
                     elif isinstance(msg, ChangeV1):
-                        if msg.changeset.is_empty_set:
-                            # EmptySet groups advance the cleared
-                            # watermark per group, so they must apply in
-                            # served order and must never be dropped —
-                            # bypass the drop-oldest ingest queue (the
-                            # reference likewise gives emptysets their
-                            # own ordered channel, handlers.rs:539-734)
-                            await self._loop.run_in_executor(
-                                self._apply_pool, self.handle_change,
-                                msg, ChangeSource.SYNC,
-                            )
-                        else:
-                            self.enqueue_change(msg, ChangeSource.SYNC)
+                        await self._ingest_sync_change(msg)
                         count += 1
             self.members.update_sync_ts(m.actor_id, time.time())
             self.metrics.counter("corro_sync_client_rounds_total")
@@ -1379,6 +1543,14 @@ class Agent:
         writer.write(speedy.frame(speedy.encode_sync_message(msg)))
         await writer.drain()
 
+    # sync serving knobs (peer.rs:344-348)
+    SYNC_CHUNK_MAX = 8 * 1024
+    SYNC_CHUNK_MIN = 1024
+    SYNC_ADAPT_THRESHOLD = 0.5  # halve the chunk beyond this send time
+    SYNC_SLOW_ABORT = 5.0  # abort the session beyond this send time
+    SYNC_NEED_JOBS = 6  # concurrent need jobs per session (peer.rs:843)
+    SYNC_MAX_PARTIAL_SPANS = 1024  # clamp hostile partial seqs lists
+
     async def _serve_sync(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
         """Sync server (serve_sync, peer.rs:1469): read the SyncStart
@@ -1392,6 +1564,14 @@ class Agent:
             writer.close()
             return
         async with self._sync_sem:
+            jobs: set = set()
+            job_sem = asyncio.Semaphore(self.SYNC_NEED_JOBS)
+            sess = {"chunk": self.SYNC_CHUNK_MAX}
+
+            async def run_need(actor_b: bytes, need: SyncNeedV1) -> None:
+                async with job_sem:
+                    await self._serve_need(writer, actor_b, need, sess)
+
             try:
                 frames = speedy.FrameReader()
                 payloads: List[bytes] = []
@@ -1412,16 +1592,25 @@ class Agent:
                 await self._send_sync_msg(writer, self.generate_sync())
                 await self._send_sync_msg(writer, self.clock.new_timestamp())
                 queued = payloads[1:]
-                while True:
+                eof = False
+                while not eof:
                     if queued:
                         msgs, queued = queued, []
                     else:
-                        data = await asyncio.wait_for(
-                            reader.read(65536), timeout=10.0
-                        )
+                        try:
+                            data = await asyncio.wait_for(
+                                reader.read(65536), timeout=10.0
+                            )
+                        except asyncio.TimeoutError:
+                            # a stalled client that never half-closes
+                            # still gets its jobs reaped below (their
+                            # drains hit the slow-peer abort budget)
+                            break
                         if not data:
-                            return  # client half-closed: all needs served
-                        msgs = frames.feed(data)
+                            eof = True  # client half-closed: no more needs
+                            msgs = []
+                        else:
+                            msgs = frames.feed(data)
                     for payload in msgs:
                         msg = speedy.decode_sync_message(payload)
                         if isinstance(msg, Timestamp):
@@ -1430,19 +1619,48 @@ class Agent:
                             except Exception:
                                 pass
                         elif isinstance(msg, tuple) and msg[0] == "request":
+                            # needs run as concurrent jobs, up to
+                            # SYNC_NEED_JOBS at once (peer.rs:836-844);
+                            # frame writes are atomic per message, so
+                            # interleaved jobs cannot corrupt the stream
                             for actor, needs in msg[1]:
                                 for need in needs:
-                                    await self._serve_need(
-                                        writer, actor.bytes, need
+                                    t = asyncio.ensure_future(
+                                        run_need(actor.bytes, need)
                                     )
+                                    jobs.add(t)
+                # requests done (EOF or stall): wait for serving to end
+                if jobs:
+                    results = await asyncio.gather(
+                        *jobs, return_exceptions=True
+                    )
+                    jobs.clear()
+                    errors = [r for r in results if isinstance(r, Exception)]
+                    if errors:
+                        if any(isinstance(r, _SlowPeer) for r in errors):
+                            self.metrics.counter(
+                                "corro_sync_slow_peer_aborts_total"
+                            )
+                        else:
+                            self.metrics.counter(
+                                "corro_sync_serve_errors_total"
+                            )
+                        # a failed serve must NOT end as a clean EOF the
+                        # client mistakes for a complete session — and
+                        # close() would wait on a reader that may not be
+                        # reading; reset the stream instead
+                        writer.transport.abort()
             except (asyncio.TimeoutError, OSError, ConnectionError,
                     speedy.SpeedyError):
                 return
             finally:
+                for t in jobs:
+                    t.cancel()
                 writer.close()
 
     async def _serve_need(self, writer: asyncio.StreamWriter, actor: bytes,
-                          need: SyncNeedV1) -> None:
+                          need: SyncNeedV1,
+                          sess: Optional[dict] = None) -> None:
         bv = self.bookie.for_actor(actor)
         kind = need.kind
         if kind == "full":
@@ -1450,14 +1668,20 @@ class Agent:
             # clamp hostile/stale ranges to what we can possibly serve
             s, e = max(1, int(s)), min(int(e), bv.last())
             for i, v in enumerate(range(s, e + 1)):
-                await self._serve_version(writer, actor, bv, v)
+                await self._serve_version(writer, actor, bv, v, sess=sess)
                 if i % 64 == 63:
                     await asyncio.sleep(0)  # don't starve the event loop
         elif kind == "partial":
             v = int(need.version)
             await self._serve_version(
                 writer, actor, bv, v,
-                seq_spans=[tuple(sp) for sp in need.seqs],
+                # span-count clamp: a hostile seqs list cannot force an
+                # unbounded number of per-span re-scans
+                seq_spans=[
+                    tuple(sp)
+                    for sp in need.seqs[: self.SYNC_MAX_PARTIAL_SPANS]
+                ],
+                sess=sess,
             )
         elif kind == "empty":
             # only cleared ranges strictly NEWER than the requester's
@@ -1475,6 +1699,7 @@ class Agent:
     async def _serve_version(
         self, writer, actor: bytes, bv, v: int,
         seq_spans: Optional[List[Tuple[int, int]]] = None,
+        sess: Optional[dict] = None,
     ) -> None:
         if bv.cleared.contains(v):
             lo, hi = v, v
@@ -1483,7 +1708,7 @@ class Agent:
                     lo, hi = s, e
                     break
             cs = Changeset.empty((Version(lo), Version(hi)), bv.last_cleared_ts)
-            await self._send_sync_change(writer, actor, cs)
+            await self._send_sync_change(writer, actor, cs, sess)
             return
         entry = bv.versions.get(v)
         if entry is None:
@@ -1510,7 +1735,7 @@ class Agent:
                     Version(v), chunk, (s, e), partial.last_seq,
                     partial.ts or Timestamp(0),
                 )
-                await self._send_sync_change(writer, actor, cs)
+                await self._send_sync_change(writer, actor, cs, sess)
             return
         db_version, last_seq = entry
         site = None if actor == self.actor_id else actor
@@ -1531,16 +1756,40 @@ class Agent:
                     Version(v), span_changes, (s, e), last_seq,
                     (bv.partials[v].ts or ts) if v in bv.partials else ts,
                 )
-                await self._send_sync_change(writer, actor, cs)
+                await self._send_sync_change(writer, actor, cs, sess)
             return
-        for chunk, seqs in ChunkedChanges(changes, 0, last_seq):
+        chunker = ChunkedChanges(
+            changes, 0, last_seq,
+            max_buf_size=sess["chunk"] if sess else MAX_CHANGES_BYTE_SIZE,
+        )
+        for chunk, seqs in chunker:
             cs = Changeset.full(Version(v), chunk, seqs, last_seq, ts)
-            await self._send_sync_change(writer, actor, cs)
+            await self._send_sync_change(writer, actor, cs, sess)
 
-    async def _send_sync_change(self, writer, actor: bytes, cs: Changeset) -> None:
+    async def _send_sync_change(self, writer, actor: bytes, cs: Changeset,
+                                sess: Optional[dict] = None) -> None:
+        """Send one changeset frame, timing the flush: a slow reader
+        first halves the session's chunk budget (8 KiB floor 1 KiB),
+        then aborts the session outright (peer.rs:344-348,796-811)."""
         cv = ChangeV1(actor_id=ActorId(actor), changeset=cs)
         writer.write(speedy.frame(speedy.encode_sync_message(cv)))
-        await writer.drain()
+        self.metrics.counter("corro_sync_served_total")
+        t0 = time.monotonic()
+        try:
+            await asyncio.wait_for(
+                writer.drain(), timeout=self.SYNC_SLOW_ABORT
+            )
+        except asyncio.TimeoutError:
+            raise _SlowPeer("peer too slow: send exceeded abort budget")
+        if sess is not None:
+            elapsed = time.monotonic() - t0
+            if elapsed > self.SYNC_ADAPT_THRESHOLD:
+                if sess["chunk"] <= self.SYNC_CHUNK_MIN:
+                    raise _SlowPeer(
+                        "peer too slow even at the minimum chunk size"
+                    )
+                sess["chunk"] //= 2
+                self.metrics.counter("corro_sync_chunk_halvings_total")
 
 
 # ---------------------------------------------------------------------------

@@ -60,6 +60,17 @@ class TableInfo:
     data_cols: Tuple[str, ...]  # non-pk columns
 
 
+def register_udfs(conn: sqlite3.Connection) -> None:
+    """Register every SQL function the CRR layer depends on.  ANY
+    connection touching an agent database needs these: the CRR tables
+    carry expression indexes on corro_pack, so even a plain VACUUM
+    fails without it."""
+    conn.create_function("corro_pack", -1, _udf_pack, deterministic=True)
+    conn.create_function(
+        "corro_json_contains", 2, _udf_json_contains, deterministic=True
+    )
+
+
 class CrConn:
     """A sqlite3 connection with the CRDT layer installed."""
 
@@ -77,10 +88,7 @@ class CrConn:
             self._lock = TrackedLock(lock_registry, "storage")
         else:
             self._lock = threading.RLock()
-        self.conn.create_function("corro_pack", -1, _udf_pack, deterministic=True)
-        self.conn.create_function(
-            "corro_json_contains", 2, _udf_json_contains, deterministic=True
-        )
+        register_udfs(self.conn)
         self._init_meta(site_id)
         self._tables: Dict[str, TableInfo] = {}
         self._load_crr_tables()
@@ -100,13 +108,7 @@ class CrConn:
                 )
                 # triggers resolve functions at prepare time, so the RO
                 # conn needs them registered even though writes will fail
-                self._ro_conn.create_function(
-                    "corro_pack", -1, _udf_pack, deterministic=True
-                )
-                self._ro_conn.create_function(
-                    "corro_json_contains", 2, _udf_json_contains,
-                    deterministic=True,
-                )
+                register_udfs(self._ro_conn)
             cur = self._ro_conn.execute(sql, params)
             cols = [d[0] for d in cur.description or []]
             return cols, cur.fetchall()
@@ -241,6 +243,16 @@ class CrConn:
         c.execute(
             f'CREATE INDEX IF NOT EXISTS "{t}__corro_cl_dbv" '
             f'ON "{t}__corro_cl" (site_ordinal, db_version)'
+        )
+        # expression index on the packed pk: change collection joins the
+        # data table ON corro_pack(pk cols) = clock.pk — without this the
+        # join is a per-clock-row full scan (quadratic in table size)
+        pack_expr = "corro_pack(" + ", ".join(
+            f'"{p}"' for p in info.pk_cols
+        ) + ")"
+        c.execute(
+            f'CREATE INDEX IF NOT EXISTS "{t}__corro_packpk" '
+            f'ON "{t}" ({pack_expr})'
         )
         self._create_triggers(info)
         self._create_impact_triggers(t)

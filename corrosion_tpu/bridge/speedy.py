@@ -401,13 +401,20 @@ def _w_need(w: Writer, n: SyncNeedV1) -> None:
         w.opt(n.ts, lambda ts: _w_ts(w, ts))
 
 
+def _span(r: Reader) -> Tuple[int, int]:
+    s, e = r.u64(), r.u64()
+    if e < s:
+        raise SpeedyError(f"inverted range {s}..={e}")
+    return s, e
+
+
 def _r_need(r: Reader) -> SyncNeedV1:
     t = r.tag()
     if t == _SN_FULL:
-        return SyncNeedV1.full(r.u64(), r.u64())
+        return SyncNeedV1.full(*_span(r))
     if t == _SN_PARTIAL:
         version = r.u64()
-        seqs = [(r.u64(), r.u64()) for _ in range(r.u32())]
+        seqs = [_span(r) for _ in range(r.u32())]
         return SyncNeedV1.partial(version, seqs)
     if t == _SN_EMPTY:
         return SyncNeedV1.empty(r.opt(lambda: _r_ts(r)))
@@ -447,14 +454,14 @@ def _r_sync_state(r: Reader) -> SyncStateV1:
     need: Dict[ActorId, List[Tuple[int, int]]] = {}
     for _ in range(r.u32()):
         a = _r_actor(r)
-        need[a] = [(r.u64(), r.u64()) for _ in range(r.u32())]
+        need[a] = [_span(r) for _ in range(r.u32())]
     partial_need: Dict[ActorId, Dict[Version, List[Tuple[int, int]]]] = {}
     for _ in range(r.u32()):
         a = _r_actor(r)
         partials = {}
         for _ in range(r.u32()):
             v = Version(r.u64())
-            partials[v] = [(r.u64(), r.u64()) for _ in range(r.u32())]
+            partials[v] = [_span(r) for _ in range(r.u32())]
         partial_need[a] = partials
     last_cleared_ts = None if r.eof else r.opt(lambda: _r_ts(r))
     return SyncStateV1(

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -66,10 +67,21 @@ def _draw_targets(key, params: BroadcastParams):
 HOP_UNSET = jnp.int32(2**30)
 
 
+class BroadcastStep(NamedTuple):
+    """One-shape result for every broadcast_step variant; optional
+    outputs are None when the corresponding input wasn't supplied."""
+
+    rows: jnp.ndarray
+    tx_remaining: jnp.ndarray
+    msgs_sent: jnp.ndarray
+    hops: Optional[jnp.ndarray] = None
+    next_send: Optional[jnp.ndarray] = None
+
+
 @partial(jax.jit, static_argnames=("params",))
 def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
                    partition_id=None, partition_active=False, hops=None,
-                   tick=None, next_send=None):
+                   tick=None, next_send=None) -> BroadcastStep:
     """One gossip tick for every node at once.
 
     rows:         [N, R] packed CRDT keys (the node's table state)
@@ -84,8 +96,8 @@ def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
                   sender_hop+1 over delivering messages — directly
                   comparable to the live agent's debug_hops counter
 
-    Returns (rows', tx_remaining', msgs_sent') or, with hops,
-    (rows', tx_remaining', msgs_sent', hops').
+    Returns a :class:`BroadcastStep` (hops'/next_send' are None when the
+    corresponding input wasn't supplied).
     """
     n, k = params.n_nodes, params.fanout
     key_t, key_l = jax.random.split(key)
@@ -115,6 +127,7 @@ def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
     tx = jnp.where(learned, params.max_transmissions, tx)
 
     msgs = msgs_sent + jnp.where(active, k, 0).astype(msgs_sent.dtype)
+    nxt = None
     if next_send is not None:
         # nth retransmission waits backoff*n ticks; a fresh payload
         # (learner) forwards on the very next tick
@@ -125,21 +138,16 @@ def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
         )
         nxt = jnp.where(active, tick + gap, next_send)
         nxt = jnp.where(learned, tick + 1, nxt)
-    if hops is None:
-        if next_send is not None:
-            return new_rows, tx, msgs, nxt
-        return new_rows, tx, msgs
-
-    # first-infection depth: min over this tick's delivering senders
-    sender_hops = jnp.repeat(
-        jnp.minimum(hops, HOP_UNSET) + 1, k
-    )  # [N*K]
-    cand = (
-        jnp.full((n + 1,), HOP_UNSET, jnp.int32)
-        .at[flat_targets]
-        .min(sender_hops)[:n]
-    )
-    new_hops = jnp.where(learned, jnp.minimum(hops, cand), hops)
-    if next_send is not None:
-        return new_rows, tx, msgs, new_hops, nxt
-    return new_rows, tx, msgs, new_hops
+    new_hops = None
+    if hops is not None:
+        # first-infection depth: min over this tick's delivering senders
+        sender_hops = jnp.repeat(
+            jnp.minimum(hops, HOP_UNSET) + 1, k
+        )  # [N*K]
+        cand = (
+            jnp.full((n + 1,), HOP_UNSET, jnp.int32)
+            .at[flat_targets]
+            .min(sender_hops)[:n]
+        )
+        new_hops = jnp.where(learned, jnp.minimum(hops, cand), hops)
+    return BroadcastStep(new_rows, tx, msgs, new_hops, nxt)

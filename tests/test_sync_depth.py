@@ -1,0 +1,184 @@
+"""Sync protocol depth: peer choice, cross-peer dedup, concurrent need
+jobs, adaptive chunking + slow-peer abort.
+
+Parity: ``crates/corro-agent/src/api/peer.rs:344-348,796-811,836-844,
+1240-1371`` and ``agent/handlers.rs:963-1074``.
+"""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.agent.members import Member, MemberState
+from corrosion_tpu.agent.runtime import STREAM_BI
+from corrosion_tpu.agent.testing import launch_test_agent, wait_for
+from corrosion_tpu.bridge import speedy
+from corrosion_tpu.types import ActorId, SyncNeedV1, Timestamp
+from corrosion_tpu.types.actor import ClusterId
+from corrosion_tpu.types.payload import BiPayload
+
+QUIET = dict(
+    sync_interval_min=3600.0,
+    sync_interval_max=7200.0,
+    probe_interval=3600.0,
+    maintenance_interval=3600.0,
+)
+
+
+def _member_for(agent) -> Member:
+    return Member(actor_id=agent.actor_id, addr=tuple(agent.gossip_addr))
+
+
+def test_parallel_sync_serves_disjoint_halves(tmp_path):
+    """Two peers holding the same 40 versions each serve roughly half of
+    a fresh node's needs (round-robin allocation + cross-peer dedup)."""
+    async def main():
+        (tmp_path / "a").mkdir(); (tmp_path / "b").mkdir()
+        (tmp_path / "c").mkdir(); (tmp_path / "d").mkdir()
+        a = await launch_test_agent(tmpdir=str(tmp_path / "a"))
+        # >100 versions: the round-robin allocator drains 10 needs/turn,
+        # each need a 10-version chunk, so 120 versions = 12 chunk-needs
+        # — the first server takes 10, the second the rest
+        for i in range(120):
+            a.execute_transaction(
+                [("INSERT INTO tests (id, text) VALUES (?, ?)",
+                  (i, f"v{i}"))]
+            )
+        boot = [f"{a.gossip_addr[0]}:{a.gossip_addr[1]}"]
+        b = await launch_test_agent(bootstrap=boot, tmpdir=str(tmp_path / "b"))
+        c = await launch_test_agent(bootstrap=boot, tmpdir=str(tmp_path / "c"))
+
+        def caught_up(x):
+            return x.bookie.for_actor(a.actor_id).contains_range(1, 120)
+
+        await wait_for(lambda: caught_up(b) and caught_up(c), timeout=30)
+
+        # fresh node that only knows b and c — NOT the origin
+        d = await launch_test_agent(tmpdir=str(tmp_path / "d"), **QUIET)
+        d.members.upsert(b.actor_id, tuple(b.gossip_addr))
+        d.members.upsert(c.actor_id, tuple(c.gossip_addr))
+        served_before = {
+            x.actor_id: int(x.metrics.get_counter("corro_sync_served_total") or 0)
+            for x in (b, c)
+        }
+        n = await d.parallel_sync(
+            [_member_for(b), _member_for(c)]
+        )
+        assert n > 0
+        await wait_for(lambda: caught_up(d), timeout=20)
+        served = {
+            x.actor_id: int(x.metrics.get_counter("corro_sync_served_total") or 0)
+            - served_before[x.actor_id]
+            for x in (b, c)
+        }
+        # BOTH peers served a share (not one peer serving everything)
+        assert served[b.actor_id] > 0, served
+        assert served[c.actor_id] > 0, served
+        for x in (a, b, c, d):
+            await x.stop()
+
+    asyncio.run(main())
+
+
+async def _open_sync_session(a, rcvbuf=None):
+    """Raw-socket sync client: SyncStart + Clock + request-everything."""
+    import socket
+
+    h, p = a.gossip_addr
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    if rcvbuf is not None:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, rcvbuf)
+    sock.setblocking(False)
+    await asyncio.get_running_loop().sock_connect(sock, (h, p))
+    reader, writer = await asyncio.open_connection(sock=sock, limit=2**16)
+    writer.write(STREAM_BI)
+    writer.write(
+        speedy.frame(
+            speedy.encode_bi_payload(
+                BiPayload(actor_id=ActorId(b"\xbb" * 16)), ClusterId(0)
+            )
+        )
+    )
+    writer.write(speedy.frame(speedy.encode_sync_message(Timestamp(1))))
+    req = [(ActorId(a.actor_id), [SyncNeedV1.full(1, 1)])]
+    writer.write(speedy.frame(speedy.encode_sync_message(("request", req))))
+    await writer.drain()
+    writer.write_eof()
+    return reader, writer
+
+
+def _big_write(a, rows: int, width: int) -> None:
+    big = "x" * width
+    a.execute_transaction(
+        [("INSERT INTO tests (id, text) VALUES (?, ?)", (i, big))
+         for i in range(rows)]
+    )
+
+
+def test_slow_reader_triggers_abort(tmp_path):
+    """A client that requests everything and never reads trips the
+    slow-peer abort once the socket buffers fill (peer.rs:796-800)."""
+    async def main():
+        a = await launch_test_agent(tmpdir=str(tmp_path), **QUIET)
+        _big_write(a, 4000, 2048)  # ~8 MB to serve
+        a.SYNC_SLOW_ABORT = 0.4
+        reader, writer = await _open_sync_session(a, rcvbuf=4096)
+        # do NOT read: the server's sends back up until drain stalls
+        await wait_for(
+            lambda: a.metrics.get_counter(
+                "corro_sync_slow_peer_aborts_total"
+            ),
+            timeout=30,
+        )
+        writer.close()
+        await a.stop()
+
+    asyncio.run(main())
+
+
+def test_slow_reader_triggers_chunk_halving(tmp_path):
+    """A trickling reader drives the server's adaptive chunk size down
+    (8 KiB halving toward the 1 KiB floor, peer.rs:344-348,801-811)."""
+    async def main():
+        a = await launch_test_agent(tmpdir=str(tmp_path), **QUIET)
+        # must exceed the kernel's auto-tuned send buffer or drains
+        # never block and the server finishes before adapting
+        _big_write(a, 4000, 2048)  # ~8 MB to serve
+        a.SYNC_ADAPT_THRESHOLD = 0.02
+        a.SYNC_SLOW_ABORT = 30.0
+        reader, writer = await _open_sync_session(a, rcvbuf=4096)
+        # trickle-read so drains are slow but never fully stall
+        for _ in range(400):
+            try:
+                await asyncio.wait_for(reader.read(2048), timeout=1.0)
+            except asyncio.TimeoutError:
+                pass
+            if a.metrics.get_counter("corro_sync_chunk_halvings_total"):
+                break
+            await asyncio.sleep(0.05)
+        assert a.metrics.get_counter("corro_sync_chunk_halvings_total")
+        writer.close()
+        await a.stop()
+
+    asyncio.run(main())
+
+
+def test_peer_choice_prefers_needed_stale_and_close(tmp_path):
+    """_choose_sync_peers ranks by (need_len desc, last_sync_ts asc,
+    rtt asc) over a 2x random sample (handlers.rs:963-1074)."""
+    async def main():
+        a = await launch_test_agent(tmpdir=str(tmp_path), **QUIET)
+        rich = b"\x01" * 16   # we need 50 versions from this actor
+        poor = b"\x02" * 16   # nothing needed
+        a.members.upsert(rich, ("127.0.0.1", 1001))
+        a.members.upsert(poor, ("127.0.0.1", 1002))
+        bv = a.bookie.for_actor(rich)
+        bv.apply_version(60, 1, 0)  # creates needed gap 1..59
+        ours = a.generate_sync()
+        assert ours.need_len_for_actor(ActorId(rich)) > 0
+        chosen = a._choose_sync_peers(ours)
+        assert chosen, "expected peers chosen"
+        assert chosen[0].actor_id == rich
+        await a.stop()
+
+    asyncio.run(main())
