@@ -11,11 +11,17 @@ Design differences (deliberate):
 * table extraction uses sqlite's authorizer hook during prepare — the
   database is the SQL parser (the reference rewrites ASTs with
   ``sqlite3-parser``);
-* incremental maintenance re-evaluates the subscription query on the
-  read-only connection and diffs against the previous materialized rows
-  (keyed by row identity), batched behind a short debounce window — the
-  reference's per-table candidate rewrite is an optimization of the same
-  observable behavior, and can slot in later without changing events;
+* incremental maintenance is pk-scoped like the reference's candidate
+  rewrite (``pubsub.rs:602-737,1432-1707``), but achieved through query
+  nesting instead of AST surgery: when a subscription reads ONE
+  replicated table, projects that table's primary key columns, and uses
+  no global operator (DISTINCT / GROUP BY / LIMIT / set ops / windows),
+  a change batch evaluates ``SELECT * FROM (<orig>) WHERE (pk cols) IN
+  (VALUES ...candidates...)`` — sqlite's subquery flattening pushes the
+  predicate onto the base table's pk index, so the work is proportional
+  to the candidate rows, not the table.  Materialized rows are keyed by
+  pk, yielding true ``update`` events.  Ineligible queries keep the
+  re-evaluate-and-diff path (correct, not incremental);
 * per-subscription state (sql, rows, change log) persists in its own
   sqlite file under ``subs_path`` and is restored on boot
   (``pubsub.rs:819-856`` parity).
@@ -33,18 +39,34 @@ import hashlib
 import json
 import os
 import queue
+import re
 import sqlite3
 import threading
 import time
 import uuid
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from corrosion_tpu.agent.pack import jsonable_row, unpack_values
+from corrosion_tpu.agent.pack import jsonable_row, pack_values, unpack_values
 from corrosion_tpu.types.change import SENTINEL_CID
 from corrosion_tpu.types.changeset import ChangeV1
 
 DEBOUNCE_S = 0.05
 MAX_CHANGE_LOG = 100_000
+# more candidate pks than this per round -> full refresh is cheaper
+DELTA_MAX_PKS = 2048
+# words whose presence means a row's content or membership can depend on
+# OTHER rows: pk-scoped delta evaluation would be wrong, so such queries
+# use full refresh.  Deliberately over-broad (a column merely NAMED
+# "count" costs only the optimization, never correctness).
+_GLOBAL_WORDS = frozenset(
+    (
+        "DISTINCT", "GROUP", "HAVING", "UNION", "INTERSECT", "EXCEPT",
+        "LIMIT", "OFFSET", "OVER", "WITH", "JOIN",
+        # aggregates
+        "COUNT", "SUM", "AVG", "TOTAL", "MAX", "MIN", "GROUP_CONCAT",
+        "STRING_AGG",
+    )
+)
 
 
 def normalize_sql(sql: str) -> str:
@@ -108,6 +130,12 @@ class SubscriptionHandle:
         self.last_change_id = 0
         self._closed = False
         self._streams: List[queue.Queue] = []
+        # pk-scoped incremental evaluation (set by the manager when the
+        # query qualifies): the single table, its pk column indices in
+        # the projection, and an identity index pk-hex -> [identities]
+        self.single_table: Optional[str] = None
+        self.pk_proj_idx: Optional[List[int]] = None
+        self.by_pk: Dict[str, List[str]] = {}
         self._db = sqlite3.connect(db_path, check_same_thread=False)
         self._db.executescript(
             """
@@ -119,10 +147,17 @@ CREATE TABLE IF NOT EXISTS changes (
   row_id INTEGER NOT NULL, cells TEXT NOT NULL);
 """
         )
+        have = {r[1] for r in self._db.execute("PRAGMA table_info(rows)")}
+        if "pk" not in have:
+            self._db.execute("ALTER TABLE rows ADD COLUMN pk TEXT")
         self._db.execute(
             "INSERT OR REPLACE INTO meta VALUES ('sql', ?)", (sql,)
         )
         self._db.commit()
+
+    @property
+    def incremental(self) -> bool:
+        return self.pk_proj_idx is not None
 
     # -- persistence -----------------------------------------------------
 
@@ -133,18 +168,36 @@ CREATE TABLE IF NOT EXISTS changes (
         last = self._db.execute("SELECT MAX(change_id) FROM changes").fetchone()
         self.last_change_id = last[0] or 0
         rows = self._db.execute(
-            "SELECT identity, row_id, cells FROM rows"
+            "SELECT identity, row_id, cells, pk FROM rows"
         ).fetchall()
-        for identity, row_id, cells in rows:
+        if self.incremental and rows and any(pk is None for *_r, pk in rows):
+            # state persisted under the old hash-keyed identity scheme:
+            # silently re-key (a diff against the restored identities
+            # would read as a full-table delete+insert storm).  The old
+            # change log references the now-dead row_ids, so truncate it
+            # too — _can_catch_up then fails and resuming clients get a
+            # fresh snapshot instead of events against unknown rids
+            self._db.execute("DELETE FROM rows")
+            self._db.execute("DELETE FROM changes")
+            self._db.commit()
+            self.last_row_id = max((r[1] for r in rows), default=0)
+            self.refresh(initial=True)
+            return True
+        for identity, row_id, cells, pk in rows:
             self.rows[identity] = (row_id, json.loads(cells))
             self.last_row_id = max(self.last_row_id, row_id)
+            if pk is not None:
+                self.by_pk.setdefault(pk, []).append(identity)
         return bool(rows) or self.last_change_id > 0
 
-    def _persist_rows(self, upserts, deletes) -> None:
+    def _persist_rows(self, upserts, deletes, pks=None) -> None:
         self._db.executemany(
-            "INSERT OR REPLACE INTO rows (identity, row_id, cells) "
-            "VALUES (?, ?, ?)",
-            [(i, rid, json.dumps(c)) for i, (rid, c) in upserts.items()],
+            "INSERT OR REPLACE INTO rows (identity, row_id, cells, pk) "
+            "VALUES (?, ?, ?, ?)",
+            [
+                (i, rid, json.dumps(c), (pks or {}).get(i))
+                for i, (rid, c) in upserts.items()
+            ],
         )
         self._db.executemany(
             "DELETE FROM rows WHERE identity=?", [(i,) for i in deletes]
@@ -175,12 +228,82 @@ CREATE TABLE IF NOT EXISTS changes (
         ).hexdigest()
         return f"{h}:{occurrence}"
 
+    def _pk_keyed(self, rows):
+        """identity -> cells and identity -> pk-hex for a result set,
+        with identities keyed by the projected primary key (stable
+        across evaluations: enables true update events)."""
+        new_ids: Dict[str, list] = {}
+        pks_of: Dict[str, str] = {}
+        counts: Dict[str, int] = {}
+        for r in rows:
+            cells = jsonable_row(r)
+            pk_hex = pack_values([r[i] for i in self.pk_proj_idx]).hex()
+            occ = counts.get(pk_hex, 0)
+            counts[pk_hex] = occ + 1
+            identity = f"{pk_hex}:{occ}"
+            new_ids[identity] = cells
+            pks_of[identity] = pk_hex
+        return new_ids, pks_of
+
+    def _apply_diff(self, new_ids, pks_of, scope_old, initial,
+                    cand_hexes=None) -> None:
+        """Diff ``new_ids`` against ``scope_old`` (the materialized rows
+        the evaluation could have produced), persist, emit events.
+        Caller holds ``self._lock``."""
+        upserts: Dict[str, Tuple[int, list]] = {}
+        events = []
+        for identity, cells in new_ids.items():
+            old = scope_old.get(identity)
+            if old is None:
+                self.last_row_id += 1
+                rid = self.last_row_id
+                upserts[identity] = (rid, cells)
+                if not initial:
+                    self.last_change_id += 1
+                    events.append(("insert", rid, cells, self.last_change_id))
+            elif old[1] != cells:
+                rid = old[0]
+                upserts[identity] = (rid, cells)
+                if not initial:
+                    self.last_change_id += 1
+                    events.append(("update", rid, cells, self.last_change_id))
+        deletes = []
+        for identity, (rid, cells) in scope_old.items():
+            if identity not in new_ids:
+                deletes.append(identity)
+                if not initial:
+                    self.last_change_id += 1
+                    events.append(("delete", rid, cells, self.last_change_id))
+        self.rows.update(upserts)
+        for i in deletes:
+            self.rows.pop(i, None)
+        if self.incremental:
+            if cand_hexes is None:
+                self.by_pk = {}
+            else:
+                for h in cand_hexes:
+                    self.by_pk.pop(h, None)
+            for identity, pk_hex in pks_of.items():
+                lst = self.by_pk.setdefault(pk_hex, [])
+                if identity not in lst:
+                    lst.append(identity)
+        self._persist_rows(upserts, deletes, pks_of)
+        for kind, rid, cells, cid in events:
+            self._persist_change(cid, kind, rid, cells)
+        self._db.commit()
+        for kind, rid, cells, cid in events:
+            self._fanout({"change": [kind, rid, cells, cid]})
+
     def refresh(self, initial: bool = False) -> None:
-        """Re-evaluate the query and emit diff events."""
+        """Re-evaluate the whole query and emit diff events."""
         cols, rows = self.manager.agent.storage.read_query(self.sql)
         with self._lock:
             self.columns = cols
-            new_ids: Dict[str, list] = {}
+            if self.incremental:
+                new_ids, pks_of = self._pk_keyed(rows)
+                self._apply_diff(new_ids, pks_of, dict(self.rows), initial)
+                return
+            new_ids = {}
             counts: Dict[str, int] = {}
             for r in rows:
                 cells = jsonable_row(r)
@@ -188,37 +311,37 @@ CREATE TABLE IF NOT EXISTS changes (
                 occ = counts.get(key, 0)
                 counts[key] = occ + 1
                 new_ids[self._identity(cells, occ)] = cells
-            old = self.rows
-            upserts: Dict[str, Tuple[int, list]] = {}
-            events = []
-            for identity, cells in new_ids.items():
-                if identity not in old:
-                    self.last_row_id += 1
-                    rid = self.last_row_id
-                    upserts[identity] = (rid, cells)
-                    if not initial:
-                        self.last_change_id += 1
-                        events.append(
-                            ("insert", rid, cells, self.last_change_id)
-                        )
-            deletes = []
-            for identity, (rid, cells) in old.items():
-                if identity not in new_ids:
-                    deletes.append(identity)
-                    if not initial:
-                        self.last_change_id += 1
-                        events.append(
-                            ("delete", rid, cells, self.last_change_id)
-                        )
-            old.update(upserts)
-            for i in deletes:
-                del old[i]
-            self._persist_rows(upserts, deletes)
-            for kind, rid, cells, cid in events:
-                self._persist_change(cid, kind, rid, cells)
-            self._db.commit()
-            for kind, rid, cells, cid in events:
-                self._fanout({"change": [kind, rid, cells, cid]})
+            self._apply_diff(new_ids, {}, dict(self.rows), initial)
+
+    def delta(self, pks: Set[bytes]) -> None:
+        """Pk-scoped incremental evaluation (the candidate path,
+        ``pubsub.rs:1432-1707``): work proportional to the candidate
+        rows, not the table."""
+        if not pks:
+            return
+        pk_names = [self.columns[i] for i in self.pk_proj_idx]
+        cols_sql = ", ".join(f'"{c}"' for c in pk_names)
+        row_ph = "(" + ", ".join("?" for _ in pk_names) + ")"
+        values = ", ".join(row_ph for _ in pks)
+        sql = (
+            f"SELECT * FROM ({self.sql}) "
+            f"WHERE ({cols_sql}) IN (VALUES {values})"
+        )
+        params = [v for pk in pks for v in unpack_values(pk)]
+        _, rows = self.manager.agent.storage.read_query(sql, params)
+        cand_hexes = {pk.hex() for pk in pks}
+        with self._lock:
+            new_ids, pks_of = self._pk_keyed(rows)
+            scope_old = {
+                i: self.rows[i]
+                for h in cand_hexes
+                for i in self.by_pk.get(h, [])
+                if i in self.rows
+            }
+            self._apply_diff(
+                new_ids, pks_of, scope_old, initial=False,
+                cand_hexes=cand_hexes,
+            )
 
     def _fanout(self, event: dict) -> None:
         for q in list(self._streams):
@@ -299,6 +422,7 @@ class SubsManager:
         self._by_sql: Dict[str, str] = {}
         self._lock = threading.RLock()
         self._pending: Set[str] = set()
+        self._pending_pks: Dict[str, Set[bytes]] = {}
         self._update_streams: Dict[str, List[queue.Queue]] = {}
         self._wake = threading.Event()
         self._closed = False
@@ -364,6 +488,7 @@ class SubsManager:
             tables = tables_of_query(scratch, nsql)
         finally:
             scratch.close()
+        raw_tables = set(tables)
         crr = set(self.agent.storage.tables)
         tables &= crr
         if not tables:
@@ -374,10 +499,79 @@ class SubsManager:
             self, sub_id, nsql, [], tables,
             os.path.join(self.subs_path, f"{sub_id}.db"),
         )
+        self._detect_incremental(handle, nsql, tables, raw_tables)
         with self._lock:
             self._subs[sub_id] = handle
             self._by_sql[nsql] = sub_id
         return handle
+
+    def _detect_incremental(self, handle: SubscriptionHandle, nsql: str,
+                            tables: Set[str],
+                            raw_tables: Set[str]) -> None:
+        """Qualify a query for pk-scoped delta evaluation.  Requirements
+        (conservative — a miss costs the optimization, never
+        correctness):
+
+        * exactly one replicated table, referenced exactly once (no
+          self-joins), one SELECT (no subqueries — a same-table scalar
+          subquery would make rows interdependent);
+        * no global operator or aggregate word;
+        * the table's pk columns appear in the projection under their
+          own names, and the delta filter on them provably reaches the
+          base table's index (EXPLAIN QUERY PLAN shows a SEARCH, never a
+          SCAN — this also rejects ``expr AS pkname`` aliases).
+
+        Remaining caveat, documented: aliasing a DIFFERENT indexed
+        column to a pk column's name (``SELECT other AS id``) defeats
+        detection; such queries should not name non-pk columns after pk
+        columns.
+        """
+        if len(tables) != 1 or len(raw_tables) != 1:
+            # raw_tables counts non-replicated tables too: a comma-join
+            # against a local lookup table would yield several result
+            # rows per pk in unguaranteed order — not delta-safe
+            return
+        up = nsql.upper()
+        words = re.findall(r"[A-Za-z_]+", up)
+        if words.count("SELECT") != 1:
+            return
+        if any(w in _GLOBAL_WORDS for w in words):
+            return
+        t = next(iter(tables))
+        if words.count(t.upper()) != 1:
+            return  # table referenced more than once (self-join)
+        info = self.agent.storage._tables.get(t)
+        if info is None:
+            return
+        try:
+            cols, _ = self.agent.storage.read_query(
+                f"SELECT * FROM ({nsql}) LIMIT 0"
+            )
+        except sqlite3.Error:
+            return
+        lower = [c.lower() for c in cols]
+        idx: List[int] = []
+        for p in info.pk_cols:
+            if p.lower() not in lower:
+                return
+            idx.append(lower.index(p.lower()))
+        # the filter must reach the base table's index; an expression
+        # aliased to the pk name (or any failed pushdown) plans as SCAN
+        pk_names = ", ".join(f'"{cols[i]}"' for i in idx)
+        row_ph = "(" + ", ".join("?" for _ in idx) + ")"
+        try:
+            _, plan = self.agent.storage.read_query(
+                "EXPLAIN QUERY PLAN SELECT * FROM "
+                f"({nsql}) WHERE ({pk_names}) IN (VALUES {row_ph})",
+                [None] * len(idx),
+            )
+        except sqlite3.Error:
+            return
+        plan_text = " ".join(str(c) for row in plan for c in row)
+        if f"SEARCH {t}" not in plan_text or f"SCAN {t}" in plan_text:
+            return
+        handle.single_table = t
+        handle.pk_proj_idx = idx
 
     def get(self, sub_id: str) -> Optional[SubscriptionHandle]:
         with self._lock:
@@ -407,7 +601,11 @@ class SubsManager:
             touched.setdefault(ch.table, []).append(ch)
         with self._lock:
             for h in self._subs.values():
-                if any(t in h.tables for t in touched):
+                if h.incremental and h.single_table in touched:
+                    self._pending_pks.setdefault(h.id, set()).update(
+                        ch.pk for ch in touched[h.single_table]
+                    )
+                elif any(t in h.tables for t in touched):
                     self._pending.add(h.id)
         for table, chs in touched.items():
             self._notify_updates(table, chs)
@@ -423,6 +621,23 @@ class SubsManager:
             self._wake.clear()
             with self._lock:
                 pending, self._pending = self._pending, set()
+                pending_pks, self._pending_pks = self._pending_pks, {}
+            for sub_id, pks in pending_pks.items():
+                if sub_id in pending:
+                    continue  # a full refresh covers the candidates
+                h = self._subs.get(sub_id)
+                if h is None:
+                    continue
+                # the delta path needs the projection (first refresh) and
+                # loses to a full pass beyond DELTA_MAX_PKS candidates
+                if not h.columns or len(pks) > DELTA_MAX_PKS:
+                    pending.add(sub_id)
+                    continue
+                try:
+                    h.delta(pks)
+                except sqlite3.Error:
+                    pending.add(sub_id)  # fall back to a full pass
+            with self._lock:
                 handles = [self._subs[i] for i in pending if i in self._subs]
             for h in handles:
                 try:

@@ -1550,6 +1550,7 @@ class Agent:
     SYNC_SLOW_ABORT = 5.0  # abort the session beyond this send time
     SYNC_NEED_JOBS = 6  # concurrent need jobs per session (peer.rs:843)
     SYNC_MAX_PARTIAL_SPANS = 1024  # clamp hostile partial seqs lists
+    SYNC_MAX_SESSION_NEEDS = 10_000  # total needs one session may request
 
     async def _serve_sync(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
@@ -1567,6 +1568,7 @@ class Agent:
             jobs: set = set()
             job_sem = asyncio.Semaphore(self.SYNC_NEED_JOBS)
             sess = {"chunk": self.SYNC_CHUNK_MAX}
+            total_needs = 0
 
             async def run_need(actor_b: bytes, need: SyncNeedV1) -> None:
                 async with job_sem:
@@ -1625,10 +1627,19 @@ class Agent:
                             # interleaved jobs cannot corrupt the stream
                             for actor, needs in msg[1]:
                                 for need in needs:
+                                    total_needs += 1
+                                    if (total_needs
+                                            > self.SYNC_MAX_SESSION_NEEDS):
+                                        # hostile request stream: stop
+                                        # accepting, serve what's queued
+                                        eof = True
+                                        break
                                     t = asyncio.ensure_future(
                                         run_need(actor.bytes, need)
                                     )
                                     jobs.add(t)
+                                if eof:
+                                    break
                 # requests done (EOF or stall): wait for serving to end
                 if jobs:
                     results = await asyncio.gather(

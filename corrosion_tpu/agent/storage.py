@@ -159,10 +159,20 @@ class CrConn:
 
     def _load_crr_tables(self) -> None:
         for (name,) in self.conn.execute("SELECT name FROM __corro_crr_tables"):
-            self._tables[name] = self._introspect(name)
+            info = self._introspect(name)
+            self._tables[name] = info
             # idempotent: databases created before the compaction feature
             # need the impact triggers installed on reopen
             self._create_impact_triggers(name)
+            # likewise for the packed-pk expression index (without it,
+            # change collection degrades to per-clock-row full scans)
+            pack_expr = "corro_pack(" + ", ".join(
+                f'"{p}"' for p in info.pk_cols
+            ) + ")"
+            self.conn.execute(
+                f'CREATE INDEX IF NOT EXISTS "{name}__corro_packpk" '
+                f'ON "{name}" ({pack_expr})'
+            )
 
     def _introspect(self, table: str) -> TableInfo:
         info = self.conn.execute(f'PRAGMA table_info("{_ident(table)}")').fetchall()
