@@ -49,6 +49,29 @@ def bitmap_needs(ours, theirs):
     return theirs & ~ours
 
 
+def session_msgs(msgs_sent, peers, chunks, handshake_msgs, reachable=None):
+    """Charge sync-session messages (shared by both sync kernels).
+
+    The client pays half the handshake per session; each serving peer
+    pays the other half plus its chunk stream (like the reference's
+    server-side send loop).  peers/chunks: [N, P]; chunks are the chunk
+    messages each session actually sent.
+    """
+    if reachable is None:
+        reachable = jnp.ones(peers.shape, dtype=bool)
+    sessions = jnp.sum(reachable, axis=1)  # [N] sessions as client
+    client_msgs = sessions * (handshake_msgs // 2)
+    per_server = (
+        (handshake_msgs - handshake_msgs // 2) + chunks
+    ) * reachable
+    server_msgs = (
+        jnp.zeros_like(msgs_sent)
+        .at[peers.reshape(-1)]
+        .add(per_server.reshape(-1).astype(msgs_sent.dtype))
+    )
+    return msgs_sent + client_msgs.astype(msgs_sent.dtype) + server_msgs
+
+
 @partial(jax.jit, static_argnames=("params",))
 def sync_step(rows, msgs_sent, key, params: SyncParams,
               partition_id=None, partition_active=False):
@@ -78,18 +101,82 @@ def sync_step(rows, msgs_sent, key, params: SyncParams,
     )
     new_rows = jnp.maximum(rows, merged)
 
-    # accounting: the client pays half the handshake per session; each
-    # serving peer pays the other half plus its chunk stream
-    sessions = jnp.sum(reachable, axis=1)  # [N] sessions as client
     chunks = -(-served_cells // params.cells_per_chunk)  # [N, P] ceil div
-    client_msgs = sessions * (params.handshake_msgs // 2)
-    per_server = (
-        (params.handshake_msgs - params.handshake_msgs // 2) + chunks
-    ) * reachable
-    server_msgs = (
-        jnp.zeros_like(msgs_sent)
-        .at[peers.reshape(-1)]
-        .add(per_server.reshape(-1).astype(msgs_sent.dtype))
+    msgs = session_msgs(
+        msgs_sent, peers, chunks, params.handshake_msgs, reachable
     )
-    msgs = msgs_sent + client_msgs.astype(msgs_sent.dtype) + server_msgs
     return new_rows, msgs
+
+
+# -- sequence-chunked reassembly ---------------------------------------
+#
+# The host protocol never transfers a version atomically: a changeset is
+# split into ≤8 KiB chunks of contiguous seq spans
+# (``crates/corro-types/src/change.rs`` ChunkedChanges; partial
+# buffering/promotion in ``agent/bookkeeping.py``), chunks arrive out of
+# order, and the gaps left by lost chunks are recomputed as needs the
+# next sync round.  This models that reassembly as a first-class
+# vectorized structure: a dense [N, S] seq bitmap per node, with the gap
+# algebra (``utils/ranges.py`` RangeSet) collapsing to bitwise ops.
+
+
+@dataclass(frozen=True)
+class SeqSyncParams:
+    n_nodes: int
+    n_seqs: int  # seqs in the changeset under reassembly
+    peers_per_round: int = 1  # subset peer selection
+    seqs_per_chunk: int = 8  # contiguous seqs per chunk message
+    chunk_budget: int = 4  # chunks a server sends per session
+    loss: float = 0.0  # per-CHUNK drop probability
+    handshake_msgs: int = 2
+
+
+def bitmap_gaps(bits):
+    """Missing-seq bitmap — the dense twin of ``RangeSet.gaps``.
+
+    bits: [..., S] bool (seqs held).  The host agent keeps the same fact
+    as sparse spans; tests cross-check the two representations.
+    """
+    return ~bits
+
+
+@partial(jax.jit, static_argnames=("params",))
+def seq_sync_step(bits, msgs_sent, key, params: SeqSyncParams):
+    """One anti-entropy round over partially-reassembled changesets.
+
+    bits:      [N, S] bool — seqs each node holds (buffered partials)
+    msgs_sent: [N] int32 cumulative message counter
+    Returns (bits', msgs_sent').
+
+    Each node pulls from ``peers_per_round`` random peers.  A serving
+    peer walks the client's needs (``peer & ~mine`` — exactly the
+    RangeSet gap algebra, dense) in ascending seq order and sends up to
+    ``chunk_budget`` chunks of ``seqs_per_chunk`` seqs.  Each chunk is
+    dropped i.i.d. with ``loss`` — a lost chunk while later chunks of
+    the same session land is precisely out-of-order arrival, and the
+    hole it leaves is healed by a later round recomputing needs from the
+    bitmap.  Partial holders serve their partials (complementary-partial
+    serving, ``runtime.py`` _serve_need parity).
+    """
+    n, p = params.n_nodes, params.peers_per_round
+    spc, budget = params.seqs_per_chunk, params.chunk_budget
+    k_peers, k_drop = jax.random.split(key)
+
+    peers = rand_peers(k_peers, n, (n, p))  # [N, P]
+    peer_bits = bits[peers]  # [N, P, S]
+    needs = peer_bits & ~bits[:, None, :]  # [N, P, S] gap algebra
+
+    # serve in ascending seq order, capped at the session budget
+    order = jnp.cumsum(needs.astype(jnp.int32), axis=2)  # 1-based rank
+    served = needs & (order <= budget * spc)
+    # chunk index of each served seq within its session
+    chunk_of = jnp.clip((order - 1) // spc, 0, budget - 1)  # [N, P, S]
+    dropped = (
+        jax.random.uniform(k_drop, (n, p, budget)) < params.loss
+    )  # [N, P, B]
+    arrived = served & ~jnp.take_along_axis(dropped, chunk_of, axis=2)
+    new_bits = bits | jnp.any(arrived, axis=1)
+
+    chunks = -(-jnp.sum(served, axis=2) // spc)  # [N, P] ceil
+    msgs = session_msgs(msgs_sent, peers, chunks, params.handshake_msgs)
+    return new_bits, msgs

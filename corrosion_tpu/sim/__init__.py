@@ -16,8 +16,14 @@ from corrosion_tpu.sim.epidemic import (
     run_epidemic_seeds,
 )
 from corrosion_tpu.sim.churn import ChurnConfig, run_churn
+from corrosion_tpu.sim.antientropy import (
+    AntiEntropyConfig,
+    run_anti_entropy_seeds,
+)
 
 __all__ = [
+    "AntiEntropyConfig",
+    "run_anti_entropy_seeds",
     "EpidemicConfig",
     "EpidemicState",
     "epidemic_init",

@@ -196,6 +196,20 @@ def _scan_chunk(state: EpidemicState, seed_key, target_row, cfg: EpidemicConfig)
     return jax.lax.scan(body, state, xs=None, length=cfg.chunk_ticks)
 
 
+def seed_convergence(allflags):
+    """Per-seed convergence extraction shared by the sim runners.
+
+    allflags: [S, T] bool per-tick convergence.  Returns (converged
+    mask, index of each seed's OWN convergence tick — last tick run if
+    it never converged — and 1-based first tick, inf if never)."""
+    converged = allflags.any(axis=1)
+    first_idx = np.where(
+        converged, allflags.argmax(axis=1), allflags.shape[1] - 1
+    )
+    first = np.where(converged, first_idx + 1, np.inf)
+    return converged, first_idx, first
+
+
 def run_epidemic(cfg: EpidemicConfig, seed: int = 0):
     """Single-universe run.  Returns a stats dict (host values)."""
     stats = run_epidemic_seeds(cfg, n_seeds=1, seed=seed)
@@ -246,11 +260,7 @@ def run_epidemic_seeds(cfg: EpidemicConfig, n_seeds: int = 16, seed: int = 0):
     allp99s = np.concatenate(p99s, axis=1)
     allh50s = np.concatenate(h50s, axis=1)
     allh99s = np.concatenate(h99s, axis=1)
-    converged = allflags.any(axis=1)
-    # per-seed stats taken at that seed's own convergence tick (last tick
-    # run if it never converged)
-    first_idx = np.where(converged, allflags.argmax(axis=1), allflags.shape[1] - 1)
-    first = np.where(converged, first_idx + 1, np.inf)
+    converged, first_idx, first = seed_convergence(allflags)
     rows = np.arange(n_seeds)
     return {
         "n_nodes": cfg.n_nodes,

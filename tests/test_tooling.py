@@ -157,12 +157,14 @@ def test_template_render_and_reactive_loop(run):
                     "{% endfor %}"
                 )
             t.start()
-            await wait_for(lambda: os.path.exists(out_path))
+            # generous timeouts: under a loaded full-suite run the
+            # subscription round-trip can take several seconds
+            await wait_for(lambda: os.path.exists(out_path), timeout=30.0)
             client.execute([["INSERT INTO tests (id, text) VALUES (3, 'three')"]])
             await wait_for(
                 lambda: os.path.exists(out_path)
                 and "server 3 = three" in open(out_path).read(),
-                timeout=10.0,
+                timeout=30.0,
             )
             stop.set()
         finally:
