@@ -14,9 +14,20 @@ Implementation notes:
   ?, ``::type`` casts stripped, a few function renames) — the reference
   does a full sqlparser→sqlite3-parser AST translation; ours leans on
   the large shared SQL dialect instead.
-* results are sent in text format with OID 25 (TEXT) per column, which
-  every driver accepts; ``version()`` and trivial ``pg_catalog`` probes
-  get canned answers.
+* parameters bind TYPED: the Parse message's declared OIDs (and binary
+  format codes) decode ints as ints, floats as floats, bytea as bytes —
+  so a PG-written row stores the same sqlite value a HTTP-written row
+  does and the two merge identically under LWW (``corro-pg/src/lib.rs``
+  name_to_type parity; the reference binds by declared OID the same
+  way).
+* results are sent in text format with per-column OIDs inferred from
+  the row values (int8/float8/bool/bytea/text), which typed drivers
+  parse back into native values.
+* ``pg_catalog`` / ``information_schema`` queries run against a real
+  sqlite rendering of the catalog (pg_class, pg_namespace,
+  pg_attribute, pg_type + information_schema tables/columns) rebuilt
+  from the live schema — the sqlite answer to the reference's
+  ``corro-pg/src/vtab/`` virtual tables.
 * BEGIN/COMMIT group writes into ONE replication version (buffered until
   COMMIT); reads always see committed state.
 """
@@ -35,7 +46,71 @@ PROTO_V3 = 196608
 SSL_REQUEST = 80877103
 CANCEL_REQUEST = 80877102
 
+BOOL_OID = 16
+BYTEA_OID = 17
+INT8_OID = 20
+INT2_OID = 21
+INT4_OID = 23
 TEXT_OID = 25
+FLOAT4_OID = 700
+FLOAT8_OID = 701
+VARCHAR_OID = 1043
+NUMERIC_OID = 1700
+
+_INT_OIDS = (INT2_OID, INT4_OID, INT8_OID)
+_FLOAT_OIDS = (FLOAT4_OID, FLOAT8_OID)
+
+
+def _decode_param(data: bytes, oid: int, fmt: int):
+    """Decode one Bind parameter into the native sqlite value its
+    declared OID names (the typed-binding fix: TEXT-decoding everything
+    made PG writes diverge from HTTP writes under LWW)."""
+    if fmt == 1:  # binary
+        if oid in _INT_OIDS:
+            return int.from_bytes(data, "big", signed=True)
+        if oid == FLOAT8_OID:
+            return struct.unpack(">d", data)[0]
+        if oid == FLOAT4_OID:
+            return struct.unpack(">f", data)[0]
+        if oid == BOOL_OID:
+            return 1 if data and data[0] else 0
+        if oid == BYTEA_OID:
+            return data
+        if oid in (TEXT_OID, VARCHAR_OID, 0):
+            return data.decode()
+        raise ValueError(f"binary format for OID {oid} not supported")
+    s = data.decode()
+    if oid in _INT_OIDS:
+        return int(s)
+    if oid in _FLOAT_OIDS:
+        return float(s)
+    if oid == NUMERIC_OID:
+        return int(s) if re.fullmatch(r"[+-]?\d+", s) else float(s)
+    if oid == BOOL_OID:
+        return 1 if s.lower() in ("t", "true", "1", "yes", "on") else 0
+    if oid == BYTEA_OID:
+        return bytes.fromhex(s[2:]) if s.startswith("\\x") else s.encode()
+    # unknown / text: sqlite column affinity does the rest, exactly as
+    # it does for an HTTP-written JSON string
+    return s
+
+
+def _infer_oid(values) -> int:
+    """Result-column OID from the first non-null value (the schema is
+    sqlite's, so value type IS the column's storage class)."""
+    for v in values:
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return BOOL_OID
+        if isinstance(v, int):
+            return INT8_OID
+        if isinstance(v, float):
+            return FLOAT8_OID
+        if isinstance(v, (bytes, bytearray, memoryview)):
+            return BYTEA_OID
+        return TEXT_OID
+    return TEXT_OID
 
 
 def _msg(tag: bytes, payload: bytes = b"") -> bytes:
@@ -147,14 +222,138 @@ def _tag_for(sql: str, rowcount: int, nrows: int) -> str:
     return word or "OK"
 
 
+_PG_TYPE_ROWS = [
+    (BOOL_OID, "bool"), (BYTEA_OID, "bytea"), (INT8_OID, "int8"),
+    (INT2_OID, "int2"), (INT4_OID, "int4"), (TEXT_OID, "text"),
+    (FLOAT4_OID, "float4"), (FLOAT8_OID, "float8"),
+    (VARCHAR_OID, "varchar"), (NUMERIC_OID, "numeric"),
+]
+
+
+def _decltype_oid(decl: str) -> int:
+    d = (decl or "").upper()
+    if "INT" in d:
+        return INT8_OID
+    if any(k in d for k in ("REAL", "FLOA", "DOUB")):
+        return FLOAT8_OID
+    if "BOOL" in d:
+        return BOOL_OID
+    if "BLOB" in d or not d:
+        return BYTEA_OID
+    return TEXT_OID
+
+
+def _pg_typename(oid: int) -> str:
+    return dict(_PG_TYPE_ROWS).get(oid, "text")
+
+
+def build_catalog(agent: "Agent"):
+    """Render the live schema as REAL pg_catalog / information_schema
+    tables in a throwaway in-memory sqlite db, so clients can run
+    actual catalog SQL (joins over pg_class/pg_attribute, \\d-style
+    probes) instead of getting canned one-liners.  The sqlite answer to
+    the reference's ``corro-pg/src/vtab/`` (pg_class.rs etc.) virtual
+    tables, rebuilt per query from ``PRAGMA table_info``.
+    """
+    import sqlite3
+
+    cat = sqlite3.connect(":memory:")
+    cat.executescript(
+        """
+CREATE TABLE pg_namespace (oid INTEGER PRIMARY KEY, nspname TEXT);
+CREATE TABLE pg_class (
+  oid INTEGER PRIMARY KEY, relname TEXT, relnamespace INTEGER,
+  relkind TEXT, relnatts INTEGER);
+CREATE TABLE pg_attribute (
+  attrelid INTEGER, attname TEXT, atttypid INTEGER, attnum INTEGER,
+  attnotnull INTEGER, attisdropped INTEGER DEFAULT 0);
+CREATE TABLE pg_type (oid INTEGER PRIMARY KEY, typname TEXT);
+CREATE TABLE pg_index (
+  indexrelid INTEGER, indrelid INTEGER, indisprimary INTEGER,
+  indkey TEXT);
+CREATE TABLE pg_description (objoid INTEGER, description TEXT);
+-- information_schema (bare names: this db holds nothing else)
+CREATE TABLE tables (
+  table_catalog TEXT, table_schema TEXT, table_name TEXT,
+  table_type TEXT);
+CREATE TABLE columns (
+  table_catalog TEXT, table_schema TEXT, table_name TEXT,
+  column_name TEXT, ordinal_position INTEGER, data_type TEXT,
+  is_nullable TEXT);
+"""
+    )
+    cat.executemany("INSERT INTO pg_type VALUES (?, ?)", _PG_TYPE_ROWS)
+    cat.execute("INSERT INTO pg_namespace VALUES (2200, 'public')")
+    cat.execute("INSERT INTO pg_namespace VALUES (11, 'pg_catalog')")
+    rel_oid = 16384
+    for t in sorted(agent.storage.tables):
+        _, info = agent.storage.read_query(f'PRAGMA table_info("{t}")')
+        cat.execute(
+            "INSERT INTO pg_class VALUES (?, ?, 2200, 'r', ?)",
+            (rel_oid, t, len(info)),
+        )
+        cat.execute(
+            "INSERT INTO tables VALUES ('corrosion', 'public', ?, "
+            "'BASE TABLE')", (t,),
+        )
+        pk_nums = []
+        for cid, name, decl, notnull, _dflt, pk in info:
+            oid = _decltype_oid(decl)
+            cat.execute(
+                "INSERT INTO pg_attribute VALUES (?, ?, ?, ?, ?, 0)",
+                (rel_oid, name, oid, cid + 1, 1 if (notnull or pk) else 0),
+            )
+            cat.execute(
+                "INSERT INTO columns VALUES ('corrosion', 'public', ?, ?, "
+                "?, ?, ?)",
+                (t, name, cid + 1, _pg_typename(oid),
+                 "NO" if (notnull or pk) else "YES"),
+            )
+            if pk:
+                pk_nums.append(str(cid + 1))
+        if pk_nums:
+            cat.execute(
+                "INSERT INTO pg_index VALUES (?, ?, 1, ?)",
+                (rel_oid + 1, rel_oid, " ".join(pk_nums)),
+            )
+        rel_oid += 2
+    return cat
+
+
+_SCHEMA_PREFIX_RE = re.compile(
+    r"\b(?:pg_catalog|information_schema)\s*\.\s*", re.IGNORECASE
+)
+
+def _catalog_for(agent: "Agent"):
+    """Cached rendered catalog (stored on the agent), invalidated by
+    sqlite's schema_version counter (bumps on any DDL) — driver/ORM
+    startup fires bursts of catalog queries and must not rebuild N
+    tables' worth each time."""
+    _, rows = agent.storage.read_query("PRAGMA schema_version")
+    key = (rows[0][0], tuple(sorted(agent.storage.tables)))
+    hit = getattr(agent, "_pg_catalog", None)
+    if hit and hit[0] == key:
+        return hit[1]
+    cat = build_catalog(agent)
+    if hit:
+        hit[1].close()
+    agent._pg_catalog = (key, cat)
+    return cat
+
+
 class _Session:
     def __init__(self, agent: "Agent"):
         self.agent = agent
-        self.stmts: Dict[str, Tuple[str, str]] = {}  # name -> (raw, translated)
-        self.portals: Dict[str, Tuple[str, List[Optional[bytes]]]] = {}
+        # name -> (raw, translated, declared param OIDs)
+        self.stmts: Dict[str, Tuple[str, str, List[int]]] = {}
+        # name -> {"stmt", "values", "described", "cached"}
+        self.portals: Dict[str, dict] = {}
         self.in_txn = False
         self.txn_failed = False
         self.txn_writes: List[list] = []
+        # extended-protocol error recovery: after an error, further
+        # Parse/Bind/Describe/Execute are discarded until Sync
+        self.skip_until_sync = False
 
     # -- execution -------------------------------------------------------
 
@@ -180,7 +379,7 @@ class _Session:
         if not raw:
             return [], [], 0, ""
 
-        canned = self._canned(raw)
+        canned = self._canned(raw, params)
         if canned is not None:
             return canned
 
@@ -197,7 +396,7 @@ class _Session:
         cols, rows = self.agent.storage.read_query(tsql, params)
         return cols, rows, len(rows), _tag_for(tsql, -1, len(rows))
 
-    def _canned(self, raw: str):
+    def _canned(self, raw: str, params: Tuple = ()):
         low = " ".join(raw.lower().split())
         if low in ("select version()", "select version();"):
             return (
@@ -211,11 +410,12 @@ class _Session:
         if low.startswith("show "):
             return ["setting"], [("",)], 1, "SELECT 1"
         if "pg_catalog" in low or "information_schema" in low:
-            # minimal catalog: list CRR tables for pg_class-style probes
-            if "pg_class" in low or "tables" in low:
-                rows = [(t,) for t in self.agent.storage.tables]
-                return ["relname"], rows, len(rows), f"SELECT {len(rows)}"
-            return ["?column?"], [], 0, "SELECT 0"
+            # run real catalog SQL against the rendered catalog
+            tsql = _SCHEMA_PREFIX_RE.sub("", translate_sql(raw))
+            cur = _catalog_for(self.agent).execute(tsql, params)
+            cols = [d[0] for d in cur.description or []]
+            rows = cur.fetchall()
+            return cols, rows, len(rows), f"SELECT {len(rows)}"
         return None
 
 
@@ -265,12 +465,18 @@ async def _handle_conn(agent: "Agent", reader: asyncio.StreamReader,
             body = await reader.readexactly(length - 4)
             if tag == b"X":
                 return
+            if session.skip_until_sync and tag in (b"P", b"B", b"D",
+                                                   b"E", b"C", b"H"):
+                continue  # discard until Sync (extended-protocol rule)
             if tag == b"Q":
+                session.skip_until_sync = False
                 await _simple_query(writer, session, _Buffer(body).string())
             elif tag == b"P":
                 b = _Buffer(body)
                 name, query = b.string(), b.string()
-                session.stmts[name] = (query, translate_sql(query))
+                n_oids = b.int16()
+                oids = [b.int32() for _ in range(n_oids)]
+                session.stmts[name] = (query, translate_sql(query), oids)
                 writer.write(_msg(b"1"))
             elif tag == b"B":
                 _bind(writer, session, _Buffer(body))
@@ -284,6 +490,7 @@ async def _handle_conn(agent: "Agent", reader: asyncio.StreamReader,
                 (session.stmts if kind == b"S" else session.portals).pop(name, None)
                 writer.write(_msg(b"3"))
             elif tag == b"S":
+                session.skip_until_sync = False
                 _ready(writer, session)
             elif tag == b"H":
                 pass  # flush: we always flush below
@@ -309,11 +516,24 @@ def _error(writer, code: str, message: str) -> None:
     writer.write(_msg(b"E", payload))
 
 
-def _row_description(writer, cols: List[str]) -> None:
+def _ext_error(writer, session: _Session, code: str, message: str) -> None:
+    """ErrorResponse inside the extended protocol: subsequent messages
+    are discarded until the client's Sync."""
+    session.skip_until_sync = True
+    _error(writer, code, message)
+
+
+def _row_description(writer, cols: List[str],
+                     oids: Optional[List[int]] = None) -> None:
     payload = struct.pack(">h", len(cols))
-    for c in cols:
-        payload += _cstr(c) + struct.pack(">IhIhih", 0, 0, TEXT_OID, -1, -1, 0)
+    for i, c in enumerate(cols):
+        oid = oids[i] if oids else TEXT_OID
+        payload += _cstr(c) + struct.pack(">IhIhih", 0, 0, oid, -1, -1, 0)
     writer.write(_msg(b"T", payload))
+
+
+def _result_oids(rows: List[tuple], ncols: int) -> List[int]:
+    return [_infer_oid(r[i] for r in rows) for i in range(ncols)]
 
 
 def _data_rows(writer, rows: List[tuple]) -> None:
@@ -348,7 +568,7 @@ async def _simple_query(writer, session: _Session, query: str) -> None:
             _error(writer, "42601", str(e))
             break
         if cols:
-            _row_description(writer, cols)
+            _row_description(writer, cols, _result_oids(rows, len(cols)))
             _data_rows(writer, rows)
         writer.write(_msg(b"C", _cstr(tag)))
     _ready(writer, session)
@@ -359,55 +579,127 @@ def _bind(writer, session: _Session, b: _Buffer) -> None:
     nfmt = b.int16()
     fmts = [b.int16() for _ in range(nfmt)]
     nparams = b.int16()
-    params: List[Optional[bytes]] = []
+    raw_params: List[Optional[bytes]] = []
     for i in range(nparams):
         ln = b.int32()
-        params.append(None if ln == -1 else b.read(ln))
+        raw_params.append(None if ln == -1 else b.read(ln))
+    nrfmt = b.int16()
+    rfmts = [b.int16() for _ in range(nrfmt)]
     if stmt not in session.stmts:
-        _error(writer, "26000", f"unknown prepared statement {stmt!r}")
+        _ext_error(writer, session, "26000",
+                   f"unknown prepared statement {stmt!r}")
         return
-    # text format assumed (fmt 0); binary params are rejected
-    if any(f == 1 for f in fmts):
-        _error(writer, "0A000", "binary parameter format not supported")
+    if any(f == 1 for f in rfmts):
+        _ext_error(writer, session, "0A000",
+                   "binary result format not supported")
         return
-    session.portals[portal] = (stmt, params)
+    oids = session.stmts[stmt][2]
+    values: List = []
+    for i, data in enumerate(raw_params):
+        # per-protocol: 0 fmts = all text, 1 fmt = applies to all
+        fmt = fmts[i] if len(fmts) == nparams else (fmts[0] if fmts else 0)
+        oid = oids[i] if i < len(oids) else 0
+        if data is None:
+            values.append(None)
+            continue
+        try:
+            values.append(_decode_param(data, oid, fmt))
+        except (ValueError, struct.error) as e:
+            # the stale portal must not survive a failed Bind: a
+            # pipelined Execute would silently re-run the old statement
+            session.portals.pop(portal, None)
+            _ext_error(writer, session, "22P02", f"parameter ${i + 1}: {e}")
+            return
+    session.portals[portal] = {
+        "stmt": stmt, "values": values, "described": False, "cached": None,
+    }
     writer.write(_msg(b"2"))
 
 
 def _describe(writer, session: _Session, b: _Buffer) -> None:
     kind, name = b.read(1), b.string()
-    # we don't know result columns until execution: report NoData for
-    # writes, ParameterDescription+NoData for statements
     if kind == b"S":
-        raw = session.stmts.get(name, ("", ""))[0]
-        nparams = len(set(re.findall(r"\$(\d+)", raw)))
-        writer.write(
-            _msg(b"t", struct.pack(">h", nparams) + struct.pack(">I", TEXT_OID) * nparams)
-        )
-    writer.write(_msg(b"n"))  # NoData; RowDescription arrives with Execute
+        if name not in session.stmts:
+            _ext_error(writer, session, "26000",
+                       f"unknown prepared statement {name!r}")
+            return
+        raw, tsql, oids = session.stmts[name]
+        # real placeholder count (translate_query is literal-aware;
+        # counting '?' would also count ones inside strings)
+        order = translate_query(raw)[1]
+        nparams = len(set(order))
+        payload = struct.pack(">h", nparams)
+        for i in range(nparams):
+            payload += struct.pack(
+                ">I", oids[i] if i < len(oids) and oids[i] else TEXT_OID
+            )
+        writer.write(_msg(b"t", payload))
+        # probe result columns without executing: NULL-bound LIMIT 0
+        if tsql and not _is_write(tsql) and "pg_catalog" not in tsql.lower():
+            try:
+                cols, _rows = session.agent.storage.read_query(
+                    f"SELECT * FROM ({tsql.rstrip(';')}) LIMIT 0",
+                    [None] * len(order),
+                )
+                if cols:
+                    _row_description(writer, cols, [TEXT_OID] * len(cols))
+                    return
+            except Exception:
+                pass
+        writer.write(_msg(b"n"))
+        return
+    # Describe(portal): params are bound, so the query can run NOW —
+    # the RowDescription carries the real inferred OIDs and Execute
+    # replays the cached result instead of emitting a second (protocol-
+    # violating) RowDescription.
+    entry = session.portals.get(name)
+    if entry is None or entry["stmt"] not in session.stmts:
+        _ext_error(writer, session, "34000", f"unknown portal {name!r}")
+        return
+    raw = session.stmts[entry["stmt"]][0]
+    if _is_write(translate_sql(raw)):
+        entry["described"] = True
+        writer.write(_msg(b"n"))  # writes produce no rows
+        return
+    try:
+        cols, rows, rc, tag = session.execute(raw, tuple(entry["values"]))
+    except Exception as e:
+        if session.in_txn:
+            session.txn_failed = True
+        _ext_error(writer, session, "42601", str(e))
+        return
+    entry["described"] = True
+    entry["cached"] = (cols, rows, rc, tag)
+    if cols:
+        _row_description(writer, cols, _result_oids(rows, len(cols)))
+    else:
+        writer.write(_msg(b"n"))
 
 
 async def _execute_portal(writer, session: _Session, b: _Buffer) -> None:
     portal = b.string()
     b.int32()  # row limit (0 = all); portals are always drained fully
     entry = session.portals.get(portal)
-    if entry is None:
-        _error(writer, "34000", f"unknown portal {portal!r}")
+    if entry is None or entry["stmt"] not in session.stmts:
+        _ext_error(writer, session, "34000", f"unknown portal {portal!r}")
         return
-    stmt_name, raw_params = entry
-    raw, tsql = session.stmts[stmt_name]
-    params = tuple(
-        None if p is None else p.decode() for p in raw_params
-    )
-    try:
-        cols, rows, rc, tag = session.execute(raw, params)
-    except Exception as e:
-        if session.in_txn:
-            session.txn_failed = True
-        _error(writer, "42601", str(e))
-        return
+    if entry["cached"] is not None:
+        cols, rows, rc, tag = entry["cached"]
+        entry["cached"] = None
+    else:
+        raw = session.stmts[entry["stmt"]][0]
+        try:
+            cols, rows, rc, tag = session.execute(
+                raw, tuple(entry["values"])
+            )
+        except Exception as e:
+            if session.in_txn:
+                session.txn_failed = True
+            _ext_error(writer, session, "42601", str(e))
+            return
     if cols:
-        _row_description(writer, cols)
+        if not entry["described"]:
+            _row_description(writer, cols, _result_oids(rows, len(cols)))
         _data_rows(writer, rows)
     writer.write(_msg(b"C", _cstr(tag)))
 

@@ -80,14 +80,31 @@ class PgClient:
 
     # -- extended protocol -----------------------------------------------
 
-    def prepared(self, sql: str, params: Tuple = ()):
-        """Parse/Bind/Execute/Sync round; returns (cols, rows, tag, err)."""
-        self._send(b"P", b"\x00" + sql.encode() + b"\x00" + struct.pack(">h", 0))
-        bind = b"\x00\x00" + struct.pack(">h", 0)  # portal, stmt, no fmts
+    def prepared(self, sql: str, params: Tuple = (),
+                 param_oids: Tuple = (), binary: bool = False):
+        """Parse/Bind/Execute/Sync round; returns (cols, rows, tag, err).
+
+        With ``param_oids`` the Parse message declares each parameter's
+        type (what psycopg does for typed Python values); with
+        ``binary`` params are sent in binary format for their OID.
+        """
+        parse = b"\x00" + sql.encode() + b"\x00"
+        parse += struct.pack(">h", len(param_oids))
+        for oid in param_oids:
+            parse += struct.pack(">I", oid)
+        self._send(b"P", parse)
+        if binary:
+            bind = b"\x00\x00" + struct.pack(">hh", 1, 1)  # all binary
+        else:
+            bind = b"\x00\x00" + struct.pack(">h", 0)
         bind += struct.pack(">h", len(params))
-        for p in params:
+        for i, p in enumerate(params):
             if p is None:
                 bind += struct.pack(">i", -1)
+            elif binary:
+                oid = param_oids[i] if i < len(param_oids) else 0
+                s = self._encode_binary(p, oid)
+                bind += struct.pack(">i", len(s)) + s
             else:
                 s = str(p).encode()
                 bind += struct.pack(">i", len(s)) + s
@@ -97,12 +114,14 @@ class PgClient:
         self._send(b"E", b"\x00" + struct.pack(">i", 0))
         self._send(b"S")
         cols: List[str] = []
+        self.col_oids: List[int] = []
         rows: List[list] = []
         tag_out: Optional[str] = None
         err: Optional[str] = None
         for tag, payload in self._messages_until(b"Z"):
             if tag == b"T":
                 cols = self._parse_rowdesc(payload)
+                self.col_oids = self._parse_rowdesc_oids(payload)
             elif tag == b"D" and len(payload) >= 2:
                 rows.append(self._parse_datarow(payload))
             elif tag == b"C":
@@ -112,6 +131,54 @@ class PgClient:
             elif tag == b"Z":
                 self.txn_status = payload.decode()
         return cols, rows, tag_out, err
+
+    def typed_query(self, sql: str, params: Tuple = (),
+                    param_oids: Tuple = (), binary: bool = False):
+        """prepared() + decode each result cell by its column OID, the
+        way a real typed driver (psycopg) consumes text-format results."""
+        cols, rows, tag, err = self.prepared(sql, params, param_oids, binary)
+        if err:
+            return cols, rows, tag, err
+        decoded = [
+            tuple(
+                self._decode_text(v, oid)
+                for v, oid in zip(row, self.col_oids)
+            )
+            for row in rows
+        ]
+        return cols, decoded, tag, err
+
+    @staticmethod
+    def _encode_binary(p, oid: int) -> bytes:
+        if oid in (21,):
+            return struct.pack(">h", p)
+        if oid in (23,):
+            return struct.pack(">i", p)
+        if oid in (20,):
+            return struct.pack(">q", p)
+        if oid == 700:
+            return struct.pack(">f", p)
+        if oid == 701:
+            return struct.pack(">d", p)
+        if oid == 16:
+            return b"\x01" if p else b"\x00"
+        if oid == 17:
+            return bytes(p)
+        return str(p).encode()
+
+    @staticmethod
+    def _decode_text(v, oid: int):
+        if v is None:
+            return None
+        if oid in (20, 21, 23):
+            return int(v)
+        if oid in (700, 701):
+            return float(v)
+        if oid == 16:
+            return v in ("t", "true", "1")
+        if oid == 17:
+            return bytes.fromhex(v[2:]) if v.startswith("\\x") else v.encode()
+        return v
 
     # -- parsing ---------------------------------------------------------
 
@@ -140,6 +207,20 @@ class PgClient:
                 out.append(payload[pos : pos + ln].decode())
                 pos += ln
         return out
+
+    @staticmethod
+    def _parse_rowdesc_oids(payload: bytes) -> List[int]:
+        (n,) = struct.unpack_from(">h", payload, 0)
+        oids = []
+        pos = 2
+        for _ in range(n):
+            end = payload.index(b"\x00", pos)
+            pos = end + 1
+            # table oid (4) + attnum (2), then the type OID
+            (oid,) = struct.unpack_from(">I", payload, pos + 6)
+            oids.append(oid)
+            pos += 18
+        return oids
 
     @staticmethod
     def _parse_error(payload: bytes) -> str:

@@ -165,3 +165,176 @@ def test_pg_write_broadcasts_to_cluster(run):
             await a.stop()
 
     run(main())
+
+
+def test_pg_typed_params_and_results(run):
+    """Declared OIDs bind natively (text and binary format) and result
+    columns carry inferred OIDs a typed driver decodes back — the
+    round-trip a stock psycopg would do (no PG driver in this image, so
+    the raw-wire client plays its part)."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                INT8, TEXT = 20, 25
+                c = PgClient(*a.pg_addr)
+                _, _, tag, err = c.prepared(
+                    "INSERT INTO tests (id, text) VALUES ($1, $2)",
+                    (7, "typed"), param_oids=(INT8, TEXT),
+                )
+                assert err is None and tag == "INSERT 0 1"
+                # binary-format params (psycopg's int binding)
+                _, _, tag, err = c.prepared(
+                    "INSERT INTO tests (id, text) VALUES ($1, $2)",
+                    (8, "binary"), param_oids=(INT8, TEXT), binary=True,
+                )
+                assert err is None and tag == "INSERT 0 1"
+                # typed results: ints come back as ints
+                cols, rows, tag, err = c.typed_query(
+                    "SELECT id, text FROM tests ORDER BY id"
+                )
+                assert err is None
+                assert rows == [(7, "typed"), (8, "binary")]
+                assert c.col_oids == [INT8, TEXT]
+                c.close()
+
+            await asyncio.to_thread(drive)
+            # the stored values are native sqlite INTEGERs, not text
+            _, rows = a.storage.read_query(
+                "SELECT typeof(id), typeof(text) FROM tests"
+            )
+            assert rows == [("integer", "text")] * 2
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_and_http_writes_merge_identically(run):
+    """The golden divergence case: the same logical write through the
+    PG wire and through HTTP must produce byte-identical CRDT state, so
+    LWW ties resolve the same on every node."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        b = await launch_test_agent(
+            pg_port=0,
+            bootstrap=[f"{a.gossip_addr[0]}:{a.gossip_addr[1]}"],
+        )
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+            # HTTP write on a
+            a.execute_transaction([
+                ["INSERT INTO tests (id, text) VALUES (?, ?)", [100, "h"]]
+            ])
+            # the same-shape write via PG on b (typed params)
+            def drive():
+                c = PgClient(*b.pg_addr)
+                _, _, tag, err = c.prepared(
+                    "INSERT INTO tests (id, text) VALUES ($1, $2)",
+                    (200, "p"), param_oids=(20, 25),
+                )
+                assert err is None and tag == "INSERT 0 1"
+                c.close()
+
+            await asyncio.to_thread(drive)
+
+            def table(x):
+                return x.storage.read_query(
+                    "SELECT id, text, typeof(id) FROM tests ORDER BY id"
+                )[1]
+
+            await wait_for(
+                lambda: table(a) == table(b) and len(table(a)) == 2,
+                timeout=15,
+            )
+            assert table(a) == [
+                (100, "h", "integer"), (200, "p", "integer")
+            ]
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_catalog_is_queryable(run):
+    """Real catalog SQL (the joins \\d-style tooling runs) works against
+    the rendered pg_catalog, and information_schema lists columns."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                c = PgClient(*a.pg_addr)
+                cols, rows, _, errs = c.query(
+                    "SELECT c.relname, a.attname, t.typname"
+                    " FROM pg_catalog.pg_class c"
+                    " JOIN pg_catalog.pg_attribute a ON a.attrelid = c.oid"
+                    " JOIN pg_catalog.pg_type t ON t.oid = a.atttypid"
+                    " WHERE c.relnamespace = 2200"
+                    " ORDER BY c.relname, a.attnum"
+                )
+                assert not errs
+                assert ["tests", "id", "int8"] in rows
+                assert ["tests", "text", "text"] in rows
+                cols, rows, _, errs = c.query(
+                    "SELECT table_name, column_name, data_type"
+                    " FROM information_schema.columns"
+                    " WHERE table_name = 'tests2' ORDER BY ordinal_position"
+                )
+                assert not errs
+                assert rows == [
+                    ["tests2", "id", "int8"], ["tests2", "text", "text"]
+                ]
+                c.close()
+
+            await asyncio.to_thread(drive)
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_bind_error_discards_until_sync(run):
+    """A failed Bind must not leave the previous portal bound: the
+    pipelined Execute that follows is discarded until Sync instead of
+    silently re-running the old statement (duplicate INSERT)."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                import struct as st
+
+                c = PgClient(*a.pg_addr)
+                # a successful prepared INSERT leaves portal '' bound
+                _, _, tag, err = c.prepared(
+                    "INSERT INTO tests (id, text) VALUES ($1, $2)",
+                    (1, "once"), param_oids=(20, 25),
+                )
+                assert err is None and tag == "INSERT 0 1"
+                # now a Bind that fails to decode (binary date OID),
+                # pipelined with an Execute + Sync
+                parse = b"\x00" + b"INSERT INTO tests (id, text) VALUES ($1, 'x')\x00"
+                parse += st.pack(">h", 1) + st.pack(">I", 1082)  # date OID
+                c._send(b"P", parse)
+                bind = b"\x00\x00" + st.pack(">hh", 1, 1)  # binary fmt
+                bind += st.pack(">h", 1) + st.pack(">i", 4) + st.pack(">i", 123)
+                bind += st.pack(">h", 0)
+                c._send(b"B", bind)
+                c._send(b"E", b"\x00" + st.pack(">i", 0))
+                c._send(b"S")
+                saw_error = False
+                for tag_, payload in c._messages_until(b"Z"):
+                    if tag_ == b"E":
+                        saw_error = True
+                assert saw_error
+                c.close()
+
+            await asyncio.to_thread(drive)
+            # exactly ONE row: the discarded Execute did not re-run the
+            # old INSERT, and the failed one never ran
+            _, rows = a.storage.read_query("SELECT count(*) FROM tests")
+            assert rows == [(1,)]
+        finally:
+            await a.stop()
+
+    run(main())
