@@ -99,6 +99,25 @@ def load_config(path: Optional[str] = None, **overrides) -> AgentConfig:
         else api.get("authorization"),
         subs_path=data.get("subscriptions", {}).get("path"),
     )
+    # [gossip.tls] (config.rs TlsConfig: cert-file/key-file/ca-file/
+    # insecure + [gossip.tls.client] cert-file/key-file/required)
+    tls = gossip.get("tls", {})
+    if tls:
+        kwargs.update(
+            tls_cert_file=tls.get("cert_file") or tls.get("cert-file"),
+            tls_key_file=tls.get("key_file") or tls.get("key-file"),
+            tls_ca_file=tls.get("ca_file") or tls.get("ca-file"),
+            tls_insecure=bool(tls.get("insecure", False)),
+        )
+        client = tls.get("client", {})
+        if client:
+            kwargs.update(
+                tls_client_required=bool(client.get("required", False)),
+                tls_client_cert_file=(client.get("cert_file")
+                                      or client.get("cert-file")),
+                tls_client_key_file=(client.get("key_file")
+                                     or client.get("key-file")),
+            )
     for key in (
         "probe_interval",
         "probe_timeout",

@@ -116,6 +116,16 @@ class AgentConfig:
     ring0_enabled: bool = True
     # LRU cap on cached outbound uni connections (fd budget)
     uni_cache_size: int = 512
+    # TLS over the gossip/sync TCP streams (main.rs:707-760 tooling,
+    # peer.rs:128-318 rustls config). Off unless tls_cert_file is set;
+    # SWIM datagrams stay plaintext UDP (see agent/tls.py).
+    tls_cert_file: Optional[str] = None
+    tls_key_file: Optional[str] = None
+    tls_ca_file: Optional[str] = None
+    tls_insecure: bool = False  # skip server-cert verification
+    tls_client_required: bool = False  # mTLS: peers must present certs
+    tls_client_cert_file: Optional[str] = None
+    tls_client_key_file: Optional[str] = None
 
 
 class Agent:
@@ -222,9 +232,13 @@ class Agent:
             max_workers=self.config.max_concurrent_applies,
             thread_name_prefix="corro-apply",
         )
+        from corrosion_tpu.agent.tls import contexts_from_config
+
+        tls_server_ctx, tls_client_ctx = contexts_from_config(self.config)
         self.transport = Transport(
             metrics=self.metrics, on_rtt=self._record_rtt,
             max_cached=self.config.uni_cache_size,
+            ssl_context=tls_client_ctx,
         )
         # one gossip port for both datagrams (SWIM) and streams, like the
         # reference's single QUIC/UDP endpoint; with an ephemeral port the
@@ -239,6 +253,7 @@ class Agent:
                 self._tcp = await asyncio.start_server(
                     self._serve_tcp, self.config.gossip_host,
                     self.gossip_addr[1],
+                    ssl=tls_server_ctx,
                 )
                 break
             except OSError:

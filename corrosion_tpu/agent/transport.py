@@ -72,11 +72,12 @@ class Transport:
     """Connection-caching sender.  All methods are loop-affine."""
 
     def __init__(self, metrics=None, connect_timeout: float = 2.0,
-                 on_rtt=None, max_cached: int = 512):
+                 on_rtt=None, max_cached: int = 512, ssl_context=None):
         self._uni: Dict[Addr, UniConnection] = {}
         self.metrics = metrics
         self.connect_timeout = connect_timeout
         self.on_rtt = on_rtt  # callback(addr, rtt_seconds)
+        self.ssl_context = ssl_context  # TLS for uni/bi streams (or None)
         # LRU cap on cached uni connections (the reference's QUIC conns
         # close on idle timeout; an unbounded TCP cache leaks fds in
         # large in-process clusters)
@@ -85,7 +86,9 @@ class Transport:
     async def _open(self, addr: Addr, header: bytes) -> UniConnection:
         t0 = time.monotonic()
         reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(addr[0], addr[1]),
+            asyncio.open_connection(
+                addr[0], addr[1], ssl=self.ssl_context
+            ),
             timeout=self.connect_timeout,
         )
         rtt = time.monotonic() - t0
@@ -147,7 +150,9 @@ class Transport:
         per-session like the reference's open_bi."""
         t0 = time.monotonic()
         reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(addr[0], addr[1]),
+            asyncio.open_connection(
+                addr[0], addr[1], ssl=self.ssl_context
+            ),
             timeout=self.connect_timeout,
         )
         if self.on_rtt is not None:

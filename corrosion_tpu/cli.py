@@ -214,7 +214,75 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--once", action="store_true")
     sp.set_defaults(fn=cmd_consul_sync)
 
+    # corrosion tls {ca,server,client} generate (main.rs:707-760)
+    tls = sub.add_parser(
+        "tls", help="generate a CA and signed server/client certs"
+    ).add_subparsers(dest="sub", required=True)
+    ca = tls.add_parser("ca").add_subparsers(dest="op", required=True)
+    sp = ca.add_parser("generate")
+    sp.add_argument("--dir", default=".")
+    sp.add_argument("--days", type=int, default=3650)
+    sp.set_defaults(fn=cmd_tls_ca)
+    server = tls.add_parser("server").add_subparsers(dest="op", required=True)
+    sp = server.add_parser("generate")
+    sp.add_argument("names", nargs="+",
+                    help="SANs: gossip IPs or DNS names")
+    sp.add_argument("--dir", default=".")
+    sp.add_argument("--ca-cert", default=None,
+                    help="default: <dir>/ca.crt")
+    sp.add_argument("--ca-key", default=None,
+                    help="default: <dir>/ca.key")
+    sp.add_argument("--days", type=int, default=365)
+    sp.set_defaults(fn=cmd_tls_server)
+    client = tls.add_parser("client").add_subparsers(dest="op", required=True)
+    sp = client.add_parser("generate")
+    sp.add_argument("--dir", default=".")
+    sp.add_argument("--ca-cert", default=None,
+                    help="default: <dir>/ca.crt")
+    sp.add_argument("--ca-key", default=None,
+                    help="default: <dir>/ca.key")
+    sp.add_argument("--days", type=int, default=365)
+    sp.set_defaults(fn=cmd_tls_client)
+
     return p
+
+
+def cmd_tls_ca(args) -> int:
+    from corrosion_tpu.agent.tls import generate_ca
+
+    cert, key = generate_ca(args.dir, days=args.days)
+    print(f"wrote {cert} and {key}")
+    return 0
+
+
+def cmd_tls_server(args) -> int:
+    import os
+
+    from corrosion_tpu.agent.tls import generate_server_cert
+
+    cert, key = generate_server_cert(
+        args.dir,
+        args.ca_cert or os.path.join(args.dir, "ca.crt"),
+        args.ca_key or os.path.join(args.dir, "ca.key"),
+        args.names, days=args.days,
+    )
+    print(f"wrote {cert} and {key}")
+    return 0
+
+
+def cmd_tls_client(args) -> int:
+    import os
+
+    from corrosion_tpu.agent.tls import generate_client_cert
+
+    cert, key = generate_client_cert(
+        args.dir,
+        args.ca_cert or os.path.join(args.dir, "ca.crt"),
+        args.ca_key or os.path.join(args.dir, "ca.key"),
+        days=args.days,
+    )
+    print(f"wrote {cert} and {key}")
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1,0 +1,152 @@
+"""TLS gossip/sync stream tests: cert tooling + mTLS cluster.
+
+Parity: reference ``corrosion tls ca/server/client generate``
+(``crates/corrosion/src/main.rs:707-760``) and rustls-secured gossip
+(``api/peer.rs:128-318``).  Plaintext stays the default everywhere
+else in the suite.
+"""
+
+import asyncio
+import socket
+import ssl
+
+import pytest
+
+from corrosion_tpu.agent.testing import launch_test_agent, wait_for
+
+
+@pytest.fixture
+def run():
+    def _run(coro):
+        return asyncio.run(coro)
+
+    return _run
+
+
+@pytest.fixture
+def certs(tmp_path):
+    """CA + server cert (valid for 127.0.0.1) + client cert via the
+    same code paths the CLI uses."""
+    from corrosion_tpu.agent.tls import (
+        generate_ca, generate_client_cert, generate_server_cert,
+    )
+
+    d = str(tmp_path)
+    ca_cert, ca_key = generate_ca(d)
+    srv_cert, srv_key = generate_server_cert(
+        d, ca_cert, ca_key, ["127.0.0.1", "localhost"]
+    )
+    cli_cert, cli_key = generate_client_cert(d, ca_cert, ca_key)
+    return {
+        "ca": ca_cert, "ca_key": ca_key,
+        "server": srv_cert, "server_key": srv_key,
+        "client": cli_cert, "client_key": cli_key,
+    }
+
+
+def test_cli_tls_generate(tmp_path):
+    from corrosion_tpu.cli import main
+
+    d = str(tmp_path)
+    assert main(["tls", "ca", "generate", "--dir", d]) == 0
+    # --ca-cert/--ca-key default to <dir>/ca.{crt,key}
+    assert main(["tls", "server", "generate", "127.0.0.1", "--dir", d]) == 0
+    assert main(["tls", "client", "generate", "--dir", d]) == 0
+    # both leaf certs genuinely verify against the CA's signature
+    from cryptography import x509
+
+    with open(f"{d}/ca.crt", "rb") as f:
+        ca = x509.load_pem_x509_certificate(f.read())
+    for leaf_name in ("server.crt", "client.crt"):
+        with open(f"{d}/{leaf_name}", "rb") as f:
+            leaf = x509.load_pem_x509_certificate(f.read())
+        leaf.verify_directly_issued_by(ca)  # raises on a bad chain
+
+
+def test_mtls_cluster_converges(run, certs):
+    """A 2-node cluster with mutual TLS on every gossip/sync stream
+    still converges; the wire genuinely refuses plaintext."""
+    async def main():
+        tls_kw = dict(
+            tls_cert_file=certs["server"],
+            tls_key_file=certs["server_key"],
+            tls_ca_file=certs["ca"],
+            tls_client_required=True,
+        )
+        a = await launch_test_agent(**tls_kw)
+        b = await launch_test_agent(
+            bootstrap=[f"{a.gossip_addr[0]}:{a.gossip_addr[1]}"], **tls_kw
+        )
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+            a.execute_transaction(
+                [["INSERT INTO tests (id, text) VALUES (1, 'secret')"]]
+            )
+            await wait_for(
+                lambda: b.storage.read_query(
+                    "SELECT text FROM tests WHERE id=1"
+                )[1] == [("secret",)],
+                timeout=15,
+            )
+            # sync path too: an isolated later write heals over TLS
+            b.execute_transaction(
+                [["INSERT INTO tests (id, text) VALUES (2, 'back')"]]
+            )
+            await wait_for(
+                lambda: a.storage.read_query(
+                    "SELECT count(*) FROM tests"
+                )[1] == [(2,)],
+                timeout=15,
+            )
+
+            # a plaintext TCP client gets no gossip service
+            with socket.create_connection(tuple(a.gossip_addr),
+                                          timeout=5) as s:
+                s.sendall(b"\x00" * 64)
+                s.settimeout(5)
+                try:
+                    data = s.recv(1024)
+                except (ConnectionError, socket.timeout):
+                    data = b""
+                assert data == b""  # TLS server rejects, never speaks
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())
+
+
+def test_tls_without_client_cert_rejected(run, certs):
+    """With tls_client_required, a TLS client that presents no client
+    cert cannot complete a stream handshake (mTLS is enforced)."""
+    async def main():
+        a = await launch_test_agent(
+            tls_cert_file=certs["server"],
+            tls_key_file=certs["server_key"],
+            tls_ca_file=certs["ca"],
+            tls_client_required=True,
+        )
+        try:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+
+            def try_connect():
+                with socket.create_connection(tuple(a.gossip_addr),
+                                              timeout=5) as raw:
+                    with ctx.wrap_socket(raw) as s:
+                        # TLS 1.3: the certificate-required alert lands
+                        # on the first read/write after the handshake —
+                        # as an SSLError or as an abrupt empty read
+                        s.sendall(b"x")
+                        return s.recv(64)
+
+            try:
+                data = await asyncio.to_thread(try_connect)
+            except (ssl.SSLError, ConnectionError, OSError):
+                data = b""
+            assert data == b"", "server served a certless client"
+        finally:
+            await a.stop()
+
+    run(main())
