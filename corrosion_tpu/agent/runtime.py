@@ -31,7 +31,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from corrosion_tpu.agent import wire
+from corrosion_tpu.agent import tracing, wire
 from corrosion_tpu.agent.bookkeeping import Bookie
 from corrosion_tpu.agent.members import Member, Members, MemberState
 from corrosion_tpu.agent.schema import apply_schema
@@ -1237,28 +1237,35 @@ class Agent:
         node's gaps is the healthy case, not a coincidence."""
         if ours is None:
             ours = self.generate_sync()
-        sessions = [
-            s
-            for s in await asyncio.gather(
-                *(self._sync_handshake(m) for m in members),
+        # the whole client round is one trace; each handshake's
+        # BiPayload carries its traceparent so the servers' spans share
+        # the trace id (sync.rs:32-67 propagation)
+        with tracing.span("sync.client_round", peers=len(members)) as sp:
+            self.metrics.counter("corro_trace_spans_total")
+            sessions = [
+                s
+                for s in await asyncio.gather(
+                    *(self._sync_handshake(m) for m in members),
+                    return_exceptions=True,
+                )
+                if isinstance(s, dict)
+            ]
+            if not sessions:
+                return 0
+            try:
+                self._allocate_needs(sessions, ours)
+            except BaseException:
+                # one malformed peer state must not leak the other sessions
+                for s in sessions:
+                    s["writer"].close()
+                raise
+            counts = await asyncio.gather(
+                *(self._sync_session(s) for s in sessions),
                 return_exceptions=True,
             )
-            if isinstance(s, dict)
-        ]
-        if not sessions:
-            return 0
-        try:
-            self._allocate_needs(sessions, ours)
-        except BaseException:
-            # one malformed peer state must not leak the other sessions
-            for s in sessions:
-                s["writer"].close()
-            raise
-        counts = await asyncio.gather(
-            *(self._sync_session(s) for s in sessions),
-            return_exceptions=True,
-        )
-        return sum(c for c in counts if isinstance(c, int))
+            total = sum(c for c in counts if isinstance(c, int))
+            sp.set(sessions=len(sessions), changes=total)
+            return total
 
     def _allocate_needs(
         self, sessions: List[dict], ours: SyncStateV1
@@ -1340,11 +1347,15 @@ class Agent:
         except (OSError, asyncio.TimeoutError):
             return None
         try:
+            tp = tracing.current_traceparent()
             writer.write(STREAM_BI)
             writer.write(
                 speedy.frame(
                     speedy.encode_bi_payload(
-                        BiPayload(actor_id=ActorId(self.actor_id)),
+                        BiPayload(
+                            actor_id=ActorId(self.actor_id),
+                            trace_ctx={"traceparent": tp} if tp else None,
+                        ),
                         ClusterId(self.config.cluster_id),
                     )
                 )
@@ -1584,6 +1595,7 @@ class Agent:
             job_sem = asyncio.Semaphore(self.SYNC_NEED_JOBS)
             sess = {"chunk": self.SYNC_CHUNK_MAX}
             total_needs = 0
+            srv_span = None  # opened once the SyncStart is decoded
 
             async def run_need(actor_b: bytes, need: SyncNeedV1) -> None:
                 async with job_sem:
@@ -1600,6 +1612,14 @@ class Agent:
                         return
                     payloads = frames.feed(data)
                 _bi, cluster = speedy.decode_bi_payload(payloads[0])
+                # re-parent on the client's traceparent so both ends of
+                # the round log the same trace id (sync.rs:32-67)
+                srv_span = tracing.span(
+                    "sync.server",
+                    remote=(_bi.trace_ctx or {}).get("traceparent"),
+                )
+                srv_span.__enter__()
+                self.metrics.counter("corro_trace_spans_total")
                 if int(cluster) != self.config.cluster_id:
                     await self._send_sync_msg(
                         writer,
@@ -1677,9 +1697,16 @@ class Agent:
                         # reading; reset the stream instead
                         writer.transport.abort()
             except (asyncio.TimeoutError, OSError, ConnectionError,
-                    speedy.SpeedyError):
+                    speedy.SpeedyError) as e:
+                # swallowed for the protocol, but the span must not
+                # read as a clean session
+                if srv_span is not None:
+                    srv_span.span.set(error=repr(e))
                 return
             finally:
+                if srv_span is not None:
+                    srv_span.span.set(needs=total_needs)
+                    srv_span.__exit__(None, None, None)
                 for t in jobs:
                     t.cancel()
                 writer.close()

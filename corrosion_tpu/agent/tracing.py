@@ -1,0 +1,130 @@
+"""Minimal distributed tracing with cross-node propagation.
+
+Parity: the reference attaches a W3C ``traceparent`` to the sync
+handshake (``crates/corro-types/src/sync.rs:32-67`` SyncTraceContextV1)
+and re-parents the server's span on it (``api/peer.rs`` serve_sync /
+parallel_sync).  This is the same propagation with a deliberately small
+surface: spans log one structured line on end (tagged ``trace_id`` /
+``span_id`` / duration) and land in a bounded in-memory ring for
+introspection — no OTLP exporter exists in this image, so the log line
+IS the export.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import logging
+import os
+import re
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+log = logging.getLogger("corrosion_tpu.trace")
+
+_current: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "corro_current_span", default=None
+)
+
+# bounded export ring (admin/debug surface)
+RECENT_MAX = 1024
+_recent: deque = deque(maxlen=RECENT_MAX)
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str  # 32 hex chars
+    span_id: str  # 16 hex chars
+    parent_id: Optional[str] = None
+    start: float = field(default_factory=time.time)  # wall, for display
+    start_mono: float = field(default_factory=time.monotonic)
+    end: Optional[float] = None
+    dur_ms: Optional[float] = None  # from the monotonic clock
+    attrs: Dict[str, object] = field(default_factory=dict)
+
+    @property
+    def traceparent(self) -> str:
+        """W3C trace-context header value (version 00, sampled)."""
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+
+_TRACEPARENT_RE = re.compile(
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$"
+)
+
+
+def parse_traceparent(value: Optional[str]):
+    """(trace_id, parent_span_id) from a W3C traceparent, or None.
+
+    Strict hex validation: the string comes off the wire from a peer
+    and ends up in log lines and the admin span ring — length checks
+    alone would let an attacker inject arbitrary bytes there."""
+    if not value:
+        return None
+    m = _TRACEPARENT_RE.match(value)
+    if m is None:
+        return None
+    return m.group(1), m.group(2)
+
+
+class span:
+    """Context manager: opens a Span, parents it on ``remote`` (a
+    traceparent string) or on the task's current span, logs one line on
+    exit.  Works in both sync and async code (no awaits inside)."""
+
+    def __init__(self, name: str, remote: Optional[str] = None, **attrs):
+        self.name = name
+        self.remote = remote
+        self.attrs = attrs
+        self.span: Optional[Span] = None
+        self._token = None
+
+    def __enter__(self) -> Span:
+        parent = _current.get()
+        remote = parse_traceparent(self.remote)
+        if remote is not None:
+            trace_id, parent_id = remote
+        elif parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        else:
+            trace_id, parent_id = os.urandom(16).hex(), None
+        self.span = Span(
+            name=self.name,
+            trace_id=trace_id,
+            span_id=os.urandom(8).hex(),
+            parent_id=parent_id,
+            attrs=dict(self.attrs),
+        )
+        self._token = _current.set(self.span)
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        s = self.span
+        s.end = time.time()
+        s.dur_ms = (time.monotonic() - s.start_mono) * 1000.0
+        if exc is not None:
+            s.attrs["error"] = repr(exc)
+        _current.reset(self._token)
+        _recent.append(s)
+        extras = " ".join(f"{k}={v}" for k, v in sorted(s.attrs.items()))
+        log.info(
+            "span %s trace_id=%s span_id=%s parent_id=%s dur_ms=%.1f %s",
+            s.name, s.trace_id, s.span_id, s.parent_id or "-",
+            s.dur_ms, extras,
+        )
+
+
+def current_traceparent() -> Optional[str]:
+    s = _current.get()
+    return s.traceparent if s is not None else None
+
+
+def recent_spans(limit: int = 100):
+    """Most recent finished spans, newest last (admin surface)."""
+    return list(_recent)[-limit:]

@@ -1,0 +1,84 @@
+"""Cross-node trace propagation tests (sync.rs:32-67 parity)."""
+
+import asyncio
+import logging
+import re
+
+import pytest
+
+from corrosion_tpu.agent import tracing
+from corrosion_tpu.agent.testing import launch_test_agent, wait_for
+
+
+@pytest.fixture
+def run():
+    def _run(coro):
+        return asyncio.run(coro)
+
+    return _run
+
+
+def test_span_parenting_and_traceparent():
+    with tracing.span("outer") as outer:
+        tp = tracing.current_traceparent()
+        assert tp == outer.traceparent
+        with tracing.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    # remote re-parenting from the wire string
+    with tracing.span("server", remote=outer.traceparent) as srv:
+        assert srv.trace_id == outer.trace_id
+        assert srv.parent_id == outer.span_id
+    assert tracing.parse_traceparent("garbage") is None
+    assert tracing.parse_traceparent(None) is None
+
+
+def test_sync_round_shares_trace_id_across_nodes(run, caplog):
+    """A sync round's client span (node B) and server span (node A) log
+    the SAME trace id: the traceparent rode the SyncStart BiPayload."""
+    async def main():
+        a = await launch_test_agent()
+        b = await launch_test_agent(
+            bootstrap=[f"{a.gossip_addr[0]}:{a.gossip_addr[1]}"]
+        )
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+            with caplog.at_level(logging.INFO, logger="corrosion_tpu.trace"):
+                # sync runs on its own cadence (fast test timers); wait
+                # until both span kinds have been logged
+                def spans(name):
+                    out = {}
+                    for rec in caplog.records:
+                        m = re.search(
+                            rf"span {name} trace_id=(\w+)", rec.getMessage()
+                        )
+                        if m:
+                            out.setdefault(m.group(1), 0)
+                            out[m.group(1)] += 1
+                    return out
+
+                await wait_for(
+                    lambda: spans("sync.client_round")
+                    and spans("sync.server"),
+                    timeout=20,
+                )
+                client_traces = spans("sync.client_round")
+                server_traces = spans("sync.server")
+            shared = set(client_traces) & set(server_traces)
+            assert shared, (
+                f"no shared trace ids: client={client_traces} "
+                f"server={server_traces}"
+            )
+            # the shared trace is visible in the span ring too
+            names = {
+                (s.trace_id, s.name) for s in tracing.recent_spans(500)
+            }
+            tid = next(iter(shared))
+            assert (tid, "sync.client_round") in names
+            assert (tid, "sync.server") in names
+            assert a.metrics.get_counter("corro_trace_spans_total") >= 1
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())
