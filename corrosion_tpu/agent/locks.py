@@ -72,57 +72,118 @@ class LockRegistry:
         ]
 
 
-class TrackedLock:
-    """An RLock whose acquisitions appear in a LockRegistry."""
+PRIO_HIGH, PRIO_NORMAL, PRIO_LOW = 0, 1, 2
 
-    def __init__(self, registry: LockRegistry, default_label: str = "storage"):
-        self._lock = threading.RLock()
+
+class PriorityLock:
+    """Reentrant mutex with 3 acquisition tiers (write-pool parity).
+
+    The reference splits writes across three priority pools — high for
+    applying replicated changes, normal for API writes, low for
+    background maintenance (``agent.rs:614-765``,
+    ``sqlite-pool/src/lib.rs``).  One sqlite RW connection can't run
+    concurrent transactions, so the pools collapse to a SCHEDULING
+    question: when the writer frees, the highest-priority waiter goes
+    next (FIFO-fair within a tier via Condition wakeup order being
+    irrelevant — every waiter re-checks).  Plain ``with lock:`` takes
+    NORMAL; hot paths say ``with lock.prio(PRIO_HIGH, "apply"):``.
+
+    Optionally registers acquisitions in a LockRegistry so the admin
+    ``locks`` surface sees priority waits like any other.
+    """
+
+    def __init__(self, registry: Optional[LockRegistry] = None,
+                 default_label: str = "storage"):
+        self._cv = threading.Condition()
+        self._owner: Optional[int] = None
+        self._count = 0
+        self._waiting = [0, 0, 0]
         self.registry = registry
         self.default_label = default_label
-        self._local = threading.local()  # per-thread stack of entry ids
+        self._local = threading.local()  # per-thread entry-id stack
 
-    def hold(self, label: str, kind: str = "write"):
-        return _Hold(self, label, kind)
+    def acquire(self, priority: int = PRIO_NORMAL) -> None:
+        me = threading.get_ident()
+        with self._cv:
+            if self._owner == me:
+                self._count += 1
+                return
+            self._waiting[priority] += 1
+            try:
+                while self._owner is not None or any(
+                    self._waiting[p] for p in range(priority)
+                ):
+                    self._cv.wait()
+                self._owner = me
+                self._count = 1
+            finally:
+                self._waiting[priority] -= 1
 
-    # RLock interface (so it can drop in where threading.RLock was used)
-    def acquire(self, *a, **kw):
-        return self._lock.acquire(*a, **kw)
+    def release(self) -> None:
+        with self._cv:
+            if self._owner != threading.get_ident():
+                raise RuntimeError("release of un-owned PriorityLock")
+            self._count -= 1
+            if self._count == 0:
+                self._owner = None
+                self._cv.notify_all()
 
-    def release(self):
-        return self._lock.release()
+    def prio(self, priority: int, label: Optional[str] = None,
+             kind: str = "write"):
+        return _PrioHold(self, priority, label or self.default_label, kind)
 
+    # plain `with lock:` == normal priority
     def __enter__(self):
-        lid = self.registry.begin(self.default_label, "write")
+        self._track_begin(self.default_label, "write")
+        self.acquire(PRIO_NORMAL)
+        self._track_acquired()
+        return self
+
+    def __exit__(self, *exc):
+        self._track_released()
+        self.release()
+        return False
+
+    # registry plumbing (no-ops when untracked)
+    def _track_begin(self, label: str, kind: str) -> None:
+        if self.registry is None:
+            return
         stack = getattr(self._local, "stack", None)
         if stack is None:
             stack = self._local.stack = []
-        stack.append(lid)
-        self._lock.acquire()
-        self.registry.acquired(lid)
-        return self
+        stack.append(self.registry.begin(label, kind))
 
-    def __exit__(self, *exc):
+    def _track_acquired(self) -> None:
+        if self.registry is None:
+            return
+        stack = getattr(self._local, "stack", [])
+        if stack:
+            self.registry.acquired(stack[-1])
+
+    def _track_released(self) -> None:
+        if self.registry is None:
+            return
         stack = getattr(self._local, "stack", [])
         if stack:
             self.registry.released(stack.pop())
-        self._lock.release()
-        return False
 
 
-class _Hold:
-    def __init__(self, lock: TrackedLock, label: str, kind: str):
+class _PrioHold:
+    def __init__(self, lock: PriorityLock, priority: int, label: str,
+                 kind: str):
         self.lock = lock
+        self.priority = priority
         self.label = label
         self.kind = kind
-        self.lid: Optional[int] = None
 
     def __enter__(self):
-        self.lid = self.lock.registry.begin(self.label, self.kind)
-        self.lock.acquire()
-        self.lock.registry.acquired(self.lid)
+        self.lock._track_begin(self.label, self.kind)
+        self.lock.acquire(self.priority)
+        self.lock._track_acquired()
         return self
 
     def __exit__(self, *exc):
-        self.lock.registry.released(self.lid)
+        self.lock._track_released()
         self.lock.release()
         return False
+

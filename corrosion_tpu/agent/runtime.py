@@ -1164,7 +1164,13 @@ class Agent:
             except Exception:
                 pass
             try:
-                with self.storage._lock:
+                from corrosion_tpu.agent.locks import PRIO_LOW
+
+                # maintenance yields the connection to applies and API
+                # writes (LOW tier) and gets interrupted rather than
+                # stalling them behind a long truncate/vacuum
+                with self.storage._lock.prio(PRIO_LOW, "maintenance"), \
+                        self.storage.interruptible(30.0):
                     (wal_pages, _) = self.storage.conn.execute(
                         "PRAGMA wal_checkpoint(PASSIVE)"
                     ).fetchone()[1:]

@@ -74,42 +74,96 @@ def register_udfs(conn: sqlite3.Connection) -> None:
 class CrConn:
     """A sqlite3 connection with the CRDT layer installed."""
 
+    RO_POOL_SIZE = 20  # reference: 1 RW + 20 RO (agent.rs:614-765)
+
     def __init__(self, path: str, site_id: Optional[bytes] = None,
                  lock_registry=None):
+        from corrosion_tpu.agent.locks import PriorityLock
+
         self.path = path
         self.conn = sqlite3.connect(path, check_same_thread=False)
         self.conn.isolation_level = None  # manual transactions
         self.conn.execute("PRAGMA journal_mode=WAL")
         self.conn.execute("PRAGMA synchronous=NORMAL")
         self.conn.execute("PRAGMA foreign_keys=OFF")
-        if lock_registry is not None:
-            from corrosion_tpu.agent.locks import TrackedLock
-
-            self._lock = TrackedLock(lock_registry, "storage")
-        else:
-            self._lock = threading.RLock()
+        # single RW connection behind a 3-tier priority mutex: applies
+        # of replicated changes go first, API writes next, maintenance
+        # last (the scheduling the reference gets from its split write
+        # pools, agent.rs:614-765)
+        self._lock = PriorityLock(lock_registry, "storage")
         register_udfs(self.conn)
         self._init_meta(site_id)
         self._tables: Dict[str, TableInfo] = {}
         self._load_crr_tables()
-        self._ro_conn: Optional[sqlite3.Connection] = None
-        self._ro_lock = threading.Lock()
+        # read pool: up to RO_POOL_SIZE read-only connections created
+        # lazily; concurrent readers no longer serialize on one conn
+        self._ro_free: List[sqlite3.Connection] = []
+        self._ro_all: List[sqlite3.Connection] = []
+        self._ro_cv = threading.Condition()
+        self._ro_closed = False
+
+    def _new_ro(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(
+            f"file:{self.path}?mode=ro", uri=True, check_same_thread=False,
+        )
+        # triggers resolve functions at prepare time, so RO conns need
+        # them registered even though writes will fail
+        register_udfs(conn)
+        return conn
+
+    @property
+    def _ro_conn(self) -> sqlite3.Connection:
+        """The pool's first reader — the instrumentation anchor: tests
+        attach progress handlers here, and checkout PREFERS it whenever
+        it is free, so single-threaded callers reliably land on it even
+        after the pool has grown."""
+        with self._ro_cv:
+            if not self._ro_all:
+                conn = self._new_ro()
+                self._ro_all.append(conn)
+                self._ro_free.append(conn)
+            return self._ro_all[0]
+
+    @contextmanager
+    def reader(self):
+        """Check a read-only connection out of the pool (split-pool
+        parity).  Blocks when all RO_POOL_SIZE readers are in flight."""
+        with self._ro_cv:
+            while not self._ro_free and len(self._ro_all) >= self.RO_POOL_SIZE:
+                if self._ro_closed:
+                    raise sqlite3.ProgrammingError("storage is closed")
+                self._ro_cv.wait()
+            if self._ro_closed:
+                raise sqlite3.ProgrammingError("storage is closed")
+            if self._ro_free:
+                # prefer the instrumented first reader when free
+                first = self._ro_all[0] if self._ro_all else None
+                if first is not None and first in self._ro_free:
+                    self._ro_free.remove(first)
+                    conn = first
+                else:
+                    conn = self._ro_free.pop()
+            else:
+                conn = self._new_ro()
+                self._ro_all.append(conn)
+        try:
+            yield conn
+        finally:
+            with self._ro_cv:
+                if self._ro_closed:
+                    conn.close()
+                    if conn in self._ro_all:
+                        self._ro_all.remove(conn)
+                else:
+                    self._ro_free.append(conn)
+                    self._ro_cv.notify()
 
     def read_query(self, sql: str, params: Sequence = ()):
-        """Run a query on a read-only connection (split-pool parity: the
-        reference keeps 1 RW + 20 RO connections, ``agent.rs:614-765``).
-        Writes through this path fail with a sqlite 'readonly' error
-        instead of corrupting version accounting."""
-        with self._ro_lock:
-            if self._ro_conn is None:
-                self._ro_conn = sqlite3.connect(
-                    f"file:{self.path}?mode=ro", uri=True,
-                    check_same_thread=False,
-                )
-                # triggers resolve functions at prepare time, so the RO
-                # conn needs them registered even though writes will fail
-                register_udfs(self._ro_conn)
-            cur = self._ro_conn.execute(sql, params)
+        """Run a query on a pooled read-only connection.  Writes through
+        this path fail with a sqlite 'readonly' error instead of
+        corrupting version accounting."""
+        with self.reader() as conn:
+            cur = conn.execute(sql, params)
             cols = [d[0] for d in cur.description or []]
             return cols, cur.fetchall()
 
@@ -698,8 +752,13 @@ END;
     @contextmanager
     def apply_tx(self):
         """Open one merge transaction; bookkeeping writes through the same
-        connection commit atomically with the applied changes."""
-        with self._lock:
+        connection commit atomically with the applied changes.  Applies
+        take the HIGH write tier: replicated changes beat local API
+        writes and maintenance to the connection (agent.rs write-pool
+        priorities)."""
+        from corrosion_tpu.agent.locks import PRIO_HIGH
+
+        with self._lock.prio(PRIO_HIGH, "apply", kind="apply"):
             self.conn.execute("BEGIN IMMEDIATE")
             try:
                 self._set_state("apply_mode", 1)
@@ -850,9 +909,47 @@ END;
             [val] + pk_vals,
         )
 
+    @contextmanager
+    def interruptible(self, budget_s: float):
+        """Interrupt the RW connection if the enclosed work overruns its
+        budget (InterruptibleTransaction parity,
+        ``sqlite-pool/src/lib.rs:116``): a runaway maintenance statement
+        surfaces as sqlite3.OperationalError('interrupted') instead of
+        stalling high-priority applies behind it.
+
+        The disarm is mutually exclusive with the firing: Timer.cancel()
+        cannot stop a timer that already fired, and a stray interrupt
+        after block exit would abort the NEXT holder's transaction."""
+        guard = threading.Lock()
+        state = {"armed": True}
+
+        def fire():
+            with guard:
+                if state["armed"]:
+                    self.conn.interrupt()
+
+        timer = threading.Timer(budget_s, fire)
+        timer.daemon = True
+        timer.start()
+        try:
+            yield
+        finally:
+            with guard:
+                state["armed"] = False
+            timer.cancel()
+
     def close(self) -> None:
-        if self._ro_conn is not None:
-            self._ro_conn.close()
+        with self._ro_cv:
+            self._ro_closed = True
+            # close only the FREE readers: a conn mid-query belongs to
+            # its checkout and is closed by reader()'s finally; waiters
+            # parked in reader() are woken to fail instead of hanging
+            for conn in self._ro_free:
+                conn.close()
+                if conn in self._ro_all:
+                    self._ro_all.remove(conn)
+            self._ro_free.clear()
+            self._ro_cv.notify_all()
         self.conn.close()
 
 

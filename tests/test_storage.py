@@ -247,3 +247,96 @@ def test_partial_new_generation_resets_stale_cells(db):
     b.apply_changes(gen3)
     row = b.conn.execute("SELECT name, status FROM machines WHERE id=1").fetchone()
     assert row == ("reborn", "new")
+
+
+# -- split read/write pool ---------------------------------------------
+
+
+def test_reader_pool_allows_concurrent_reads(db):
+    """Two readers run at once: one thread holds a pooled reader while
+    another completes a read_query (the old single-RO-conn design
+    serialized them)."""
+    import threading
+
+    c = db("pool")
+    c.execute("INSERT INTO machines (id, name) VALUES (1, 'a')")
+
+    holding = threading.Event()
+    release = threading.Event()
+    done = []
+
+    def hold_reader():
+        with c.reader():
+            holding.set()
+            release.wait(timeout=10)
+
+    t = threading.Thread(target=hold_reader, daemon=True)
+    t.start()
+    assert holding.wait(timeout=5)
+    # a second read must not block on the held reader
+    _, rows = c.read_query("SELECT count(*) FROM machines")
+    done.append(rows)
+    release.set()
+    t.join(timeout=5)
+    assert done == [[(1,)]]
+    assert len(c._ro_all) >= 2  # the pool genuinely grew
+
+
+def test_write_priority_high_beats_low(db):
+    """With the connection contended, a HIGH (apply) waiter acquires
+    before a LOW (maintenance) waiter that arrived first."""
+    import threading
+    import time
+
+    from corrosion_tpu.agent.locks import PRIO_HIGH, PRIO_LOW
+
+    c = db("prio")
+    order = []
+    low_waiting = threading.Event()
+    high_waiting = threading.Event()
+
+    c._lock.acquire()  # main thread owns the connection
+    try:
+        def low():
+            low_waiting.set()
+            with c._lock.prio(PRIO_LOW, "maintenance"):
+                order.append("low")
+
+        def high():
+            high_waiting.set()
+            with c._lock.prio(PRIO_HIGH, "apply"):
+                order.append("high")
+
+        tl = threading.Thread(target=low, daemon=True)
+        tl.start()
+        assert low_waiting.wait(timeout=5)
+        time.sleep(0.05)  # low is parked in acquire()
+        th = threading.Thread(target=high, daemon=True)
+        th.start()
+        assert high_waiting.wait(timeout=5)
+        time.sleep(0.05)
+    finally:
+        c._lock.release()
+    tl.join(timeout=5)
+    th.join(timeout=5)
+    assert order == ["high", "low"]
+
+
+def test_interruptible_transaction_aborts_runaway(db):
+    """A statement overrunning its budget is interrupted instead of
+    holding the write connection (InterruptibleTransaction parity)."""
+    import sqlite3
+
+    c = db("intr")
+    slow = (
+        "WITH RECURSIVE c(x) AS (SELECT 1 UNION ALL SELECT x+1 FROM c "
+        "WHERE x < 100000000) SELECT max(x) FROM c"
+    )
+    with pytest.raises(sqlite3.OperationalError, match="interrupt"):
+        with c._lock, c.interruptible(0.1):
+            c.conn.execute(slow).fetchone()
+    # the connection remains usable afterwards
+    c.execute("INSERT INTO machines (id, name) VALUES (9, 'alive')")
+    assert c.read_query("SELECT name FROM machines WHERE id=9")[1] == [
+        ("alive",)
+    ]
