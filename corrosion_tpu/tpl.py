@@ -209,6 +209,13 @@ def render_loop(api_addr, template_path: str, out_path: str,
                 for ev in client.subscribe(query):
                     if "change" in ev:
                         wake.set()
+                    elif "eoq" in ev:
+                        # snapshot complete: a write landing between our
+                        # one-shot render queries and this subscription's
+                        # creation is absorbed into the snapshot and
+                        # never emits a change event — re-render once so
+                        # that gap can't leave the file stale forever
+                        wake.set()
                     if stop.is_set():
                         return
             except Exception:
