@@ -76,12 +76,13 @@ class BroadcastStep(NamedTuple):
     msgs_sent: jnp.ndarray
     hops: Optional[jnp.ndarray] = None
     next_send: Optional[jnp.ndarray] = None
+    sent: Optional[jnp.ndarray] = None
 
 
 @partial(jax.jit, static_argnames=("params",))
 def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
                    partition_id=None, partition_active=False, hops=None,
-                   tick=None, next_send=None) -> BroadcastStep:
+                   tick=None, next_send=None, sent=None) -> BroadcastStep:
     """One gossip tick for every node at once.
 
     rows:         [N, R] packed CRDT keys (the node's table state)
@@ -95,9 +96,17 @@ def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
                   not infected); maintained by scatter-min of
                   sender_hop+1 over delivering messages — directly
                   comparable to the live agent's debug_hops counter
+    sent:         optional [N, N] bool per-payload transmission memory —
+                  the agent's ``sent_to`` set: a sender never re-picks a
+                  peer it already transmitted this payload to
+                  (broadcast/mod.rs member sampling).  Quadratic state:
+                  calibration-scale only.  Draws become uniform
+                  without-replacement over the not-yet-sent peers
+                  (ring0/global split is ignored in this mode, matching
+                  the ring0_enabled=False calibration harness).
 
-    Returns a :class:`BroadcastStep` (hops'/next_send' are None when the
-    corresponding input wasn't supplied).
+    Returns a :class:`BroadcastStep` (hops'/next_send'/sent' are None
+    when the corresponding input wasn't supplied).
     """
     n, k = params.n_nodes, params.fanout
     key_t, key_l = jax.random.split(key)
@@ -107,10 +116,24 @@ def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
         if tick is None:
             raise ValueError("next_send requires tick")
         active &= next_send <= tick
-    targets = _draw_targets(key_t, params)  # [N, K]
+
+    if sent is not None:
+        # uniform sample WITHOUT replacement over peers not yet sent to:
+        # random scores, exclusions pushed to +inf, take the k smallest
+        scores = jax.random.uniform(key_t, (n, n))
+        excluded = sent | jnp.eye(n, dtype=bool)
+        scores = jnp.where(excluded, jnp.inf, scores)
+        order = jnp.argsort(scores, axis=1)
+        targets = order[:, :k]  # [N, K]
+        avail = jnp.take_along_axis(scores, targets, axis=1) < jnp.inf
+    else:
+        targets = _draw_targets(key_t, params)  # [N, K]
+        avail = None
 
     # message viability: sender active, not lost, not across a partition
     ok = jnp.broadcast_to(active[:, None], (n, k))
+    if avail is not None:
+        ok &= avail
     if params.loss > 0.0:
         ok &= jax.random.uniform(key_l, (n, k)) >= params.loss
     ok &= partition_ok(partition_id, targets, partition_active)
@@ -126,7 +149,18 @@ def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
     tx = jnp.where(active, tx_remaining - 1, tx_remaining)
     tx = jnp.where(learned, params.max_transmissions, tx)
 
-    msgs = msgs_sent + jnp.where(active, k, 0).astype(msgs_sent.dtype)
+    new_sent = None
+    if sent is not None:
+        # sent_to marks on SEND (before loss/partition: the sender can't
+        # know the message died), and the charge is per actual send —
+        # a sender with fewer than k fresh peers transmits fewer
+        marks = jnp.broadcast_to(active[:, None], (n, k)) & avail
+        senders = jnp.repeat(jnp.arange(n), k)
+        mark_cols = jnp.where(marks, targets, n).reshape(-1)
+        new_sent = sent.at[senders, mark_cols].set(True, mode="drop")
+        msgs = msgs_sent + jnp.sum(marks, axis=1).astype(msgs_sent.dtype)
+    else:
+        msgs = msgs_sent + jnp.where(active, k, 0).astype(msgs_sent.dtype)
     nxt = None
     if next_send is not None:
         # nth retransmission waits backoff*n ticks; a fresh payload
@@ -150,4 +184,4 @@ def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
             .min(sender_hops)[:n]
         )
         new_hops = jnp.where(learned, jnp.minimum(hops, cand), hops)
-    return BroadcastStep(new_rows, tx, msgs, new_hops, nxt)
+    return BroadcastStep(new_rows, tx, msgs, new_hops, nxt, new_sent)

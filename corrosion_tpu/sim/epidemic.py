@@ -58,6 +58,9 @@ class EpidemicConfig:
     # nth retransmission waits backoff_ticks*n (reference 100ms*n);
     # 0 = send every tick (synchronous rounds)
     backoff_ticks: float = 0.0
+    # model the agents' per-payload sent_to exclusion exactly ([N, N]
+    # memory — calibration-scale only; see broadcast_step's sent arg)
+    track_sent: bool = False
     # anti-entropy cadence (0 = disabled)
     sync_interval: int = 8
     sync_peers: int = 1
@@ -93,6 +96,9 @@ class EpidemicState(NamedTuple):
     tick: jnp.ndarray  # scalar int32
     hops: jnp.ndarray  # [N] int32 infection depth (HOP_UNSET = not yet)
     next_send: jnp.ndarray  # [N] int32 earliest tick of the next send
+    # [N, N] bool when cfg.track_sent, else None (a jnp default here
+    # would initialize the JAX backend at import time)
+    sent: Optional[jnp.ndarray] = None
 
 
 def epidemic_init(cfg: EpidemicConfig, writer: int = 0) -> EpidemicState:
@@ -119,6 +125,7 @@ def epidemic_init(cfg: EpidemicConfig, writer: int = 0) -> EpidemicState:
         tick=jnp.zeros((), jnp.int32),
         hops=jnp.full((n,), HOP_UNSET, jnp.int32).at[writer].set(0),
         next_send=jnp.zeros((n,), jnp.int32),
+        sent=jnp.zeros((n, n), bool) if cfg.track_sent else None,
     )
 
 
@@ -138,7 +145,7 @@ def epidemic_tick(state: EpidemicState, key, cfg: EpidemicConfig) -> EpidemicSta
     part_active = state.tick < cfg.heal_tick
     k_b, k_s = jax.random.split(key)
 
-    rows, tx, msgs, hops, next_send = broadcast_step(
+    rows, tx, msgs, hops, next_send, sent = broadcast_step(
         state.rows,
         state.tx_remaining,
         state.msgs,
@@ -149,7 +156,10 @@ def epidemic_tick(state: EpidemicState, key, cfg: EpidemicConfig) -> EpidemicSta
         hops=state.hops,
         tick=state.tick,
         next_send=state.next_send,
+        sent=state.sent if cfg.track_sent else None,
     )
+    if sent is None:
+        sent = state.sent
 
     if cfg.sync_interval > 0:
         def do_sync(args):
@@ -166,7 +176,8 @@ def epidemic_tick(state: EpidemicState, key, cfg: EpidemicConfig) -> EpidemicSta
             (rows, msgs),
         )
 
-    return EpidemicState(rows, tx, msgs, state.tick + 1, hops, next_send)
+    return EpidemicState(rows, tx, msgs, state.tick + 1, hops, next_send,
+                         sent)
 
 
 @partial(jax.jit, static_argnames=("cfg",))

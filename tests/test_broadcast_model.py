@@ -29,7 +29,7 @@ def test_lossless_epidemic_converges():
     msgs = jnp.zeros((n,), jnp.int32)
     key = jax.random.PRNGKey(0)
     for t in range(40):
-        rows, tx, msgs, _, _ = broadcast_step(rows, tx, msgs, jax.random.fold_in(key, t), p)
+        rows, tx, msgs, *_ = broadcast_step(rows, tx, msgs, jax.random.fold_in(key, t), p)
         if bool(jnp.all(rows == news[None, :])):
             break
     assert bool(jnp.all(rows == news[None, :])), "did not converge in 40 ticks"
@@ -43,7 +43,7 @@ def test_messages_counted_only_for_active_senders():
     rows, _ = _init(n)
     tx = jnp.zeros((n,), jnp.int32).at[0].set(2)
     msgs = jnp.zeros((n,), jnp.int32)
-    rows2, tx2, msgs2, _, _ = broadcast_step(rows, tx, msgs, jax.random.PRNGKey(1), p)
+    rows2, tx2, msgs2, *_ = broadcast_step(rows, tx, msgs, jax.random.PRNGKey(1), p)
     assert int(msgs2[0]) == p.fanout
     assert int(tx2[0]) == 1
     # quiescent nodes sent nothing (unless they just learned -> only recv)
@@ -59,11 +59,11 @@ def test_retransmit_decay_quiesces():
     msgs = jnp.zeros((n,), jnp.int32)
     key = jax.random.PRNGKey(2)
     for t in range(64):
-        rows, tx, msgs, _, _ = broadcast_step(rows, tx, msgs, jax.random.fold_in(key, t), p)
+        rows, tx, msgs, *_ = broadcast_step(rows, tx, msgs, jax.random.fold_in(key, t), p)
     assert int(tx.max()) == 0, "all transmission budgets must eventually drain"
     total = int(msgs.sum())
     for t in range(64, 70):
-        rows, tx, msgs, _, _ = broadcast_step(rows, tx, msgs, jax.random.fold_in(key, t), p)
+        rows, tx, msgs, *_ = broadcast_step(rows, tx, msgs, jax.random.fold_in(key, t), p)
     assert int(msgs.sum()) == total, "quiescent cluster must stop sending"
 
 
@@ -76,7 +76,7 @@ def test_partition_blocks_cross_traffic():
     part = (jnp.arange(n) >= n // 2).astype(jnp.int32)
     key = jax.random.PRNGKey(3)
     for t in range(50):
-        rows, tx, msgs, _, _ = broadcast_step(
+        rows, tx, msgs, *_ = broadcast_step(
             rows, tx, msgs, jax.random.fold_in(key, t), p,
             partition_id=part, partition_active=jnp.array(True),
         )
@@ -94,7 +94,7 @@ def test_loss_slows_but_does_not_stop():
     msgs = jnp.zeros((n,), jnp.int32)
     key = jax.random.PRNGKey(4)
     for t in range(60):
-        rows, tx, msgs, _, _ = broadcast_step(rows, tx, msgs, jax.random.fold_in(key, t), p)
+        rows, tx, msgs, *_ = broadcast_step(rows, tx, msgs, jax.random.fold_in(key, t), p)
         if bool(jnp.all(rows == news[None, :])):
             break
     assert bool(jnp.all(rows == news[None, :]))
