@@ -93,7 +93,11 @@ def pack_values(values: Iterable[SqlValue]) -> bytes:
             out += struct.pack(">q", int(v))
         elif isinstance(v, int):
             out.append(_T_INT)
-            out += struct.pack(">q", v)
+            try:
+                out += struct.pack(">q", v)
+            except struct.error:
+                # same exception type as the native kernel
+                raise OverflowError("int too large for packed i64") from None
         elif isinstance(v, float):
             out.append(_T_REAL)
             out += struct.pack(">d", v)
@@ -121,14 +125,20 @@ def unpack_values(blob: bytes) -> List[SqlValue]:
         if tag == _T_NULL:
             out.append(None)
         elif tag == _T_INT:
+            if i + 8 > n:
+                raise ValueError("truncated packed value")
             (v,) = struct.unpack_from(">q", blob, i)
             i += 8
             out.append(v)
         elif tag == _T_REAL:
+            if i + 8 > n:
+                raise ValueError("truncated packed value")
             (v,) = struct.unpack_from(">d", blob, i)
             i += 8
             out.append(v)
         elif tag in (_T_TEXT, _T_BLOB):
+            if i + 4 > n:
+                raise ValueError("truncated packed value")
             (ln,) = struct.unpack_from(">I", blob, i)
             i += 4
             raw = blob[i : i + ln]
@@ -139,3 +149,19 @@ def unpack_values(blob: bytes) -> List[SqlValue]:
         else:
             raise ValueError(f"bad tag {tag} at offset {i-1}")
     return out
+
+
+# keep the Python twins importable for cross-checking, then prefer the
+# native kernels (corrosion_tpu/native) — these run inside the CRR
+# triggers on every row write, so the constant factor matters
+_py_pack_values = pack_values
+_py_unpack_values = unpack_values
+_py_value_cmp = value_cmp
+
+from corrosion_tpu.native import load_or_none as _load_native
+
+_native = _load_native()
+if _native is not None:
+    pack_values = _native.pack_values  # type: ignore[assignment]
+    unpack_values = _native.unpack_values  # type: ignore[assignment]
+    value_cmp = _native.value_cmp  # type: ignore[assignment]

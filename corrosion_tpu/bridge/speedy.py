@@ -549,7 +549,7 @@ def frame(payload: bytes) -> bytes:
     return struct.pack(">I", len(payload)) + payload
 
 
-def deframe(buf: bytes) -> Tuple[List[bytes], bytes]:
+def _py_deframe(buf: bytes) -> Tuple[List[bytes], bytes]:
     """Split complete frames off the front; return (frames, remainder)."""
     frames = []
     pos = 0
@@ -562,6 +562,21 @@ def deframe(buf: bytes) -> Tuple[List[bytes], bytes]:
         frames.append(buf[pos + 4 : pos + 4 + n])
         pos += 4 + n
     return frames, buf[pos:]
+
+
+from corrosion_tpu.native import load_or_none as _load_native
+
+_native = _load_native()
+
+if _native is not None:
+    def deframe(buf: bytes) -> Tuple[List[bytes], bytes]:
+        """Native frame splitter (semantics pinned to :func:`_py_deframe`)."""
+        try:
+            return _native.deframe(buf, MAX_FRAME_LEN)
+        except ValueError as e:
+            raise SpeedyError(str(e)) from None
+else:
+    deframe = _py_deframe
 
 
 class FrameReader:

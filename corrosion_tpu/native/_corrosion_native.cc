@@ -1,0 +1,321 @@
+/* Native hot-path kernels for the corrosion-tpu host runtime.
+ *
+ * The reference implements its entire runtime in Rust; the TPU compute
+ * path here is JAX/XLA, and this extension is the native runtime layer
+ * around it for the host agent's hottest per-row / per-message work:
+ *
+ *   - pack_values / unpack_values: the packed-pk codec invoked by the
+ *     CRR triggers (corro_pack UDF) on EVERY row write and by change
+ *     collection / subscription bookkeeping
+ *     (reference: crates/corro-types/src/pubsub.rs:2302-2449);
+ *   - value_cmp: cr-sqlite's merge tie-break total order (type-enum
+ *     rank first, then within-type comparison);
+ *   - deframe: the u32-BE LengthDelimited splitter on the gossip/sync
+ *     wire (tokio_util's codec in the reference).
+ *
+ * Semantics are pinned to the pure-Python twins in agent/pack.py and
+ * bridge/speedy.py; tests/test_native.py cross-checks them on random
+ * inputs.  Python remains the fallback when no compiler is available.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+
+constexpr uint8_t T_NULL = 0;
+constexpr uint8_t T_INT = 1;
+constexpr uint8_t T_REAL = 2;
+constexpr uint8_t T_TEXT = 3;
+constexpr uint8_t T_BLOB = 4;
+
+void put_u32(std::string &out, uint32_t v) {
+  char b[4] = {static_cast<char>(v >> 24), static_cast<char>(v >> 16),
+               static_cast<char>(v >> 8), static_cast<char>(v)};
+  out.append(b, 4);
+}
+
+void put_u64(std::string &out, uint64_t v) {
+  char b[8];
+  for (int i = 0; i < 8; i++) b[i] = static_cast<char>(v >> (56 - 8 * i));
+  out.append(b, 8);
+}
+
+uint32_t get_u32(const uint8_t *p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+uint64_t get_u64(const uint8_t *p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; i++) v = (v << 8) | p[i];
+  return v;
+}
+
+/* -- pack_values ----------------------------------------------------- */
+
+PyObject *pack_values(PyObject *, PyObject *arg) {
+  PyObject *seq = PySequence_Fast(arg, "pack_values expects a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  std::string out;
+  out.reserve(16 * static_cast<size_t>(n) + 8);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *v = PySequence_Fast_GET_ITEM(seq, i);
+    if (v == Py_None) {
+      out.push_back(static_cast<char>(T_NULL));
+    } else if (PyBool_Check(v)) {
+      out.push_back(static_cast<char>(T_INT));
+      put_u64(out, v == Py_True ? 1 : 0);
+    } else if (PyLong_Check(v)) {
+      int overflow = 0;
+      long long ll = PyLong_AsLongLongAndOverflow(v, &overflow);
+      if (overflow != 0 || (ll == -1 && PyErr_Occurred())) {
+        if (!PyErr_Occurred())
+          PyErr_SetString(PyExc_OverflowError,
+                          "int too large for packed i64");
+        Py_DECREF(seq);
+        return nullptr;
+      }
+      out.push_back(static_cast<char>(T_INT));
+      put_u64(out, static_cast<uint64_t>(ll));
+    } else if (PyFloat_Check(v)) {
+      double d = PyFloat_AS_DOUBLE(v);
+      uint64_t bits;
+      std::memcpy(&bits, &d, 8);
+      out.push_back(static_cast<char>(T_REAL));
+      put_u64(out, bits);
+    } else if (PyUnicode_Check(v)) {
+      Py_ssize_t len = 0;
+      const char *s = PyUnicode_AsUTF8AndSize(v, &len);
+      if (!s) {
+        Py_DECREF(seq);
+        return nullptr;
+      }
+      out.push_back(static_cast<char>(T_TEXT));
+      put_u32(out, static_cast<uint32_t>(len));
+      out.append(s, static_cast<size_t>(len));
+    } else if (PyBytes_Check(v) || PyByteArray_Check(v) ||
+               PyMemoryView_Check(v)) {
+      /* exactly the types the Python twin accepts — a generic buffer
+       * check would silently pack array/numpy/mmap objects that the
+       * fallback rejects with TypeError */
+      Py_buffer buf;
+      if (PyObject_GetBuffer(v, &buf, PyBUF_SIMPLE) != 0) {
+        Py_DECREF(seq);
+        return nullptr;
+      }
+      out.push_back(static_cast<char>(T_BLOB));
+      put_u32(out, static_cast<uint32_t>(buf.len));
+      out.append(static_cast<const char *>(buf.buf),
+                 static_cast<size_t>(buf.len));
+      PyBuffer_Release(&buf);
+    } else {
+      PyErr_Format(PyExc_TypeError, "unsupported SQL value: %R",
+                   reinterpret_cast<PyObject *>(Py_TYPE(v)));
+      Py_DECREF(seq);
+      return nullptr;
+    }
+  }
+  Py_DECREF(seq);
+  return PyBytes_FromStringAndSize(out.data(),
+                                   static_cast<Py_ssize_t>(out.size()));
+}
+
+/* -- unpack_values --------------------------------------------------- */
+
+PyObject *unpack_values(PyObject *, PyObject *arg) {
+  Py_buffer buf;
+  if (PyObject_GetBuffer(arg, &buf, PyBUF_SIMPLE) != 0) return nullptr;
+  const uint8_t *p = static_cast<const uint8_t *>(buf.buf);
+  Py_ssize_t n = buf.len;
+  PyObject *out = PyList_New(0);
+  if (!out) {
+    PyBuffer_Release(&buf);
+    return nullptr;
+  }
+  Py_ssize_t i = 0;
+  while (i < n) {
+    uint8_t tag = p[i];
+    i += 1;
+    PyObject *item = nullptr;
+    if (tag == T_NULL) {
+      item = Py_NewRef(Py_None);
+    } else if (tag == T_INT || tag == T_REAL) {
+      if (i + 8 > n) {
+        PyErr_SetString(PyExc_ValueError, "truncated packed value");
+        goto fail;
+      }
+      uint64_t bits = get_u64(p + i);
+      i += 8;
+      if (tag == T_INT) {
+        item = PyLong_FromLongLong(static_cast<long long>(bits));
+      } else {
+        double d;
+        std::memcpy(&d, &bits, 8);
+        item = PyFloat_FromDouble(d);
+      }
+    } else if (tag == T_TEXT || tag == T_BLOB) {
+      if (i + 4 > n) {
+        PyErr_SetString(PyExc_ValueError, "truncated packed value");
+        goto fail;
+      }
+      uint32_t len = get_u32(p + i);
+      i += 4;
+      if (i + static_cast<Py_ssize_t>(len) > n) {
+        PyErr_SetString(PyExc_ValueError, "truncated packed value");
+        goto fail;
+      }
+      const char *s = reinterpret_cast<const char *>(p + i);
+      item = (tag == T_TEXT)
+                 ? PyUnicode_DecodeUTF8(s, len, nullptr)
+                 : PyBytes_FromStringAndSize(s, len);
+      i += len;
+    } else {
+      PyErr_Format(PyExc_ValueError, "bad tag %d at offset %zd", tag, i - 1);
+      goto fail;
+    }
+    if (!item || PyList_Append(out, item) != 0) {
+      Py_XDECREF(item);
+      goto fail;
+    }
+    Py_DECREF(item);
+  }
+  PyBuffer_Release(&buf);
+  return out;
+fail:
+  PyBuffer_Release(&buf);
+  Py_DECREF(out);
+  return nullptr;
+}
+
+/* -- value_cmp ------------------------------------------------------- */
+
+int type_rank(PyObject *v) {
+  if (v == Py_None) return 0;
+  if (PyBool_Check(v) || PyLong_Check(v)) return 4;
+  if (PyFloat_Check(v)) return 3;
+  if (PyUnicode_Check(v)) return 2;
+  if (PyBytes_Check(v) || PyByteArray_Check(v) || PyMemoryView_Check(v))
+    return 1;
+  return -1;
+}
+
+PyObject *value_cmp(PyObject *, PyObject *args) {
+  PyObject *a, *b;
+  if (!PyArg_ParseTuple(args, "OO", &a, &b)) return nullptr;
+  int ra = type_rank(a), rb = type_rank(b);
+  if (ra < 0 || rb < 0) {
+    PyErr_Format(PyExc_TypeError, "unsupported SQL value: %R",
+                 reinterpret_cast<PyObject *>(Py_TYPE(ra < 0 ? a : b)));
+    return nullptr;
+  }
+  if (ra != rb) return PyLong_FromLong(ra < rb ? -1 : 1);
+  if (ra == 0) return PyLong_FromLong(0);
+  if (ra == 2) {
+    /* compare UTF-8 bytes, like the Python twin */
+    Py_ssize_t la = 0, lb = 0;
+    const char *sa = PyUnicode_AsUTF8AndSize(a, &la);
+    const char *sb = PyUnicode_AsUTF8AndSize(b, &lb);
+    if (!sa || !sb) return nullptr;
+    int c = std::memcmp(sa, sb, static_cast<size_t>(la < lb ? la : lb));
+    if (c == 0) c = (la > lb) - (la < lb);
+    return PyLong_FromLong(c > 0 ? 1 : (c < 0 ? -1 : 0));
+  }
+  if (ra == 1) {
+    Py_buffer ba, bb;
+    if (PyObject_GetBuffer(a, &ba, PyBUF_SIMPLE) != 0) return nullptr;
+    if (PyObject_GetBuffer(b, &bb, PyBUF_SIMPLE) != 0) {
+      PyBuffer_Release(&ba);
+      return nullptr;
+    }
+    int c = std::memcmp(ba.buf, bb.buf,
+                        static_cast<size_t>(ba.len < bb.len ? ba.len : bb.len));
+    if (c == 0) c = (ba.len > bb.len) - (ba.len < bb.len);
+    PyBuffer_Release(&ba);
+    PyBuffer_Release(&bb);
+    return PyLong_FromLong(c > 0 ? 1 : (c < 0 ? -1 : 0));
+  }
+  /* numerics: defer to Python comparison (bigints, NaN semantics) */
+  int lt = PyObject_RichCompareBool(a, b, Py_LT);
+  if (lt < 0) return nullptr;
+  int gt = PyObject_RichCompareBool(a, b, Py_GT);
+  if (gt < 0) return nullptr;
+  return PyLong_FromLong(gt - lt);
+}
+
+/* -- deframe --------------------------------------------------------- */
+
+PyObject *deframe(PyObject *, PyObject *args) {
+  Py_buffer buf;
+  unsigned int max_len = 8 * 1024 * 1024;
+  if (!PyArg_ParseTuple(args, "y*|I", &buf, &max_len)) return nullptr;
+  const uint8_t *p = static_cast<const uint8_t *>(buf.buf);
+  Py_ssize_t n = buf.len;
+  PyObject *frames = PyList_New(0);
+  if (!frames) {
+    PyBuffer_Release(&buf);
+    return nullptr;
+  }
+  Py_ssize_t pos = 0;
+  while (pos + 4 <= n) {
+    uint32_t len = get_u32(p + pos);
+    if (len > max_len) {
+      PyErr_Format(PyExc_ValueError, "frame length %u exceeds max %u", len,
+                   max_len);
+      Py_DECREF(frames);
+      PyBuffer_Release(&buf);
+      return nullptr;
+    }
+    if (pos + 4 + static_cast<Py_ssize_t>(len) > n) break;
+    PyObject *payload = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char *>(p + pos + 4), len);
+    if (!payload || PyList_Append(frames, payload) != 0) {
+      Py_XDECREF(payload);
+      Py_DECREF(frames);
+      PyBuffer_Release(&buf);
+      return nullptr;
+    }
+    Py_DECREF(payload);
+    pos += 4 + len;
+  }
+  PyObject *rest = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(p + pos), n - pos);
+  PyBuffer_Release(&buf);
+  if (!rest) {
+    Py_DECREF(frames);
+    return nullptr;
+  }
+  PyObject *out = PyTuple_Pack(2, frames, rest);
+  Py_DECREF(frames);
+  Py_DECREF(rest);
+  return out;
+}
+
+PyMethodDef methods[] = {
+    {"pack_values", pack_values, METH_O,
+     "Pack a sequence of SQL values into one self-describing blob."},
+    {"unpack_values", unpack_values, METH_O,
+     "Inverse of pack_values."},
+    {"value_cmp", value_cmp, METH_VARARGS,
+     "cr-sqlite merge tie-break comparison (-1/0/1)."},
+    {"deframe", deframe, METH_VARARGS,
+     "Split complete u32-BE length-delimited frames off the front."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_corrosion_native",
+    "Native hot-path kernels (packed-pk codec, merge compare, framing).",
+    -1, methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__corrosion_native(void) {
+  return PyModule_Create(&moduledef);
+}

@@ -1,0 +1,122 @@
+"""Native kernels vs their pure-Python twins: exact behavioral match.
+
+The native module (corrosion_tpu/native/_corrosion_native.cc) carries
+the hottest host-runtime paths; these tests pin it to the Python
+implementations on randomized inputs so the two can never drift.  When
+no toolchain is available the module is absent and the suite still
+passes (the package falls back to Python everywhere).
+"""
+
+import random
+
+import pytest
+
+from corrosion_tpu.agent import pack
+from corrosion_tpu.native import load
+
+native = load()
+
+pytestmark = pytest.mark.skipif(
+    native is None, reason="no C++ toolchain: Python fallback in use"
+)
+
+
+def _rand_value(rng):
+    kind = rng.randrange(6)
+    if kind == 0:
+        return None
+    if kind == 1:
+        return rng.randint(-(2**62), 2**62)
+    if kind == 2:
+        return rng.random() * 1e6 - 5e5
+    if kind == 3:
+        return "".join(
+            chr(rng.randrange(1, 0x250)) for _ in range(rng.randrange(12))
+        )
+    if kind == 4:
+        return bytes(rng.randrange(256) for _ in range(rng.randrange(12)))
+    return bool(rng.randrange(2))
+
+
+def test_pack_unpack_matches_python():
+    rng = random.Random(11)
+    for _ in range(300):
+        vals = [_rand_value(rng) for _ in range(rng.randrange(5))]
+        pb = pack._py_pack_values(vals)
+        nb = native.pack_values(vals)
+        assert nb == pb, vals
+        assert native.unpack_values(pb) == pack._py_unpack_values(pb)
+
+
+def test_unpack_error_parity():
+    # truncated payloads and bad tags raise the same way
+    good = native.pack_values([1, "abc"])
+    for cut in range(1, len(good)):
+        try:
+            py = pack._py_unpack_values(good[:cut])
+        except ValueError:
+            py = ValueError
+        try:
+            nat = native.unpack_values(good[:cut])
+        except ValueError:
+            nat = ValueError
+        assert nat == py, cut
+    with pytest.raises(ValueError, match="bad tag"):
+        native.unpack_values(b"\x09")
+    with pytest.raises(TypeError):
+        native.pack_values([object()])
+
+
+def test_value_cmp_matches_python():
+    rng = random.Random(12)
+    vals = [_rand_value(rng) for _ in range(60)]
+    for a in vals:
+        for b in vals:
+            assert native.value_cmp(a, b) == pack._py_value_cmp(a, b), (a, b)
+    # total-order sanity: INTEGER > FLOAT > TEXT > BLOB > NULL
+    assert native.value_cmp(0, 1e9) == 1
+    assert native.value_cmp(1.0, "zzz") == 1
+    assert native.value_cmp("", b"\xff") == 1
+    assert native.value_cmp(b"", None) == 1
+
+
+def test_deframe_matches_python():
+    from corrosion_tpu.bridge import speedy
+
+    rng = random.Random(13)
+    payloads = [
+        bytes(rng.randrange(256) for _ in range(rng.randrange(40)))
+        for _ in range(20)
+    ]
+    stream = b"".join(speedy.frame(p) for p in payloads)
+    # every prefix split must agree between native and Python
+    for cut in range(0, len(stream), 7):
+        nf, nr = native.deframe(stream[:cut], speedy.MAX_FRAME_LEN)
+        pf, pr = speedy._py_deframe(stream[:cut])
+        assert nf == pf and nr == pr, cut
+    with pytest.raises(ValueError):
+        native.deframe(b"\xff\xff\xff\xff rest", speedy.MAX_FRAME_LEN)
+
+
+def test_agent_paths_use_native():
+    """The hot call sites actually resolve to the native functions."""
+    assert pack.pack_values is native.pack_values
+    assert pack.unpack_values is native.unpack_values
+    from corrosion_tpu.bridge import speedy
+
+    assert speedy.deframe is not speedy._py_deframe
+
+
+def test_pack_rejects_nonstandard_buffers_and_big_ints():
+    """Divergence guards: objects the Python twin rejects must fail the
+    same way natively (array.array is a buffer but NOT a SQL value)."""
+    import array
+
+    with pytest.raises(TypeError):
+        native.pack_values([array.array("b", [1, 2])])
+    with pytest.raises(TypeError):
+        pack._py_pack_values([array.array("b", [1, 2])])
+    with pytest.raises(OverflowError):
+        native.pack_values([2**70])
+    with pytest.raises(OverflowError):
+        pack._py_pack_values([2**70])
