@@ -89,6 +89,9 @@ def _handle(agent: "Agent", msg: dict) -> dict:
             ]
         }
 
+    if cmd == "cluster_rejoin":
+        return {"ok": {"announced": agent.rejoin()}}
+
     if cmd == "actor_version":
         actor = bytes.fromhex(msg.get("actor", agent.actor_id.hex()))
         bv = agent.bookie.for_actor(actor)

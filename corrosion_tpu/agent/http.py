@@ -197,21 +197,24 @@ def _make_handler(agent: "Agent"):
             self._json(200, {"tables": stats})
 
         def _members(self):
-            self._json(
-                200,
-                {
-                    "members": [
-                        {
-                            "actor": m.actor_id.hex(),
-                            "addr": list(m.addr),
-                            "state": m.state.value,
-                            "incarnation": m.incarnation,
-                            "rtt_ms": m.rtt_ms,
-                        }
-                        for m in agent.members.all()
-                    ]
-                },
-            )
+            transport = getattr(agent, "transport", None)
+            conn_stats = transport.stats if transport is not None else {}
+            members = []
+            for m in agent.members.all():
+                # .get is the atomic read: the event loop may evict the
+                # entry concurrently with this handler thread
+                stats = conn_stats.get(tuple(m.addr))
+                members.append({
+                    "actor": m.actor_id.hex(),
+                    "addr": list(m.addr),
+                    "state": m.state.value,
+                    "incarnation": m.incarnation,
+                    "rtt_ms": m.rtt_ms,
+                    # per-peer transport stats (transport.rs
+                    # ConnectionStats parity)
+                    "conn": stats.as_dict() if stats is not None else None,
+                })
+            self._json(200, {"members": members})
 
         def _subscribe(self):
             if agent.subs is None:

@@ -123,6 +123,8 @@ class SubscriptionHandle:
         self.columns = columns
         self.tables = tables
         self.db_path = db_path
+        # zero-receiver GC bookkeeping (pubsub.rs:131-227 parity)
+        self.last_receiver_at = time.time()
         self._lock = threading.RLock()
         # row identity -> (row_id, cells); change log for catch-up
         self.rows: Dict[str, Tuple[int, list]] = {}
@@ -390,6 +392,7 @@ CREATE TABLE IF NOT EXISTS changes (
             with self._lock:
                 if q in self._streams:
                     self._streams.remove(q)
+                self.last_receiver_at = time.time()
 
     def unsubscribe_stream(self) -> None:
         pass  # generator finally-block handles removal
@@ -472,7 +475,11 @@ class SubsManager:
         with self._lock:
             sub_id = self._by_sql.get(nsql)
             if sub_id:
-                return self._subs[sub_id]
+                h = self._subs[sub_id]
+                # hand-out counts as receiver activity: the caller gets
+                # a full GC horizon to attach its stream
+                h.last_receiver_at = time.time()
+                return h
             # create while holding the lock: two racing subscribers with
             # the same new SQL must share one subscription
             handle = self._create(str(uuid.uuid4()), nsql)
@@ -575,7 +582,10 @@ class SubsManager:
 
     def get(self, sub_id: str) -> Optional[SubscriptionHandle]:
         with self._lock:
-            return self._subs.get(sub_id)
+            h = self._subs.get(sub_id)
+            if h is not None:
+                h.last_receiver_at = time.time()  # see subscribe()
+            return h
 
     def list(self) -> List[dict]:
         with self._lock:
@@ -612,11 +622,22 @@ class SubsManager:
         if touched:
             self._wake.set()
 
+    SUB_GC_S = 120.0  # drop subs with no receivers this long (pubsub.rs GC)
+    GC_SWEEP_S = 5.0
+
     def _run(self) -> None:
+        last_gc = time.monotonic()
         while not self._closed:
-            self._wake.wait()
+            woke = self._wake.wait(timeout=self.GC_SWEEP_S)
             if self._closed:
                 return
+            # sweep on a deadline, NOT only when idle: a node with
+            # steady write traffic never times the wait out
+            if time.monotonic() - last_gc >= self.GC_SWEEP_S:
+                self._gc_idle_subs()
+                last_gc = time.monotonic()
+            if not woke:
+                continue
             time.sleep(DEBOUNCE_S)  # batch candidates
             self._wake.clear()
             with self._lock:
@@ -644,6 +665,27 @@ class SubsManager:
                     h.refresh()
                 except sqlite3.Error:
                     pass
+
+    def _gc_idle_subs(self) -> None:
+        """Drop subscriptions nobody has streamed from in SUB_GC_S
+        (``public/pubsub.rs:131-227``: matchers with zero receivers are
+        garbage-collected after 120 s; a later identical subscribe
+        simply recreates the state from a fresh snapshot)."""
+        now = time.time()
+        with self._lock:
+            dead = [
+                h for h in self._subs.values()
+                if not h._streams and now - h.last_receiver_at > self.SUB_GC_S
+            ]
+            for h in dead:
+                self._subs.pop(h.id, None)
+                self._by_sql.pop(h.sql, None)
+        for h in dead:
+            h.close()
+            try:
+                os.unlink(h.db_path)
+            except OSError:
+                pass
 
     # -- table-level updates (updates.rs parity) -------------------------
 

@@ -431,6 +431,19 @@ class Agent:
                 delay = min(delay * 2, 30.0)
             await asyncio.sleep(delay)
 
+    def rejoin(self) -> int:
+        """Renew our identity and re-announce (foca ``Identity::renew``
+        + the admin Rejoin command, ``actor.rs:199-210``): bump our
+        incarnation so peers holding a stale/suspect view refresh it,
+        then announce to every known member and configured bootstrap."""
+        self.incarnation += 1
+        targets = {tuple(m.addr) for m in self.members.alive()}
+        targets.update(_parse_addr(b) for b in self.config.bootstrap)
+        targets.discard(tuple(self.gossip_addr))
+        for addr in targets:
+            self._send_udp(addr, {"k": "announce", "pb": self._piggyback()})
+        return len(targets)
+
     async def _probe_loop(self) -> None:
         while True:
             await asyncio.sleep(self.config.probe_interval)

@@ -54,6 +54,35 @@ class TokenBucket:
             await asyncio.sleep(min(need, 1.0))
 
 
+class ConnStats:
+    """Per-peer connection statistics (the TCP/TLS stand-in for the
+    reference's full quinn ConnectionStats export,
+    ``transport.rs:235-419``): cumulative across reconnects to the same
+    address, surfaced through metrics and ``cluster members``."""
+
+    __slots__ = ("connects", "bytes_sent", "frames_sent", "failures",
+                 "rtt_last_ms", "rtt_min_ms", "last_used")
+
+    def __init__(self):
+        self.connects = 0
+        self.bytes_sent = 0
+        self.frames_sent = 0
+        self.failures = 0
+        self.rtt_last_ms: Optional[float] = None
+        self.rtt_min_ms: Optional[float] = None
+        self.last_used = 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "connects": self.connects,
+            "bytes_sent": self.bytes_sent,
+            "frames_sent": self.frames_sent,
+            "failures": self.failures,
+            "rtt_last_ms": self.rtt_last_ms,
+            "rtt_min_ms": self.rtt_min_ms,
+        }
+
+
 class UniConnection:
     def __init__(self, reader: asyncio.StreamReader,
                  writer: asyncio.StreamWriter):
@@ -78,10 +107,29 @@ class Transport:
         self.connect_timeout = connect_timeout
         self.on_rtt = on_rtt  # callback(addr, rtt_seconds)
         self.ssl_context = ssl_context  # TLS for uni/bi streams (or None)
+        self.stats: Dict[Addr, ConnStats] = {}
         # LRU cap on cached uni connections (the reference's QUIC conns
         # close on idle timeout; an unbounded TCP cache leaks fds in
         # large in-process clusters)
         self.max_cached = max_cached
+
+    def _stat(self, addr: Addr) -> ConnStats:
+        s = self.stats.get(addr)
+        if s is None:
+            s = self.stats[addr] = ConnStats()
+            # bound the map like the conn cache (dead peers age out)
+            if len(self.stats) > 4 * self.max_cached:
+                oldest = sorted(self.stats, key=lambda a: self.stats[a].last_used)
+                for a in oldest[: len(self.stats) - 2 * self.max_cached]:
+                    del self.stats[a]
+        s.last_used = time.monotonic()
+        return s
+
+    def _record_rtt_stat(self, addr: Addr, rtt_s: float) -> None:
+        s = self._stat(addr)
+        ms = rtt_s * 1000.0
+        s.rtt_last_ms = ms
+        s.rtt_min_ms = ms if s.rtt_min_ms is None else min(s.rtt_min_ms, ms)
 
     async def _open(self, addr: Addr, header: bytes) -> UniConnection:
         t0 = time.monotonic()
@@ -92,6 +140,8 @@ class Transport:
             timeout=self.connect_timeout,
         )
         rtt = time.monotonic() - t0
+        self._stat(addr).connects += 1
+        self._record_rtt_stat(addr, rtt)
         if self.on_rtt is not None:
             self.on_rtt(addr, rtt)
         if self.metrics is not None:
@@ -129,6 +179,9 @@ class Transport:
                 async with conn.lock:
                     conn.writer.write(frames)
                     await conn.writer.drain()
+                st = self._stat(addr)
+                st.bytes_sent += len(frames)
+                st.frames_sent += 1
                 if self.metrics is not None:
                     self.metrics.counter(
                         "corro_transport_uni_bytes_total", len(frames)
@@ -138,6 +191,7 @@ class Transport:
                 if addr in self._uni:
                     self._uni.pop(addr).close()
                 if attempt == 1:
+                    self._stat(addr).failures += 1
                     if self.metrics is not None:
                         self.metrics.counter(
                             "corro_transport_uni_failures_total"
@@ -155,8 +209,11 @@ class Transport:
             ),
             timeout=self.connect_timeout,
         )
+        rtt = time.monotonic() - t0
+        self._stat(addr).connects += 1
+        self._record_rtt_stat(addr, rtt)
         if self.on_rtt is not None:
-            self.on_rtt(addr, time.monotonic() - t0)
+            self.on_rtt(addr, rtt)
         return reader, writer
 
     async def aclose(self) -> None:

@@ -176,6 +176,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=lambda a: cmd_admin(a, "cluster_members"))
     sp = cluster.add_parser("membership-states")
     sp.set_defaults(fn=lambda a: cmd_admin(a, "cluster_members"))
+    sp = cluster.add_parser(
+        "rejoin", help="renew identity and re-announce to the cluster"
+    )
+    sp.set_defaults(fn=lambda a: cmd_admin(a, "cluster_rejoin"))
 
     syncp = sub.add_parser("sync").add_subparsers(dest="sub", required=True)
     sp = syncp.add_parser("generate")

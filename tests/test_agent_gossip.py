@@ -269,3 +269,45 @@ def test_queries_endpoint_is_read_only(run):
             await a.stop()
 
     run(main())
+
+
+def test_members_expose_connection_stats(run):
+    """/v1/members carries per-peer transport stats once traffic has
+    flowed (ConnectionStats parity, transport.rs:235-419)."""
+    import json
+    import urllib.request
+
+    async def main():
+        a = await launch_test_agent()
+        b = await launch_test_agent(
+            bootstrap=[f"{a.gossip_addr[0]}:{a.gossip_addr[1]}"]
+        )
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+            a.execute_transaction(
+                [["INSERT INTO tests (id, text) VALUES (1, 'x')"]]
+            )
+            await wait_for(
+                lambda: b.storage.read_query(
+                    "SELECT count(*) FROM tests")[1] == [(1,)]
+            )
+
+            def peer_conn():
+                url = f"http://{a.api_addr[0]}:{a.api_addr[1]}/v1/members"
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    data = json.load(resp)
+                return [m.get("conn") for m in data["members"]]
+
+            await wait_for(
+                lambda: any(
+                    c and c["connects"] >= 1 and c["bytes_sent"] > 0
+                    and c["rtt_last_ms"] is not None
+                    for c in peer_conn()
+                ),
+                timeout=10,
+            )
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())

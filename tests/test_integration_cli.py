@@ -1,0 +1,115 @@
+"""Integration test: the REAL CLI binary end to end.
+
+Parity: the reference's ``integration-tests`` crate spawns the compiled
+``corrosion`` binary against a live agent (``cli_test.rs:8-51``).  Here
+the binary is ``python -m corrosion_tpu.cli``: one subprocess runs
+``agent`` from a TOML config; further subprocesses drive it with
+``exec`` / ``query`` / ``cluster members`` / ``cluster rejoin`` exactly
+as an operator would.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _cli(*argv, timeout=30):
+    return subprocess.run(
+        [sys.executable, "-m", "corrosion_tpu.cli", *argv],
+        capture_output=True, text=True, timeout=timeout,
+        cwd="/root/repo",
+    )
+
+
+@pytest.fixture
+def live_agent(tmp_path):
+    api_port = _free_port()
+    schema = tmp_path / "schema.sql"
+    schema.write_text(
+        "CREATE TABLE IF NOT EXISTS tests ("
+        " id INTEGER NOT NULL PRIMARY KEY,"
+        " text TEXT NOT NULL DEFAULT '');"
+    )
+    admin_path = str(tmp_path / "admin.sock")
+    cfg = tmp_path / "config.toml"
+    cfg.write_text(
+        f"""
+[db]
+path = "{tmp_path}/corrosion.db"
+schema_paths = ["{schema}"]
+
+[api]
+addr = "127.0.0.1:{api_port}"
+
+[gossip]
+addr = "127.0.0.1:0"
+
+[admin]
+path = "{admin_path}"
+"""
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "corrosion_tpu.cli", "agent", "-c", str(cfg)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd="/root/repo",
+    )
+    # wait for the startup banner
+    deadline = time.time() + 30
+    line = ""
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if "api=" in line:
+            break
+    else:
+        proc.kill()
+        pytest.fail(f"agent did not start: {proc.stderr.read()[:2000]}")
+    yield {"api": f"127.0.0.1:{api_port}", "admin": admin_path,
+           "proc": proc, "banner": line}
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_cli_against_live_agent(live_agent):
+    api = live_agent["api"]
+
+    out = _cli("--api-addr", api, "exec",
+               "INSERT INTO tests (id, text) VALUES (7, 'from-cli')")
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout)["results"][0]["rows_affected"] == 1
+
+    out = _cli("--api-addr", api, "query", "--columns",
+               "SELECT id, text FROM tests")
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.splitlines() == ["id\ttext", "7\tfrom-cli"]
+
+    # admin surface over the UDS
+    out = _cli("--admin-path", live_agent["admin"], "cluster", "members")
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout) == []  # no peers: empty membership
+
+    out = _cli("--admin-path", live_agent["admin"], "cluster", "rejoin")
+    assert out.returncode == 0, out.stderr
+    assert "announced" in json.loads(out.stdout)
+
+    # SIGTERM shuts the agent down cleanly (tripwire parity)
+    proc = live_agent["proc"]
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=15) == 0

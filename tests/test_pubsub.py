@@ -347,3 +347,38 @@ def test_incremental_eligibility(run):
             await a.stop()
 
     run(main())
+
+
+def test_idle_subscription_gc(run, monkeypatch):
+    """A subscription with no attached receivers is garbage-collected
+    after SUB_GC_S and its state file removed; re-subscribing recreates
+    it from a fresh snapshot (reference 120s zero-receiver GC)."""
+    import os
+
+    from corrosion_tpu.agent.pubsub import SubsManager
+
+    monkeypatch.setattr(SubsManager, "SUB_GC_S", 0.1)
+
+    async def main():
+        a = await launch_test_agent()
+        try:
+            h = a.subs.subscribe("SELECT id FROM tests")
+            path = h.db_path
+            assert os.path.exists(path)
+            # the GC sweep runs on the worker's 5s deadline.  NB: poll
+            # the state file, not subs.get() — get() counts as receiver
+            # activity and would keep the sub alive
+            await wait_for(
+                lambda: not os.path.exists(path), timeout=15
+            )
+            assert h.id not in a.subs._subs
+            # an attached stream keeps a new sub alive past the horizon
+            h2 = a.subs.subscribe("SELECT id FROM tests")
+            gen = h2.stream()
+            next(gen)  # attach (columns event)
+            await asyncio.sleep(0.3)
+            assert a.subs.get(h2.id) is not None
+        finally:
+            await a.stop()
+
+    run(main())
