@@ -28,7 +28,6 @@ import jax
 import jax.numpy as jnp
 
 from corrosion_tpu.models.common import block_peers, partition_ok, rand_peers
-from corrosion_tpu.ops.merge import scatter_merge
 
 
 @dataclass(frozen=True)
@@ -138,10 +137,15 @@ def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
         ok &= jax.random.uniform(key_l, (n, k)) >= params.loss
     ok &= partition_ok(partition_id, targets, partition_active)
 
-    # masked delivery: dead messages point past the end and get dropped
-    flat_targets = jnp.where(ok, targets, n).reshape(-1)
-    msg_keys = jnp.repeat(rows, k, axis=0)  # [N*K, R] sender payloads
-    new_rows = scatter_merge(rows, flat_targets, msg_keys)
+    # masked delivery: dead messages point past the end and get dropped.
+    # One scatter per fanout column, each carrying the senders' rows
+    # directly — scatter-max is associative, so K column scatters equal
+    # the combined [N*K] scatter, WITHOUT materializing the [N*K, R]
+    # jnp.repeat of every payload (~20% of the 100k-node tick's wall)
+    masked = jnp.where(ok, targets, n)  # [N, K]
+    new_rows = rows
+    for j in range(k):
+        new_rows = new_rows.at[masked[:, j]].max(rows, mode="drop")
 
     # retransmit decay for senders; fresh budget for nodes that learned
     # something new (rebroadcast semantics)
@@ -175,13 +179,11 @@ def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
     new_hops = None
     if hops is not None:
         # first-infection depth: min over this tick's delivering senders
-        sender_hops = jnp.repeat(
-            jnp.minimum(hops, HOP_UNSET) + 1, k
-        )  # [N*K]
-        cand = (
-            jnp.full((n + 1,), HOP_UNSET, jnp.int32)
-            .at[flat_targets]
-            .min(sender_hops)[:n]
-        )
+        # (same per-column structure as delivery; scatter-min associates)
+        sender_hops = jnp.minimum(hops, HOP_UNSET) + 1  # [N]
+        cand = jnp.full((n + 1,), HOP_UNSET, jnp.int32)
+        for j in range(k):
+            cand = cand.at[masked[:, j]].min(sender_hops)
+        cand = cand[:n]
         new_hops = jnp.where(learned, jnp.minimum(hops, cand), hops)
     return BroadcastStep(new_rows, tx, msgs, new_hops, nxt, new_sent)
