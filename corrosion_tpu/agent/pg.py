@@ -429,6 +429,7 @@ async def serve_pg(agent: "Agent", host: str = "127.0.0.1", port: int = 0):
 async def _handle_conn(agent: "Agent", reader: asyncio.StreamReader,
                        writer: asyncio.StreamWriter) -> None:
     session = _Session(agent)
+    agent.metrics.counter("corro_pg_connections_total")
     try:
         # --- startup ----------------------------------------------------
         while True:

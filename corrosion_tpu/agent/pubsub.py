@@ -346,6 +346,7 @@ CREATE TABLE IF NOT EXISTS changes (
             )
 
     def _fanout(self, event: dict) -> None:
+        self.manager.agent.metrics.counter("corro_subs_events_total")
         for q in list(self._streams):
             try:
                 q.put_nowait(event)
@@ -686,6 +687,9 @@ class SubsManager:
                 os.unlink(h.db_path)
             except OSError:
                 pass
+        if dead:
+            self.agent.metrics.counter("corro_subs_gcd_total", len(dead))
+        self.agent.metrics.gauge("corro_subs_active", len(self._subs))
 
     # -- table-level updates (updates.rs parity) -------------------------
 

@@ -944,6 +944,8 @@ class Agent:
             self._apply_max_overlap = max(
                 self._apply_max_overlap, self._apply_active
             )
+            self.metrics.gauge("corro_apply_in_flight", self._apply_active)
+        self.metrics.histogram("corro_apply_batch_size", len(batch))
         out = []
         try:
             for cv, source in batch:
@@ -956,6 +958,9 @@ class Agent:
         finally:
             with self._apply_gauge_lock:
                 self._apply_active -= 1
+                self.metrics.gauge(
+                    "corro_apply_in_flight", self._apply_active
+                )
         return out
 
     # ------------------------------------------------------------------
@@ -1259,7 +1264,10 @@ class Agent:
         # the whole client round is one trace; each handshake's
         # BiPayload carries its traceparent so the servers' spans share
         # the trace id (sync.rs:32-67 propagation)
-        with tracing.span("sync.client_round", peers=len(members)) as sp:
+        # timed() records on every exit path — including handshake-
+        # timeout rounds, which are exactly the slow ones
+        with self.metrics.timed("corro_sync_client_round_seconds"), \
+                tracing.span("sync.client_round", peers=len(members)) as sp:
             self.metrics.counter("corro_trace_spans_total")
             sessions = [
                 s

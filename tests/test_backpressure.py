@@ -120,3 +120,63 @@ def test_udp_oversize_guard(tmp_path):
         await a.stop()
 
     asyncio.run(main())
+
+
+def test_applies_genuinely_overlap(tmp_path):
+    """Up to max_concurrent_applies batches are in flight on the worker
+    pool at once — two _apply_batch executions overlap in time (the
+    reference runs <=5 concurrent process_multiple_changes,
+    handlers.rs:742-956)."""
+    import threading
+    import time as _time
+
+    async def main():
+        (tmp_path / "n1").mkdir()
+        (tmp_path / "n2").mkdir()
+        a = await launch_test_agent(
+            tmpdir=str(tmp_path / "n1"),
+            apply_queue_len=1,       # every changeset = its own batch
+            apply_queue_timeout=0.001,
+        )
+        b = await launch_test_agent(
+            tmpdir=str(tmp_path / "n2"),
+            bootstrap=[f"{a.gossip_addr[0]}:{a.gossip_addr[1]}"],
+        )
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+            # instrument: count concurrent _apply_batch entries on agent a
+            orig = a._apply_batch
+            state = {"cur": 0, "max": 0}
+            guard = threading.Lock()
+
+            def slow_apply(batch):
+                with guard:
+                    state["cur"] += 1
+                    state["max"] = max(state["max"], state["cur"])
+                _time.sleep(0.05)  # hold the slot so batches can overlap
+                try:
+                    return orig(batch)
+                finally:
+                    with guard:
+                        state["cur"] -= 1
+
+            a._apply_batch = slow_apply
+            # a burst of separate transactions from b -> many changesets
+            for i in range(12):
+                b.execute_transaction([
+                    ["INSERT INTO tests (id, text) VALUES (?, ?)",
+                     [i, f"v{i}"]]
+                ])
+            await wait_for(
+                lambda: a.storage.read_query(
+                    "SELECT count(*) FROM tests")[1] == [(12,)],
+                timeout=30,
+            )
+            assert state["max"] >= 2, (
+                f"applies never overlapped (max in flight {state['max']})"
+            )
+        finally:
+            await b.stop()
+            await a.stop()
+
+    asyncio.run(main())
