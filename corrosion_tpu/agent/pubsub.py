@@ -658,6 +658,11 @@ class SubsManager:
                 try:
                     h.delta(pks)
                 except sqlite3.Error:
+                    # correct but expensive; counted so a systemic
+                    # cause (e.g. busy storms) is visible in metrics
+                    self.agent.metrics.counter(
+                        "corro_subs_delta_fallbacks_total"
+                    )
                     pending.add(sub_id)  # fall back to a full pass
             with self._lock:
                 handles = [self._subs[i] for i in pending if i in self._subs]

@@ -1473,8 +1473,11 @@ class Agent:
             # dropped — bypass the drop-oldest ingest queue (the
             # reference likewise gives emptysets their own ordered
             # channel, handlers.rs:539-734)
+            # route through _apply_batch so the in-flight gauge and
+            # batch-size histogram see sync emptyset work too
             await self._loop.run_in_executor(
-                self._apply_pool, self.handle_change, cv, ChangeSource.SYNC,
+                self._apply_pool, self._apply_batch,
+                [(cv, ChangeSource.SYNC)],
             )
         else:
             self.enqueue_change(cv, ChangeSource.SYNC)

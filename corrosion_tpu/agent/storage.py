@@ -86,6 +86,10 @@ class CrConn:
         self.conn.execute("PRAGMA journal_mode=WAL")
         self.conn.execute("PRAGMA synchronous=NORMAL")
         self.conn.execute("PRAGMA foreign_keys=OFF")
+        # transient SQLITE_BUSY (e.g. a checkpoint of a large WAL racing
+        # a snapshot open) should wait, not raise: a raise on the
+        # subscription delta path degrades it to a full re-evaluation
+        self.conn.execute("PRAGMA busy_timeout=5000")
         # single RW connection behind a 3-tier priority mutex: applies
         # of replicated changes go first, API writes next, maintenance
         # last (the scheduling the reference gets from its split write
@@ -106,6 +110,7 @@ class CrConn:
         conn = sqlite3.connect(
             f"file:{self.path}?mode=ro", uri=True, check_same_thread=False,
         )
+        conn.execute("PRAGMA busy_timeout=5000")  # see RW conn note
         # triggers resolve functions at prepare time, so RO conns need
         # them registered even though writes will fail
         register_udfs(conn)

@@ -1,0 +1,119 @@
+"""Cluster soak: writes under churn, failure detection, restart catch-up.
+
+The reference exercises this shape with ``configurable_stress_test``
+(``corro-agent/src/agent/tests.rs``): many real agents, concurrent
+writes, nodes dying and returning, convergence asserted at the end.
+"""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.agent.testing import launch_test_agent, wait_for
+
+
+@pytest.fixture
+def run():
+    def _run(coro):
+        return asyncio.run(coro)
+
+    return _run
+
+
+def test_soak_writes_churn_and_restart_catchup(run, tmp_path):
+    async def main():
+        n = 6
+        agents = []
+        dirs = []
+        for i in range(n):
+            d = tmp_path / f"n{i}"
+            d.mkdir()
+            dirs.append(str(d))
+            boots = (
+                [f"{agents[0].gossip_addr[0]}:{agents[0].gossip_addr[1]}"]
+                if agents else []
+            )
+            agents.append(
+                await launch_test_agent(tmpdir=str(d), bootstrap=boots)
+            )
+        try:
+            await wait_for(
+                lambda: all(len(a.members.alive()) == n - 1 for a in agents),
+                timeout=30,
+            )
+
+            # concurrent writes spread over several writers
+            for i in range(30):
+                agents[i % n].execute_transaction([
+                    ["INSERT INTO tests (id, text) VALUES (?, ?)",
+                     [i, f"w{i}"]]
+                ])
+
+            def table(a):
+                return a.storage.read_query(
+                    "SELECT id, text FROM tests ORDER BY id")[1]
+
+            def all_converged(group, want):
+                ref = table(group[0])
+                if len(ref) != want:
+                    return False
+                return all(table(a) == ref for a in group[1:])
+
+            await wait_for(lambda: all_converged(agents, 30), timeout=30)
+
+            # kill one node; the rest must mark it down and keep going
+            victim_dir = dirs[-1]
+            victim_actor = agents[-1].actor_id
+            await agents[-1].stop()
+            survivors = agents[:-1]
+
+            def victim_down_everywhere():
+                from corrosion_tpu.agent.members import MemberState
+                for a in survivors:
+                    m = next(
+                        (m for m in a.members.all()
+                         if m.actor_id == victim_actor), None
+                    )
+                    # require the full suspicion pipeline: SUSPECT alone
+                    # is not failure detection
+                    if m is not None and m.state is not MemberState.DOWN:
+                        return False
+                return True
+
+            await wait_for(victim_down_everywhere, timeout=30)
+
+            # writes continue while the victim is gone
+            for i in range(30, 45):
+                survivors[i % (n - 1)].execute_transaction([
+                    ["INSERT INTO tests (id, text) VALUES (?, ?)",
+                     [i, f"w{i}"]]
+                ])
+            await wait_for(
+                lambda: all(len(table(a)) == 45 for a in survivors),
+                timeout=30,
+            )
+
+            # the victim restarts from its own disk state (resume, not
+            # re-seed) and catches up on everything it missed via sync
+            reborn = await launch_test_agent(
+                tmpdir=victim_dir,
+                bootstrap=[
+                    f"{survivors[0].gossip_addr[0]}:"
+                    f"{survivors[0].gossip_addr[1]}"
+                ],
+            )
+            agents[-1] = reborn
+            assert reborn.actor_id == victim_actor  # same identity
+            await wait_for(
+                lambda: len(table(reborn)) == 45
+                and table(reborn) == table(survivors[0]),
+                timeout=45,
+            )
+        finally:
+            for a in agents:
+                try:
+                    await a.stop()
+                except Exception:
+                    pass
+
+    run(main())

@@ -283,7 +283,13 @@ def test_incremental_delta_work_scales_with_change_not_table(run):
                 a.storage._ro_conn.set_progress_handler(None, 0)
             # full re-evaluation walks 100k+ rows -> hundreds of ticks at
             # 1000 insns/tick; the pk-scoped delta touches ~10 rows
-            assert ticks[0] < 50, f"delta cost blew up: {ticks[0]} ticks"
+            fallbacks = a.metrics.get_counter(
+                "corro_subs_delta_fallbacks_total"
+            )
+            assert ticks[0] < 50, (
+                f"delta cost blew up: {ticks[0]} ticks "
+                f"(delta fallbacks: {fallbacks})"
+            )
         finally:
             await a.stop()
 
