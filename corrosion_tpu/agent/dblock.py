@@ -1,0 +1,119 @@
+"""Whole-database file locking for offline maintenance.
+
+Parity: ``sqlite3-restore``'s ``lock_all`` (byte-level locks on every
+range SQLite's unix VFS uses, ``sqlite3-restore/src/lib.rs:51-151``)
+and the ``corrosion db lock <cmd>`` command (``main.rs:493-525``): grab
+every lock, run a shell command (copy, fsck, restore) while holding
+them, release on exit.
+
+SQLite's unix VFS uses POSIX advisory record locks at fixed offsets, so
+``fcntl.lockf`` on the same bytes genuinely excludes live SQLite
+connections in other processes — this is interop, not imitation:
+
+* main db file: PENDING (0x40000000), RESERVED (+1), and the SHARED
+  range (+2 .. +511);
+* ``-shm`` file (WAL mode): the 8 WAL-index lock bytes at offset 120.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import time
+from typing import List, Optional
+
+PENDING_BYTE = 0x40000000
+RESERVED_BYTE = PENDING_BYTE + 1
+SHARED_FIRST = PENDING_BYTE + 2
+SHARED_SIZE = 510
+WAL_LOCK_OFFSET = 120  # unixShmLock region in the -shm file
+WAL_LOCK_COUNT = 8
+
+
+class DbLock:
+    """Holds every SQLite file lock; release with :meth:`close` (or use
+    as a context manager)."""
+
+    def __init__(self, files: List):
+        self._files = files
+
+    def close(self) -> None:
+        for f in self._files:
+            try:
+                f.close()  # closing drops this process's POSIX locks
+            except OSError:
+                pass
+        self._files = []
+
+    def __enter__(self) -> "DbLock":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _lock_range(f, start: int, length: int, deadline: float) -> None:
+    import errno
+
+    while True:
+        try:
+            fcntl.lockf(f, fcntl.LOCK_EX | fcntl.LOCK_NB, length, start)
+            return
+        except OSError as e:
+            # only CONTENTION retries; a filesystem that cannot lock at
+            # all (e.g. ENOLCK on NFS) must fail immediately and say why
+            if e.errno not in (errno.EACCES, errno.EAGAIN):
+                raise
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"could not lock bytes {start}+{length} of "
+                    f"{f.name} (database in use?)"
+                ) from None
+            time.sleep(0.05)
+
+
+def lock_all(db_path: str, timeout_s: float = 30.0) -> DbLock:
+    """Acquire every SQLite lock on ``db_path`` (and its ``-shm`` WAL
+    index when present), retrying until ``timeout_s``.  While the
+    returned handle is open, no other process's SQLite connection can
+    read or write the database."""
+    deadline = time.monotonic() + timeout_s
+    files = []
+    try:
+        # r+b: a typo'd path must fail loudly, not silently lock (and
+        # later "back up") a freshly created empty file
+        db = open(db_path, "r+b")
+        files.append(db)
+        _lock_range(db, PENDING_BYTE, 1, deadline)
+        _lock_range(db, RESERVED_BYTE, 1, deadline)
+        _lock_range(db, SHARED_FIRST, SHARED_SIZE, deadline)
+        shm_path = db_path + "-shm"
+        if os.path.exists(shm_path):
+            shm = open(shm_path, "r+b")
+            files.append(shm)
+            _lock_range(shm, WAL_LOCK_OFFSET, WAL_LOCK_COUNT, deadline)
+        return DbLock(files)
+    except BaseException:
+        for f in files:
+            try:
+                f.close()
+            except OSError:
+                pass
+        raise
+
+
+def run_locked(db_path: str, cmd: str,
+               timeout_s: float = 30.0) -> int:
+    """``corrosion db lock <cmd>``: hold every lock while ``cmd`` runs;
+    returns the command's exit code.
+
+    ``cmd`` is argv-split (shlex) and executed WITHOUT a shell, exactly
+    like the reference's shell_words::split + Command::new — pipe/
+    redirect metacharacters are literal arguments, not shell syntax.
+    """
+    import shlex
+    import subprocess
+
+    with lock_all(db_path, timeout_s):
+        proc = subprocess.run(shlex.split(cmd))
+        return proc.returncode

@@ -218,6 +218,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--once", action="store_true")
     sp.set_defaults(fn=cmd_consul_sync)
 
+    # corrosion db lock <cmd> (main.rs:493-525): hold every sqlite file
+    # lock while an external command runs against the frozen database
+    db = sub.add_parser("db").add_subparsers(dest="sub", required=True)
+    sp = db.add_parser("lock", help="run a command holding all DB locks")
+    sp.add_argument("db_path")
+    sp.add_argument("command",
+                    help="argv-split and run without a shell (no pipes/"
+                         "redirects)")
+    sp.add_argument("--timeout", type=float, default=30.0)
+    sp.set_defaults(fn=cmd_db_lock)
+
     # corrosion tls {ca,server,client} generate (main.rs:707-760)
     tls = sub.add_parser(
         "tls", help="generate a CA and signed server/client certs"
@@ -249,6 +260,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_tls_client)
 
     return p
+
+
+def cmd_db_lock(args) -> int:
+    from corrosion_tpu.agent.dblock import run_locked
+
+    return run_locked(args.db_path, args.command, timeout_s=args.timeout)
 
 
 def cmd_tls_ca(args) -> int:

@@ -320,3 +320,61 @@ def test_metrics_endpoint_and_backoff(run):
             await a.stop()
 
     run(main())
+
+
+def test_db_lock_excludes_other_processes(tmp_path):
+    """db lock's POSIX byte locks land on the offsets SQLite's unix VFS
+    uses, so a live sqlite3 connection in ANOTHER process is genuinely
+    excluded while the lock is held and works again after release."""
+    import sqlite3
+    import subprocess
+    import sys
+
+    from corrosion_tpu.agent.dblock import lock_all
+
+    db = str(tmp_path / "locked.db")
+    conn = sqlite3.connect(db)
+    conn.execute("PRAGMA journal_mode=WAL")
+    conn.execute("CREATE TABLE t (x INTEGER)")
+    conn.commit()
+    conn.close()
+
+    probe = (
+        "import sqlite3, sys\n"
+        f"c = sqlite3.connect({db!r}, timeout=0.2)\n"
+        "try:\n"
+        "    c.execute('INSERT INTO t VALUES (1)'); c.commit()\n"
+        "    print('WROTE')\n"
+        "except sqlite3.OperationalError as e:\n"
+        "    print('BLOCKED', e)\n"
+    )
+
+    with lock_all(db, timeout_s=5):
+        out = subprocess.run(
+            [sys.executable, "-c", probe], capture_output=True, text=True,
+            timeout=30,
+        )
+        assert "BLOCKED" in out.stdout, out.stdout + out.stderr
+    out = subprocess.run(
+        [sys.executable, "-c", probe], capture_output=True, text=True,
+        timeout=30,
+    )
+    assert "WROTE" in out.stdout, out.stdout + out.stderr
+
+
+def test_db_lock_cli_runs_command_under_lock(tmp_path):
+    import sqlite3
+    import subprocess
+    import sys
+
+    db = str(tmp_path / "locked2.db")
+    sqlite3.connect(db).execute("CREATE TABLE t (x)").connection.commit()
+
+    out = subprocess.run(
+        [sys.executable, "-m", "corrosion_tpu.cli", "db", "lock", db,
+         f"cp {db} {db}.copy"],
+        capture_output=True, text=True, timeout=30, cwd="/root/repo",
+    )
+    assert out.returncode == 0, out.stderr
+    import os
+    assert os.path.exists(f"{db}.copy")
