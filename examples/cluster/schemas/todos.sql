@@ -1,0 +1,9 @@
+-- Every CREATE TABLE in a schema file becomes a replicated CRR table.
+-- Constraints follow the reference's rules: a primary key is required;
+-- foreign keys, unique indexes, and NOT NULL without a default are
+-- rejected (they cannot merge deterministically).
+CREATE TABLE todos (
+    id BLOB NOT NULL PRIMARY KEY,
+    title TEXT NOT NULL DEFAULT '',
+    completed_at INTEGER
+);

@@ -1,0 +1,3 @@
+{% for r in sql("SELECT hex(id) AS id, title, completed_at FROM todos ORDER BY title") %}
+[{% if r.completed_at %}x{% else %} {% endif %}] {{ r.title }}
+{% endfor %}
