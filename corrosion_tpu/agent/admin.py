@@ -92,6 +92,24 @@ def _handle(agent: "Agent", msg: dict) -> dict:
     if cmd == "cluster_rejoin":
         return {"ok": {"announced": agent.rejoin()}}
 
+    if cmd == "trace_spans":
+        from corrosion_tpu.agent import tracing
+
+        return {
+            "ok": [
+                {
+                    "name": s.name,
+                    "trace_id": s.trace_id,
+                    "span_id": s.span_id,
+                    "parent_id": s.parent_id,
+                    "start": s.start,
+                    "dur_ms": s.dur_ms,
+                    "attrs": {k: str(v) for k, v in s.attrs.items()},
+                }
+                for s in tracing.recent_spans(int(msg.get("limit", 100)))
+            ]
+        }
+
     if cmd == "actor_version":
         actor = bytes.fromhex(msg.get("actor", agent.actor_id.hex()))
         bv = agent.bookie.for_actor(actor)

@@ -597,6 +597,8 @@ class SubsManager:
                     "tables": sorted(h.tables),
                     "rows": len(h.rows),
                     "last_change_id": h.last_change_id,
+                    "incremental": h.incremental,
+                    "receivers": len(h._streams),
                 }
                 for h in self._subs.values()
             ]

@@ -126,5 +126,9 @@ def current_traceparent() -> Optional[str]:
 
 
 def recent_spans(limit: int = 100):
-    """Most recent finished spans, newest last (admin surface)."""
+    """Most recent finished spans, newest last (admin surface).  A
+    non-positive limit returns none — ``[-0:]`` would invert the bound
+    and dump the whole ring."""
+    if limit <= 0:
+        return []
     return list(_recent)[-limit:]

@@ -190,6 +190,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("locks")
     sp.set_defaults(fn=lambda a: cmd_admin(a, "locks"))
 
+    trace = sub.add_parser("trace").add_subparsers(dest="sub", required=True)
+    sp = trace.add_parser("spans", help="recent finished spans")
+    sp.add_argument("--limit", type=int, default=100)
+    sp.set_defaults(fn=lambda a: cmd_admin(a, "trace_spans", limit=a.limit))
+
     actor = sub.add_parser("actor").add_subparsers(dest="sub", required=True)
     sp = actor.add_parser("version")
     sp.add_argument("--actor", default=None)

@@ -82,3 +82,32 @@ def test_sync_round_shares_trace_id_across_nodes(run, caplog):
             await a.stop()
 
     run(main())
+
+
+def test_trace_spans_admin_surface(run, tmp_path):
+    """`trace spans` returns the recent-span ring over the admin UDS."""
+    async def main():
+        import asyncio as aio
+
+        sock = str(tmp_path / "admin.sock")
+        a = await launch_test_agent(tmpdir=str(tmp_path), admin_path=sock)
+        try:
+            with tracing.span("test.marker", origin="admin-surface"):
+                pass
+            from corrosion_tpu.agent.admin import AdminClient
+
+            def call():
+                c = AdminClient(sock)
+                try:
+                    return c.call("trace_spans", limit=50)
+                finally:
+                    c.close()
+
+            spans = await aio.to_thread(call)
+            ours = [s for s in spans if s["name"] == "test.marker"]
+            assert ours and ours[-1]["attrs"]["origin"] == "admin-surface"
+            assert ours[-1]["dur_ms"] is not None
+        finally:
+            await a.stop()
+
+    run(main())
