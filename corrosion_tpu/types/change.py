@@ -91,10 +91,22 @@ class ChunkedChanges:
         buf: List[Change] = []
         buf_size = 0
         start = self._next_start
-        for change in self._iter:
+        _end = object()
+        nxt = next(self._iter, _end)
+        while nxt is not _end:
+            change = nxt
+            nxt = next(self._iter, _end)
             buf.append(change)
             buf_size += change.estimated_byte_size()
-            if buf_size >= self._max_buf_size and int(change.seq) < int(self._last_seq):
+            if int(change.seq) >= int(self._last_seq):
+                # the advertised range ends here: trailing rows beyond
+                # last_seq are elided, never emitted outside the range
+                # (change.rs test_change_chunker, last_seq==0 scenario)
+                break
+            if buf_size >= self._max_buf_size and nxt is not _end:
+                # flush on budget only when more rows are coming — an
+                # exhausted iterator folds into the final chunk whose
+                # range extends to last_seq (gap-absorption semantics)
                 yield buf, (start, change.seq)
                 start = change.seq.succ()
                 buf = []

@@ -1,0 +1,173 @@
+"""Golden port of the reference's matcher integration scenario.
+
+Mirrors ``crates/corro-types/src/pubsub.rs`` ``test_diff`` (the
+matcher's only end-to-end behavior test): a 4-table schema with
+generated JSON columns and composite pks, a LEFT-JOIN + json_object
+subscription, then the exact event sequence — snapshot row, a new
+matching service arriving as an insert, a removed service as a delete,
+and an address change updating the rendered JSON.
+
+One documented divergence: the reference's AST matcher keys join rows
+by the concatenated base-table pks and emits the address change as an
+in-place UPDATE; our fallback path keys by row content, so the same
+change arrives as delete(old)+insert(new).  Both leave identical
+materialized rows.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from corrosion_tpu.agent.testing import launch_test_agent, wait_for
+
+SCHEMA = """
+CREATE TABLE consul_services (
+    node TEXT NOT NULL,
+    id TEXT NOT NULL,
+    name TEXT NOT NULL DEFAULT '',
+    tags TEXT NOT NULL DEFAULT '[]',
+    meta TEXT NOT NULL DEFAULT '{}',
+    port INTEGER NOT NULL DEFAULT 0,
+    address TEXT NOT NULL DEFAULT '',
+    updated_at INTEGER NOT NULL DEFAULT 0,
+    app_id INTEGER AS (CAST(JSON_EXTRACT(meta, '$.app_id') AS INTEGER)),
+    app_name TEXT AS (JSON_EXTRACT(meta, '$.app_name')),
+    instance_id TEXT AS (COALESCE(
+        JSON_EXTRACT(meta, '$.machine_id'),
+        SUBSTR(JSON_EXTRACT(meta, '$.alloc_id'), 1, 8))),
+    organization_id INTEGER AS (
+        CAST(JSON_EXTRACT(meta, '$.organization_id') AS INTEGER)),
+    PRIMARY KEY (node, id)
+);
+CREATE TABLE machines (
+    id TEXT NOT NULL PRIMARY KEY,
+    node TEXT NOT NULL DEFAULT '',
+    name TEXT NOT NULL DEFAULT '',
+    machine_version_id TEXT NOT NULL DEFAULT '',
+    app_id INTEGER NOT NULL DEFAULT 0,
+    organization_id INTEGER NOT NULL DEFAULT 0,
+    network_id INTEGER NOT NULL DEFAULT 0,
+    updated_at INTEGER NOT NULL DEFAULT 0
+);
+CREATE TABLE machine_versions (
+    machine_id TEXT NOT NULL,
+    id TEXT NOT NULL DEFAULT '',
+    config TEXT NOT NULL DEFAULT '{}',
+    updated_at INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (machine_id, id)
+);
+CREATE TABLE machine_version_statuses (
+    machine_id TEXT NOT NULL,
+    id TEXT NOT NULL,
+    status TEXT NOT NULL DEFAULT '',
+    updated_at INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (machine_id, id)
+);
+"""
+
+SUB_SQL = """SELECT json_object(
+  'targets', json_array(cs.address||':'||cs.port),
+  'labels',  json_object(
+    '__metrics_path__', JSON_EXTRACT(cs.meta, '$.path'),
+    'app',            cs.app_name,
+    'vm_account_id',  cs.organization_id,
+    'instance',       cs.instance_id
+  )
+)
+FROM consul_services cs
+  LEFT JOIN machines m                   ON m.id = cs.instance_id
+  LEFT JOIN machine_versions mv          ON m.id = mv.machine_id
+      AND m.machine_version_id = mv.id
+  LEFT JOIN machine_version_statuses mvs ON m.id = mvs.machine_id
+      AND m.machine_version_id = mvs.id
+WHERE cs.node = 'test-hostname'
+  AND (mvs.status IS NULL OR mvs.status = 'started')
+  AND cs.name = 'app-prometheus'"""
+
+
+def _expected(path, machine, address="127.0.0.1", port=1):
+    return json.dumps({
+        "targets": [f"{address}:{port}"],
+        "labels": {
+            "__metrics_path__": path,
+            "app": None,
+            "vm_account_id": None,
+            "instance": machine,
+        },
+    }, separators=(",", ":"))
+
+
+def _seed(agent, service, name, machine):
+    agent.execute_transaction([
+        ["INSERT INTO consul_services (node, id, name, address, port, meta)"
+         " VALUES ('test-hostname', ?, ?, '127.0.0.1', 1, ?)",
+         [service, name,
+          json.dumps({"path": "/1", "machine_id": machine})]],
+        ["INSERT INTO machines (id, machine_version_id) VALUES (?, ?)",
+         [machine, f"mv-{machine}"]],
+        ["INSERT INTO machine_versions (machine_id, id) VALUES (?, ?)",
+         [machine, f"mv-{machine}"]],
+        ["INSERT INTO machine_version_statuses (machine_id, id, status)"
+         " VALUES (?, ?, 'started')", [machine, f"mv-{machine}"]],
+    ])
+
+
+def test_matcher_reference_diff_scenario():
+    async def main():
+        a = await launch_test_agent(schema=SCHEMA)
+        try:
+            # seed: one matching service, one with a different name
+            _seed(a, "service-1", "app-prometheus", "m-1")
+            _seed(a, "service-2", "not-app-prometheus", "m-2")
+
+            handle = a.subs.subscribe(SUB_SQL)
+            gen = handle.stream()
+            ev = next(gen)
+            assert "columns" in ev
+            ev = next(gen)
+            assert ev["row"][0] == 1  # RowId(1)
+            assert json.loads(ev["row"][1][0]) == json.loads(
+                _expected("/1", "m-1"))
+            assert "eoq" in next(gen)
+
+            # a new matching service arrives -> Insert, RowId 2, ChangeId 1
+            _seed(a, "service-3", "app-prometheus", "m-3")
+            ev = await asyncio.to_thread(next, gen)
+            assert ev["change"][0] == "insert"
+            assert ev["change"][1] == 2
+            assert ev["change"][3] == 1
+            assert json.loads(ev["change"][2][0]) == json.loads(
+                _expected("/1", "m-3"))
+
+            # service-1 removed -> Delete of RowId 1, ChangeId 2
+            a.execute_transaction([
+                ["DELETE FROM consul_services WHERE node = 'test-hostname'"
+                 " AND id = 'service-1'"]
+            ])
+            ev = await asyncio.to_thread(next, gen)
+            assert ev["change"][0] == "delete"
+            assert ev["change"][1] == 1
+            assert ev["change"][3] == 2
+
+            # address change re-renders service-3's JSON.  Reference
+            # emits an in-place Update (pk-keyed join rows); our
+            # fallback path re-keys by content: delete(old)+insert(new)
+            # with identical final materialization.
+            a.execute_transaction([
+                ["UPDATE consul_services SET address = '127.0.0.2'"
+                 " WHERE node = 'test-hostname' AND id = 'service-3'"]
+            ])
+            kinds = {}
+            for _ in range(2):
+                ev = await asyncio.to_thread(next, gen)
+                kinds[ev["change"][0]] = ev["change"][2][0]
+            assert set(kinds) == {"delete", "insert"}
+            assert json.loads(kinds["insert"]) == json.loads(
+                _expected("/1", "m-3", address="127.0.0.2"))
+            # final state: exactly one row, the updated service-3
+            assert len(handle.rows) == 1
+        finally:
+            await a.stop()
+
+    asyncio.run(main())

@@ -108,3 +108,47 @@ def test_chunker_last_chunk_extends_to_last_seq():
     changes = [_mk_change(0), _mk_change(1)]
     chunks = list(ChunkedChanges(changes, 0, 5))
     assert chunks[-1][1][1] == 5
+
+
+def test_change_chunker_reference_scenarios():
+    """Named port of ``change.rs`` ``test_change_chunker`` — every
+    scenario, same expectations (empty iterator, budget splits, elided
+    trailing rows, seq gaps riding the enclosing range)."""
+    changes = [_mk_change(seq) for seq in range(100)]
+    size = changes[0].estimated_byte_size()
+
+    # empty iterator: one empty chunk covering the whole range
+    assert list(ChunkedChanges([], 0, 100)) == [([], (0, 100))]
+
+    # budget = 2 changes: [c0, c1] 0..=1 then [c2] 2..=100
+    out = list(ChunkedChanges(changes[:3], 0, 100, max_buf_size=2 * size))
+    assert out == [
+        ([changes[0], changes[1]], (0, 1)),
+        ([changes[2]], (2, 100)),
+    ]
+
+    # last_seq == 0 with a trailing change beyond it: only [c0] 0..=0
+    out = list(ChunkedChanges(changes[:2], 0, 0, max_buf_size=size))
+    assert out == [([changes[0]], (0, 0))]
+
+    # seq gaps inside one budget: the range rides to last_seq
+    out = list(ChunkedChanges([changes[0], changes[2]], 0, 100,
+                              max_buf_size=2 * size))
+    assert out == [([changes[0], changes[2]], (0, 100))]
+
+    # all-gaps, huge budget: one chunk 0..=100
+    out = list(ChunkedChanges(
+        [changes[2], changes[4], changes[7], changes[8]], 0, 100,
+        max_buf_size=100_000))
+    assert out == [
+        ([changes[2], changes[4], changes[7], changes[8]], (0, 100))
+    ]
+
+    # gaps split by budget: [c2, c4] 0..=4 then [c7, c8] 5..=10
+    out = list(ChunkedChanges(
+        [changes[2], changes[4], changes[7], changes[8]], 0, 10,
+        max_buf_size=2 * size))
+    assert out == [
+        ([changes[2], changes[4]], (0, 4)),
+        ([changes[7], changes[8]], (5, 10)),
+    ]
