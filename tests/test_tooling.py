@@ -378,3 +378,26 @@ def test_db_lock_cli_runs_command_under_lock(tmp_path):
     assert out.returncode == 0, out.stderr
     import os
     assert os.path.exists(f"{db}.copy")
+
+
+def test_named_param_statements(run):
+    """Statement::WithNamedParams parity: [sql, {name: value}] works for
+    writes and reads over the HTTP API (and ? params stay positional)."""
+    async def main():
+        a = await launch_test_agent()
+        try:
+            client = CorrosionApiClient(a.api_addr)
+            out = client.execute([
+                ["INSERT INTO tests (id, text) VALUES (:id, :text)",
+                 {"id": 7, "text": "named"}],
+                ["INSERT INTO tests (id, text) VALUES (?, ?)", [8, "pos"]],
+            ])
+            assert [r["rows_affected"] for r in out["results"]] == [1, 1]
+            cols, rows = client.query(
+                ["SELECT text FROM tests WHERE id = :id", {"id": 7}]
+            )
+            assert rows == [["named"]]
+        finally:
+            await a.stop()
+
+    run(main())
