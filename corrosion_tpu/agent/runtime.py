@@ -1750,8 +1750,17 @@ class Agent:
             s, e = need.versions
             # clamp hostile/stale ranges to what we can possibly serve
             s, e = max(1, int(s)), min(int(e), bv.last())
-            for i, v in enumerate(range(s, e + 1)):
-                await self._serve_version(writer, actor, bv, v, sess=sess)
+            # newest first (peer.rs serve order): under a chunk budget or
+            # a slow-peer abort the requester keeps the freshest data.
+            # A version served as a cleared span jumps the cursor BELOW
+            # the whole span — no per-version spin over large ranges
+            v, i = e, 0
+            while v >= s:
+                span = await self._serve_version(
+                    writer, actor, bv, v, sess=sess
+                )
+                v = (span[0] - 1) if span is not None else (v - 1)
+                i += 1
                 if i % 64 == 63:
                     await asyncio.sleep(0)  # don't starve the event loop
         elif kind == "partial":
@@ -1783,7 +1792,10 @@ class Agent:
         self, writer, actor: bytes, bv, v: int,
         seq_spans: Optional[List[Tuple[int, int]]] = None,
         sess: Optional[dict] = None,
-    ) -> None:
+    ) -> Optional[Tuple[int, int]]:
+        """Serve one version; returns the enclosing (lo, hi) span when
+        it went out as a cleared/empty changeset (so a full-range serve
+        can skip the rest of the span), else None."""
         if bv.cleared.contains(v):
             lo, hi = v, v
             for s, e in bv.cleared:
@@ -1792,7 +1804,7 @@ class Agent:
                     break
             cs = Changeset.empty((Version(lo), Version(hi)), bv.last_cleared_ts)
             await self._send_sync_change(writer, actor, cs, sess)
-            return
+            return (lo, hi)
         entry = bv.versions.get(v)
         if entry is None:
             # we may still hold part of it: serve the buffered seqs we have
@@ -1827,6 +1839,16 @@ class Agent:
         # (broadcast.rs:118): re-serve with the ts recorded at apply time
         row_ts = self.bookie.version_ts(actor, v)
         ts = Timestamp(row_ts) if row_ts is not None else Timestamp(0)
+        if not changes:
+            # the version HAD rows (versions are only allocated for
+            # non-empty transactions); all gone means newer versions
+            # overwrote them — read-time cleared detection: serve an
+            # EmptySet so the requester records a cleared range, not a
+            # hollow full version (peer.rs:350-762 behavior, pinned by
+            # its test_handle_need)
+            cs = Changeset.empty((Version(v), Version(v)), ts)
+            await self._send_sync_change(writer, actor, cs, sess)
+            return (v, v)
         if seq_spans is not None:
             changes = [
                 c

@@ -1,0 +1,130 @@
+"""Golden port of the reference's sync serve-side scenarios.
+
+Mirrors ``crates/corro-agent/src/api/peer.rs`` ``test_handle_need``:
+apply two versions from a foreign actor, then assert the exact wire
+responses for a full need, a partial need of a fully-known version
+(promoted to a full changeset), a partial need of an overwritten
+version (read-time cleared detection: an EmptySet), and a full range
+spanning live + overwritten versions (served newest first, the
+overwritten version as an EmptySet).
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from corrosion_tpu.agent.runtime import ChangeSource
+from corrosion_tpu.agent.testing import launch_test_agent
+from corrosion_tpu.bridge import speedy
+from corrosion_tpu.types import ActorId, SyncNeedV1, Version
+from corrosion_tpu.types.change import Change, CrsqlDbVersion, CrsqlSeq
+from corrosion_tpu.types.changeset import Changeset, ChangeV1
+from corrosion_tpu.agent.pack import pack_values
+
+
+class _CaptureWriter:
+    def __init__(self):
+        self.buf = bytearray()
+
+    def write(self, b: bytes) -> None:
+        self.buf += b
+
+    async def drain(self) -> None:
+        pass
+
+
+def _mk(pk, val, col_version, db_version, site):
+    return Change(
+        table="tests", pk=pack_values([pk]), cid="text", val=val,
+        col_version=col_version, db_version=CrsqlDbVersion(db_version),
+        seq=CrsqlSeq(0), site_id=site, cl=1,
+    )
+
+
+def test_serve_need_reference_scenarios():
+    async def main():
+        a = await launch_test_agent()
+        try:
+            foreign = os.urandom(16)
+            ts = a.clock.new_timestamp()
+            change1 = _mk(1, "one", 1, 1, foreign)
+            change2 = _mk(2, "two", 1, 2, foreign)
+            for v, ch in ((1, change1), (2, change2)):
+                a.handle_change(
+                    ChangeV1(
+                        actor_id=ActorId(foreign),
+                        changeset=Changeset.full(
+                            Version(v), [ch], (0, 0), 0, ts
+                        ),
+                    ),
+                    ChangeSource.SYNC,
+                    rebroadcast=False,
+                )
+            bv = a.bookie.for_actor(foreign)
+            assert bv.contains_version(1) and bv.contains_version(2)
+
+            async def serve(need):
+                w = _CaptureWriter()
+                await a._serve_need(w, foreign, need)
+                return [
+                    speedy.decode_sync_message(p)
+                    for p in speedy.FrameReader().feed(bytes(w.buf))
+                ]
+
+            # full need of v1: exactly change1 back, byte-faithful
+            msgs = await serve(SyncNeedV1.full(1, 1))
+            assert len(msgs) == 1
+            cv = msgs[0]
+            assert isinstance(cv, ChangeV1)
+            assert cv.actor_id.bytes == foreign
+            cs = cv.changeset
+            assert cs.is_full and int(cs.version) == 1
+            assert list(cs.changes) == [change1]
+            assert tuple(map(int, cs.seqs)) == (0, 0) and int(cs.last_seq) == 0
+
+            # partial need of a fully-known version promotes to full
+            msgs = await serve(SyncNeedV1.partial(2, [(0, 0)]))
+            assert len(msgs) == 1
+            cs = msgs[0].changeset
+            assert cs.is_full and int(cs.version) == 2
+            assert list(cs.changes) == [change2]
+
+            # v3 overwrites pk 1 -> v1's change rows vanish
+            change3 = _mk(1, "one override", 2, 3, foreign)
+            a.handle_change(
+                ChangeV1(
+                    actor_id=ActorId(foreign),
+                    changeset=Changeset.full(
+                        Version(3), [change3], (0, 0), 0,
+                        a.clock.new_timestamp(),
+                    ),
+                ),
+                ChangeSource.SYNC,
+                rebroadcast=False,
+            )
+
+            # partial need of the overwritten version: read-time cleared
+            # detection serves an EmptySet, not a hollow full changeset
+            msgs = await serve(SyncNeedV1.partial(1, [(0, 0)]))
+            assert len(msgs) == 1
+            cs = msgs[0].changeset
+            assert cs.is_empty_variant and not cs.changes
+            assert tuple(map(int, cs.versions)) == (1, 1)
+
+            # full range over live + overwritten versions: newest first,
+            # the overwritten one last as an EmptySet (reference order)
+            msgs = await serve(SyncNeedV1.full(1, 6))
+            kinds = [
+                (int(m.changeset.version)
+                 if m.changeset.is_full else ("empty",) + tuple(
+                     map(int, m.changeset.versions)))
+                for m in msgs
+            ]
+            assert kinds == [3, 2, ("empty", 1, 1)]
+            assert list(msgs[0].changeset.changes) == [change3]
+            assert list(msgs[1].changeset.changes) == [change2]
+        finally:
+            await a.stop()
+
+    asyncio.run(main())
