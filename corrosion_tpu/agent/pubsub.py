@@ -427,6 +427,8 @@ class SubsManager:
         self._lock = threading.RLock()
         self._pending: Set[str] = set()
         self._pending_pks: Dict[str, Set[bytes]] = {}
+        self._draining = False
+        self._worker_died = False
         self._update_streams: Dict[str, List[queue.Queue]] = {}
         self._wake = threading.Event()
         self._closed = False
@@ -629,6 +631,15 @@ class SubsManager:
     GC_SWEEP_S = 5.0
 
     def _run(self) -> None:
+        try:
+            self._run_inner()
+        except BaseException:
+            # a dead worker must fail idle() loudly, not hang it
+            # (_draining stuck) or lie (popped batch never processed)
+            self._worker_died = True
+            raise
+
+    def _run_inner(self) -> None:
         last_gc = time.monotonic()
         while not self._closed:
             woke = self._wake.wait(timeout=self.GC_SWEEP_S)
@@ -646,33 +657,59 @@ class SubsManager:
             with self._lock:
                 pending, self._pending = self._pending, set()
                 pending_pks, self._pending_pks = self._pending_pks, {}
-            for sub_id, pks in pending_pks.items():
-                if sub_id in pending:
-                    continue  # a full refresh covers the candidates
-                h = self._subs.get(sub_id)
-                if h is None:
-                    continue
-                # the delta path needs the projection (first refresh) and
-                # loses to a full pass beyond DELTA_MAX_PKS candidates
-                if not h.columns or len(pks) > DELTA_MAX_PKS:
-                    pending.add(sub_id)
-                    continue
-                try:
-                    h.delta(pks)
-                except sqlite3.Error:
-                    # correct but expensive; counted so a systemic
-                    # cause (e.g. busy storms) is visible in metrics
-                    self.agent.metrics.counter(
-                        "corro_subs_delta_fallbacks_total"
-                    )
-                    pending.add(sub_id)  # fall back to a full pass
-            with self._lock:
-                handles = [self._subs[i] for i in pending if i in self._subs]
-            for h in handles:
-                try:
-                    h.refresh()
-                except sqlite3.Error:
-                    pass
+                # popped-but-unprocessed work keeps idle() false: the
+                # sets alone go empty the instant a round is claimed,
+                # long before its refresh/delta SQL has finished
+                self._draining = bool(pending or pending_pks)
+            try:
+                self._drain_round(pending, pending_pks)
+            finally:
+                with self._lock:
+                    self._draining = False
+
+    def _drain_round(
+        self, pending: Set[str], pending_pks: Dict[str, Set[bytes]]
+    ) -> None:
+        """Process one popped batch of candidate work."""
+        for sub_id, pks in pending_pks.items():
+            if sub_id in pending:
+                continue  # a full refresh covers the candidates
+            h = self._subs.get(sub_id)
+            if h is None:
+                continue
+            # the delta path needs the projection (first refresh) and
+            # loses to a full pass beyond DELTA_MAX_PKS candidates
+            if not h.columns or len(pks) > DELTA_MAX_PKS:
+                pending.add(sub_id)
+                continue
+            try:
+                h.delta(pks)
+            except sqlite3.Error:
+                # correct but expensive; counted so a systemic
+                # cause (e.g. busy storms) is visible in metrics
+                self.agent.metrics.counter(
+                    "corro_subs_delta_fallbacks_total"
+                )
+                pending.add(sub_id)  # fall back to a full pass
+        with self._lock:
+            handles = [self._subs[i] for i in pending if i in self._subs]
+        for h in handles:
+            try:
+                h.refresh()
+            except sqlite3.Error:
+                pass
+
+    def idle(self) -> bool:
+        """True when no candidate work is queued OR in flight — the
+        condition tests must wait on before measuring delta cost.
+        Raises if the worker died: neither a hang (flag stuck) nor a
+        silent True (batch never processed) is an acceptable answer."""
+        if self._worker_died:
+            raise RuntimeError("subscription worker thread died")
+        with self._lock:
+            return not (
+                self._pending or self._pending_pks or self._draining
+            )
 
     def _gc_idle_subs(self) -> None:
         """Drop subscriptions nobody has streamed from in SUB_GC_S

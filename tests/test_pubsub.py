@@ -236,10 +236,9 @@ def test_incremental_delta_work_scales_with_change_not_table(run):
             # candidate pks (handled by the full-refresh fallback).
             # Local on_change deliveries are FIFO on the event loop, so
             # a probe row inserted NOW reaches the worker only after the
-            # whole backlog; once its event has been emitted, the
-            # backlog's round has fully completed — a deterministic
-            # quiescence marker (dict-emptiness alone is racy: it also
-            # holds mid-round, while the fallback refresh still runs)
+            # whole backlog; then idle() confirms no refresh round is
+            # still in flight (the sets go empty the moment a round is
+            # claimed, long before its SQL finishes)
             a.execute_transaction([
                 ["INSERT INTO tests (id, text) VALUES (199998, 'probe')"]
             ])
@@ -249,10 +248,7 @@ def test_incremental_delta_work_scales_with_change_not_table(run):
                 ),
                 timeout=60,
             )
-            await wait_for(
-                lambda: not a.subs._pending and not a.subs._pending_pks,
-                timeout=60,
-            )
+            await wait_for(a.subs.idle, timeout=60)
 
             # the delta query must be an indexed SEARCH, not a SCAN
             cols, plan = a.storage.read_query(
