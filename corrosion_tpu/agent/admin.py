@@ -92,6 +92,18 @@ def _handle(agent: "Agent", msg: dict) -> dict:
     if cmd == "cluster_rejoin":
         return {"ok": {"announced": agent.rejoin()}}
 
+    if cmd == "cluster_set_id":
+        try:
+            announced = agent.set_cluster_id(int(msg["cluster_id"]))
+        except (KeyError, ValueError) as e:
+            return {"error": f"bad cluster_id: {e}"}
+        return {
+            "ok": {
+                "cluster_id": agent.config.cluster_id,
+                "announced": announced,
+            }
+        }
+
     if cmd == "trace_spans":
         from corrosion_tpu.agent import tracing
 

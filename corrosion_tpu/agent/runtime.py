@@ -407,6 +407,12 @@ class Agent:
 
     def _send_udp(self, addr: Tuple[str, int], msg: dict) -> None:
         if self._udp:
+            if self.config.cluster_id:
+                # SWIM is cluster-scoped like the foca identity's
+                # cluster_id (actor.rs:222): receivers in other
+                # clusters drop the datagram, so membership — not just
+                # the data plane — partitions on cluster id
+                msg.setdefault("c", self.config.cluster_id)
             data = wire.encode_datagram(msg)
             if len(data) > MAX_UDP_PAYLOAD:
                 # foca caps SWIM packets at 1178 B (broadcast/mod.rs:943);
@@ -443,6 +449,23 @@ class Agent:
         for addr in targets:
             self._send_udp(addr, {"k": "announce", "pb": self._piggyback()})
         return len(targets)
+
+    def set_cluster_id(self, cluster_id: int) -> int:
+        """Move this node to another cluster (admin ``cluster set-id``,
+        ``corro-admin/src/lib.rs`` Cluster SetId → FocaCmd change
+        identity): SWIM datagrams and data-plane payloads to/from peers
+        with a different cluster_id are rejected, so switching ids
+        detaches us from the old cluster on both planes; old members
+        are forgotten here, and the old cluster's view of us decays to
+        down once our refutations stop (its probes are dropped).  The
+        renewed announce lets same-id peers adopt us."""
+        ClusterId(cluster_id)  # range-check (u16)
+        old_members = self.members.all()
+        self.config.cluster_id = int(cluster_id)
+        announced = self.rejoin()
+        for m in old_members:
+            self.members.remove(m.actor_id)
+        return announced
 
     async def _probe_loop(self) -> None:
         while True:
@@ -1911,6 +1934,12 @@ class _UdpProtocol(asyncio.DatagramProtocol):
         try:
             msg = wire.decode_datagram(data)
         except ValueError:
+            return
+        if msg.get("c", 0) != a.config.cluster_id:
+            # cross-cluster SWIM traffic is dropped wholesale: the
+            # sender is not a member here and must not refresh (or
+            # create) a membership entry
+            a.metrics.counter("corro_swim_cluster_rejected_total")
             return
         kind = msg.get("k")
         if kind == "announce":

@@ -180,6 +180,13 @@ def build_parser() -> argparse.ArgumentParser:
         "rejoin", help="renew identity and re-announce to the cluster"
     )
     sp.set_defaults(fn=lambda a: cmd_admin(a, "cluster_rejoin"))
+    sp = cluster.add_parser(
+        "set-id", help="move this node to another cluster id"
+    )
+    sp.add_argument("cluster_id", type=int)
+    sp.set_defaults(
+        fn=lambda a: cmd_admin(a, "cluster_set_id", cluster_id=a.cluster_id)
+    )
 
     syncp = sub.add_parser("sync").add_subparsers(dest="sub", required=True)
     sp = syncp.add_parser("generate")

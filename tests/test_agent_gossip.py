@@ -311,3 +311,45 @@ def test_members_expose_connection_stats(run):
             await a.stop()
 
     run(main())
+
+
+def test_cluster_set_id_detaches_node(run):
+    """``cluster set-id`` (corro-admin Cluster SetId): moving a node to
+    another cluster id makes its gossip rejectable, so writes stop
+    replicating to it, while same-id nodes still converge."""
+    async def main():
+        a = await launch_test_agent()
+        b = await launch_test_agent(bootstrap=[addr_str(a)])
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+            a.execute_transaction(
+                [["INSERT INTO tests (id, text) VALUES (1, 'pre')"]]
+            )
+            await wait_for(
+                lambda: b.storage.read_query(
+                    "SELECT count(*) FROM tests")[1] == [(1,)]
+            )
+
+            assert b.set_cluster_id(7) >= 1  # announced (and rejected)
+            assert b.members.all() == []  # old-cluster members forgotten
+            # a write on a no longer reaches b: cross-cluster uni
+            # payloads are rejected at ingest
+            a.execute_transaction(
+                [["INSERT INTO tests (id, text) VALUES (2, 'post')"]]
+            )
+            await asyncio.sleep(1.0)
+            assert b.storage.read_query(
+                "SELECT count(*) FROM tests")[1] == [(1,)]
+            # membership detaches too: b's probes/refutations are now
+            # dropped by a, so a's SWIM view of b decays to down
+            await wait_for(lambda: not a.members.alive(), timeout=15)
+
+            # out-of-range ids are refused before any state changes
+            with pytest.raises(ValueError):
+                b.set_cluster_id(1 << 16)
+            assert b.config.cluster_id == 7
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())
