@@ -6,13 +6,15 @@ XLA infer collectives from `NamedSharding` annotations, this module
 spells the fabric out: node state lives sharded over the mesh's
 ``nodes`` axis and one gossip tick is
 
-  1. every shard draws the SAME global [N, K] fanout targets from the
-     shared tick key (replicated compute — cheap integers);
+  1. every shard draws the SAME per-column inverse permutations from
+     the shared tick key (replicated compute — cheap integers), so all
+     shards agree on each receiver's sender;
   2. an ``all_gather`` over ``nodes`` moves every shard's sender rows
      and activity mask across the fabric (the ICI stand-in for the
      reference's QUIC uni-streams);
-  3. each shard scatter-maxes the messages that land in ITS node range
-     (delivery is local after the gather).
+  3. each shard's receivers gather from their column senders out of the
+     gathered global state (delivery is local after the gather — no
+     scatter anywhere, mirroring the permutation-fanout kernel).
 
 The result is bitwise identical to the unsharded
 :func:`corrosion_tpu.models.broadcast.broadcast_step` for the same key
@@ -30,10 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from corrosion_tpu.models.broadcast import (
-    BroadcastParams,
-    _draw_targets,
-)
+from corrosion_tpu.models.broadcast import BroadcastParams
 
 def _shard_map(f, mesh, in_specs, out_specs):
     """shard_map across jax versions: the promoted jax.shard_map (>=0.8,
@@ -65,11 +64,15 @@ def sharded_broadcast_step(mesh, params: BroadcastParams):
         raise ValueError(f"n_nodes {n} must divide over {d_shards} shards")
     n_local = n // d_shards
 
+    from corrosion_tpu.models.broadcast import _perm_senders
+
+    u = params.universe or n
+
     def local_step(rows_l, tx_l, msgs_l, key):
-        # (1) replicated global draw — same key everywhere, so every
-        # shard agrees on who sends where this tick
+        # (1) replicated permutation draw — same key everywhere, so
+        # every shard agrees on each receiver's sender this tick
+        # (mirrors _deliver_perm's column structure bitwise)
         key_t, key_l = jax.random.split(key)
-        targets = _draw_targets(key_t, params)  # [N, K] global ids
 
         # (2) the fabric: move sender rows + activity across ICI
         rows_all = jax.lax.all_gather(
@@ -77,20 +80,26 @@ def sharded_broadcast_step(mesh, params: BroadcastParams):
         ).reshape(n, rows_l.shape[-1])
         active_all = jax.lax.all_gather(tx_l > 0, "nodes").reshape(n)
 
-        ok = jnp.broadcast_to(active_all[:, None], (n, k))
         if params.loss > 0.0:
-            ok &= jax.random.uniform(key_l, (n, k)) >= params.loss
+            drop = jax.random.uniform(key_l, (n, k)) < params.loss
 
-        # (3) local delivery: only messages addressed to MY node range
+        # (3) local delivery: each of MY receivers gathers from its
+        # column sender out of the gathered global state
         shard = jax.lax.axis_index("nodes")
         lo = shard * n_local
-        t_local = targets - lo
-        mine = ok & (t_local >= 0) & (t_local < n_local)
-        masked = jnp.where(mine, t_local, n_local)
+        my_idx = lo + jnp.arange(n_local, dtype=jnp.int32)
         new_rows_l = rows_l
         for j in range(k):
-            new_rows_l = new_rows_l.at[masked[:, j]].max(
-                rows_all, mode="drop"
+            sender_all = _perm_senders(
+                key_t, j, n, u, j < params.fanout_ring0, params.ring0_size
+            )  # [N] receiver->sender, identical on every shard
+            sender = sender_all[my_idx]  # my receivers' senders
+            valid = active_all[sender]
+            if params.loss > 0.0:
+                valid &= ~drop[my_idx, j]
+            new_rows_l = jnp.maximum(
+                new_rows_l,
+                jnp.where(valid[:, None], rows_all[sender], rows_l),
             )
 
         # bookkeeping is local: decay my senders, refresh my learners

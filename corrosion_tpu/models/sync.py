@@ -24,6 +24,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +38,9 @@ class SyncParams:
     peers_per_round: int = 1  # concurrent sync partners (ref: 3..10)
     cells_per_chunk: int = 64  # cells that fit one 8 KiB chunk message
     handshake_msgs: int = 2  # SyncStart + State exchange per session
+    # seed-flattening (models/common.py): peer draws stay inside the
+    # sender's own universe of this width when set
+    universe: Optional[int] = None
 
 
 def bitmap_needs(ours, theirs):
@@ -86,7 +90,7 @@ def sync_step(rows, msgs_sent, key, params: SyncParams,
     server, like the reference's server-side send loop).
     """
     n, p = params.n_nodes, params.peers_per_round
-    peers = rand_peers(key, n, (n, p))  # [N, P], never self
+    peers = rand_peers(key, n, (n, p), universe=params.universe)  # [N, P]
 
     reachable = jnp.ones((n, p), dtype=bool)
     reachable &= partition_ok(partition_id, peers, partition_active)
@@ -129,6 +133,8 @@ class SeqSyncParams:
     chunk_budget: int = 4  # chunks a server sends per session
     loss: float = 0.0  # per-CHUNK drop probability
     handshake_msgs: int = 2
+    # seed-flattening (models/common.py)
+    universe: Optional[int] = None
 
 
 def bitmap_gaps(bits):
@@ -162,7 +168,7 @@ def seq_sync_step(bits, msgs_sent, key, params: SeqSyncParams):
     spc, budget = params.seqs_per_chunk, params.chunk_budget
     k_peers, k_drop = jax.random.split(key)
 
-    peers = rand_peers(k_peers, n, (n, p))  # [N, P]
+    peers = rand_peers(k_peers, n, (n, p), universe=params.universe)  # [N, P]
     peer_bits = bits[peers]  # [N, P, S]
     needs = peer_bits & ~bits[:, None, :]  # [N, P, S] gap algebra
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -34,58 +35,75 @@ class AntiEntropyConfig:
     loss: float = 0.02  # per-chunk drop (exercises gap healing)
     max_ticks: int = 96
     chunk_ticks: int = 8
+    # seed-flattening (models/common.py): S universes side by side so
+    # every gather/scatter in the round runs unbatched
+    n_universes: Optional[int] = None
+
+    @property
+    def flat_nodes(self) -> int:
+        return self.n_nodes * (self.n_universes or 1)
 
     @property
     def params(self) -> SeqSyncParams:
         return SeqSyncParams(
-            n_nodes=self.n_nodes,
+            n_nodes=self.flat_nodes,
             n_seqs=self.n_seqs,
             peers_per_round=self.peers_per_round,
             seqs_per_chunk=self.seqs_per_chunk,
             chunk_budget=self.chunk_budget,
             loss=self.loss,
+            universe=self.n_nodes if self.n_universes else None,
         )
 
 
 def anti_entropy_init(cfg: AntiEntropyConfig, writer: int = 0):
-    bits = jnp.zeros((cfg.n_nodes, cfg.n_seqs), bool).at[writer].set(True)
-    msgs = jnp.zeros((cfg.n_nodes,), jnp.int32)
+    writers = (
+        writer
+        + jnp.arange(cfg.n_universes or 1, dtype=jnp.int32) * cfg.n_nodes
+    )
+    bits = jnp.zeros((cfg.flat_nodes, cfg.n_seqs), bool).at[writers].set(True)
+    msgs = jnp.zeros((cfg.flat_nodes,), jnp.int32)
     return bits, msgs
 
 
 @partial(jax.jit, static_argnames=("cfg",))
 def _scan_chunk(carry, seed_key, start_tick, cfg: AntiEntropyConfig):
+    S = cfg.n_universes or 1
+
     def body(c, i):
         bits, msgs = c
         key = jax.random.fold_in(seed_key, start_tick + i)
         bits, msgs = seq_sync_step(bits, msgs, key, cfg.params)
-        converged = jnp.all(bits)
-        return (bits, msgs), (converged, jnp.mean(msgs.astype(jnp.float32)))
+        converged = jnp.all(
+            bits.reshape(S, cfg.n_nodes, cfg.n_seqs), axis=(1, 2)
+        )
+        m_mean = jnp.mean(
+            msgs.astype(jnp.float32).reshape(S, cfg.n_nodes), axis=1
+        )
+        if cfg.n_universes is None:  # legacy scalar outputs (vmap path)
+            converged, m_mean = converged[0], m_mean[0]
+        return (bits, msgs), (converged, m_mean)
 
     return jax.lax.scan(body, carry, jnp.arange(cfg.chunk_ticks))
 
 
 def run_anti_entropy_seeds(cfg: AntiEntropyConfig, n_seeds: int = 16,
                            seed: int = 0):
-    """Vmapped multi-universe run; convergence distribution stats."""
-    keys = jax.random.split(jax.random.PRNGKey(seed), n_seeds)
-    bits, msgs = anti_entropy_init(cfg)
-    carry = (
-        jnp.broadcast_to(bits, (n_seeds,) + bits.shape),
-        jnp.broadcast_to(msgs, (n_seeds,) + msgs.shape),
-    )
-    chunk = jax.vmap(
-        lambda c, k, t: _scan_chunk(c, k, t, cfg), in_axes=(0, 0, None)
-    )
+    """Multi-universe run (seed-flattened); convergence stats."""
+    from dataclasses import replace
+
+    flat_cfg = replace(cfg, n_universes=n_seeds)
+    key = jax.random.PRNGKey(seed)
+    carry = anti_entropy_init(flat_cfg)
 
     t0 = time.perf_counter()
     flags, means = [], []
     ticks_done = 0
     while ticks_done < cfg.max_ticks:
-        carry, (conv, m_mean) = chunk(carry, keys, ticks_done)
-        conv = np.asarray(conv)  # [S, C]
+        carry, (conv, m_mean) = _scan_chunk(carry, key, ticks_done, flat_cfg)
+        conv = np.asarray(conv).T  # scan stacks [C, S] -> [S, C]
         flags.append(conv)
-        means.append(np.asarray(m_mean))
+        means.append(np.asarray(m_mean).T)
         ticks_done += cfg.chunk_ticks
         if conv[:, -1].all():
             break

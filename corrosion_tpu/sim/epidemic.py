@@ -25,7 +25,7 @@ TPU design notes:
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from functools import partial
 from typing import NamedTuple, Optional
 
@@ -61,6 +61,12 @@ class EpidemicConfig:
     # model the agents' per-payload sent_to exclusion exactly ([N, N]
     # memory — calibration-scale only; see broadcast_step's sent arg)
     track_sent: bool = False
+    # infection-depth (hop) tracking: needed by the sim-vs-agent
+    # calibration (simdiff) but not by the convergence metrics; the
+    # scatter-min it needs lowers to a slow serialized path on TPU at
+    # 100k nodes (~80% of the headline tick), so large-N configs whose
+    # outputs don't include hops turn it off
+    track_hops: bool = True
     # anti-entropy cadence (0 = disabled)
     sync_interval: int = 8
     sync_peers: int = 1
@@ -68,24 +74,38 @@ class EpidemicConfig:
     max_ticks: int = 256
     chunk_ticks: int = 16  # scan chunk between host convergence checks
 
+    # seed-flattening (models/common.py): S universes of n_nodes laid
+    # side by side in one flat index space; None = single universe
+    n_universes: Optional[int] = None
+
+    @property
+    def flat_nodes(self) -> int:
+        return self.n_nodes * (self.n_universes or 1)
+
+    @property
+    def _universe(self) -> Optional[int]:
+        return self.n_nodes if self.n_universes else None
+
     @property
     def broadcast_params(self) -> BroadcastParams:
         return BroadcastParams(
-            n_nodes=self.n_nodes,
+            n_nodes=self.flat_nodes,
             fanout_ring0=self.fanout_ring0,
             fanout_global=self.fanout_global,
             ring0_size=min(self.ring0_size, self.n_nodes),
             max_transmissions=self.max_transmissions,
             loss=self.loss,
             backoff_ticks=self.backoff_ticks,
+            universe=self._universe,
         )
 
     @property
     def sync_params(self) -> SyncParams:
         return SyncParams(
-            n_nodes=self.n_nodes,
+            n_nodes=self.flat_nodes,
             peers_per_round=self.sync_peers,
             cells_per_chunk=self.cells_per_chunk,
+            universe=self._universe,
         )
 
 
@@ -94,7 +114,9 @@ class EpidemicState(NamedTuple):
     tx_remaining: jnp.ndarray  # [N] int32
     msgs: jnp.ndarray  # [N] int32
     tick: jnp.ndarray  # scalar int32
-    hops: jnp.ndarray  # [N] int32 infection depth (HOP_UNSET = not yet)
+    # [N] int32 infection depth (HOP_UNSET = not yet); None when
+    # cfg.track_hops is off
+    hops: Optional[jnp.ndarray]
     next_send: jnp.ndarray  # [N] int32 earliest tick of the next send
     # [N, N] bool when cfg.track_sent, else None (a jnp default here
     # would initialize the JAX backend at import time)
@@ -102,10 +124,10 @@ class EpidemicState(NamedTuple):
 
 
 def epidemic_init(cfg: EpidemicConfig, writer: int = 0) -> EpidemicState:
-    """All nodes at the base state; the writer holds one committed
-    changeset (col_version 2) ready to broadcast."""
+    """All nodes at the base state; each universe's writer holds one
+    committed changeset (col_version 2) ready to broadcast."""
     codec = DEFAULT_CODEC
-    n, r = cfg.n_nodes, cfg.n_rows
+    n, r = cfg.flat_nodes, cfg.n_rows
     base = codec.pack(
         jnp.ones((n, r), jnp.int32),
         jnp.ones((n, r), jnp.int32),
@@ -116,14 +138,22 @@ def epidemic_init(cfg: EpidemicConfig, writer: int = 0) -> EpidemicState:
         jnp.full((r,), 2, jnp.int32),
         jnp.ones((r,), jnp.int32),
     )
-    rows = base.at[writer].set(news)
-    tx = jnp.zeros((n,), jnp.int32).at[writer].set(cfg.max_transmissions)
+    # one writer per universe at the same local offset
+    writers = (
+        writer
+        + jnp.arange(cfg.n_universes or 1, dtype=jnp.int32) * cfg.n_nodes
+    )
+    rows = base.at[writers].set(news)
+    tx = jnp.zeros((n,), jnp.int32).at[writers].set(cfg.max_transmissions)
     return EpidemicState(
         rows=rows,
         tx_remaining=tx,
         msgs=jnp.zeros((n,), jnp.int32),
         tick=jnp.zeros((), jnp.int32),
-        hops=jnp.full((n,), HOP_UNSET, jnp.int32).at[writer].set(0),
+        hops=(
+            jnp.full((n,), HOP_UNSET, jnp.int32).at[writers].set(0)
+            if cfg.track_hops else None
+        ),
         next_send=jnp.zeros((n,), jnp.int32),
         sent=jnp.zeros((n, n), bool) if cfg.track_sent else None,
     )
@@ -132,11 +162,8 @@ def epidemic_init(cfg: EpidemicConfig, writer: int = 0) -> EpidemicState:
 def _partition_ids(cfg: EpidemicConfig):
     if cfg.partition_blocks <= 1:
         return None
-    return (
-        jnp.arange(cfg.n_nodes, dtype=jnp.int32)
-        * cfg.partition_blocks
-        // cfg.n_nodes
-    )
+    local = jnp.arange(cfg.flat_nodes, dtype=jnp.int32) % cfg.n_nodes
+    return local * cfg.partition_blocks // cfg.n_nodes
 
 
 def epidemic_tick(state: EpidemicState, key, cfg: EpidemicConfig) -> EpidemicState:
@@ -182,27 +209,48 @@ def epidemic_tick(state: EpidemicState, key, cfg: EpidemicConfig) -> EpidemicSta
 
 @partial(jax.jit, static_argnames=("cfg",))
 def _scan_chunk(state: EpidemicState, seed_key, target_row, cfg: EpidemicConfig):
-    """Run cfg.chunk_ticks rounds; record per-tick convergence flags."""
+    """Run cfg.chunk_ticks rounds; record per-tick convergence flags.
+
+    In flat (seed-flattened) mode every per-tick statistic comes back
+    per-universe with shape [S]; in single-universe mode they are
+    scalars (the legacy vmap path)."""
+    S = cfg.n_universes
+
+    def per_universe(x):
+        """[flat_nodes]-shaped stat -> [S, n_nodes] (or [1, n] unflat)."""
+        return x.reshape((S or 1), cfg.n_nodes)
 
     def body(st, _):
         key = jax.random.fold_in(seed_key, st.tick)
         nxt = epidemic_tick(st, key, cfg)
-        converged = jnp.all(nxt.rows == target_row[None, :])
+        conv = jnp.all(
+            nxt.rows.reshape((S or 1), cfg.n_nodes, cfg.n_rows)
+            == target_row[None, None, :],
+            axis=(1, 2),
+        )
         # per-tick message aggregates so per-seed stats can be read at the
         # seed's OWN convergence tick, not at global loop stop
-        msgs_f = nxt.msgs.astype(jnp.float32)
-        # infection depth; nodes healed by sync (never infected via
-        # broadcast) report as max_ticks so loss shows up, not hides
-        hops_f = jnp.where(
-            nxt.hops >= HOP_UNSET, jnp.int32(cfg.max_ticks), nxt.hops
-        ).astype(jnp.float32)
-        return nxt, (
-            converged,
-            jnp.mean(msgs_f),
-            jnp.percentile(msgs_f, 99),
-            jnp.percentile(hops_f, 50),
-            jnp.percentile(hops_f, 99),
+        msgs_f = per_universe(nxt.msgs.astype(jnp.float32))
+        if nxt.hops is not None:
+            # infection depth; nodes healed by sync (never infected via
+            # broadcast) report as max_ticks so loss shows up, not hides
+            hops_f = per_universe(jnp.where(
+                nxt.hops >= HOP_UNSET, jnp.int32(cfg.max_ticks), nxt.hops
+            ).astype(jnp.float32))
+            h50 = jnp.percentile(hops_f, 50, axis=1)
+            h99 = jnp.percentile(hops_f, 99, axis=1)
+        else:  # hops untracked: report the "never infected" sentinel
+            h50 = h99 = jnp.full(((S or 1),), cfg.max_ticks, jnp.float32)
+        stats = (
+            conv,
+            jnp.mean(msgs_f, axis=1),
+            jnp.percentile(msgs_f, 99, axis=1),
+            h50,
+            h99,
         )
+        if S is None:  # legacy scalar outputs for the vmap path
+            stats = tuple(x[0] for x in stats)
+        return nxt, stats
 
     return jax.lax.scan(body, state, xs=None, length=cfg.chunk_ticks)
 
@@ -229,15 +277,56 @@ def run_epidemic(cfg: EpidemicConfig, seed: int = 0):
 
 
 def run_epidemic_seeds(cfg: EpidemicConfig, n_seeds: int = 16, seed: int = 0):
-    """Vmapped multi-seed run; returns convergence distribution stats.
+    """Multi-seed run; returns convergence distribution stats.
 
     The scan advances all universes together in chunks; the host loop
     stops as soon as every universe has converged (or max_ticks hit).
+
+    Seed-flattening: the S universes are laid side by side in one flat
+    [S*N] index space (block-local peer draws) instead of being vmapped
+    — batched scatter serializes on TPU, and the flat layout turns the
+    tick's scatters into single unbatched ops (measured ~70x faster at
+    N=100k).  Only ``track_sent`` (the [N, N] calibration mode) still
+    uses the legacy vmap path.
     """
-    keys = jax.random.split(jax.random.PRNGKey(seed), n_seeds)
-    init = epidemic_init(cfg)
+    if cfg.track_sent:
+        return _run_epidemic_seeds_vmap(cfg, n_seeds, seed)
+    flat_cfg = replace(cfg, n_universes=n_seeds)
+    key = jax.random.PRNGKey(seed)
+    init = epidemic_init(flat_cfg)
     # convergence target = the writer's committed state (the join of all
     # writes in this single-writer scenario)
+    target = init.rows[0]
+
+    t0 = time.perf_counter()
+    flags, means, p99s = [], [], []  # each: list of [S, C] arrays
+    h50s, h99s = [], []
+    ticks_done = 0
+    state = init
+    while ticks_done < cfg.max_ticks:
+        state, (conv, m_mean, m_p99, h_p50, h_p99) = _scan_chunk(
+            state, key, target, flat_cfg
+        )
+        conv = np.asarray(conv).T  # scan stacks [C, S] -> [S, C]
+        flags.append(conv)
+        means.append(np.asarray(m_mean).T)
+        p99s.append(np.asarray(m_p99).T)
+        h50s.append(np.asarray(h_p50).T)
+        h99s.append(np.asarray(h_p99).T)
+        ticks_done += cfg.chunk_ticks
+        if conv[:, -1].all():
+            break
+    wall = time.perf_counter() - t0
+    return _epidemic_stats(
+        cfg, n_seeds, flags, means, p99s, h50s, h99s, wall, ticks_done
+    )
+
+
+def _run_epidemic_seeds_vmap(cfg: EpidemicConfig, n_seeds: int, seed: int):
+    """Legacy vmapped multi-seed path (required by track_sent's [N, N]
+    per-universe memory; calibration-scale only)."""
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_seeds)
+    init = epidemic_init(cfg)
     target = init.rows[0]
     states = jax.tree.map(
         lambda x: jnp.broadcast_to(x, (n_seeds,) + x.shape), init
@@ -265,7 +354,14 @@ def run_epidemic_seeds(cfg: EpidemicConfig, n_seeds: int = 16, seed: int = 0):
         if conv[:, -1].all():
             break
     wall = time.perf_counter() - t0
+    return _epidemic_stats(
+        cfg, n_seeds, flags, means, p99s, h50s, h99s, wall, ticks_done
+    )
 
+
+def _epidemic_stats(cfg, n_seeds, flags, means, p99s, h50s, h99s, wall,
+                    ticks_done):
+    """Fold per-chunk [S, C] stat arrays into the result dict."""
     allflags = np.concatenate(flags, axis=1)  # [S, T]
     allmeans = np.concatenate(means, axis=1)
     allp99s = np.concatenate(p99s, axis=1)
@@ -281,8 +377,14 @@ def run_epidemic_seeds(cfg: EpidemicConfig, n_seeds: int = 16, seed: int = 0):
         "ticks_p99": float(np.percentile(first, 99)),
         "msgs_per_node_mean": float(allmeans[rows, first_idx].mean()),
         "msgs_per_node_p99": float(allp99s[rows, first_idx].mean()),
-        "hops_p50": float(allh50s[rows, first_idx].mean()),
-        "hops_p99": float(allh99s[rows, first_idx].mean()),
+        "hops_p50": (
+            float(allh50s[rows, first_idx].mean())
+            if cfg.track_hops else None
+        ),
+        "hops_p99": (
+            float(allh99s[rows, first_idx].mean())
+            if cfg.track_hops else None
+        ),
         "wall_s": wall,
         "ticks_run": ticks_done,
     }

@@ -98,3 +98,46 @@ def test_loss_slows_but_does_not_stop():
         if bool(jnp.all(rows == news[None, :])):
             break
     assert bool(jnp.all(rows == news[None, :]))
+
+
+def test_prime_n_ring0_fallback_still_spreads():
+    """A node count with no useful divisor <= ring0_size (e.g. prime)
+    must not degenerate ring0 columns into self-sends: the sliding-
+    window fallback keeps the tier delivering (_perm_senders)."""
+    n = 97  # prime: largest divisor <= 16 is 1
+    p = BroadcastParams(n_nodes=n, fanout_ring0=2, fanout_global=0,
+                        ring0_size=16, max_transmissions=8)
+    rows, news = _init(n)
+    tx = jnp.zeros((n,), jnp.int32).at[0].set(p.max_transmissions)
+    msgs = jnp.zeros((n,), jnp.int32)
+    key = jax.random.PRNGKey(5)
+    for t in range(8):
+        rows, tx, msgs, *_ = broadcast_step(
+            rows, tx, msgs, jax.random.fold_in(key, t), p)
+    infected = int((rows == news[None, :]).all(axis=1).sum())
+    assert infected > 1, "ring0-only fanout at prime n must still spread"
+
+
+def test_active_sender_with_unset_hops_still_delivers():
+    """The packed activity/hop field must not conflate 'uninfected' with
+    'inactive': a sender granted tx budget while its hop depth is the
+    HOP_UNSET sentinel (e.g. healed by sync, then rebroadcasting) still
+    delivers; receivers record a clamped 'unknown depth'."""
+    from corrosion_tpu.models.broadcast import HOP_UNSET
+
+    n = 64
+    p = BroadcastParams(n_nodes=n, fanout_ring0=0, fanout_global=3,
+                        ring0_size=1, max_transmissions=4)
+    rows, news = _init(n)
+    tx = jnp.zeros((n,), jnp.int32).at[0].set(p.max_transmissions)
+    msgs = jnp.zeros((n,), jnp.int32)
+    hops = jnp.full((n,), HOP_UNSET, jnp.int32)  # writer's hop UNSET too
+    key = jax.random.PRNGKey(6)
+    for t in range(40):
+        step = broadcast_step(
+            rows, tx, msgs, jax.random.fold_in(key, t), p, hops=hops)
+        rows, tx, msgs, hops = (
+            step.rows, step.tx_remaining, step.msgs_sent, step.hops)
+        if bool(jnp.all(rows == news[None, :])):
+            break
+    assert bool(jnp.all(rows == news[None, :])), "delivery must not stall"
