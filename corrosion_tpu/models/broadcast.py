@@ -31,7 +31,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from corrosion_tpu.models.common import block_peers, partition_ok, rand_peers
+from corrosion_tpu.models.common import partition_ok
 
 
 @dataclass(frozen=True)
@@ -58,20 +58,6 @@ class BroadcastParams:
     @property
     def fanout(self) -> int:
         return self.fanout_ring0 + self.fanout_global
-
-
-def _draw_targets(key, params: BroadcastParams):
-    """[N, K] target draw: ring0 block neighbors first, then global."""
-    n = params.n_nodes
-    key_r, key_g = jax.random.split(key)
-    ring0_targets = block_peers(
-        key_r, n, (n, params.fanout_ring0), params.ring0_size,
-        universe=params.universe,
-    )
-    global_targets = rand_peers(
-        key_g, n, (n, params.fanout_global), universe=params.universe
-    )
-    return jnp.concatenate([ring0_targets, global_targets], axis=1)
 
 
 # sentinel hop depth for "not yet infected" (far above any real depth)

@@ -25,6 +25,10 @@ def _local_base(n: int, shape, universe: Optional[int]):
     )
     if universe is None:
         return rows, 0, n
+    if n % universe:
+        # a partial trailing block would draw out-of-range peers that
+        # gather-clamping silently folds back onto self
+        raise ValueError(f"universe {universe} must divide n_nodes {n}")
     return rows % universe, rows - rows % universe, universe
 
 
@@ -38,17 +42,6 @@ def rand_peers(key, n: int, shape, universe: Optional[int] = None):
     """
     local, base, u = _local_base(n, shape, universe)
     offs = jax.random.randint(key, shape, 1, max(u, 2))
-    return base + (local + offs) % u
-
-
-def block_peers(key, n: int, shape, block: int,
-                universe: Optional[int] = None):
-    """Random peers within a contiguous index block of ``block`` neighbors
-    (offsets 1..block inclusive, capped at the universe width), never
-    self."""
-    local, base, u = _local_base(n, shape, universe)
-    hi = min(block, u - 1) if u > 1 else 1
-    offs = jax.random.randint(key, shape, 1, hi + 1)
     return base + (local + offs) % u
 
 

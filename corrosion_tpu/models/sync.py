@@ -180,7 +180,14 @@ def seq_sync_step(bits, msgs_sent, key, params: SeqSyncParams):
     dropped = (
         jax.random.uniform(k_drop, (n, p, budget)) < params.loss
     )  # [N, P, B]
-    arrived = served & ~jnp.take_along_axis(dropped, chunk_of, axis=2)
+    # expand each seq's chunk fate by a static select per chunk slot:
+    # take_along_axis lowers to a serialized per-element gather on TPU
+    # (measured ~20x the whole rest of the round); budget is tiny and
+    # static, so B elementwise selects replace it
+    drop_of = jnp.zeros_like(served)
+    for b in range(budget):
+        drop_of |= (chunk_of == b) & dropped[:, :, b][:, :, None]
+    arrived = served & ~drop_of
     new_bits = bits | jnp.any(arrived, axis=1)
 
     chunks = -(-jnp.sum(served, axis=2) // spc)  # [N, P] ceil

@@ -80,8 +80,6 @@ def _scan_chunk(carry, seed_key, start_tick, cfg: AntiEntropyConfig):
         m_mean = jnp.mean(
             msgs.astype(jnp.float32).reshape(S, cfg.n_nodes), axis=1
         )
-        if cfg.n_universes is None:  # legacy scalar outputs (vmap path)
-            converged, m_mean = converged[0], m_mean[0]
         return (bits, msgs), (converged, m_mean)
 
     return jax.lax.scan(body, carry, jnp.arange(cfg.chunk_ticks))

@@ -36,7 +36,10 @@ class ChurnConfig:
     revive_tick: int = 40  # when it comes back
     victim: int = 1
     max_ticks: int = 128
-    chunk_ticks: int = 8
+    # bigger chunks = fewer host sync points: each chunk call pays a
+    # fixed dispatch cost that dwarfs the N=64 compute, and per-tick
+    # flags keep the reported latencies exact either way
+    chunk_ticks: int = 32
 
     def __post_init__(self):
         if self.params is None:

@@ -233,9 +233,12 @@ def _scan_chunk(state: EpidemicState, seed_key, target_row, cfg: EpidemicConfig)
         msgs_f = per_universe(nxt.msgs.astype(jnp.float32))
         if nxt.hops is not None:
             # infection depth; nodes healed by sync (never infected via
-            # broadcast) report as max_ticks so loss shows up, not hides
+            # broadcast) report as max_ticks so loss shows up, not
+            # hides.  >= HOP_UNSET-1 also catches the perm path's
+            # clamped "delivered by a sender of unknown depth" value
             hops_f = per_universe(jnp.where(
-                nxt.hops >= HOP_UNSET, jnp.int32(cfg.max_ticks), nxt.hops
+                nxt.hops >= HOP_UNSET - 1, jnp.int32(cfg.max_ticks),
+                nxt.hops
             ).astype(jnp.float32))
             h50 = jnp.percentile(hops_f, 50, axis=1)
             h99 = jnp.percentile(hops_f, 99, axis=1)

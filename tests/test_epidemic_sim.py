@@ -71,3 +71,22 @@ def test_churn_detection_and_rejoin():
     assert stats["detect_latency"] is not None and stats["detect_latency"] > 0
     assert stats["rejoin_latency"] is not None and stats["rejoin_latency"] >= 0
     assert stats["msgs_per_node_mean"] > 0
+
+
+def test_track_hops_off_converges_with_null_hop_stats():
+    """track_hops=False (the large-N knob) must run the whole pipeline
+    without a hops array and report hop stats as None."""
+    cfg = EpidemicConfig(
+        n_nodes=256,
+        n_rows=4,
+        ring0_size=16,
+        max_transmissions=4,
+        sync_interval=0,
+        max_ticks=48,
+        chunk_ticks=8,
+        track_hops=False,
+    )
+    stats = run_epidemic_seeds(cfg, n_seeds=4, seed=2)
+    assert stats["converged_frac"] == 1.0
+    assert stats["hops_p50"] is None and stats["hops_p99"] is None
+    assert stats["msgs_per_node_mean"] > 0
