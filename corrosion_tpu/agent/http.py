@@ -88,7 +88,29 @@ def _make_handler(agent: "Agent"):
 
         # -- routes ----------------------------------------------------
 
+        _ENDPOINTS = (
+            "/v1/transactions", "/v1/queries", "/v1/migrations",
+            "/v1/subscriptions", "/v1/updates", "/v1/table_stats",
+            "/v1/members", "/metrics",
+        )
+
+        def _count_request(self) -> None:
+            # label values must stay bounded AND server-chosen: raw
+            # request paths would let an unauthenticated client mint
+            # unlimited series (and inject into the exposition)
+            path = self.path.split("?")[0]
+            for ep in self._ENDPOINTS:
+                if path == ep or path.startswith(ep + "/"):
+                    agent.metrics.counter(
+                        "corro_http_requests_total", endpoint=ep
+                    )
+                    return
+            agent.metrics.counter(
+                "corro_http_requests_total", endpoint="other"
+            )
+
         def do_POST(self):
+            self._count_request()
             if not self._authorized():
                 return self._json(401, {"error": "unauthorized"})
             try:
@@ -110,6 +132,7 @@ def _make_handler(agent: "Agent"):
                     pass
 
         def do_GET(self):
+            self._count_request()
             if not self._authorized():
                 return self._json(401, {"error": "unauthorized"})
             try:

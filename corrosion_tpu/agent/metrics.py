@@ -57,9 +57,18 @@ class Metrics:
     def render(self, extra_gauges: Iterable[Tuple[str, float, dict]] = ()) -> str:
         out: List[str] = []
 
+        def esc(val) -> str:
+            # Prometheus label-value escaping: backslash, quote, newline
+            return (
+                str(val)
+                .replace("\\", "\\\\")
+                .replace('"', '\\"')
+                .replace("\n", "\\n")
+            )
+
         def fmt(name: str, key: LabelKV, v: float, suffix: str = "") -> str:
             if key:
-                lbl = ",".join(f'{k}="{val}"' for k, val in key)
+                lbl = ",".join(f'{k}="{esc(val)}"' for k, val in key)
                 return f"{name}{suffix}{{{lbl}}} {v}"
             return f"{name}{suffix} {v}"
 

@@ -419,6 +419,7 @@ class Agent:
                 # anything bigger belongs on a uni-stream
                 self.metrics.counter("corro_udp_oversize_dropped_total")
                 return
+            self.metrics.counter("corro_gossip_datagrams_sent_total")
             self._udp.sendto(data, tuple(addr))
 
     async def _announce_loop(self) -> None:
@@ -1226,6 +1227,33 @@ class Agent:
                             f"PRAGMA incremental_vacuum({freelist // 2})"
                         )
                         self.metrics.counter("corro_db_vacuums")
+                    # db-size gauges (agent/metrics.rs:18-108 set)
+                    page_count, page_size = (
+                        self.storage.conn.execute(
+                            "PRAGMA page_count"
+                        ).fetchone()[0],
+                        self.storage.conn.execute(
+                            "PRAGMA page_size"
+                        ).fetchone()[0],
+                    )
+                    self.metrics.gauge(
+                        "corro_db_size_bytes", page_count * page_size
+                    )
+                    self.metrics.gauge("corro_db_freelist_pages", freelist)
+                    if wal_pages is not None:
+                        self.metrics.gauge(
+                            "corro_db_wal_pages", wal_pages
+                        )
+                # queue-depth gauges (channel.rs:53-95 metered channels)
+                self.metrics.gauge(
+                    "corro_change_queue_depth", len(self._ingest)
+                )
+                self.metrics.gauge(
+                    "corro_bcast_queue_depth", self._bcast_queue.qsize()
+                )
+                self.metrics.gauge(
+                    "corro_members_ring0", len(self.members.ring0())
+                )
             except Exception:
                 pass
 
@@ -1769,6 +1797,10 @@ class Agent:
                           sess: Optional[dict] = None) -> None:
         bv = self.bookie.for_actor(actor)
         kind = need.kind
+        self.metrics.counter(
+            "corro_sync_needs_served_total",
+            kind=kind if kind in ("full", "partial", "empty") else "other",
+        )
         if kind == "full":
             s, e = need.versions
             # clamp hostile/stale ranges to what we can possibly serve
@@ -1925,6 +1957,12 @@ class Agent:
 # ---------------------------------------------------------------------------
 
 
+_SWIM_KINDS = frozenset(
+    ("announce", "announce_ack", "probe", "ack", "ping_req",
+     "probe_relay", "change")
+)
+
+
 class _UdpProtocol(asyncio.DatagramProtocol):
     def __init__(self, agent: Agent):
         self.agent = agent
@@ -1942,6 +1980,12 @@ class _UdpProtocol(asyncio.DatagramProtocol):
             a.metrics.counter("corro_swim_cluster_rejected_total")
             return
         kind = msg.get("k")
+        a.metrics.counter(
+            "corro_gossip_datagrams_received_total",
+            # remote-supplied: clamp to the known protocol kinds so a
+            # hostile peer can't mint unbounded series
+            kind=kind if kind in _SWIM_KINDS else "other",
+        )
         if kind == "announce":
             a._ingest_piggyback(msg.get("pb", []))
             a._send_udp(addr, {"k": "announce_ack", "pb": a._piggyback(10)})

@@ -8,6 +8,7 @@ detection and automatic re-attach via ``from=``).
 
 from __future__ import annotations
 
+import http.client
 import json
 import time
 import urllib.error
@@ -73,6 +74,7 @@ class SubscriptionStream:
 class CorrosionApiClient:
     def __init__(self, addr: Tuple[str, int], token: Optional[str] = None,
                  timeout: float = 30.0):
+        self.addr = tuple(addr)
         self.base = f"http://{addr[0]}:{addr[1]}"
         self.token = token
         self.timeout = timeout
@@ -171,3 +173,90 @@ class CorrosionApiClient:
         with resp:
             for raw in resp:
                 yield json.loads(raw)
+
+
+class PooledApiClient:
+    """DNS-resolving, failover-aware API client.
+
+    Parity with ``CorrosionPooledClient`` (corro-client/src/lib.rs, the
+    hickory-resolving pooled client): a hostname is resolved to its full
+    address set, requests go to the current pick, a connection-level
+    failure rotates to the next address and marks the bad one, and the
+    name is re-resolved once `ttl` expires or every address has failed.
+    """
+
+    def __init__(self, host: str, port: int, token: Optional[str] = None,
+                 timeout: float = 30.0, ttl: float = 30.0,
+                 resolver=None):
+        self.host, self.port = host, port
+        self.token, self.timeout, self.ttl = token, timeout, ttl
+        self._resolve = resolver or self._dns_resolve
+        self._addrs: List[str] = []
+        self._bad: set = set()
+        self._pick = 0
+        self._resolved_at = 0.0
+
+    def _dns_resolve(self, host: str) -> List[str]:
+        import socket
+
+        infos = socket.getaddrinfo(host, self.port, type=socket.SOCK_STREAM)
+        # stable order so rotation is deterministic across re-resolves
+        return sorted({i[4][0] for i in infos})
+
+    def _addresses(self) -> List[str]:
+        now = time.time()
+        stale = now - self._resolved_at > self.ttl
+        if not self._addrs or stale or self._bad >= set(self._addrs):
+            self._addrs = list(self._resolve(self.host))
+            self._resolved_at = now
+            self._bad.clear()
+            if not self._addrs:
+                raise ClientError(0, f"no addresses for {self.host}")
+        return self._addrs
+
+    def client(self) -> CorrosionApiClient:
+        """The client for the currently-picked healthy address.
+        ``_addresses()`` re-resolves (and clears the bad set) whenever
+        every known address has been marked bad, so the scan below
+        always finds a usable one."""
+        addrs = self._addresses()
+        for _ in range(len(addrs)):
+            addr = addrs[self._pick % len(addrs)]
+            if addr not in self._bad:
+                return CorrosionApiClient(
+                    (addr, self.port), token=self.token, timeout=self.timeout
+                )
+            self._pick += 1
+        raise AssertionError("unreachable: _addresses() clears full bad sets")
+
+    # connection-level failures that mark an address bad and rotate;
+    # mid-stream deaths surface as raw socket/http errors, not ClientError
+    _FAILOVER_ERRORS = (ClientError, OSError, TimeoutError,
+                        http.client.HTTPException)
+
+    def _with_failover(self, fn):
+        last: Optional[Exception] = None
+        for _ in range(max(2, len(self._addresses()) + 1)):
+            c = self.client()
+            try:
+                return fn(c)
+            except self._FAILOVER_ERRORS as e:
+                if isinstance(e, ClientError) and e.status != 0:
+                    raise  # an HTTP answer: the node is up
+                host = c.addr[0]
+                self._bad.add(host)
+                self._pick += 1
+                last = e
+        raise last  # type: ignore[misc]
+
+    def execute(self, statements: Sequence) -> dict:
+        return self._with_failover(lambda c: c.execute(statements))
+
+    def query(self, statement) -> Tuple[List[str], List[list]]:
+        return self._with_failover(lambda c: c.query(statement))
+
+    def table_stats(self) -> dict:
+        return self._with_failover(lambda c: c.table_stats())
+
+    def members(self) -> dict:
+        return self._with_failover(lambda c: c.members())

@@ -315,6 +315,10 @@ def test_metrics_endpoint_and_backoff(run):
             assert "corro_changes_received_total" in text
             assert 'corro_table_rows{table="tests"} 1.0' in text
             assert "corro_members_alive 1.0" in text
+            # per-kind gossip counters + endpoint-labeled HTTP counters
+            assert 'corro_gossip_datagrams_received_total{kind="' in text
+            assert "corro_gossip_datagrams_sent_total" in text
+            assert 'corro_http_requests_total{endpoint="/metrics"}' in text
         finally:
             await b.stop()
             await a.stop()
@@ -397,6 +401,51 @@ def test_named_param_statements(run):
                 ["SELECT text FROM tests WHERE id = :id", {"id": 7}]
             )
             assert rows == [["named"]]
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pooled_client_failover_and_reresolve(run):
+    """PooledApiClient (corro-client's DNS-pooled client): a dead
+    address is marked bad and the next one serves; exhausting every
+    address forces a re-resolve."""
+    async def main():
+        a = await launch_test_agent()
+        try:
+            from corrosion_tpu.client import PooledApiClient
+
+            live = a.api_addr
+            resolutions = []
+
+            def resolver(host):
+                # first resolution: a dead addr sorted before the live
+                # one; later resolutions: only the live addr
+                resolutions.append(host)
+                if len(resolutions) == 1:
+                    return ["127.1.2.3", live[0]]
+                return [live[0]]
+
+            pc = PooledApiClient("cluster.test", live[1], timeout=2.0,
+                                 ttl=3600.0, resolver=resolver)
+
+            def do_query():
+                return pc.query("SELECT count(*) FROM tests")
+
+            cols, rows = await asyncio.to_thread(do_query)
+            assert rows == [[0]]
+            assert resolutions == ["cluster.test"]  # one resolve so far
+            # the dead address is remembered as bad: the next call goes
+            # straight to the live node (no retry loop)
+            _, rows = await asyncio.to_thread(do_query)
+            assert rows == [[0]]
+
+            # every address bad -> re-resolve
+            pc._bad = set(pc._addrs)
+            _, rows = await asyncio.to_thread(do_query)
+            assert rows == [[0]]
+            assert len(resolutions) == 2
         finally:
             await a.stop()
 
