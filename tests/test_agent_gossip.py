@@ -353,3 +353,65 @@ def test_cluster_set_id_detaches_node(run):
             await a.stop()
 
     run(main())
+
+
+def test_failed_changes_do_not_poison_the_batch(run):
+    """A changeset mixing unapplyable changes (unknown table) with good
+    ones applies the good rows, books the version so it is never
+    re-fetched, and leaves the agent healthy (agent/tests.rs
+    process_failed_changes)."""
+    async def main():
+        from corrosion_tpu.agent.pack import pack_values
+        from corrosion_tpu.types import (
+            ActorId, ChangeSource, ChangeV1, Changeset,
+        )
+        from corrosion_tpu.types.base import CrsqlDbVersion, CrsqlSeq, Version
+        from corrosion_tpu.types.change import Change
+
+        a = await launch_test_agent()
+        b = await launch_test_agent(bootstrap=[addr_str(a)])
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+            fake_site = bytes(range(16))
+
+            def ch(i, table, pk_val, cid, val):
+                return Change(
+                    table=table, pk=pack_values([pk_val]), cid=cid, val=val,
+                    col_version=1, db_version=CrsqlDbVersion(1),
+                    seq=CrsqlSeq(i), site_id=fake_site, cl=1,
+                )
+
+            changes = [
+                ch(0, "no_such_table", 1, "text", "bad"),
+                ch(1, "tests", 77, "text", "good"),
+                ch(2, "no_such_table", 2, "text", "bad2"),
+            ]
+            cv = ChangeV1(
+                actor_id=ActorId(fake_site),
+                changeset=Changeset.full(
+                    Version(1), changes, (CrsqlSeq(0), CrsqlSeq(2)),
+                    CrsqlSeq(2), a.clock.new_timestamp(),
+                ),
+            )
+            a.enqueue_change(cv, ChangeSource.BROADCAST)
+            await wait_for(
+                lambda: a.storage.read_query(
+                    "SELECT text FROM tests WHERE id=77")[1] == [("good",)]
+            )
+            # the version is booked applied: no lingering need/partial
+            bv = a.bookie.for_actor(fake_site)
+            assert bv.contains_version(1) and bv.partials == {}
+            # the agent still takes local writes AND its broadcast path
+            # is intact: a fresh write must reach the live peer
+            a.execute_transaction(
+                [["INSERT INTO tests (id, text) VALUES (78, 'after')"]]
+            )
+            await wait_for(
+                lambda: b.storage.read_query(
+                    "SELECT text FROM tests WHERE id=78")[1] == [("after",)]
+            )
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())
