@@ -272,6 +272,9 @@ CREATE TABLE pg_index (
   indexrelid INTEGER, indrelid INTEGER, indisprimary INTEGER,
   indkey TEXT);
 CREATE TABLE pg_description (objoid INTEGER, description TEXT);
+CREATE TABLE pg_database (
+  oid INTEGER PRIMARY KEY, datname TEXT, datallowconn INTEGER DEFAULT 1);
+CREATE TABLE pg_range (rngtypid INTEGER PRIMARY KEY, rngsubtype INTEGER);
 -- information_schema (bare names: this db holds nothing else)
 CREATE TABLE tables (
   table_catalog TEXT, table_schema TEXT, table_name TEXT,
@@ -285,6 +288,7 @@ CREATE TABLE columns (
     cat.executemany("INSERT INTO pg_type VALUES (?, ?)", _PG_TYPE_ROWS)
     cat.execute("INSERT INTO pg_namespace VALUES (2200, 'public')")
     cat.execute("INSERT INTO pg_namespace VALUES (11, 'pg_catalog')")
+    cat.execute("INSERT INTO pg_database VALUES (1, 'corrosion', 1)")
     rel_oid = 16384
     for t in sorted(agent.storage.tables):
         _, info = agent.storage.read_query(f'PRAGMA table_info("{t}")')
@@ -405,6 +409,12 @@ class _Session:
                 1,
                 "SELECT 1",
             )
+        if low in (
+            "select current_database()", "select current_database();",
+        ):
+            return ["current_database"], [("corrosion",)], 1, "SELECT 1"
+        if low in ("select current_schema()", "select current_schema();"):
+            return ["current_schema"], [("public",)], 1, "SELECT 1"
         if low.startswith("set ") or low.startswith("reset "):
             return [], [], 0, "SET"
         if low.startswith("show "):

@@ -285,6 +285,16 @@ def test_pg_catalog_is_queryable(run):
                 assert rows == [
                     ["tests2", "id", "int8"], ["tests2", "text", "text"]
                 ]
+                # driver-startup probes: database list + identity funcs
+                _, rows, _, errs = c.query(
+                    "SELECT datname FROM pg_catalog.pg_database"
+                    " WHERE datallowconn = 1"
+                )
+                assert not errs and rows == [["corrosion"]]
+                _, rows, _, errs = c.query("SELECT current_database()")
+                assert not errs and rows == [["corrosion"]]
+                _, rows, _, errs = c.query("SELECT current_schema()")
+                assert not errs and rows == [["public"]]
                 c.close()
 
             await asyncio.to_thread(drive)
