@@ -257,7 +257,10 @@ def build_catalog(agent: "Agent"):
     """
     import sqlite3
 
+    from corrosion_tpu.agent.storage import register_udfs
+
     cat = sqlite3.connect(":memory:")
+    register_udfs(cat)  # current_database() etc. inside catalog queries
     cat.executescript(
         """
 CREATE TABLE pg_namespace (oid INTEGER PRIMARY KEY, nspname TEXT);
@@ -326,6 +329,12 @@ CREATE TABLE columns (
 
 _SCHEMA_PREFIX_RE = re.compile(
     r"\b(?:pg_catalog|information_schema)\s*\.\s*", re.IGNORECASE
+)
+
+# catalog tables routed even when referenced unqualified
+_CATALOG_TABLE_RE = re.compile(
+    r"\b(?:pg_database|pg_class|pg_namespace|pg_attribute|pg_type"
+    r"|pg_index|pg_description|pg_range)\b"
 )
 
 def _catalog_for(agent: "Agent"):
@@ -402,25 +411,22 @@ class _Session:
 
     def _canned(self, raw: str, params: Tuple = ()):
         low = " ".join(raw.lower().split())
-        if low in ("select version()", "select version();"):
-            return (
-                ["version"],
-                [("PostgreSQL 14.9 (corrosion-tpu sqlite CRDT)",)],
-                1,
-                "SELECT 1",
-            )
-        if low in (
-            "select current_database()", "select current_database();",
-        ):
-            return ["current_database"], [("corrosion",)], 1, "SELECT 1"
-        if low in ("select current_schema()", "select current_schema();"):
-            return ["current_schema"], [("public",)], 1, "SELECT 1"
+        # version()/current_database()/current_schema() are real SQL
+        # functions (storage.register_udfs), so they work in any
+        # expression context through the normal execution path
         if low.startswith("set ") or low.startswith("reset "):
             return [], [], 0, "SET"
         if low.startswith("show "):
             return ["setting"], [("",)], 1, "SELECT 1"
-        if "pg_catalog" in low or "information_schema" in low:
-            # run real catalog SQL against the rendered catalog
+        if (
+            "pg_catalog" in low
+            or "information_schema" in low
+            or _CATALOG_TABLE_RE.search(low)
+        ):
+            # run real catalog SQL against the rendered catalog —
+            # including unqualified references: pg_catalog is always on
+            # a real server's search_path, so drivers routinely write
+            # bare "FROM pg_database"
             tsql = _SCHEMA_PREFIX_RE.sub("", translate_sql(raw))
             cur = _catalog_for(self.agent).execute(tsql, params)
             cols = [d[0] for d in cur.description or []]
@@ -430,10 +436,24 @@ class _Session:
 
 
 async def serve_pg(agent: "Agent", host: str = "127.0.0.1", port: int = 0):
-    """Start the pgwire listener; returns the asyncio server."""
-    return await asyncio.start_server(
-        lambda r, w: _handle_conn(agent, r, w), host, port
-    )
+    """Start the pgwire listener; returns the asyncio server.
+
+    Live session writers are tracked on ``server.corro_conns`` so
+    shutdown can abort them: ``Server.wait_closed()`` waits for every
+    handler to return, and an idle client would otherwise hold
+    ``Agent.stop()`` open indefinitely."""
+    conns: set = set()
+
+    async def handler(r, w):
+        conns.add(w)
+        try:
+            await _handle_conn(agent, r, w)
+        finally:
+            conns.discard(w)
+
+    server = await asyncio.start_server(handler, host, port)
+    server.corro_conns = conns
+    return server
 
 
 async def _handle_conn(agent: "Agent", reader: asyncio.StreamReader,

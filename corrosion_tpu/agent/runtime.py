@@ -331,7 +331,20 @@ class Agent:
             await self._admin.wait_closed()
         if self._pg is not None:
             self._pg.close()
-            await self._pg.wait_closed()
+            # abort live sessions: wait_closed() waits for every
+            # handler, so an idle client would hold stop() forever.
+            # abort (not close): close() flushes first, and a peer
+            # that stopped reading would outlive the grace period and
+            # touch storage after it closes
+            for w in list(getattr(self._pg, "corro_conns", ())):
+                try:
+                    w.transport.abort()
+                except Exception:
+                    pass
+            try:
+                await asyncio.wait_for(self._pg.wait_closed(), timeout=2.0)
+            except asyncio.TimeoutError:
+                pass
         if self.subs is not None:
             self.subs.close()
         self._persist_members()

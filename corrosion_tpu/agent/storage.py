@@ -69,6 +69,21 @@ def register_udfs(conn: sqlite3.Connection) -> None:
     conn.create_function(
         "corro_json_contains", 2, _udf_json_contains, deterministic=True
     )
+    # PG-compat identity functions: drivers call these in arbitrary
+    # expression contexts ("SELECT current_database() AS name"), so they
+    # must exist as real functions, not canned string matches (the
+    # pgwire front-end routes such queries here; corro-pg parity)
+    conn.create_function(
+        "current_database", 0, lambda: "corrosion", deterministic=True
+    )
+    conn.create_function(
+        "current_schema", 0, lambda: "public", deterministic=True
+    )
+    conn.create_function(
+        "version", 0,
+        lambda: "PostgreSQL 14.9 (corrosion-tpu sqlite CRDT)",
+        deterministic=True,
+    )
 
 
 class CrConn:

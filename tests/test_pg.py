@@ -295,6 +295,16 @@ def test_pg_catalog_is_queryable(run):
                 assert not errs and rows == [["corrosion"]]
                 _, rows, _, errs = c.query("SELECT current_schema()")
                 assert not errs and rows == [["public"]]
+                # unqualified catalog names + expression contexts, the
+                # forms real driver/ORM startups actually send
+                _, rows, _, errs = c.query(
+                    "SELECT datname FROM pg_database WHERE datallowconn = 1"
+                )
+                assert not errs and rows == [["corrosion"]]
+                _, rows, _, errs = c.query(
+                    "SELECT current_database() AS name, current_schema()"
+                )
+                assert not errs and rows == [["corrosion", "public"]]
                 c.close()
 
             await asyncio.to_thread(drive)
@@ -346,5 +356,30 @@ def test_pg_bind_error_discards_until_sync(run):
             assert rows == [(1,)]
         finally:
             await a.stop()
+
+    run(main())
+
+
+def test_pg_stop_aborts_idle_sessions(run):
+    """Agent.stop() must not hang while a pgwire client sits idle on an
+    open session: wait_closed() waits for every handler, so shutdown
+    aborts live connections (the reference's tripwire teardown)."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        c = None
+        try:
+            def connect():
+                cl = PgClient(*a.pg_addr)
+                cl.query("SELECT 1")
+                return cl
+            c = await asyncio.to_thread(connect)
+        finally:
+            # the client is never closed: stop() must still return
+            await asyncio.wait_for(a.stop(), timeout=10)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:
+                pass
 
     run(main())
