@@ -99,6 +99,13 @@ def load_config(path: Optional[str] = None, **overrides) -> AgentConfig:
         else api.get("authorization"),
         subs_path=data.get("subscriptions", {}).get("path"),
     )
+    # [api.pg] addr = "host:port" (config.rs PgConfig): the PostgreSQL
+    # wire-protocol listener; None/absent = off
+    pg = api.get("pg")
+    if isinstance(pg, dict) and pg.get("addr"):
+        pg_host, pg_port = _split_addr(pg["addr"])
+        kwargs["pg_host"] = pg_host
+        kwargs["pg_port"] = pg_port
     # [gossip.tls] (config.rs TlsConfig: cert-file/key-file/ca-file/
     # insecure + [gossip.tls.client] cert-file/key-file/required)
     tls = gossip.get("tls", {})

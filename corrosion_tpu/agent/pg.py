@@ -418,10 +418,16 @@ class _Session:
             return [], [], 0, "SET"
         if low.startswith("show "):
             return ["setting"], [("",)], 1, "SELECT 1"
+        # unqualified catalog routing must not fire on string literals
+        # ("... WHERE note LIKE '%pg_class%'") and only reroutes reads
+        no_literals = re.sub(r"'[^']*'", "''", low)
         if (
-            "pg_catalog" in low
-            or "information_schema" in low
-            or _CATALOG_TABLE_RE.search(low)
+            "pg_catalog" in no_literals
+            or "information_schema" in no_literals
+            or (
+                no_literals.lstrip().startswith("select")
+                and _CATALOG_TABLE_RE.search(no_literals)
+            )
         ):
             # run real catalog SQL against the rendered catalog —
             # including unqualified references: pg_catalog is always on

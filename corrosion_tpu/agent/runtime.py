@@ -103,6 +103,7 @@ class AgentConfig:
     subs_path: Optional[str] = None
     admin_path: Optional[str] = None
     pg_port: Optional[int] = None  # PostgreSQL wire protocol (None = off)
+    pg_host: Optional[str] = None  # PG bind host (None = api_host)
     maintenance_interval: float = 60.0
     wal_truncate_pages: int = 250_000  # ~1 GB at 4 KiB pages
     vacuum_free_pages: int = 10_000
@@ -288,7 +289,9 @@ class Agent:
             from corrosion_tpu.agent.pg import serve_pg
 
             self._pg = await serve_pg(
-                self, self.config.api_host, self.config.pg_port
+                self,
+                self.config.pg_host or self.config.api_host,
+                self.config.pg_port,
             )
             self.pg_addr = self._pg.sockets[0].getsockname()[:2]
 
@@ -335,16 +338,22 @@ class Agent:
             # handler, so an idle client would hold stop() forever.
             # abort (not close): close() flushes first, and a peer
             # that stopped reading would outlive the grace period and
-            # touch storage after it closes
-            for w in list(getattr(self._pg, "corro_conns", ())):
+            # touch storage after it closes.  Two abort passes: a
+            # connection accepted just before close() can register in
+            # corro_conns after the first snapshot
+            for timeout in (1.0, 1.0):
+                for w in list(getattr(self._pg, "corro_conns", ())):
+                    try:
+                        w.transport.abort()
+                    except Exception:
+                        pass
                 try:
-                    w.transport.abort()
-                except Exception:
-                    pass
-            try:
-                await asyncio.wait_for(self._pg.wait_closed(), timeout=2.0)
-            except asyncio.TimeoutError:
-                pass
+                    await asyncio.wait_for(
+                        self._pg.wait_closed(), timeout=timeout
+                    )
+                    break
+                except asyncio.TimeoutError:
+                    continue
         if self.subs is not None:
             self.subs.close()
         self._persist_members()

@@ -450,3 +450,22 @@ def test_pooled_client_failover_and_reresolve(run):
             await a.stop()
 
     run(main())
+
+
+def test_config_api_pg_addr_enables_pg(tmp_path):
+    """[api.pg] addr in the TOML config wires up the PostgreSQL
+    listener (config.rs PgConfig parity)."""
+    from corrosion_tpu.agent.config import load_config
+
+    cfg = tmp_path / "c.toml"
+    cfg.write_text(
+        '[db]\npath = "x.db"\n'
+        '[api]\naddr = "127.0.0.1:0"\n'
+        '[api.pg]\naddr = "127.0.0.1:6543"\n'
+    )
+    c = load_config(str(cfg))
+    assert c.pg_port == 6543
+    # absent section leaves PG off
+    cfg2 = tmp_path / "c2.toml"
+    cfg2.write_text('[db]\npath = "x.db"\n')
+    assert load_config(str(cfg2)).pg_port is None
