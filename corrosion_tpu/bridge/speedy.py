@@ -59,6 +59,24 @@ class SpeedyError(ValueError):
     pass
 
 
+def _load_native():
+    from corrosion_tpu.native import load_or_none
+
+    return load_or_none()
+
+
+# the C extension, gated per feature so a stale build missing newer
+# entry points falls back to the Python twin for just those paths
+_native_mod = _load_native()
+_native = (
+    _native_mod
+    if _native_mod is not None
+    and hasattr(_native_mod, "speedy_encode_changes")
+    and hasattr(_native_mod, "speedy_decode_changes")
+    else None
+)
+
+
 # ---------------------------------------------------------------------------
 # primitive writer/reader
 # ---------------------------------------------------------------------------
@@ -262,6 +280,38 @@ def _r_change(r: Reader) -> Change:
 _CS_EMPTY, _CS_FULL, _CS_EMPTY_SET = 0, 1, 2
 
 
+def _w_changes(w: Writer, changes) -> None:
+    """The change-array hot loop: native when available (the C
+    extension packs the speedy layout directly), Python twin otherwise."""
+    if _native is not None:
+        try:
+            w.raw(_native.speedy_encode_changes(changes))
+        except (TypeError, OverflowError) as e:
+            # error-type parity with the Python twin's SpeedyError
+            raise SpeedyError(str(e)) from None
+        return
+    for c in changes:
+        _w_change(w, c)
+
+
+def _r_changes(r: Reader, count: int) -> List[Change]:
+    if _native is not None:
+        try:
+            tups, end = _native.speedy_decode_changes(r.data, r.pos, count)
+        except ValueError as e:
+            raise SpeedyError(str(e)) from None
+        r.pos = end
+        return [
+            Change(
+                table=t, pk=pk, cid=cid, val=val, col_version=cv,
+                db_version=CrsqlDbVersion(dv), seq=CrsqlSeq(sq),
+                site_id=site, cl=cl,
+            )
+            for t, pk, cid, val, cv, dv, sq, site, cl in tups
+        ]
+    return [_r_change(r) for _ in range(count)]
+
+
 def _w_changeset(w: Writer, cs: Changeset) -> None:
     if cs.kind is ChangesetKind.EMPTY:
         w.tag(_CS_EMPTY)
@@ -271,8 +321,7 @@ def _w_changeset(w: Writer, cs: Changeset) -> None:
         w.tag(_CS_FULL)
         w.u64(int(cs.version))
         w.u32(len(cs.changes))
-        for c in cs.changes:
-            _w_change(w, c)
+        _w_changes(w, cs.changes)
         w.u64(int(cs.seqs[0])).u64(int(cs.seqs[1]))
         w.u64(int(cs.last_seq))
         _w_ts(w, cs.ts)
@@ -293,7 +342,7 @@ def _r_changeset(r: Reader) -> Changeset:
         return Changeset.empty(versions, ts)
     if t == _CS_FULL:
         version = Version(r.u64())
-        changes = [_r_change(r) for _ in range(r.u32())]
+        changes = _r_changes(r, r.u32())
         seqs = (CrsqlSeq(r.u64()), CrsqlSeq(r.u64()))
         last_seq = CrsqlSeq(r.u64())
         ts = _r_ts(r)
@@ -564,15 +613,11 @@ def _py_deframe(buf: bytes) -> Tuple[List[bytes], bytes]:
     return frames, buf[pos:]
 
 
-from corrosion_tpu.native import load_or_none as _load_native
-
-_native = _load_native()
-
-if _native is not None:
+if _native_mod is not None and hasattr(_native_mod, "deframe"):
     def deframe(buf: bytes) -> Tuple[List[bytes], bytes]:
         """Native frame splitter (semantics pinned to :func:`_py_deframe`)."""
         try:
-            return _native.deframe(buf, MAX_FRAME_LEN)
+            return _native_mod.deframe(buf, MAX_FRAME_LEN)
         except ValueError as e:
             raise SpeedyError(str(e)) from None
 else:
