@@ -181,12 +181,7 @@ def _make_handler(agent: "Agent"):
         def _migrations(self):
             body = self._body()
             sql = "\n".join(body) if isinstance(body, list) else str(body)
-            from corrosion_tpu.agent.schema import apply_schema
-
-            with agent.storage._lock:
-                touched = apply_schema(agent.storage, sql)
-                agent._register_backfills()
-            self._json(200, {"tables": touched})
+            self._json(200, {"tables": agent.apply_schema_sql(sql)})
 
         def _metrics(self):
             extra = []

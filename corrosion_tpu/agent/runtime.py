@@ -473,6 +473,18 @@ class Agent:
             self._send_udp(addr, {"k": "announce", "pb": self._piggyback()})
         return len(targets)
 
+    def apply_schema_sql(self, sql: str) -> List[str]:
+        """Apply schema additions (new tables/columns) to the live
+        agent; returns touched table names.  The one shared entry point
+        for /v1/migrations, SIGHUP reload, and tests — blocking, so
+        call it off the event loop."""
+        from corrosion_tpu.agent.schema import apply_schema
+
+        with self.storage._lock:
+            touched = apply_schema(self.storage, sql)
+            self._register_backfills()
+        return touched
+
     def set_cluster_id(self, cluster_id: int) -> int:
         """Move this node to another cluster (admin ``cluster set-id``,
         ``corro-admin/src/lib.rs`` Cluster SetId → FocaCmd change

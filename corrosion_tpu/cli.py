@@ -51,6 +51,28 @@ def cmd_agent(args) -> int:
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
             loop.add_signal_handler(sig, stop.set)
+
+        def _do_reload():
+            # blocking: config file I/O + a storage-lock acquire that
+            # HIGH-tier apply traffic may delay — never on the loop
+            fresh = load_config(args.config)
+            if fresh.schema_sql:
+                touched = agent.apply_schema_sql(fresh.schema_sql)
+                print(f"reload: schema applied, touched={touched}",
+                      flush=True)
+
+        async def _reload_task():
+            try:
+                await asyncio.to_thread(_do_reload)
+            except Exception as e:  # surfaced, never fatal to the agent
+                print(f"reload failed: {e}", flush=True)
+
+        def reload_schema():
+            # SIGHUP re-reads the schema files and applies additions
+            # (command/reload.rs + SIGHUP handling in the reference)
+            loop.create_task(_reload_task())
+
+        loop.add_signal_handler(signal.SIGHUP, reload_schema)
         await stop.wait()
         await agent.stop()
 

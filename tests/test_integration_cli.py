@@ -78,7 +78,7 @@ path = "{admin_path}"
         proc.kill()
         pytest.fail(f"agent did not start: {proc.stderr.read()[:2000]}")
     yield {"api": f"127.0.0.1:{api_port}", "admin": admin_path,
-           "proc": proc, "banner": line}
+           "proc": proc, "banner": line, "schema": str(schema)}
     if proc.poll() is None:
         proc.send_signal(signal.SIGTERM)
         try:
@@ -108,6 +108,28 @@ def test_cli_against_live_agent(live_agent):
     out = _cli("--admin-path", live_agent["admin"], "cluster", "rejoin")
     assert out.returncode == 0, out.stderr
     assert "announced" in json.loads(out.stdout)
+
+    # SIGHUP re-reads the schema files and applies additions
+    # (the reference's `corrosion reload` + SIGHUP path)
+    schema_path = live_agent["schema"]
+    with open(schema_path, "a") as f:
+        f.write(
+            "\nCREATE TABLE IF NOT EXISTS hupped ("
+            " id INTEGER NOT NULL PRIMARY KEY,"
+            " note TEXT DEFAULT '');"
+        )
+    live_agent["proc"].send_signal(signal.SIGHUP)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        out = _cli("--api-addr", api, "exec",
+                   "INSERT INTO hupped (id, note) VALUES (1, 'via hup')")
+        if out.returncode == 0:
+            break
+        time.sleep(0.3)
+    else:
+        pytest.fail(f"hupped table never appeared: {out.stdout} {out.stderr}")
+    out = _cli("--api-addr", api, "query", "SELECT note FROM hupped")
+    assert out.returncode == 0 and "via hup" in out.stdout
 
     # SIGTERM shuts the agent down cleanly (tripwire parity)
     proc = live_agent["proc"]
