@@ -106,6 +106,11 @@ def load_config(path: Optional[str] = None, **overrides) -> AgentConfig:
         pg_host, pg_port = _split_addr(pg["addr"])
         kwargs["pg_host"] = pg_host
         kwargs["pg_port"] = pg_port
+    # [telemetry.traces] path: append finished spans as OTLP-flavored
+    # JSON lines (the reference exports via OTLP; config.rs telemetry)
+    traces = data.get("telemetry", {}).get("traces")
+    if isinstance(traces, dict) and traces.get("path"):
+        kwargs["trace_export_path"] = traces["path"]
     # [gossip.tls] (config.rs TlsConfig: cert-file/key-file/ca-file/
     # insecure + [gossip.tls.client] cert-file/key-file/required)
     tls = gossip.get("tls", {})

@@ -102,6 +102,8 @@ class AgentConfig:
     subs_enabled: bool = True
     subs_path: Optional[str] = None
     admin_path: Optional[str] = None
+    # append finished spans as OTLP-flavored JSON lines ([telemetry.traces])
+    trace_export_path: Optional[str] = None
     pg_port: Optional[int] = None  # PostgreSQL wire protocol (None = off)
     pg_host: Optional[str] = None  # PG bind host (None = api_host)
     maintenance_interval: float = 60.0
@@ -208,6 +210,8 @@ class Agent:
     # ------------------------------------------------------------------
 
     async def start(self) -> None:
+        if self.config.trace_export_path:
+            tracing.configure_export(self.config.trace_export_path)
         # publish the loop and drain deferred broadcasts atomically, so a
         # concurrent writer either defers (and is flushed below) or sees
         # the live loop — never a stranded append
@@ -356,6 +360,10 @@ class Agent:
                     continue
         if self.subs is not None:
             self.subs.close()
+        if self.config.trace_export_path:
+            # symmetric with start(): the sink is process-wide, so the
+            # agent that opened it closes it
+            tracing.configure_export(None)
         self._persist_members()
         self.storage.close()
 

@@ -112,6 +112,7 @@ class span:
             s.attrs["error"] = repr(exc)
         _current.reset(self._token)
         _recent.append(s)
+        _export(s)
         extras = " ".join(f"{k}={v}" for k, v in sorted(s.attrs.items()))
         log.info(
             "span %s trace_id=%s span_id=%s parent_id=%s dur_ms=%.1f %s",
@@ -123,6 +124,57 @@ class span:
 def current_traceparent() -> Optional[str]:
     s = _current.get()
     return s.traceparent if s is not None else None
+
+
+# -- file export (the OTLP stand-in) ----------------------------------
+#
+# The reference ships spans to an OTLP endpoint (CLI main.rs tracing
+# init); no OTel SDK exists in this image, so the configurable export
+# is OTLP-flavored span records, one JSON object per line, consumable
+# by a collector's file receiver or plain jq.
+
+import json as _json
+import threading as _threading
+
+_sink_lock = _threading.Lock()
+_sink = None  # open file object
+
+
+def configure_export(path: Optional[str]) -> None:
+    """Append finished spans to ``path`` (None disables).  Process-wide,
+    like the tracing runtime itself."""
+    global _sink
+    with _sink_lock:
+        if _sink is not None:
+            try:
+                _sink.close()
+            except OSError:
+                pass
+            _sink = None
+        if path:
+            _sink = open(path, "a", buffering=1)
+
+
+def _export(s: Span) -> None:
+    with _sink_lock:
+        if _sink is None:
+            return
+        rec = {
+            "traceId": s.trace_id,
+            "spanId": s.span_id,
+            "parentSpanId": s.parent_id or "",
+            "name": s.name,
+            "startTimeUnixNano": int(s.start * 1e9),
+            "endTimeUnixNano": int((s.end or s.start) * 1e9),
+            "attributes": [
+                {"key": k, "value": {"stringValue": str(v)}}
+                for k, v in sorted(s.attrs.items())
+            ],
+        }
+        try:
+            _sink.write(_json.dumps(rec) + "\n")
+        except OSError:
+            pass
 
 
 def recent_spans(limit: int = 100):

@@ -111,3 +111,45 @@ def test_trace_spans_admin_surface(run, tmp_path):
             await a.stop()
 
     run(main())
+
+
+def test_span_file_export_shares_trace_across_nodes(run, tmp_path):
+    """[telemetry.traces] path: finished spans append as OTLP-flavored
+    JSON lines, and a sync round's client and server spans land there
+    with the SAME trace id (the cross-node propagation, exported)."""
+    import json
+
+    async def main():
+        from corrosion_tpu.agent import tracing
+
+        out = tmp_path / "spans.jsonl"
+        a = await launch_test_agent(trace_export_path=str(out))
+        b = await launch_test_agent(
+            bootstrap=[f"{a.gossip_addr[0]}:{a.gossip_addr[1]}"]
+        )
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+            a.execute_transaction(
+                [["INSERT INTO tests (id, text) VALUES (1, 'traced')"]]
+            )
+            def exported_sync_pair():
+                if not out.exists():
+                    return False
+                recs = [json.loads(l) for l in out.read_text().splitlines()]
+                by_trace = {}
+                for r in recs:
+                    assert set(r) >= {"traceId", "spanId", "name",
+                                      "startTimeUnixNano", "endTimeUnixNano"}
+                    by_trace.setdefault(r["traceId"], set()).add(r["name"])
+                return any(
+                    {"sync.client_round", "sync.server"} <= names
+                    for names in by_trace.values()
+                )
+            await wait_for(exported_sync_pair, timeout=20)
+        finally:
+            await b.stop()
+            await a.stop()
+            # stop() disables the export the configuring agent enabled
+            assert tracing._sink is None
+
+    run(main())
