@@ -299,7 +299,18 @@ class Agent:
             )
             self.pg_addr = self._pg.sockets[0].getsockname()[:2]
 
-    async def stop(self) -> None:
+    async def stop(self, graceful: bool = True) -> None:
+        # graceful leave (broadcast/mod.rs:327-366 leave_cluster): tell
+        # alive peers we are going down so they drop us immediately
+        # instead of burning a probe->suspect->down cycle on us.
+        # graceful=False simulates a crash (tests of the suspicion path)
+        if graceful and self._udp is not None:
+            for m in self.members.alive():
+                self._send_udp(
+                    m.addr,
+                    {"k": "leave", "a": wire._b64(self.actor_id),
+                     "i": self.incarnation},
+                )
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
@@ -361,9 +372,9 @@ class Agent:
         if self.subs is not None:
             self.subs.close()
         if self.config.trace_export_path:
-            # symmetric with start(): the sink is process-wide, so the
-            # agent that opened it closes it
-            tracing.configure_export(None)
+            # symmetric with start(), but only if OUR sink is still the
+            # active one — another agent in this process may own it now
+            tracing.disable_export_if(self.config.trace_export_path)
         self._persist_members()
         self.storage.close()
 
@@ -2001,7 +2012,7 @@ class Agent:
 
 _SWIM_KINDS = frozenset(
     ("announce", "announce_ack", "probe", "ack", "ping_req",
-     "probe_relay", "change")
+     "probe_relay", "leave", "change")
 )
 
 
@@ -2052,6 +2063,21 @@ class _UdpProtocol(asyncio.DatagramProtocol):
                     "pb": a._piggyback(),
                 },
             )
+        elif kind == "leave":
+            # graceful departure: mark down at the leaver's own
+            # incarnation (its refutations have stopped, so the record
+            # sticks and piggybacks onward)
+            try:
+                actor = wire._unb64(msg["a"])
+                inc = int(msg.get("i", 0))
+            except (KeyError, ValueError, TypeError):
+                return
+            m = a.members.get(actor) if actor else None
+            if m is not None:
+                a.members.upsert(
+                    actor, m.addr, MemberState.DOWN,
+                    max(m.incarnation, inc),
+                )
         elif kind == "probe_relay":
             a._ingest_piggyback(msg.get("pb", []))
             a._send_udp(

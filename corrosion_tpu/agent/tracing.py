@@ -138,12 +138,13 @@ import threading as _threading
 
 _sink_lock = _threading.Lock()
 _sink = None  # open file object
+_sink_path: Optional[str] = None
 
 
 def configure_export(path: Optional[str]) -> None:
     """Append finished spans to ``path`` (None disables).  Process-wide,
     like the tracing runtime itself."""
-    global _sink
+    global _sink, _sink_path
     with _sink_lock:
         if _sink is not None:
             try:
@@ -151,8 +152,20 @@ def configure_export(path: Optional[str]) -> None:
             except OSError:
                 pass
             _sink = None
+            _sink_path = None
         if path:
             _sink = open(path, "a", buffering=1)
+            _sink_path = path
+
+
+def disable_export_if(path: Optional[str]) -> None:
+    """Disable the sink only if ``path`` is the one currently active —
+    in a multi-agent process, an agent must not kill a sink another
+    still-running agent owns."""
+    with _sink_lock:
+        owned = path is not None and _sink_path == path
+    if owned:
+        configure_export(None)
 
 
 def _export(s: Span) -> None:

@@ -177,7 +177,8 @@ def test_failure_detection_and_member_state(run):
         b = await launch_test_agent(bootstrap=[addr_str(a)])
         try:
             await wait_for(lambda: a.members.alive() and b.members.alive())
-            await b.stop()
+            # crash (no graceful leave): only probe failure detects it
+            await b.stop(graceful=False)
             # a must eventually mark b suspect then down
             await wait_for(
                 lambda: (
@@ -412,6 +413,33 @@ def test_failed_changes_do_not_poison_the_batch(run):
             )
         finally:
             await b.stop()
+            await a.stop()
+
+    run(main())
+
+
+def test_graceful_leave_marks_peer_down_immediately(run):
+    """A clean shutdown announces departure (foca leave_cluster): the
+    peer marks the leaver down at once instead of waiting out the
+    probe -> suspect -> down cycle."""
+    async def main():
+        a = await launch_test_agent(suspect_timeout=30.0)
+        b = await launch_test_agent(
+            bootstrap=[addr_str(a)], suspect_timeout=30.0
+        )
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+            b_actor = b.actor_id
+            await b.stop()
+            # far faster than the 30s suspicion path could possibly be
+            await wait_for(
+                lambda: (
+                    (m := a.members.get(b_actor)) is not None
+                    and m.state.value == "down"
+                ),
+                timeout=3.0,
+            )
+        finally:
             await a.stop()
 
     run(main())

@@ -64,7 +64,7 @@ def test_soak_writes_churn_and_restart_catchup(run, tmp_path):
             # kill one node; the rest must mark it down and keep going
             victim_dir = dirs[-1]
             victim_actor = agents[-1].actor_id
-            await agents[-1].stop()
+            await agents[-1].stop(graceful=False)  # crash: exercise suspicion
             survivors = agents[:-1]
 
             def victim_down_everywhere():
