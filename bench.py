@@ -133,6 +133,8 @@ def _churn64() -> dict:
         "n_nodes": 64,
         "rejoin_latency_ticks": stats["rejoin_latency"],
         "msgs_per_node_mean": round(stats["msgs_per_node_mean"], 1),
+        "msgs_per_node_per_tick": round(
+            stats["msgs_per_node_per_tick"], 2),
         "wall_s": round(stats["wall_s"], 3),
     }
     if stats["detect_latency"] is None or stats["rejoin_latency"] is None:
@@ -161,6 +163,7 @@ def _timed_sim(name: str, run, n_seeds: int, headline: bool = False,
         "ticks_p99": None if not (ticks_p99 < float("inf")) else ticks_p99,
         "ticks_p50": stats.get("ticks_p50"),
         "msgs_per_node_mean": round(stats["msgs_per_node_mean"], 1),
+        "hops_p99": stats.get("hops_p99"),
         "converged_frac": stats["converged_frac"],
         "n_seeds": n_seeds,
         "compile_s": round(compile_and_first - stats["wall_s"], 1),
@@ -256,16 +259,53 @@ def main() -> None:
     if "4" in want:
         _attempt("anti_entropy_10k", lambda: _anti_entropy(args.seeds))
 
-    headline = None
-    if "5" in want:
-        cfg5 = EpidemicConfig(
-            n_nodes=args.nodes, n_rows=args.rows,
+    def _headline_cfg(n: int) -> "EpidemicConfig":
+        return EpidemicConfig(
+            n_nodes=n, n_rows=args.rows,
             fanout_ring0=2, fanout_global=2, ring0_size=256,
             max_transmissions=8, loss=0.05,
             partition_blocks=2, heal_tick=12,
             sync_interval=8, sync_peers=1,
             max_ticks=192, chunk_ticks=16,
         )
+
+    # the metric is "p99 convergence + msgs/node VS CLUSTER SIZE N":
+    # beyond the per-config series (heterogeneous protocols), sweep the
+    # HEADLINE protocol itself over N with identical parameters (the
+    # N == args.nodes point is filled from the headline run below)
+    if want == set("12345") and not args.check:
+        def _sweep() -> dict:
+            from corrosion_tpu.sim import run_epidemic_seeds
+
+            points = []
+            for n in (1000, 4000, 16000, 64000, 100000):
+                if n == args.nodes:
+                    continue  # spliced in from the headline run
+                s = run_epidemic_seeds(
+                    _headline_cfg(n), n_seeds=args.seeds, seed=0
+                )
+                points.append({
+                    "n": n,
+                    "ticks_p50": s["ticks_p50"],
+                    "ticks_p99": s["ticks_p99"],
+                    "msgs_per_node_mean": round(
+                        s["msgs_per_node_mean"], 2),
+                    "hops_p99": s["hops_p99"],
+                    "converged_frac": s["converged_frac"],
+                    "wall_s": round(s["wall_s"], 2),
+                })
+            return {
+                "metric": "epidemic_sweep_p99_and_msgs_vs_n",
+                "value": points[-1]["ticks_p99"],
+                "unit": "ticks",
+                "points": points,
+            }
+
+        _attempt("epidemic_sweep_vs_n", _sweep)
+
+    headline = None
+    if "5" in want:
+        cfg5 = _headline_cfg(args.nodes)
         try:
             headline = _epidemic(
                 f"epidemic_convergence_sim_{args.nodes//1000}k_nodes_wall",
@@ -281,6 +321,21 @@ def main() -> None:
                   file=sys.stderr)
 
     if headline is not None:
+        sweep = results.get("epidemic_sweep_vs_n")
+        if sweep and "points" in sweep:
+            # splice the headline's own point into the sweep (same
+            # config constructor; avoids re-running the priciest N)
+            sweep["points"].append({
+                "n": headline["n_nodes"],
+                "ticks_p50": headline.get("ticks_p50"),
+                "ticks_p99": headline.get("ticks_p99"),
+                "msgs_per_node_mean": headline.get("msgs_per_node_mean"),
+                "hops_p99": headline.get("hops_p99"),
+                "converged_frac": headline.get("converged_frac"),
+                "wall_s": headline.get("value"),
+            })
+            sweep["points"].sort(key=lambda p: p["n"])
+            sweep["value"] = sweep["points"][-1]["ticks_p99"]
         baseline_s = 60.0  # BASELINE.json north-star budget on v5e-8
         series = sorted(
             (r["n_nodes"], r["msgs_per_node_mean"], k)

@@ -155,7 +155,11 @@ class Agent:
 
         self.metrics = Metrics()
         self._members_table()
-        self.incarnation = 0
+        # incarnation survives restarts one-higher: a gracefully-left
+        # node re-announces ALIVE above the DOWN record peers hold for
+        # its previous life, so rejoin is immediate (foca renew())
+        self.incarnation = self._load_incarnation() + 1
+        self._persist_incarnation()
         self._seen: Dict[tuple, None] = {}
         # apply workers call handle_change concurrently; the seen cache's
         # check/insert/evict must be atomic across them
@@ -211,7 +215,9 @@ class Agent:
 
     async def start(self) -> None:
         if self.config.trace_export_path:
-            tracing.configure_export(self.config.trace_export_path)
+            self._trace_token = tracing.configure_export(
+                self.config.trace_export_path
+            )
         # publish the loop and drain deferred broadcasts atomically, so a
         # concurrent writer either defers (and is flushed below) or sees
         # the live loop — never a stranded append
@@ -374,7 +380,7 @@ class Agent:
         if self.config.trace_export_path:
             # symmetric with start(), but only if OUR sink is still the
             # active one — another agent in this process may own it now
-            tracing.disable_export_if(self.config.trace_export_path)
+            tracing.disable_export_if(getattr(self, "_trace_token", None))
         self._persist_members()
         self.storage.close()
 
@@ -443,6 +449,7 @@ class Agent:
                 # refute anything non-alive said about us
                 if state != MemberState.ALIVE.value and inc >= self.incarnation:
                     self.incarnation = inc + 1
+                    self._persist_incarnation()
                 continue
             self.members.upsert(actor, (host, port), MemberState(state), inc)
 
@@ -479,12 +486,28 @@ class Agent:
                 delay = min(delay * 2, 30.0)
             await asyncio.sleep(delay)
 
+    def _load_incarnation(self) -> int:
+        row = self.storage.conn.execute(
+            "SELECT value FROM __corro_state WHERE key='incarnation'"
+        ).fetchone()
+        return int(row[0]) if row else 0
+
+    def _persist_incarnation(self) -> None:
+        with self.storage._lock:
+            self.storage.conn.execute(
+                "INSERT INTO __corro_state (key, value) "
+                "VALUES ('incarnation', ?) "
+                "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
+                (self.incarnation,),
+            )
+
     def rejoin(self) -> int:
         """Renew our identity and re-announce (foca ``Identity::renew``
         + the admin Rejoin command, ``actor.rs:199-210``): bump our
         incarnation so peers holding a stale/suspect view refresh it,
         then announce to every known member and configured bootstrap."""
         self.incarnation += 1
+        self._persist_incarnation()
         targets = {tuple(m.addr) for m in self.members.alive()}
         targets.update(_parse_addr(b) for b in self.config.bootstrap)
         targets.discard(tuple(self.gossip_addr))

@@ -138,34 +138,45 @@ import threading as _threading
 
 _sink_lock = _threading.Lock()
 _sink = None  # open file object
-_sink_path: Optional[str] = None
+_sink_gen = 0  # bumps on every (re)configure: the ownership token
 
 
-def configure_export(path: Optional[str]) -> None:
+def configure_export(path: Optional[str]) -> Optional[int]:
     """Append finished spans to ``path`` (None disables).  Process-wide,
-    like the tracing runtime itself."""
-    global _sink, _sink_path
+    like the tracing runtime itself.  Returns an ownership token for
+    :func:`disable_export_if` (None when disabling)."""
+    global _sink, _sink_gen
     with _sink_lock:
+        _sink_gen += 1
         if _sink is not None:
             try:
                 _sink.close()
             except OSError:
                 pass
             _sink = None
-            _sink_path = None
         if path:
             _sink = open(path, "a", buffering=1)
-            _sink_path = path
+            return _sink_gen
+        return None
 
 
-def disable_export_if(path: Optional[str]) -> None:
-    """Disable the sink only if ``path`` is the one currently active —
-    in a multi-agent process, an agent must not kill a sink another
-    still-running agent owns."""
+def disable_export_if(token: Optional[int]) -> None:
+    """Disable the sink only if ``token`` is the one that opened the
+    currently-active sink — in a multi-agent process, an agent must not
+    kill a sink another still-running agent has since (re)opened.
+    Check and close happen under one lock acquisition."""
+    global _sink, _sink_gen
+    if token is None:
+        return
     with _sink_lock:
-        owned = path is not None and _sink_path == path
-    if owned:
-        configure_export(None)
+        if _sink_gen != token or _sink is None:
+            return
+        _sink_gen += 1
+        try:
+            _sink.close()
+        except OSError:
+            pass
+        _sink = None
 
 
 def _export(s: Span) -> None:

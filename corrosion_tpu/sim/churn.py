@@ -101,6 +101,9 @@ def run_churn(cfg: ChurnConfig, seed: int = 0):
             None if rejoin_tick is None else rejoin_tick - cfg.revive_tick
         ),
         "msgs_per_node_mean": float(msgs.mean()),
+        # run-length-independent rate: the total depends on where the
+        # chunk grid stops the run, the per-tick rate does not
+        "msgs_per_node_per_tick": float(msgs.mean()) / max(ticks, 1),
         "wall_s": wall,
         "ticks_run": ticks,
     }

@@ -443,3 +443,41 @@ def test_graceful_leave_marks_peer_down_immediately(run):
             await a.stop()
 
     run(main())
+
+
+def test_graceful_restart_rejoins_immediately(run, tmp_path):
+    """A gracefully-stopped node that restarts from the same data dir
+    comes back with a HIGHER incarnation, so its ALIVE announce
+    overrides the DOWN record peers hold from the leave — rejoin is
+    immediate, not at the mercy of piggyback self-refutation."""
+    async def main():
+        a = await launch_test_agent(suspect_timeout=30.0)
+        d = str(tmp_path / "b")
+        import os
+        os.makedirs(d, exist_ok=True)
+        b = await launch_test_agent(
+            tmpdir=d, bootstrap=[addr_str(a)], suspect_timeout=30.0
+        )
+        b_actor = b.actor_id
+        inc1 = b.incarnation
+        try:
+            await wait_for(lambda: a.members.alive() and b.members.alive())
+            await b.stop()  # graceful: a marks b down instantly
+            await wait_for(
+                lambda: (m := a.members.get(b_actor)) and
+                m.state.value == "down", timeout=3.0,
+            )
+            b = await launch_test_agent(
+                tmpdir=d, bootstrap=[addr_str(a)], suspect_timeout=30.0
+            )
+            assert b.actor_id == b_actor  # same identity from the db
+            assert b.incarnation > inc1  # renewed past the old life
+            await wait_for(
+                lambda: (m := a.members.get(b_actor)) and
+                m.state.value == "alive", timeout=5.0,
+            )
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())
