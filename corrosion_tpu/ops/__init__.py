@@ -6,7 +6,12 @@ API).
 """
 
 from corrosion_tpu.ops.keys import KeyCodec, DEFAULT_CODEC
-from corrosion_tpu.ops.merge import merge_keys, scatter_merge, merge_cells
+from corrosion_tpu.ops.merge import (
+    merge_cells,
+    merge_keys,
+    pallas_merge_cells,
+    scatter_merge,
+)
 
 __all__ = [
     "KeyCodec",
@@ -14,4 +19,5 @@ __all__ = [
     "merge_keys",
     "scatter_merge",
     "merge_cells",
+    "pallas_merge_cells",
 ]
