@@ -119,3 +119,53 @@ def sharded_broadcast_step(mesh, params: BroadcastParams):
             out_specs=(node_sharded, node_sharded, node_sharded),
         )
     )
+
+
+def sharded_seq_sync_step(mesh, params):
+    """Sequence-reassembly anti-entropy over the device mesh — the
+    framework's "sequence parallelism": one changeset's seq bitmap is
+    the long-sequence analogue (SURVEY §5), and its reconciliation
+    shards over the ``nodes`` axis.
+
+    Returns ``step(bits, msgs, key) -> (bits', msgs')`` on GLOBAL
+    arrays sharded [nodes] on their leading axis.  The fabric is one
+    ``all_gather`` of the seq bitmaps; the needs/served/arrival algebra
+    then runs replicated and each shard commits its own receivers'
+    rows and message charges.  Bitwise identical to the unsharded
+    :func:`corrosion_tpu.models.sync.seq_sync_step` for the same key
+    (pinned by tests/test_sharding.py).
+    """
+    from corrosion_tpu.models.sync import seq_sync_step
+
+    n = params.n_nodes
+    d_shards = mesh.shape["nodes"]
+    if n % d_shards != 0:
+        raise ValueError(f"n_nodes {n} must divide over {d_shards} shards")
+    n_local = n // d_shards
+
+    def local_step(bits_l, msgs_l, key):
+        # (1) fabric: one all_gather moves every shard's bitmaps
+        bits_all = jax.lax.all_gather(
+            bits_l, "nodes"
+        ).reshape(n, bits_l.shape[-1])
+        msgs_all = jax.lax.all_gather(msgs_l, "nodes").reshape(n)
+        # (2) replicated algebra on the gathered state — same RNG as
+        # the unsharded kernel, so every shard agrees on every session
+        new_bits, new_msgs = seq_sync_step(bits_all, msgs_all, key, params)
+        # (3) commit my rows
+        shard = jax.lax.axis_index("nodes")
+        lo = shard * n_local
+        return (
+            jax.lax.dynamic_slice_in_dim(new_bits, lo, n_local, 0),
+            jax.lax.dynamic_slice_in_dim(new_msgs, lo, n_local, 0),
+        )
+
+    node_sharded = P("nodes")
+    return jax.jit(
+        _shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(node_sharded, node_sharded, P()),
+            out_specs=(node_sharded, node_sharded),
+        )
+    )
