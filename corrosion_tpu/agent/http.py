@@ -32,9 +32,17 @@ if TYPE_CHECKING:
     from corrosion_tpu.agent.runtime import Agent
 
 
+class _ApiServer(ThreadingHTTPServer):
+    # socketserver's default listen backlog is 5: under a request burst
+    # the kernel RSTs the overflow and clients see connection resets.
+    # The reference serves on hyper/tokio with an effectively deep
+    # accept queue; match that.
+    request_queue_size = 128
+
+
 def start_http_api(agent: "Agent") -> ThreadingHTTPServer:
     handler = _make_handler(agent)
-    server = ThreadingHTTPServer(
+    server = _ApiServer(
         (agent.config.api_host, agent.config.api_port or 0), handler
     )
     server.daemon_threads = True

@@ -42,11 +42,6 @@ def cmd_agent(args) -> int:
     async def main():
         agent = Agent(cfg)
         await agent.start()
-        print(
-            f"agent {agent.actor_id.hex()} gossip={agent.gossip_addr} "
-            f"api={agent.api_addr}",
-            flush=True,
-        )
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
@@ -73,6 +68,14 @@ def cmd_agent(args) -> int:
             loop.create_task(_reload_task())
 
         loop.add_signal_handler(signal.SIGHUP, reload_schema)
+        # the banner is the readiness signal — every signal handler must
+        # be registered BEFORE it, or a prompt operator's SIGHUP hits
+        # the default action and kills the process
+        print(
+            f"agent {agent.actor_id.hex()} gossip={agent.gossip_addr} "
+            f"api={agent.api_addr}",
+            flush=True,
+        )
         await stop.wait()
         await agent.stop()
 

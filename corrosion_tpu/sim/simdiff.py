@@ -266,10 +266,12 @@ def diff_traces(sim: Dict, agents: Dict) -> Dict:
             ),
             "residual_note": (
                 "sim models the agents' sent_to exclusion (hop depths "
-                "match); remaining msgs/node gap is time quantization — "
-                "the tick-grid backoff fits a few more redundant "
-                "retransmissions before the convergence cutoff than the "
-                "agents' wall-clock schedule"
+                "match); the residual msgs/node gap is time "
+                "quantization — the tick grid and the agents' "
+                "wall-clock retransmit schedule fit slightly different "
+                "numbers of redundant retransmissions before their "
+                "respective convergence cutoffs, so the ratio lands "
+                "near 1 on either side"
             ),
         },
     }
