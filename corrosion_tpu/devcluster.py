@@ -98,6 +98,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--out", default=None,
                     help="tpu-sim: write the diff JSON here "
                          "(default SIMDIFF_N{n}.json)")
+    ap.add_argument("--port-base", type=int, default=42000,
+                    help="first gossip port for --runtime process")
     args = ap.parse_args(argv)
 
     if args.runtime == "tpu-sim":
@@ -124,7 +126,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     base = args.base_dir or tempfile.mkdtemp(prefix="corro-devcluster-")
 
     procs: List[subprocess.Popen] = []
-    port = 42000
+    port = args.port_base
     addrs: Dict[str, str] = {}
     try:
         for name in topo.nodes:
@@ -149,8 +151,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                      "--config", cfg],
                 )
             )
-            print(f"{name}: gossip={gossip} api={api} dir={d}")
-        print("devcluster up; ctrl-c to stop")
+            print(f"{name}: gossip={gossip} api={api} dir={d}",
+                  flush=True)
+        print("devcluster up; ctrl-c to stop", flush=True)
+        # block the signals BEFORE sigwait: unblocked, delivery takes
+        # the default action (terminate) and the finally-block teardown
+        # of the agent subprocesses never runs
+        signal.pthread_sigmask(
+            signal.SIG_BLOCK, {signal.SIGINT, signal.SIGTERM}
+        )
         signal.sigwait({signal.SIGINT, signal.SIGTERM})
         return 0
     finally:
