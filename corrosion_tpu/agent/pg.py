@@ -331,9 +331,13 @@ _SCHEMA_PREFIX_RE = re.compile(
     r"\b(?:pg_catalog|information_schema)\s*\.\s*", re.IGNORECASE
 )
 
-# catalog tables routed even when referenced unqualified
+# catalog tables routed even when referenced unqualified — anchored to
+# table position (after FROM/JOIN or a from-list comma, optionally
+# quoted) so a user column or alias merely *named* pg_class doesn't
+# reroute the query
 _CATALOG_TABLE_RE = re.compile(
-    r"\b(?:pg_database|pg_class|pg_namespace|pg_attribute|pg_type"
+    r"(?:\b(?:from|join)\s+|,\s*)(?:only\s+)?\"?"
+    r"(pg_database|pg_class|pg_namespace|pg_attribute|pg_type"
     r"|pg_index|pg_description|pg_range)\b"
 )
 
@@ -409,6 +413,9 @@ class _Session:
         cols, rows = self.agent.storage.read_query(tsql, params)
         return cols, rows, len(rows), _tag_for(tsql, -1, len(rows))
 
+    def _user_tables(self) -> set:
+        return {t.lower() for t in self.agent.storage.tables}
+
     def _canned(self, raw: str, params: Tuple = ()):
         low = " ".join(raw.lower().split())
         # version()/current_database()/current_schema() are real SQL
@@ -421,13 +428,18 @@ class _Session:
         # unqualified catalog routing must not fire on string literals
         # ("... WHERE note LIKE '%pg_class%'") and only reroutes reads
         no_literals = re.sub(r"'[^']*'", "''", low)
+        unqualified = (
+            no_literals.lstrip().startswith("select")
+            and (m := _CATALOG_TABLE_RE.search(no_literals)) is not None
+            # a user table legitimately named e.g. pg_class wins over
+            # unqualified catalog routing (qualified pg_catalog.* still
+            # routes below)
+            and m.group(1) not in self._user_tables()
+        )
         if (
             "pg_catalog" in no_literals
             or "information_schema" in no_literals
-            or (
-                no_literals.lstrip().startswith("select")
-                and _CATALOG_TABLE_RE.search(no_literals)
-            )
+            or unqualified
         ):
             # run real catalog SQL against the rendered catalog —
             # including unqualified references: pg_catalog is always on

@@ -1,11 +1,20 @@
 """Wire codec: framed messages for gossip, SWIM and sync traffic.
 
-Parity: the reference speaks speedy-encoded ``UniPayload``/``BiPayload``
-frames with length-delimited framing over QUIC
-(``crates/corro-types/src/broadcast.rs:37-67``).  Ours is a
-length-prefixed JSON envelope (bytes fields base64-encoded) — chosen for
-debuggability first; the codec is isolated here so a binary/native
-implementation can replace it without touching protocol logic.
+What actually travels on each channel class (keep this current —
+``tests/test_live_wire.py`` pins it at the byte level):
+
+* **uni/bi streams (broadcasts + sync)** — speedy-encoded
+  ``UniPayload``/``BiPayload`` frames with u32-BE length framing,
+  byte-compatible with the reference
+  (``crates/corro-types/src/broadcast.rs:37-67``); see
+  ``bridge/speedy.py`` and ``runtime.py`` for the encode/decode call
+  sites.  The JSON envelope in this module is NOT used on those
+  streams.
+* **SWIM datagrams (membership)** — the length-prefixed JSON envelope
+  defined here (bytes fields base64-encoded).  This is the one channel
+  class still diverging from the reference, which relays foca's own
+  binary messages verbatim
+  (``crates/corro-agent/src/broadcast/mod.rs:185-324``).
 
 Message kinds:
   swim:     {kind, probe|ack|ping_req|gossip..., member entries}

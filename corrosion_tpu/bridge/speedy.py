@@ -86,29 +86,40 @@ class Writer:
     def __init__(self):
         self._parts: List[bytes] = []
 
-    def u8(self, v: int) -> "Writer":
-        self._parts.append(struct.pack("<B", v))
+    def _pack(self, fmt: str, v) -> "Writer":
+        # error-type parity with the native path: out-of-range or
+        # wrong-typed values raise SpeedyError on both encoders
+        try:
+            self._parts.append(struct.pack(fmt, v))
+        except (struct.error, TypeError, OverflowError, ValueError) as e:
+            raise SpeedyError(f"cannot encode {v!r} as {fmt}: {e}") from e
         return self
+
+    def u8(self, v: int) -> "Writer":
+        return self._pack("<B", v)
 
     def u16(self, v: int) -> "Writer":
-        self._parts.append(struct.pack("<H", v))
-        return self
+        return self._pack("<H", v)
 
     def u32(self, v: int) -> "Writer":
-        self._parts.append(struct.pack("<I", v))
-        return self
+        return self._pack("<I", v)
 
     def u64(self, v: int) -> "Writer":
-        self._parts.append(struct.pack("<Q", int(v)))
-        return self
+        try:
+            v = int(v)
+        except (TypeError, ValueError) as e:
+            raise SpeedyError(f"cannot encode {v!r} as u64: {e}") from e
+        return self._pack("<Q", v)
 
     def i64(self, v: int) -> "Writer":
-        self._parts.append(struct.pack("<q", int(v)))
-        return self
+        try:
+            v = int(v)
+        except (TypeError, ValueError) as e:
+            raise SpeedyError(f"cannot encode {v!r} as i64: {e}") from e
+        return self._pack("<q", v)
 
     def f64(self, v: float) -> "Writer":
-        self._parts.append(struct.pack("<d", v))
-        return self
+        return self._pack("<d", v)
 
     def raw(self, b: bytes) -> "Writer":
         self._parts.append(bytes(b))

@@ -234,7 +234,18 @@ class PooledApiClient:
     _FAILOVER_ERRORS = (ClientError, OSError, TimeoutError,
                         http.client.HTTPException)
 
-    def _with_failover(self, fn):
+    def _with_failover(self, fn, retry: bool = True):
+        """Run ``fn`` against the picked address.
+
+        Connection-level failures always mark the address bad and rotate
+        the pick; with ``retry=False`` the error is then surfaced to the
+        caller instead of re-running ``fn`` elsewhere. Non-idempotent
+        calls (execute) must use ``retry=False``: a TimeoutError/OSError
+        can fire *after* the server received and applied the transaction,
+        and re-sending would apply it twice. The reference pooled client
+        never retries either — it only rotates for the next call
+        (corro-client/src/lib.rs handle_error).
+        """
         last: Optional[Exception] = None
         for _ in range(max(2, len(self._addresses()) + 1)):
             c = self.client()
@@ -247,10 +258,13 @@ class PooledApiClient:
                 self._bad.add(host)
                 self._pick += 1
                 last = e
+                if not retry:
+                    raise
         raise last  # type: ignore[misc]
 
     def execute(self, statements: Sequence) -> dict:
-        return self._with_failover(lambda c: c.execute(statements))
+        # Not idempotent: never auto-retried (see _with_failover).
+        return self._with_failover(lambda c: c.execute(statements), retry=False)
 
     def query(self, statement) -> Tuple[List[str], List[list]]:
         return self._with_failover(lambda c: c.query(statement))

@@ -305,6 +305,20 @@ def test_pg_catalog_is_queryable(run):
                     "SELECT current_database() AS name, current_schema()"
                 )
                 assert not errs and rows == [["corrosion", "public"]]
+                # comma-style from-list still routes to the catalog
+                _, rows, _, errs = c.query(
+                    "SELECT t.typname FROM pg_class c, pg_type t"
+                    " WHERE t.oid = 20 LIMIT 1"
+                )
+                assert not errs and rows and rows[0] == ["int8"]
+                # a user COLUMN merely named pg_class must not reroute
+                # the query to the rendered catalog (ADVICE r3)
+                c.query("INSERT INTO tests (id, text)"
+                        " VALUES (1, 'pg_class ref')")
+                _, rows, _, errs = c.query(
+                    "SELECT text AS pg_class FROM tests WHERE id = 1"
+                )
+                assert not errs and rows == [["pg_class ref"]]
                 c.close()
 
             await asyncio.to_thread(drive)

@@ -452,6 +452,42 @@ def test_pooled_client_failover_and_reresolve(run):
     run(main())
 
 
+def test_pooled_client_execute_never_retries(run):
+    """execute() is not idempotent: a connection-level failure marks the
+    address bad and rotates, but the error surfaces to the caller — a
+    timeout can fire after the server already applied the transaction
+    (corro-client handle_error parity; ADVICE r3)."""
+    async def main():
+        a = await launch_test_agent()
+        try:
+            from corrosion_tpu.client import PooledApiClient
+
+            live = a.api_addr
+
+            pc = PooledApiClient("cluster.test", live[1], timeout=2.0,
+                                 ttl=3600.0,
+                                 resolver=lambda h: ["127.1.2.3", live[0]])
+
+            def do_exec():
+                return pc.execute(
+                    ["INSERT INTO tests (id, text) VALUES (1, 'x')"])
+
+            with pytest.raises(ClientError) as ei:
+                await asyncio.to_thread(do_exec)
+            assert ei.value.status == 0  # connection-level, not HTTP
+            # the dead address was marked bad; the caller's own retry
+            # lands on the live node and applies exactly once
+            res = await asyncio.to_thread(do_exec)
+            assert "results" in res
+            _, rows = await asyncio.to_thread(
+                lambda: pc.query("SELECT count(*) FROM tests"))
+            assert rows == [[1]]
+        finally:
+            await a.stop()
+
+    run(main())
+
+
 def test_config_api_pg_addr_enables_pg(tmp_path):
     """[api.pg] addr in the TOML config wires up the PostgreSQL
     listener (config.rs PgConfig parity)."""
