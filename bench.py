@@ -119,6 +119,35 @@ async def _devcluster3() -> dict:
             await x.stop()
 
 
+# -- north-star exactness: deterministic bit-match ---------------------
+
+
+def _bitmatch() -> dict:
+    from corrosion_tpu.sim.bitmatch import run_bitmatch
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = {"metric": "bitmatch_sim_vs_agents", "unit": "bool"}
+    all_ok = True
+    for n in (64, 256):
+        t0 = time.perf_counter()
+        r = run_bitmatch(n, writes=2, seed=0,
+                         out_path=os.path.join(here, f"BITMATCH_N{n}.json"))
+        all_ok &= r["bitmatch"]
+        out[f"n{n}"] = {
+            "bitmatch": r["bitmatch"],
+            "ticks": [w["ticks_compared"] for w in r["per_write"]],
+            "converged": [w["converged_tick_agents"]
+                          for w in r["per_write"]],
+            "first_mismatch": [w["first_mismatch_tick"]
+                               for w in r["per_write"]],
+            "wall_s": round(time.perf_counter() - t0, 2),
+        }
+    out["value"] = 1.0 if all_ok else 0.0
+    if not all_ok:
+        out["error"] = "sim/agent traces diverged"
+    return out
+
+
 # -- config #2: 64-node SWIM churn -------------------------------------
 
 
@@ -244,6 +273,11 @@ def main() -> None:
 
     if "1" in want:
         _attempt("devcluster3", lambda: asyncio.run(_devcluster3()))
+        # the exactness half of the north star ("bit-match
+        # corro-devcluster at N<=256"): real agents under the
+        # discrete-event scheduler vs the sim's deterministic replay,
+        # per-tick infected sets + per-node msg counts exactly equal
+        _attempt("bitmatch", _bitmatch)
     if "2" in want:
         _attempt("swim_churn_64", _churn64)
     if "3" in want:

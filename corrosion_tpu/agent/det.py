@@ -1,0 +1,275 @@
+"""Deterministic discrete-event mode for the agent runtime.
+
+The north star (BASELINE.json) has two halves: the 100k-node epidemic
+under 60 s (performance — ``bench.py``) and **bit-matching** the real
+agent cluster at N≤256.  The distributional calibration
+(``sim/simdiff.py``) compares percentiles; this module makes the
+comparison *exact*: the real agents run under a seeded PRNG and a
+discrete-event **tick scheduler** instead of wall-clock timers, so the
+epidemic's delivery schedule is a pure function of (seed, parameters) —
+and the simulator's deterministic replay (``sim/bitmatch.py``) must
+reproduce the per-tick infected sets and per-node message counts
+**exactly**, tick for tick.
+
+What is real here (the whole point): agents are full ``Agent`` objects —
+real SQLite storage with CRR triggers, real bookkeeping, real speedy
+wire bytes (``encode_broadcast_frame``/``decode_uni_frame``, the same
+methods the live socket loops use), real ``handle_change`` ingest with
+seen-cache dedup and rebroadcast-on-learn, real ``Members.sample`` peer
+selection.  What the scheduler replaces is exactly the *timing layer*:
+sockets become synchronous frame hand-offs, and the broadcast loop's
+wall-clock arithmetic (``rebroadcast_delay * send_count`` requeues,
+``broadcast/mod.rs:745-765``) becomes tick arithmetic
+(``det_backoff_gap``), the same mapping the simulator's
+``backoff_ticks`` models.
+
+Tick semantics (matching ``models/broadcast.py`` with ``track_sent``):
+
+* a tick has a **send phase** — every agent, in index order, flushes
+  its due payloads, sampling fanout targets from its own seeded PRNG
+  with per-payload ``sent_to`` exclusion — and a **delivery phase** —
+  all frames sent this tick are decoded and applied; deliveries never
+  influence sends of the same tick (synchronous rounds);
+* a payload learned during tick t's delivery phase is first eligible to
+  forward at tick t+1;
+* the nth retransmission of a payload waits ``det_backoff_gap(n)``
+  ticks; a payload whose eligible-peer set is exhausted retires.
+
+Cited reference behavior: fanout sampling and sent_to exclusion
+``crates/corro-agent/src/broadcast/mod.rs:586-702``, retransmit requeue
+``:745-765``, rebroadcast-on-learn ``handlers.rs:939-949``.
+"""
+
+from __future__ import annotations
+
+import random
+import shutil
+import tempfile
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from corrosion_tpu.agent.runtime import Agent, AgentConfig, ChangeSource
+from corrosion_tpu.agent.testing import TEST_SCHEMA
+from corrosion_tpu.bridge import speedy
+
+
+def det_seed_for(seed: int, index: int) -> int:
+    """Per-node PRNG stream seed — shared with the sim replay so both
+    sides draw identical sample sequences."""
+    return seed * 1_000_003 + index
+
+
+def det_backoff_gap(backoff_ticks: float, send_count: int) -> int:
+    """Ticks until a payload's next retransmission after its nth send —
+    the tick-grid form of the reference's ``100ms * send_count`` requeue
+    (and our live loop's ``rebroadcast_delay * send_count``); shared
+    with the sim replay."""
+    return max(1, round(backoff_ticks * send_count))
+
+
+class _SyncLoop:
+    """Stand-in event loop for un-started agents: callbacks run inline,
+    synchronously — the discrete-event scheduler owns all ordering."""
+
+    def call_soon_threadsafe(self, fn, *args):
+        fn(*args)
+
+    def time(self) -> float:
+        return 0.0
+
+
+@dataclass
+class _Entry:
+    """One pending broadcast payload on one agent (the det-mode form of
+    the live loop's ``pending`` tuples)."""
+
+    cv: object
+    frame: bytes
+    remaining: int
+    next_due: int
+    sent_to: Set[bytes] = field(default_factory=set)
+
+
+@dataclass(frozen=True)
+class DetParams:
+    n_nodes: int
+    fanout: int = 3
+    max_transmissions: int = 5
+    backoff_ticks: float = 2.5
+    seed: int = 0
+    max_ticks: int = 512
+
+
+class DetCluster:
+    """N real agents under the discrete-event tick scheduler."""
+
+    def __init__(self, params: DetParams, base_dir: Optional[str] = None):
+        self.params = params
+        self._own_dir = base_dir is None
+        self.base_dir = base_dir or tempfile.mkdtemp(prefix="corro-det-")
+        self.agents: List[Agent] = []
+        for i in range(params.n_nodes):
+            cfg = AgentConfig(
+                db_path=f"{self.base_dir}/n{i}.db",
+                schema_sql=TEST_SCHEMA,
+                fanout=params.fanout,
+                max_transmissions=params.max_transmissions,
+                subs_enabled=False,
+                ring0_enabled=False,
+                debug_hops=False,
+            )
+            a = Agent(cfg)
+            # the deterministic PRNG stream replaces the actor-id seed;
+            # _SyncLoop makes queue-or-defer paths run inline
+            a._rng = random.Random(det_seed_for(params.seed, i))
+            a._loop = _SyncLoop()
+            self.agents.append(a)
+        # full static membership in index order: every agent's members
+        # dict — and therefore Members.sample's population ordering —
+        # lists peers in ascending node index (the sim replay mirrors
+        # this exact ordering)
+        for a in self.agents:
+            for b in self.agents:
+                if a is not b:
+                    a.members.upsert(b.actor_id, ("det", 0))
+        self._index_of: Dict[bytes, int] = {
+            a.actor_id: i for i, a in enumerate(self.agents)
+        }
+        self._entries: List[Dict[tuple, _Entry]] = [
+            {} for _ in range(params.n_nodes)
+        ]
+        self.msgs = [0] * params.n_nodes
+        self.tick_no = 0
+
+    # -- workload ------------------------------------------------------
+
+    def write(self, origin: int, sql: str, args: tuple = ()) -> int:
+        """One local write on ``origin``; returns its version.  The
+        broadcast enters origin's queue and first flushes on the next
+        ``tick()`` (same next-flush latency the live loop gives a fresh
+        payload)."""
+        res = self.agents[origin].execute_transaction([(sql, args)])
+        return res["version"]
+
+    # -- the scheduler -------------------------------------------------
+
+    def _drain_queues(self) -> None:
+        """Queued broadcasts (local writes + rebroadcasts-on-learn from
+        the previous delivery phase) become due entries this tick."""
+        for i, a in enumerate(self.agents):
+            while not a._bcast_queue.empty():
+                cv, remaining, hop = a._bcast_queue.get_nowait()
+                key = a._seen_key(cv)
+                if key in self._entries[i]:
+                    continue
+                self._entries[i][key] = _Entry(
+                    cv=cv,
+                    frame=a.encode_broadcast_frame(cv, hop),
+                    remaining=remaining,
+                    next_due=self.tick_no,
+                )
+
+    def tick(self) -> int:
+        """One protocol round; returns the number of messages sent."""
+        t = self.tick_no
+        self._drain_queues()
+        deliveries: List[Tuple[int, bytes]] = []
+        for i, a in enumerate(self.agents):
+            entries = self._entries[i]
+            for key in list(entries):
+                e = entries[key]
+                if e.next_due > t or e.remaining < 1:
+                    continue
+                targets = a.members.sample(
+                    self.params.fanout, a._rng,
+                    ring0_first=False, exclude=e.sent_to,
+                )
+                if not targets:
+                    # coverage exhausted: every alive peer already got it
+                    del entries[key]
+                    continue
+                for m in targets:
+                    deliveries.append((self._index_of[m.actor_id], e.frame))
+                    e.sent_to.add(m.actor_id)
+                self.msgs[i] += len(targets)
+                e.remaining -= 1
+                if e.remaining < 1:
+                    del entries[key]
+                else:
+                    send_count = self.params.max_transmissions - e.remaining
+                    e.next_due = t + det_backoff_gap(
+                        self.params.backoff_ticks, send_count
+                    )
+        # delivery phase: the real wire + ingest path, applied after all
+        # sends so same-tick deliveries can't influence same-tick sends
+        sent = len(deliveries)
+        for dest, frame in deliveries:
+            a = self.agents[dest]
+            for payload in speedy.FrameReader().feed(frame):
+                cv = a.decode_uni_frame(payload)
+                if cv is not None:
+                    a.handle_change(cv, ChangeSource.BROADCAST)
+        self.tick_no += 1
+        return sent
+
+    def quiescent(self) -> bool:
+        return all(not e for e in self._entries) and all(
+            a._bcast_queue.empty() for a in self.agents
+        )
+
+    def infected(self, origin: int, version: int) -> List[int]:
+        """Nodes holding ``version`` from ``origin`` (origin included)."""
+        origin_actor = self.agents[origin].actor_id
+        out = []
+        for i, a in enumerate(self.agents):
+            if i == origin or a.bookie.for_actor(origin_actor).contains_version(
+                version
+            ):
+                out.append(i)
+        return out
+
+    def close(self) -> None:
+        for a in self.agents:
+            try:
+                a.storage.conn.close()
+            except Exception:
+                pass
+        if self._own_dir:
+            shutil.rmtree(self.base_dir, ignore_errors=True)
+
+
+def run_det_epidemic(
+    cluster: DetCluster, origin: int, write_id: int
+) -> Dict:
+    """One full epidemic on the deterministic cluster: write at
+    ``origin``, tick until quiescent, record the per-tick trace.
+
+    Returns {"origin", "version", "ticks": [{"infected": [...],
+    "msgs": [...]} per tick], "converged_tick"} — cumulative msgs are
+    snapshotted per tick so the trace is diffable tick-for-tick against
+    the sim replay."""
+    p = cluster.params
+    version = cluster.write(
+        origin, "INSERT INTO tests (id, text) VALUES (?, ?)",
+        (write_id, f"det-{write_id}"),
+    )
+    base_msgs = list(cluster.msgs)
+    trace = []
+    converged_tick = None
+    for _ in range(p.max_ticks):
+        cluster.tick()
+        infected = cluster.infected(origin, version)
+        trace.append({
+            "infected": infected,
+            "msgs": [m - b for m, b in zip(cluster.msgs, base_msgs)],
+        })
+        if converged_tick is None and len(infected) == p.n_nodes:
+            converged_tick = len(trace) - 1  # relative to epidemic start
+        if cluster.quiescent():
+            break
+    return {
+        "origin": origin,
+        "version": version,
+        "ticks": trace,
+        "converged_tick": converged_tick,
+    }

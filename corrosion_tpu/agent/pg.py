@@ -331,15 +331,35 @@ _SCHEMA_PREFIX_RE = re.compile(
     r"\b(?:pg_catalog|information_schema)\s*\.\s*", re.IGNORECASE
 )
 
-# catalog tables routed even when referenced unqualified — anchored to
-# table position (after FROM/JOIN or a from-list comma, optionally
-# quoted) so a user column or alias merely *named* pg_class doesn't
-# reroute the query
-_CATALOG_TABLE_RE = re.compile(
-    r"(?:\b(?:from|join)\s+|,\s*)(?:only\s+)?\"?"
-    r"(pg_database|pg_class|pg_namespace|pg_attribute|pg_type"
-    r"|pg_index|pg_description|pg_range)\b"
+# catalog tables routed even when referenced unqualified — matched only
+# in genuine table position (FROM/JOIN items) so a user column or alias
+# merely *named* pg_class doesn't reroute the query
+_CATALOG_TABLES = frozenset((
+    "pg_database", "pg_class", "pg_namespace", "pg_attribute", "pg_type",
+    "pg_index", "pg_description", "pg_range",
+))
+_JOIN_ITEM_RE = re.compile(r"\bjoin\s+(?:only\s+)?\"?(\w+)")
+# a FROM clause runs to the keyword that can follow a from-list; commas
+# inside it separate table refs (old-style joins)
+_FROM_CLAUSE_RE = re.compile(
+    r"\bfrom\s+(.*?)(?:\bwhere\b|\bgroup\s+by\b|\border\s+by\b|\bhaving\b"
+    r"|\bwindow\b|\blimit\b|\bunion\b|\bexcept\b|\bintersect\b|$)",
+    re.S,
 )
+_FROM_ITEM_RE = re.compile(r"^\(*\s*(?:only\s+)?\"?(\w+)")
+
+
+def _unqualified_catalog_table(sql: str) -> Optional[str]:
+    """First catalog table referenced in table position, or None."""
+    for m in _JOIN_ITEM_RE.finditer(sql):
+        if m.group(1) in _CATALOG_TABLES:
+            return m.group(1)
+    for mf in _FROM_CLAUSE_RE.finditer(sql):
+        for item in mf.group(1).split(","):
+            mi = _FROM_ITEM_RE.match(item.strip())
+            if mi and mi.group(1) in _CATALOG_TABLES:
+                return mi.group(1)
+    return None
 
 def _catalog_for(agent: "Agent"):
     """Cached rendered catalog (stored on the agent), invalidated by
@@ -430,11 +450,11 @@ class _Session:
         no_literals = re.sub(r"'[^']*'", "''", low)
         unqualified = (
             no_literals.lstrip().startswith("select")
-            and (m := _CATALOG_TABLE_RE.search(no_literals)) is not None
+            and (name := _unqualified_catalog_table(no_literals)) is not None
             # a user table legitimately named e.g. pg_class wins over
             # unqualified catalog routing (qualified pg_catalog.* still
             # routes below)
-            and m.group(1) not in self._user_tables()
+            and name not in self._user_tables()
         )
         if (
             "pg_catalog" in no_literals

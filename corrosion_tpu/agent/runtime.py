@@ -918,15 +918,7 @@ class Agent:
                 cv, remaining, hop = await asyncio.wait_for(
                     self._bcast_queue.get(), timeout=timeout
                 )
-                payload = speedy.encode_uni_payload(
-                    UniPayload(
-                        broadcast=BroadcastV1(change=cv),
-                        cluster_id=ClusterId(cfg.cluster_id),
-                    )
-                )
-                if cfg.debug_hops:
-                    payload = bytes([min(hop, 255)]) + payload
-                frame = speedy.frame(payload)
+                frame = self.encode_broadcast_frame(cv, hop)
                 buffer.append((frame, cv, remaining, set()))
                 buf_bytes += len(frame)
             except asyncio.TimeoutError:
@@ -936,6 +928,42 @@ class Agent:
                 and time.monotonic() - last_flush >= cfg.bcast_flush_interval
             ):
                 await flush()
+
+    def encode_broadcast_frame(self, cv: ChangeV1, hop: int = 0) -> bytes:
+        """One queued broadcast → the exact on-wire frame bytes
+        (speedy UniPayload + u32-BE framing; optional debug-hop prefix).
+        Shared by the live broadcast loop and the deterministic
+        scheduler (``agent/det.py``) so both emit identical bytes."""
+        payload = speedy.encode_uni_payload(
+            UniPayload(
+                broadcast=BroadcastV1(change=cv),
+                cluster_id=ClusterId(self.config.cluster_id),
+            )
+        )
+        if self.config.debug_hops:
+            payload = bytes([min(hop, 255)]) + payload
+        return speedy.frame(payload)
+
+    def decode_uni_frame(self, payload: bytes) -> Optional[ChangeV1]:
+        """One deframed uni-stream payload → its ChangeV1 (or None on a
+        decode error / foreign cluster).  Shared by the live uni-stream
+        server and the deterministic scheduler."""
+        hop = 0
+        if self.config.debug_hops and payload:
+            hop, payload = payload[0], payload[1:]
+        try:
+            up = speedy.decode_uni_payload(payload)
+        except speedy.SpeedyError:
+            self.metrics.counter("corro_wire_decode_errors_total")
+            return None
+        if int(up.cluster_id) != self.config.cluster_id:
+            return None
+        cv = up.broadcast.change
+        if self.config.debug_hops:
+            key = self._seen_key(cv)
+            with self._seen_lock:
+                self._recv_hops.setdefault(key, hop)
+        return cv
 
     # ------------------------------------------------------------------
     # ingest pipeline (handle_changes parity: bounded queue, batching,
@@ -1694,22 +1722,9 @@ class Agent:
 
         def ingest(payloads):
             for payload in payloads:
-                hop = 0
-                if self.config.debug_hops and payload:
-                    hop, payload = payload[0], payload[1:]
-                try:
-                    up = speedy.decode_uni_payload(payload)
-                except speedy.SpeedyError:
-                    self.metrics.counter("corro_wire_decode_errors_total")
-                    continue
-                if int(up.cluster_id) != self.config.cluster_id:
-                    continue
-                cv = up.broadcast.change
-                if self.config.debug_hops:
-                    key = self._seen_key(cv)
-                    with self._seen_lock:
-                        self._recv_hops.setdefault(key, hop)
-                self.enqueue_change(cv, ChangeSource.BROADCAST)
+                cv = self.decode_uni_frame(payload)
+                if cv is not None:
+                    self.enqueue_change(cv, ChangeSource.BROADCAST)
 
         try:
             while True:

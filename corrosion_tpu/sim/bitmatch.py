@@ -1,0 +1,234 @@
+"""Bit-match of the simulator against the real agents (the exactness
+half of the north star).
+
+``agent/det.py`` runs N real agents — real CRR storage, real speedy
+bytes, real ingest — under a discrete-event tick scheduler with seeded
+PRNG streams.  This module is the **simulator side**: a deterministic
+replay of the same protocol model the JAX epidemic kernel implements
+(per-payload ``sent_to`` exclusion, retransmit-decay budget,
+backoff-scheduled retransmissions, rebroadcast-on-learn — the
+``track_sent`` semantics of ``models/broadcast.py``), drawing fanout
+targets from the *same* per-node PRNG streams.
+
+The two sides share exactly two pure functions — ``det_seed_for`` (the
+per-node stream seed) and ``det_backoff_gap`` (tick backoff) — plus the
+sampling *convention* (``Members.sample``: population in ascending node
+index, exclusion filtered before the draw, the whole population
+returned without consuming the stream when it fits the fanout).
+Everything else — who is infected, who may send, what each ``sent_to``
+contains, when budgets exhaust, every message count — is computed
+independently: the agents through their storage/bookkeeping/wire
+pipeline, the sim through this array state machine.  One diverging
+decision desynchronizes the PRNG streams and every later tick, so
+per-tick equality of infected sets and per-node message counts is a
+sharp equivalence test of the protocol semantics, not a replay of
+recorded outputs.
+
+``run_bitmatch`` produces the ``BITMATCH_N{64,256}.json`` artifacts
+(wired into ``bench.py``): per-write per-tick equality plus the first
+mismatching tick, if any.
+
+Reference anchors: sent_to sampling ``broadcast/mod.rs:586-702``,
+retransmit requeue ``:745-765``, rebroadcast-on-learn
+``handlers.rs:939-949``.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from typing import Dict, List, Optional, Set
+
+from corrosion_tpu.agent.det import (
+    DetCluster,
+    DetParams,
+    det_backoff_gap,
+    det_seed_for,
+    run_det_epidemic,
+)
+
+
+def det_sim_epidemic(params: DetParams, origin: int) -> Dict:
+    """Deterministic replay: the simulator's protocol state machine on
+    the shared PRNG streams.  Same trace shape as ``run_det_epidemic``.
+    """
+    n = params.n_nodes
+    rngs = [random.Random(det_seed_for(params.seed, i)) for i in range(n)]
+    return _det_sim_epidemic_with_rngs(params, origin, rngs)
+
+
+def diff_det_traces(sim: Dict, agents: Dict) -> Dict:
+    """Tick-for-tick equality of infected sets and per-node msgs."""
+    s_ticks, a_ticks = sim["ticks"], agents["ticks"]
+    first_mismatch: Optional[int] = None
+    detail: Optional[str] = None
+    for t in range(max(len(s_ticks), len(a_ticks))):
+        if t >= len(s_ticks) or t >= len(a_ticks):
+            first_mismatch = t
+            detail = (
+                f"trace lengths differ: sim {len(s_ticks)} vs "
+                f"agents {len(a_ticks)}"
+            )
+            break
+        if s_ticks[t]["infected"] != a_ticks[t]["infected"]:
+            first_mismatch, detail = t, "infected sets differ"
+            break
+        if s_ticks[t]["msgs"] != a_ticks[t]["msgs"]:
+            first_mismatch, detail = t, "per-node msg counts differ"
+            break
+    return {
+        "match": first_mismatch is None,
+        "ticks_compared": len(s_ticks),
+        "converged_tick_sim": sim["converged_tick"],
+        "converged_tick_agents": agents["converged_tick"],
+        "first_mismatch_tick": first_mismatch,
+        "mismatch_detail": detail,
+    }
+
+
+def run_bitmatch(
+    n: int,
+    writes: int = 2,
+    seed: int = 0,
+    fanout: int = 3,
+    max_transmissions: int = 5,
+    backoff_ticks: float = 2.5,
+    out_path: Optional[str] = None,
+    base_dir: Optional[str] = None,
+) -> Dict:
+    """Run ``writes`` sequential epidemics on both sides and diff them.
+
+    Each write starts from a different origin on the SAME deterministic
+    cluster (state carries over, as it does in a real cluster); the sim
+    side replays each epidemic with fresh single-payload state but the
+    continuing PRNG streams — exactly what the agents' scheduler does,
+    since a quiesced payload leaves no queue state behind.
+    """
+    params = DetParams(
+        n_nodes=n, fanout=fanout, max_transmissions=max_transmissions,
+        backoff_ticks=backoff_ticks, seed=seed,
+    )
+    cluster = DetCluster(params, base_dir=base_dir)
+    sim_rng_state: Optional[List] = None
+    per_write = []
+    try:
+        for w in range(writes):
+            origin = (w * (n // max(writes, 1))) % n
+            agents_trace = run_det_epidemic(cluster, origin, write_id=w)
+            assert cluster.quiescent(), "epidemic did not quiesce"
+            sim_trace = _sim_with_continued_streams(
+                params, origin, sim_rng_state
+            )
+            sim_rng_state = sim_trace.pop("_rng_state")
+            d = diff_det_traces(sim_trace, agents_trace)
+            per_write.append({
+                "origin": origin,
+                **d,
+                "msgs_total": (
+                    sum(agents_trace["ticks"][-1]["msgs"])
+                    if agents_trace["ticks"] else 0
+                ),
+            })
+    finally:
+        cluster.close()
+
+    result = {
+        "metric": "bitmatch_sim_vs_agents",
+        "n_nodes": n,
+        "writes": writes,
+        "seed": seed,
+        "fanout": fanout,
+        "max_transmissions": max_transmissions,
+        "backoff_ticks": backoff_ticks,
+        "bitmatch": all(p["match"] for p in per_write),
+        "per_write": per_write,
+        "conditions": {
+            "agents": (
+                "real Agent objects (CRR storage, speedy wire bytes, "
+                "seen-cache ingest) under the discrete-event scheduler"
+            ),
+            "sim": "deterministic replay of the track_sent model",
+            "shared": "per-node PRNG streams + tick-backoff mapping",
+        },
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+    return result
+
+
+def _sim_with_continued_streams(
+    params: DetParams, origin: int, rng_state: Optional[List]
+) -> Dict:
+    """Replay one epidemic, carrying PRNG stream state across writes the
+    same way the agents' persistent ``_rng`` objects do."""
+    n = params.n_nodes
+    rngs = [random.Random(det_seed_for(params.seed, i)) for i in range(n)]
+    if rng_state is not None:
+        for r, st in zip(rngs, rng_state):
+            r.setstate(st)
+    out = _det_sim_epidemic_with_rngs(params, origin, rngs)
+    out["_rng_state"] = [r.getstate() for r in rngs]
+    return out
+
+
+def _det_sim_epidemic_with_rngs(
+    params: DetParams, origin: int, rngs: List[random.Random]
+) -> Dict:
+    """Core replay loop parameterized by live PRNG objects."""
+    n, k, max_tx = params.n_nodes, params.fanout, params.max_transmissions
+    infected = [False] * n
+    infected[origin] = True
+    remaining = [0] * n
+    remaining[origin] = max_tx
+    next_due = [0] * n
+    sent_to: List[Set[int]] = [set() for _ in range(n)]
+    active = [False] * n
+    active[origin] = True
+    msgs = [0] * n
+
+    trace: List[Dict] = []
+    converged_tick: Optional[int] = None
+    for t in range(params.max_ticks):
+        deliveries: List[int] = []
+        for i in range(n):
+            if not active[i] or next_due[i] > t or remaining[i] < 1:
+                continue
+            pop = [j for j in range(n) if j != i and j not in sent_to[i]]
+            if len(pop) <= k:
+                targets = pop
+            else:
+                targets = rngs[i].sample(pop, k)
+            if not targets:
+                active[i] = False
+                continue
+            sent_to[i].update(targets)
+            msgs[i] += len(targets)
+            deliveries.extend(targets)
+            remaining[i] -= 1
+            if remaining[i] < 1:
+                active[i] = False
+            else:
+                send_count = max_tx - remaining[i]
+                next_due[i] = t + det_backoff_gap(
+                    params.backoff_ticks, send_count
+                )
+        for j in deliveries:
+            if not infected[j]:
+                infected[j] = True
+                active[j] = True
+                remaining[j] = max_tx
+                next_due[j] = t + 1
+        trace.append({
+            "infected": [i for i in range(n) if infected[i]],
+            "msgs": list(msgs),
+        })
+        if converged_tick is None and all(infected):
+            converged_tick = t
+        if not any(active):
+            break
+    return {
+        "origin": origin,
+        "ticks": trace,
+        "converged_tick": converged_tick,
+    }

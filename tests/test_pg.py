@@ -328,6 +328,25 @@ def test_pg_catalog_is_queryable(run):
     run(main())
 
 
+def test_unqualified_catalog_table_position_only():
+    """Unqualified catalog routing keys on genuine table position:
+    FROM/JOIN items (incl. old-style comma joins), never select-list or
+    ORDER BY identifiers that merely share a pg_* name (ADVICE r3)."""
+    from corrosion_tpu.agent.pg import _unqualified_catalog_table as f
+
+    assert f("select * from foo, pg_class") == "pg_class"
+    assert f('select * from "pg_class"') == "pg_class"
+    assert f("select a.attname from foo f join pg_attribute a"
+             " on a.x = f.x") == "pg_attribute"
+    assert f("select c.relname from pg_class c, pg_type t"
+             " where t.oid = c.oid") == "pg_class"
+    # pg_* names OUTSIDE table position must not reroute
+    assert f("select id, pg_type from readings") is None
+    assert f("select id from tests order by id, pg_index") is None
+    assert f("select pg_class from tests where id in (1, 2)") is None
+    assert f("select id from tests group by id, pg_range") is None
+
+
 def test_pg_bind_error_discards_until_sync(run):
     """A failed Bind must not leave the previous portal bound: the
     pipelined Execute that follows is discarded until Sync instead of
