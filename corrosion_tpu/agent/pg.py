@@ -349,17 +349,46 @@ _FROM_CLAUSE_RE = re.compile(
 _FROM_ITEM_RE = re.compile(r"^\(*\s*(?:only\s+)?\"?(\w+)")
 
 
+def _strip_parens(sql: str) -> str:
+    """Blank out parenthesized groups (subqueries, function args) so
+    the from-clause scan below sees only top-level table refs — an
+    inner subquery's WHERE must not terminate the outer from-list."""
+    prev = None
+    while prev != sql:
+        prev = sql
+        sql = re.sub(r"\([^()]*\)", " ", sql)
+    return sql
+
+
 def _unqualified_catalog_table(sql: str) -> Optional[str]:
-    """First catalog table referenced in table position, or None."""
-    for m in _JOIN_ITEM_RE.finditer(sql):
-        if m.group(1) in _CATALOG_TABLES:
-            return m.group(1)
-    for mf in _FROM_CLAUSE_RE.finditer(sql):
-        for item in mf.group(1).split(","):
-            mi = _FROM_ITEM_RE.match(item.strip())
-            if mi and mi.group(1) in _CATALOG_TABLES:
-                return mi.group(1)
+    """First catalog table referenced in table position, or None.
+
+    Scans with subqueries blanked, so a catalog ref *inside* a
+    subquery's from-list is found by scanning each nesting level's
+    stripped text via the recursion below.
+    """
+    for depth_text in _nesting_levels(sql):
+        for m in _JOIN_ITEM_RE.finditer(depth_text):
+            if m.group(1) in _CATALOG_TABLES:
+                return m.group(1)
+        for mf in _FROM_CLAUSE_RE.finditer(depth_text):
+            for item in mf.group(1).split(","):
+                mi = _FROM_ITEM_RE.match(item.strip())
+                if mi and mi.group(1) in _CATALOG_TABLES:
+                    return mi.group(1)
     return None
+
+
+def _nesting_levels(sql: str, max_depth: int = 8):
+    """The query text at each paren-nesting level, outermost first,
+    each with its own inner groups blanked."""
+    level = sql
+    for _ in range(max_depth):
+        yield _strip_parens(level)
+        inners = re.findall(r"\(([^()]*(?:\([^()]*\)[^()]*)*)\)", level)
+        if not inners:
+            return
+        level = " ; ".join(inners)
 
 def _catalog_for(agent: "Agent"):
     """Cached rendered catalog (stored on the agent), invalidated by

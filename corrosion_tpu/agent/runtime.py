@@ -119,6 +119,11 @@ class AgentConfig:
     ring0_enabled: bool = True
     # LRU cap on cached outbound uni connections (fd budget)
     uni_cache_size: int = 512
+    # SWIM datagram format: "foca" = binary foca messages, the wire the
+    # reference relays verbatim (broadcast/mod.rs:185-324, via
+    # bridge/foca.py); "json" = the legacy debuggable envelope.
+    # Receivers accept both (sniffed by first byte) regardless.
+    swim_wire: str = "foca"
     # TLS over the gossip/sync TCP streams (main.rs:707-760 tooling,
     # peer.rs:128-318 rustls config). Off unless tls_cert_file is set;
     # SWIM datagrams stay plaintext UDP (see agent/tls.py).
@@ -160,6 +165,14 @@ class Agent:
         # its previous life, so rejoin is immediate (foca renew())
         self.incarnation = self._load_incarnation() + 1
         self._persist_incarnation()
+        # foca identity generation: our Actor.ts; a renewed (rejoined)
+        # identity carries a fresh ts (actor.rs renew())
+        self._identity_ts = int(self.clock.new_timestamp())
+        # per-peer identity ts + per-update transmission counts (foca's
+        # freshness-prioritized update backlog)
+        self._swim_ts: Dict[bytes, int] = {}
+        self._swim_update_tx: Dict[bytes, int] = {}
+        self._probe_seq = 0  # wrapping u16 ProbeNumber counter
         self._seen: Dict[tuple, None] = {}
         # apply workers call handle_change concurrently; the seen cache's
         # check/insert/evict must be atomic across them
@@ -311,12 +324,7 @@ class Agent:
         # instead of burning a probe->suspect->down cycle on us.
         # graceful=False simulates a crash (tests of the suspicion path)
         if graceful and self._udp is not None:
-            for m in self.members.alive():
-                self._send_udp(
-                    m.addr,
-                    {"k": "leave", "a": wire._b64(self.actor_id),
-                     "i": self.incarnation},
-                )
+            self._swim_leave()
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
@@ -470,6 +478,63 @@ class Agent:
             self.metrics.counter("corro_gossip_datagrams_sent_total")
             self._udp.sendto(data, tuple(addr))
 
+    def _next_probe_number(self) -> int:
+        """Wrapping u16 probe counter (foca's ProbeNumber space): a
+        sequential counter cannot collide across the ≤2 concurrent
+        probes the loop runs, where random 16-bit draws eventually
+        would — a collision overwrites one probe's ack future and the
+        loser reads as a failed probe."""
+        self._probe_seq = (self._probe_seq + 1) & 0xFFFF
+        return self._probe_seq
+
+    def _swim_announce(self, addr: Tuple[str, int]) -> None:
+        if self.config.swim_wire == "foca":
+            from corrosion_tpu.agent import swim_foca
+
+            swim_foca.announce(self, addr)
+        else:
+            self._send_udp(addr, {"k": "announce", "pb": self._piggyback()})
+
+    def _swim_probe(self, m: Member, nonce: int) -> None:
+        if self.config.swim_wire == "foca":
+            from corrosion_tpu.agent import swim_foca
+
+            swim_foca.probe(self, m, nonce)
+        else:
+            self._send_udp(
+                m.addr, {"k": "probe", "n": nonce, "pb": self._piggyback()}
+            )
+
+    def _swim_ping_req(self, helper: Member, target: Member,
+                       nonce: int) -> None:
+        if self.config.swim_wire == "foca":
+            from corrosion_tpu.agent import swim_foca
+
+            swim_foca.ping_req(self, helper, target, nonce)
+        else:
+            self._send_udp(
+                helper.addr,
+                {
+                    "k": "ping_req",
+                    "n": nonce,
+                    "target": [target.addr[0], target.addr[1]],
+                    "reply_to": [self.gossip_addr[0], self.gossip_addr[1]],
+                },
+            )
+
+    def _swim_leave(self) -> None:
+        if self.config.swim_wire == "foca":
+            from corrosion_tpu.agent import swim_foca
+
+            swim_foca.leave(self)
+        else:
+            for m in self.members.alive():
+                self._send_udp(
+                    m.addr,
+                    {"k": "leave", "a": wire._b64(self.actor_id),
+                     "i": self.incarnation},
+                )
+
     async def _announce_loop(self) -> None:
         delay = 0.1
         while True:
@@ -479,9 +544,13 @@ class Agent:
             ]
             for addr in targets:
                 if addr != self.gossip_addr and addr not in known:
-                    self._send_udp(
-                        addr, {"k": "announce", "pb": self._piggyback()}
-                    )
+                    try:
+                        self._swim_announce(addr)
+                    except Exception:
+                        # a bad bootstrap entry must not kill the loop
+                        self.metrics.counter(
+                            "corro_swim_announce_errors_total"
+                        )
             if known or not targets:
                 delay = min(delay * 2, 30.0)
             await asyncio.sleep(delay)
@@ -504,15 +573,21 @@ class Agent:
     def rejoin(self) -> int:
         """Renew our identity and re-announce (foca ``Identity::renew``
         + the admin Rejoin command, ``actor.rs:199-210``): bump our
-        incarnation so peers holding a stale/suspect view refresh it,
-        then announce to every known member and configured bootstrap."""
+        incarnation (and, on the foca wire, our identity ts — a renewed
+        identity is a fresh generation that replaces any stale DOWN
+        record wholesale) so peers holding a stale/suspect view refresh
+        it, then announce to every known member and configured
+        bootstrap."""
         self.incarnation += 1
         self._persist_incarnation()
+        self._identity_ts = max(
+            self._identity_ts + 1, int(self.clock.new_timestamp())
+        )
         targets = {tuple(m.addr) for m in self.members.alive()}
         targets.update(_parse_addr(b) for b in self.config.bootstrap)
         targets.discard(tuple(self.gossip_addr))
         for addr in targets:
-            self._send_udp(addr, {"k": "announce", "pb": self._piggyback()})
+            self._swim_announce(addr)
         return len(targets)
 
     def apply_schema_sql(self, sql: str) -> List[str]:
@@ -558,11 +633,11 @@ class Agent:
                 self._mark_suspect(target)
 
     async def _probe(self, m: Member, timeout: Optional[float] = None) -> bool:
-        nonce = self._rng.getrandbits(48)
+        nonce = self._next_probe_number()
         fut = self._loop.create_future()
         self._acks[nonce] = fut
         t0 = time.monotonic()
-        self._send_udp(m.addr, {"k": "probe", "n": nonce, "pb": self._piggyback()})
+        self._swim_probe(m, nonce)
         try:
             await asyncio.wait_for(fut, timeout or self.config.probe_timeout)
             self.members.record_rtt(m.actor_id, (time.monotonic() - t0) * 1e3)
@@ -583,19 +658,11 @@ class Agent:
         helpers = self._rng.sample(
             helpers, min(self.config.num_indirect_probes, len(helpers))
         )
-        nonce = self._rng.getrandbits(48)
+        nonce = self._next_probe_number()
         fut = self._loop.create_future()
         self._acks[nonce] = fut
         for h in helpers:
-            self._send_udp(
-                h.addr,
-                {
-                    "k": "ping_req",
-                    "n": nonce,
-                    "target": [target.addr[0], target.addr[1]],
-                    "reply_to": [self.gossip_addr[0], self.gossip_addr[1]],
-                },
-            )
+            self._swim_ping_req(h, target, nonce)
         try:
             await asyncio.wait_for(fut, self.config.probe_timeout * 2)
             self._suspects.pop(target.actor_id, None)
@@ -2060,6 +2127,14 @@ class _UdpProtocol(asyncio.DatagramProtocol):
 
     def datagram_received(self, data: bytes, addr) -> None:
         a = self.agent
+        # wire sniff: JSON envelopes start with '{'; foca datagrams
+        # start with the uuid length prefix (0x10).  Receivers accept
+        # both so mixed-wire clusters interoperate.
+        if not data.startswith(b"{"):
+            from corrosion_tpu.agent import swim_foca
+
+            swim_foca.handle_datagram(a, data, addr)
+            return
         try:
             msg = wire.decode_datagram(data)
         except ValueError:

@@ -10,11 +10,13 @@ What actually travels on each channel class (keep this current —
   ``bridge/speedy.py`` and ``runtime.py`` for the encode/decode call
   sites.  The JSON envelope in this module is NOT used on those
   streams.
-* **SWIM datagrams (membership)** — the length-prefixed JSON envelope
-  defined here (bytes fields base64-encoded).  This is the one channel
-  class still diverging from the reference, which relays foca's own
-  binary messages verbatim
-  (``crates/corro-agent/src/broadcast/mod.rs:185-324``).
+* **SWIM datagrams (membership)** — binary foca messages
+  (``bridge/foca.py`` + ``agent/swim_foca.py``), the wire the
+  reference relays verbatim
+  (``crates/corro-agent/src/broadcast/mod.rs:185-324``); this is the
+  default (``AgentConfig.swim_wire == "foca"``).  The JSON envelope
+  defined in this module remains the ``swim_wire="json"`` fallback,
+  and receivers accept both formats (sniffed by first byte).
 
 Message kinds:
   swim:     {kind, probe|ack|ping_req|gossip..., member entries}

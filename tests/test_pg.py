@@ -340,6 +340,12 @@ def test_unqualified_catalog_table_position_only():
              " on a.x = f.x") == "pg_attribute"
     assert f("select c.relname from pg_class c, pg_type t"
              " where t.oid = c.oid") == "pg_class"
+    # subqueries: an inner WHERE must not hide later from-list items,
+    # and catalog refs inside subqueries are still found
+    assert f("select s.x, c.relname from (select 1 as x from t"
+             " where t.id > 0) s, pg_class c") == "pg_class"
+    assert f("select x from (select relname as x from pg_class) q"
+             ) == "pg_class"
     # pg_* names OUTSIDE table position must not reroute
     assert f("select id, pg_type from readings") is None
     assert f("select id from tests order by id, pg_index") is None
