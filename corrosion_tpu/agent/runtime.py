@@ -78,7 +78,8 @@ class AgentConfig:
     # perf knobs (reference defaults in config.rs / broadcast mod)
     probe_interval: float = 0.4
     probe_timeout: float = 0.35
-    suspect_timeout: float = 2.0
+    suspect_timeout: float = 2.0  # floor; scaled up with cluster size
+    suspicion_mult: int = 4  # suspicion deadline growth multiplier
     num_indirect_probes: int = 3
     fanout: int = 3
     max_transmissions: int = 5
@@ -433,6 +434,7 @@ class Agent:
             self.gossip_addr[1],
             MemberState.ALIVE.value,
             self.incarnation,
+            self._identity_ts,
         ]
 
     def _piggyback(self, k: int = 5) -> list:
@@ -446,12 +448,18 @@ class Agent:
                     m.addr[1],
                     m.state.value,
                     m.incarnation,
+                    # identity ts rides the JSON wire too, so a member
+                    # learned here is advertised with its real identity
+                    # generation on the foca wire (mixed-wire clusters)
+                    self._swim_ts.get(m.actor_id, 0),
                 ]
             )
         return entries
 
     def _ingest_piggyback(self, entries: list) -> None:
-        for actor_b64, host, port, state, inc in entries:
+        for entry in entries:
+            actor_b64, host, port, state, inc = entry[:5]
+            ts = entry[5] if len(entry) > 5 else 0
             actor = wire._unb64(actor_b64)
             if actor == self.actor_id:
                 # refute anything non-alive said about us
@@ -459,7 +467,12 @@ class Agent:
                     self.incarnation = inc + 1
                     self._persist_incarnation()
                 continue
-            self.members.upsert(actor, (host, port), MemberState(state), inc)
+            if ts > self._swim_ts.get(actor, 0):
+                self._swim_ts[actor] = ts
+            if self.members.upsert(
+                actor, (host, port), MemberState(state), inc
+            ):
+                self._swim_update_tx[actor] = 0  # fresh news
 
     def _send_udp(self, addr: Tuple[str, int], msg: dict) -> None:
         if self._udp:
@@ -587,7 +600,12 @@ class Agent:
         targets.update(_parse_addr(b) for b in self.config.bootstrap)
         targets.discard(tuple(self.gossip_addr))
         for addr in targets:
-            self._swim_announce(addr)
+            try:
+                self._swim_announce(addr)
+            except Exception:
+                # one bad (e.g. unresolvable) target must not abort the
+                # whole rejoin fan-out
+                self.metrics.counter("corro_swim_announce_errors_total")
         return len(targets)
 
     def apply_schema_sql(self, sql: str) -> List[str]:
@@ -617,6 +635,10 @@ class Agent:
         announced = self.rejoin()
         for m in old_members:
             self.members.remove(m.actor_id)
+        # fresh cluster, fresh SWIM bookkeeping (and the only unbounded
+        # growth path for these per-identity dicts)
+        self._swim_ts.clear()
+        self._swim_update_tx.clear()
         return announced
 
     async def _probe_loop(self) -> None:
@@ -678,18 +700,35 @@ class Agent:
             m.actor_id, m.addr, MemberState.SUSPECT, m.incarnation
         ):
             self._suspects[m.actor_id] = time.monotonic()
+            self._swim_update_tx[m.actor_id] = 0  # fresh news
+
+    def _suspect_deadline(self) -> float:
+        """Cluster-size-scaled suspicion timeout (make_foca_config →
+        Config::new_wan, broadcast/mod.rs:937-946): configured value as
+        the floor, growing log10 with membership so big clusters don't
+        declare slow-but-alive members down."""
+        from corrosion_tpu.utils.swimscale import scaled_suspect_timeout
+
+        return scaled_suspect_timeout(
+            self.config.suspect_timeout,
+            self.config.probe_interval,
+            len(self.members.alive()) + 1,
+            self.config.suspicion_mult,
+        )
 
     async def _suspect_reaper(self) -> None:
         while True:
             await asyncio.sleep(self.config.probe_interval)
             now = time.monotonic()
+            deadline = self._suspect_deadline()
             for actor, since in list(self._suspects.items()):
-                if now - since >= self.config.suspect_timeout:
+                if now - since >= deadline:
                     m = self.members.get(actor)
                     if m and m.state is MemberState.SUSPECT:
                         self.members.upsert(
                             actor, m.addr, MemberState.DOWN, m.incarnation
                         )
+                        self._swim_update_tx[actor] = 0  # fresh news
                     self._suspects.pop(actor, None)
 
     # ------------------------------------------------------------------

@@ -31,7 +31,7 @@ update backlog policy) up to the 1178-byte packet cap.
 
 from __future__ import annotations
 
-import time
+import functools
 from typing import TYPE_CHECKING, List, Optional, Tuple
 
 from corrosion_tpu.agent.members import MemberState
@@ -78,21 +78,27 @@ def _nil_actor(agent: "Agent", addr: Tuple[str, int]) -> foca.FocaActor:
 
 def piggyback(agent: "Agent", k: int = 5) -> List[foca.FocaMember]:
     """Self entry + up to k freshest (least-transmitted) member
-    updates.  Transmission counts persist on the agent so hot updates
-    decay out of the backlog the way foca's update queue does."""
+    updates.  Transmission counts persist on the agent and an entry
+    decays out of the backlog after the cluster-size-scaled
+    retransmission limit — foca's update queue policy (reset to fresh
+    whenever the record changes)."""
+    from corrosion_tpu.utils.swimscale import scaled_update_retransmissions
+
     out = [foca.FocaMember(
         actor=self_actor(agent),
         incarnation=agent.incarnation,
         state=foca.STATE_ALIVE,
     )]
     members = agent.members.all()
+    limit = scaled_update_retransmissions(len(members) + 1)
     members.sort(
         key=lambda m: agent._swim_update_tx.get(m.actor_id, 0)
     )
     for m in members[:k]:
-        agent._swim_update_tx[m.actor_id] = (
-            agent._swim_update_tx.get(m.actor_id, 0) + 1
-        )
+        tx = agent._swim_update_tx.get(m.actor_id, 0)
+        if tx >= limit:
+            break  # sorted ascending: everything after is decayed too
+        agent._swim_update_tx[m.actor_id] = tx + 1
         out.append(foca.FocaMember(
             actor=_member_actor(agent, m.actor_id, m.addr),
             incarnation=m.incarnation,
@@ -118,23 +124,33 @@ def send(agent: "Agent", addr: Tuple[str, int], dst: foca.FocaActor,
     agent._udp.sendto(data, tuple(addr))
 
 
+@functools.lru_cache(maxsize=256)
+def _resolve_host(host: str) -> str:
+    """Hostname → numeric IP, cached: getaddrinfo blocks, and the
+    announce loop re-announces the same bootstrap hosts every cycle —
+    a slow DNS server must not stall the event loop (and with it every
+    in-flight probe) more than once per host."""
+    import socket
+
+    try:
+        infos = socket.getaddrinfo(host, None, type=socket.SOCK_DGRAM)
+    except OSError:
+        return host  # send() will fail; caller's problem
+    return infos[0][4][0]
+
+
 def _resolve(addr: Tuple[str, int]) -> Tuple[str, int]:
     """Bootstrap entries may be hostnames; the wire's SocketAddr form
     is numeric (the reference resolves bootstrap names before
     announcing)."""
     import ipaddress
-    import socket
 
     host, port = addr
     try:
         ipaddress.ip_address(host)
         return (host, port)
     except ValueError:
-        try:
-            infos = socket.getaddrinfo(host, port, type=socket.SOCK_DGRAM)
-        except OSError:
-            return (host, port)  # send() will fail; caller's problem
-        return (infos[0][4][0], port)
+        return (_resolve_host(host), port)
 
 
 def announce(agent: "Agent", addr: Tuple[str, int]) -> None:
@@ -194,10 +210,12 @@ def _ingest_update(agent: "Agent", fm: foca.FocaMember) -> None:
         agent._swim_ts[fm.actor.id] = fm.actor.ts
         if known_ts is not None:
             agent.members.remove(fm.actor.id)
-    agent.members.upsert(
+    if agent.members.upsert(
         fm.actor.id, fm.actor.addr, _WIRE_TO_STATE[fm.state],
         fm.incarnation,
-    )
+    ):
+        # a changed record is fresh news: back into the gossip backlog
+        agent._swim_update_tx[fm.actor.id] = 0
 
 
 def handle_datagram(agent: "Agent", data: bytes, addr) -> None:

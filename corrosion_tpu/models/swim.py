@@ -46,6 +46,29 @@ class SwimParams:
     gossip_targets: int = 3  # peers gossiped to per tick
     gossip_entries: int = 6  # view entries piggybacked per gossip msg
     loss: float = 0.0  # per-leg message drop probability
+    # foca's update backlog decay: an entry rides at most this many
+    # gossip rounds after it last changed, then leaves circulation
+    # (scale with cluster size via utils/swimscale.py)
+    update_tx_limit: int = 8
+
+    @classmethod
+    def scaled(cls, n_nodes: int, probe_ticks: int = 1, **overrides):
+        """Cluster-size-scaled parameters (foca Config::new_wan via
+        make_foca_config, broadcast/mod.rs:937-946): suspicion deadline
+        and update retransmission limit grow with ceil(log10(n+1))."""
+        from corrosion_tpu.utils.swimscale import (
+            scaled_suspect_timeout,
+            scaled_update_retransmissions,
+        )
+
+        defaults = dict(
+            suspect_timeout=int(
+                scaled_suspect_timeout(0, probe_ticks, n_nodes)
+            ),
+            update_tx_limit=scaled_update_retransmissions(n_nodes),
+        )
+        defaults.update(overrides)
+        return cls(n_nodes=n_nodes, **defaults)
 
 
 class SwimState(NamedTuple):
@@ -53,6 +76,9 @@ class SwimState(NamedTuple):
     suspect_since: jnp.ndarray  # [N, N] int32 tick, _NEVER when not suspect
     incarnation: jnp.ndarray  # [N] int32 own incarnation
     msgs: jnp.ndarray  # [N] int32 messages sent
+    # [N, N] gossip rounds entry (i, j) rode since it last changed
+    # (freshness-prioritized piggyback + decay, foca's update backlog)
+    update_tx: jnp.ndarray
 
 
 def member_key(inc, state):
@@ -74,6 +100,7 @@ def swim_init(n_nodes: int) -> SwimState:
         suspect_since=jnp.full((n_nodes, n_nodes), _NEVER, jnp.int32),
         incarnation=jnp.zeros(n_nodes, jnp.int32),
         msgs=jnp.zeros(n_nodes, jnp.int32),
+        update_tx=jnp.zeros((n_nodes, n_nodes), jnp.int32),
     )
 
 
@@ -85,10 +112,10 @@ def swim_step(state: SwimState, key, tick, params: SwimParams, alive):
     ack, send, or gossip.  Returns the next SwimState.
     """
     n = params.n_nodes
-    k_probe, k_loss1, k_loss2, k_help, k_hloss, k_gt, k_ge, k_gloss = (
-        jax.random.split(key, 8)
-    )
-    view, suspect_since, inc, msgs = state
+    (k_probe, k_loss1, k_loss2, k_help, k_hloss, k_gt, k_ge, k_gloss,
+     k_tu) = jax.random.split(key, 9)
+    view, suspect_since, inc, msgs, update_tx = state
+    view_in = view  # for end-of-tick change detection (backlog reset)
 
     def lossy(k, shape):
         if params.loss > 0.0:
@@ -143,10 +170,27 @@ def swim_step(state: SwimState, key, tick, params: SwimParams, alive):
     view = jnp.where(expired, member_key(key_inc(view), DOWN), view)
 
     # --- gossip dissemination ---------------------------------------------
-    g, m = params.gossip_targets, params.gossip_entries
+    # freshness-prioritized piggyback (foca's update backlog): each node
+    # gossips its LEAST-retransmitted entries, random tie-break; entries
+    # past the retransmission limit decay out of circulation entirely
+    g = params.gossip_targets
+    m = min(params.gossip_entries, n)  # top_k cap on tiny clusters
     gt = rand_peers(k_gt, n, (n, g))  # [N, G] gossip targets
-    ge = jax.random.randint(k_ge, (n, m), 0, n)  # [N, M] entries sampled
-    ok = alive[:, None, None] & lossy(k_gloss, (n, g, m)) & alive[gt][:, :, None]
+    tie = jax.random.uniform(k_ge, (n, n))
+    scores = update_tx.astype(jnp.float32) + tie
+    scores = jnp.where(
+        update_tx >= params.update_tx_limit, jnp.inf, scores
+    )
+    _, ge = jax.lax.top_k(-scores, m)  # [N, M] freshest entries
+    sendable = (
+        jnp.take_along_axis(update_tx, ge, axis=1) < params.update_tx_limit
+    )  # [N, M]
+    ok = (
+        alive[:, None, None]
+        & lossy(k_gloss, (n, g, m))
+        & alive[gt][:, :, None]
+        & sendable[:, None, :]
+    )
     payload = view[jnp.arange(n)[:, None], ge]  # [N, M] sender's entries
     payload = jnp.broadcast_to(payload[:, None, :], (n, g, m))
     members = jnp.broadcast_to(ge[:, None, :], (n, g, m))
@@ -157,13 +201,36 @@ def swim_step(state: SwimState, key, tick, params: SwimParams, alive):
         view.reshape(-1).at[flat_idx].max(payload.reshape(-1), mode="drop")
     ).reshape(n, n)
     msgs = msgs + (alive * g).astype(jnp.int32)
+    # charge one backlog round per selected sendable entry
+    sent_round = sendable & alive[:, None]
+    update_tx = update_tx.at[
+        jnp.arange(n)[:, None], ge
+    ].add(sent_round.astype(jnp.int32))
 
     # --- refutation / renewal --------------------------------------------
     # a live node that sees itself non-alive in its own merged row bumps
-    # its incarnation past the offending record and re-announces
+    # its incarnation past the offending record and re-announces.  A
+    # DOWN record that already decayed out of the gossip backlog can't
+    # reach the victim that way — the TurnUndead path covers it: the
+    # probed peer holds a DOWN record of its prober and tells it
+    # directly (foca notify_down_members / TurnUndead, mirrored by the
+    # host's swim_foca handler)
     self_key = view[rows, rows]
-    offended = alive & (key_state(self_key) != ALIVE)
-    new_inc = jnp.where(offended, key_inc(self_key) + 1, jnp.maximum(inc, key_inc(self_key)))
+    peer_rec = view[target, rows]  # [N] probed peer's record of ME
+    # TurnUndead is a real exchange: our contact must reach the peer and
+    # its reply must come back — same loss model as every other leg
+    told_undead = (
+        alive & alive[target] & (key_state(peer_rec) == DOWN)
+        & lossy(k_tu, (n, 2)).all(axis=1)
+    )
+    offending = jnp.maximum(
+        self_key, jnp.where(told_undead, peer_rec, 0)
+    )
+    offended = alive & ((key_state(self_key) != ALIVE) | told_undead)
+    new_inc = jnp.where(
+        offended, key_inc(offending) + 1,
+        jnp.maximum(inc, key_inc(self_key)),
+    )
     inc = jnp.maximum(inc, new_inc)
     view = view.at[rows, rows].set(
         jnp.where(alive, member_key(inc, ALIVE), self_key)
@@ -176,4 +243,7 @@ def swim_step(state: SwimState, key, tick, params: SwimParams, alive):
     )
     suspect_since = jnp.where(now_suspect, suspect_since, _NEVER)
 
-    return SwimState(view, suspect_since, inc, msgs)
+    # --- backlog reset: a changed record is fresh news again --------------
+    update_tx = jnp.where(view != view_in, 0, update_tx)
+
+    return SwimState(view, suspect_since, inc, msgs, update_tx)

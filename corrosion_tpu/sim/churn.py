@@ -43,7 +43,13 @@ class ChurnConfig:
 
     def __post_init__(self):
         if self.params is None:
-            object.__setattr__(self, "params", SwimParams(n_nodes=self.n_nodes))
+            # cluster-size-scaled SWIM parameters (make_foca_config /
+            # Config::new_wan parity): at N=64 the suspicion deadline is
+            # 4 * ceil(log10(65)) = 8 probe ticks and updates ride at
+            # most 8 gossip rounds
+            object.__setattr__(
+                self, "params", SwimParams.scaled(self.n_nodes)
+            )
 
 
 @partial(jax.jit, static_argnames=("cfg",))

@@ -1,5 +1,6 @@
 import jax
 import jax.numpy as jnp
+import pytest
 import numpy as np
 
 from corrosion_tpu.models.swim import (
@@ -81,3 +82,75 @@ def test_messages_bounded_per_tick():
         + p.gossip_targets
     )
     assert per_tick <= bound
+
+
+def test_update_backlog_decays_then_refreshes():
+    """Freshness piggyback (foca's update queue): a stable cluster's
+    entries saturate at the retransmission limit and stop circulating;
+    a record change resets its counter to fresh."""
+    n = 16
+    p = SwimParams(n_nodes=n, update_tx_limit=4)
+    st, _ = _run(n, 12, lambda t: jnp.ones((n,), bool), params=p)
+    tx = np.asarray(st.update_tx)
+    assert tx.max() <= p.update_tx_limit
+    # most entries have decayed out by now (each node charges
+    # gossip_entries per tick over n peers)
+    assert (tx >= p.update_tx_limit).mean() > 0.5
+    # kill a node: detectors' records change and become fresh again
+    st2 = st
+    key = jax.random.PRNGKey(9)
+    alive = jnp.ones((n,), bool).at[3].set(False)
+    for t in range(12, 24):
+        st2 = swim_step(
+            st2, jax.random.fold_in(key, t), jnp.int32(t), p, alive
+        )
+    col_states = np.asarray(key_state(st2.view[:, 3]))
+    others = np.arange(n) != 3
+    assert (col_states[others] != ALIVE).any(), "death must be noticed"
+
+
+def test_scaled_params_grow_with_cluster():
+    from corrosion_tpu.utils.swimscale import (
+        scaled_suspect_timeout,
+        scaled_update_retransmissions,
+        swim_scale_factor,
+    )
+
+    assert swim_scale_factor(3) == 1
+    assert swim_scale_factor(64) == 2
+    assert swim_scale_factor(512) == 3
+    assert swim_scale_factor(100_000) == 6
+    # suspicion deadline: configured floor wins for small clusters,
+    # the scaled term takes over as membership grows
+    assert scaled_suspect_timeout(2.0, 0.4, 3) == 2.0
+    assert scaled_suspect_timeout(2.0, 0.4, 64) == pytest.approx(3.2)
+    assert scaled_suspect_timeout(2.0, 0.4, 512) == pytest.approx(4.8)
+    assert scaled_update_retransmissions(64) == 8
+    # the model's scaled constructor uses the same terms
+    p = SwimParams.scaled(64)
+    assert p.suspect_timeout == 8 and p.update_tx_limit == 8
+
+
+def test_agent_suspect_deadline_scales(run_async=None):
+    import asyncio
+
+    from corrosion_tpu.agent.testing import launch_test_agent
+
+    async def main():
+        a = await launch_test_agent()
+        try:
+            base = a._suspect_deadline()  # tiny cluster: floor
+            assert base == a.config.suspect_timeout
+            for i in range(99):
+                a.members.upsert(bytes([i]) * 16, ("127.0.0.1", 1000 + i))
+            grown = a._suspect_deadline()
+            # 100 members: factor 3 -> 4 * 3 * probe_interval
+            assert grown == pytest.approx(
+                max(a.config.suspect_timeout,
+                    4 * 3 * a.config.probe_interval)
+            )
+            assert grown >= base
+        finally:
+            await a.stop()
+
+    asyncio.run(main())
