@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from corrosion_tpu.agent import tracing, wire
+from corrosion_tpu.agent.locks import PRIO_HIGH, PRIO_LOW
 from corrosion_tpu.agent.bookkeeping import Bookie
 from corrosion_tpu.agent.members import Member, Members, MemberState
 from corrosion_tpu.agent.schema import apply_schema
@@ -468,7 +469,13 @@ class Agent:
                     self._persist_incarnation()
                 continue
             if ts > self._swim_ts.get(actor, 0):
+                # renewed identity generation: same replacement rule as
+                # the foca wire (swim_foca._ingest_update) — the fresh
+                # incarnation space must override a stale DOWN record,
+                # so drop the old member before the upsert
                 self._swim_ts[actor] = ts
+                if self.members.get(actor) is not None:
+                    self.members.remove(actor)
             if self.members.upsert(
                 actor, (host, port), MemberState(state), inc
             ):
@@ -777,8 +784,10 @@ class Agent:
         # hold the storage lock across COMMIT *and* the in-memory bookie
         # update: the version counter (booked.last()+1) must not be read
         # by a second writer between our COMMIT and apply_version, and
-        # apply_version must not race generate_sync's locked snapshot
-        with self.storage._lock:
+        # apply_version must not race generate_sync's locked snapshot.
+        # HIGH tier: client writes ride write_priority() in the
+        # reference (api/public/mod.rs:59)
+        with self.storage._lock.prio(PRIO_HIGH, "write", kind="write"):
             with self.storage.write_tx() as conn:
                 for stmt in statements:
                     if isinstance(stmt, str):
@@ -822,7 +831,9 @@ class Agent:
         Returns the cleared (start, end) ranges.
         """
         cleared: List[Tuple[int, int]] = []
-        with self.storage._lock:
+        # LOW tier: compaction is maintenance — the reference clears
+        # overwritten/empty ranges on write_low (handlers.rs:635-691)
+        with self.storage._lock.prio(PRIO_LOW, "compaction"):
             any_impacted, gone = self.storage.overwritten_local_db_versions()
             if not any_impacted:
                 return []

@@ -651,9 +651,14 @@ END;
         """One local transaction == at most one allocated db_version.
 
         Mirrors cr-sqlite: the version is only consumed if the transaction
-        actually produced changes.
+        actually produced changes.  Client writes take the HIGH tier —
+        the reference's API write path acquires ``write_priority()``
+        (``api/public/mod.rs:59``) so users aren't queued behind
+        replication or maintenance.
         """
-        with self._lock:
+        from corrosion_tpu.agent.locks import PRIO_HIGH
+
+        with self._lock.prio(PRIO_HIGH, "write", kind="write"):
             self.conn.execute("BEGIN IMMEDIATE")
             pending = self._state("db_version") + 1
             self._set_state("pending_db_version", pending)
@@ -773,12 +778,14 @@ END;
     def apply_tx(self):
         """Open one merge transaction; bookkeeping writes through the same
         connection commit atomically with the applied changes.  Applies
-        take the HIGH write tier: replicated changes beat local API
-        writes and maintenance to the connection (agent.rs write-pool
-        priorities)."""
-        from corrosion_tpu.agent.locks import PRIO_HIGH
+        take the NORMAL write tier — the reference runs
+        ``process_multiple_changes`` on ``write_normal()``
+        (``agent/util.rs:814``), below client API writes
+        (``write_priority()``) and above maintenance (``write_low()``),
+        so local writers stay responsive while replication streams in."""
+        from corrosion_tpu.agent.locks import PRIO_NORMAL
 
-        with self._lock.prio(PRIO_HIGH, "apply", kind="apply"):
+        with self._lock.prio(PRIO_NORMAL, "apply", kind="apply"):
             self.conn.execute("BEGIN IMMEDIATE")
             try:
                 self._set_state("apply_mode", 1)

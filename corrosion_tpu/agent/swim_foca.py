@@ -31,7 +31,6 @@ update backlog policy) up to the 1178-byte packet cap.
 
 from __future__ import annotations
 
-import functools
 from typing import TYPE_CHECKING, List, Optional, Tuple
 
 from corrosion_tpu.agent.members import MemberState
@@ -124,19 +123,34 @@ def send(agent: "Agent", addr: Tuple[str, int], dst: foca.FocaActor,
     agent._udp.sendto(data, tuple(addr))
 
 
-@functools.lru_cache(maxsize=256)
-def _resolve_host(host: str) -> str:
-    """Hostname → numeric IP, cached: getaddrinfo blocks, and the
-    announce loop re-announces the same bootstrap hosts every cycle —
-    a slow DNS server must not stall the event loop (and with it every
-    in-flight probe) more than once per host."""
-    import socket
+_RESOLVE_TTL = 30.0
+_resolve_cache: dict = {}  # host -> (ip, expires_at)
 
+
+def _resolve_host(host: str) -> str:
+    """Hostname → numeric IP with a short success-only TTL cache:
+    getaddrinfo blocks, and the announce loop re-announces the same
+    bootstrap hosts every cycle — a slow DNS server must not stall the
+    event loop (and with it every in-flight probe) on each pass.
+    Failures are NOT cached (a bootstrap peer whose record appears
+    later must still resolve) and entries expire so re-scheduled hosts
+    pick up their new address."""
+    import socket
+    import time
+
+    hit = _resolve_cache.get(host)
+    now = time.monotonic()
+    if hit is not None and hit[1] > now:
+        return hit[0]
     try:
         infos = socket.getaddrinfo(host, None, type=socket.SOCK_DGRAM)
     except OSError:
-        return host  # send() will fail; caller's problem
-    return infos[0][4][0]
+        return host  # send() will fail; retried next cycle
+    ip = infos[0][4][0]
+    _resolve_cache[host] = (ip, now + _RESOLVE_TTL)
+    if len(_resolve_cache) > 512:
+        _resolve_cache.clear()  # crude bound; bootstrap sets are tiny
+    return ip
 
 
 def _resolve(addr: Tuple[str, int]) -> Tuple[str, int]:

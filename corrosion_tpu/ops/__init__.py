@@ -9,7 +9,6 @@ from corrosion_tpu.ops.keys import KeyCodec, DEFAULT_CODEC
 from corrosion_tpu.ops.merge import (
     merge_cells,
     merge_keys,
-    pallas_merge_cells,
     scatter_merge,
 )
 
@@ -19,5 +18,4 @@ __all__ = [
     "merge_keys",
     "scatter_merge",
     "merge_cells",
-    "pallas_merge_cells",
 ]

@@ -25,50 +25,6 @@ def merge_cells(states):
     return jnp.max(states, axis=0)
 
 
-def pallas_merge_cells(states, block_rows: int = 256, interpret=None):
-    """Pallas twin of :func:`merge_cells`: the R-replica LWW join as a
-    tiled TPU kernel (SURVEY §7.1's "pallas kernel for the hot merge";
-    the jnp path stays the semantic reference and the fallback).
-
-    states: [R, N, C] int32 packed keys.  The grid walks row blocks;
-    each step loads all R replicas' [block, C] tiles into VMEM and
-    reduces them on the VPU.  ``interpret=None`` auto-selects the
-    interpreter off-TPU so the kernel is testable anywhere.
-    """
-    import jax
-    from jax.experimental import pallas as pl
-
-    r, n, c = states.shape
-    if interpret is None:
-        # interpreter only where pallas has no native lowering (CPU);
-        # TPU and GPU both lower natively
-        interpret = jax.default_backend() == "cpu"
-
-    pad = (-n) % block_rows
-    if pad:
-        # padded rows merge to the pad value and are sliced off
-        states = jnp.pad(states, ((0, 0), (0, pad), (0, 0)))
-    n_pad = n + pad
-
-    def kernel(in_ref, out_ref):
-        acc = in_ref[0]
-        for i in range(1, r):  # r is static: unrolled on the VPU
-            acc = jnp.maximum(acc, in_ref[i])
-        out_ref[:] = acc
-
-    result = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((n_pad, c), states.dtype),
-        grid=(n_pad // block_rows,),
-        in_specs=[
-            pl.BlockSpec((r, block_rows, c), lambda i: (0, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((block_rows, c), lambda i: (i, 0)),
-        interpret=interpret,
-    )(states)
-    return result[:n] if pad else result
-
-
 def scatter_merge(state, targets, msg_keys):
     """Deliver messages into a replica-indexed state via scatter-max.
 

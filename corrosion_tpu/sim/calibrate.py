@@ -1,0 +1,210 @@
+"""Exact-sampler msgs/node calibration at large N.
+
+The production epidemic kernel delivers via permutation fanout
+(``models/broadcast.py``): collision-free in-degree makes its
+msgs-at-convergence a known ~0.65-0.75× lower bound of the exact
+``sent_to``-excluding sampler the agents run.  The exact sampler's old
+home (``broadcast_step(sent=...)``) holds [N, N] *scores* per tick and
+vmaps seeds, capping calibration at N≈512.  This module runs the exact
+protocol at N=1k-16k:
+
+* one seed at a time (no vmapped [S, N, N] state);
+* ``sent`` as one [N, N] bool (256 MB at 16k — fits HBM);
+* per-tick scores generated in sender CHUNKS of [C, N] with
+  ``lax.top_k`` selection, so the 1 GB full scores matrix never
+  materializes;
+* single-payload state ([N] infected/budget/backoff), the same
+  semantics the deterministic bit-match pins against the live agents
+  (``sim/bitmatch.py``): retire on exhausted coverage, rebroadcast with
+  fresh budget on learn, nth retransmission after
+  ``max(1, round(backoff * n))`` ticks.
+
+``run_msgs_calibration`` measures msgs/node at convergence for the
+exact sampler vs the matched perm-fanout config and emits the ratio per
+N — the correction factor ``bench.py`` applies to annotate its sweep
+(``CALIB_MSGS.json``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ExactConfig:
+    n_nodes: int
+    fanout: int = 4
+    max_transmissions: int = 8
+    backoff_ticks: float = 0.0
+    max_ticks: int = 192
+    sender_chunk: int = 2048
+
+
+class ExactState(NamedTuple):
+    infected: jnp.ndarray  # [N] bool
+    tx: jnp.ndarray  # [N] int32 remaining transmissions
+    next_send: jnp.ndarray  # [N] int32
+    sent: jnp.ndarray  # [N, N] bool per-payload sent_to
+    msgs: jnp.ndarray  # [N] int32
+    tick: jnp.ndarray  # scalar int32
+
+
+def exact_init(cfg: ExactConfig, writer: int = 0) -> ExactState:
+    n = cfg.n_nodes
+    return ExactState(
+        infected=jnp.zeros((n,), bool).at[writer].set(True),
+        tx=jnp.zeros((n,), jnp.int32).at[writer].set(cfg.max_transmissions),
+        next_send=jnp.zeros((n,), jnp.int32),
+        sent=jnp.zeros((n, n), bool),
+        msgs=jnp.zeros((n,), jnp.int32),
+        tick=jnp.zeros((), jnp.int32),
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def exact_tick(state: ExactState, key, cfg: ExactConfig) -> ExactState:
+    n, k = cfg.n_nodes, cfg.fanout
+    c = min(cfg.sender_chunk, n)
+    infected, tx, next_send, sent, msgs, tick = state
+    active = infected & (tx > 0) & (next_send <= tick)
+
+    new_infected = infected
+    new_sent = sent
+    sent_counts = jnp.zeros((n,), jnp.int32)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    for start in range(0, n, c):
+        ci = min(c, n - start)  # final chunk may be short
+        rows = idx[start:start + ci]  # static slice
+        scores = jax.random.uniform(
+            jax.random.fold_in(key, start), (ci, n)
+        )
+        excluded = sent[start:start + ci] | (rows[:, None] == idx[None, :])
+        scores = jnp.where(excluded, jnp.inf, scores)
+        neg_top, targets = jax.lax.top_k(-scores, k)  # [Ci, k]
+        avail = neg_top > -jnp.inf
+        ok = avail & active[start:start + ci, None]
+        masked = jnp.where(ok, targets, n)  # dead -> dropped
+        new_infected = new_infected.at[masked.reshape(-1)].set(
+            True, mode="drop"
+        )
+        chunk_rows = jnp.repeat(rows, k)
+        new_sent = new_sent.at[chunk_rows, masked.reshape(-1)].set(
+            True, mode="drop"
+        )
+        sent_counts = sent_counts.at[start:start + ci].set(
+            ok.sum(axis=1).astype(jnp.int32)
+        )
+
+    msgs = msgs + sent_counts
+    # budget/backoff — the det-sim/agent semantics: a send decrements,
+    # exhausted coverage retires, learners get a fresh budget and first
+    # forward next tick
+    sent_now = active & (sent_counts > 0)
+    exhausted = active & (sent_counts == 0)
+    tx = jnp.where(sent_now, tx - 1, tx)
+    tx = jnp.where(exhausted, 0, tx)
+    send_count = cfg.max_transmissions - tx
+    gap = jnp.maximum(
+        1, jnp.round(cfg.backoff_ticks * send_count).astype(jnp.int32)
+    )
+    next_send = jnp.where(sent_now, tick + gap, next_send)
+    learned = new_infected & ~infected
+    tx = jnp.where(learned, cfg.max_transmissions, tx)
+    next_send = jnp.where(learned, tick + 1, next_send)
+    return ExactState(new_infected, tx, next_send, new_sent, msgs, tick + 1)
+
+
+def run_exact(cfg: ExactConfig, seed: int = 0) -> Dict:
+    """One exact-sampler epidemic; msgs/node measured at convergence."""
+    state = exact_init(cfg)
+    key = jax.random.PRNGKey(seed)
+    t0 = time.perf_counter()
+    converged_tick: Optional[int] = None
+    for t in range(cfg.max_ticks):
+        state = exact_tick(state, jax.random.fold_in(key, t), cfg)
+        # cheap host check: one bool + one int
+        if converged_tick is None and bool(state.infected.all()):
+            converged_tick = t + 1
+            break
+    msgs = np.asarray(state.msgs)
+    return {
+        "n_nodes": cfg.n_nodes,
+        "converged_tick": converged_tick,
+        "msgs_per_node_mean": float(msgs.mean()),
+        "wall_s": time.perf_counter() - t0,
+    }
+
+
+def run_msgs_calibration(
+    ns: List[int] = (1000, 4000, 16000),
+    seeds: int = 3,
+    fanout: int = 4,
+    max_transmissions: int = 8,
+    out_path: Optional[str] = None,
+) -> Dict:
+    """Exact vs perm-fanout msgs/node under matched conditions (uniform
+    sampling, no loss, no sync, no partitions) — the measured correction
+    factor for the sweep's perm-fanout lower bound."""
+    import json
+
+    from corrosion_tpu.sim.epidemic import EpidemicConfig, run_epidemic_seeds
+
+    points = []
+    for n in ns:
+        ecfg = ExactConfig(
+            n_nodes=n, fanout=fanout, max_transmissions=max_transmissions
+        )
+        exact_msgs = []
+        conv = []
+        for s in range(seeds):
+            r = run_exact(ecfg, seed=s)
+            exact_msgs.append(r["msgs_per_node_mean"])
+            conv.append(r["converged_tick"])
+        pcfg = EpidemicConfig(
+            n_nodes=n, n_rows=4,
+            fanout_ring0=0, fanout_global=fanout, ring0_size=1,
+            max_transmissions=max_transmissions, loss=0.0,
+            sync_interval=0, track_hops=False,
+            max_ticks=ecfg.max_ticks, chunk_ticks=8,
+        )
+        run_epidemic_seeds(pcfg, n_seeds=seeds, seed=1)  # warm compile
+        perm = run_epidemic_seeds(pcfg, n_seeds=seeds, seed=0)
+        exact_mean = float(np.mean(exact_msgs))
+        points.append({
+            "n": n,
+            "msgs_exact": round(exact_mean, 2),
+            "msgs_perm": round(perm["msgs_per_node_mean"], 2),
+            "exact_over_perm": round(
+                exact_mean / max(perm["msgs_per_node_mean"], 1e-9), 3
+            ),
+            "exact_converged_ticks": conv,
+            "perm_ticks_p50": perm["ticks_p50"],
+            "seeds": seeds,
+        })
+    out = {
+        "metric": "exact_vs_perm_msgs_calibration",
+        "fanout": fanout,
+        "max_transmissions": max_transmissions,
+        "conditions": "uniform sampling, no loss/sync/partition",
+        "points": points,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
+
+
+def ratio_for(calib: Dict, n: int) -> Optional[float]:
+    """exact/perm correction factor at the calibrated N nearest to n."""
+    pts = calib.get("points") or []
+    if not pts:
+        return None
+    best = min(pts, key=lambda p: abs(p["n"] - n))
+    return best["exact_over_perm"]

@@ -232,24 +232,28 @@ def _scan_chunk(state: EpidemicState, seed_key, target_row, cfg: EpidemicConfig)
         # seed's OWN convergence tick, not at global loop stop
         msgs_f = per_universe(nxt.msgs.astype(jnp.float32))
         if nxt.hops is not None:
-            # infection depth; nodes healed by sync (never infected via
-            # broadcast) report as max_ticks so loss shows up, not
-            # hides.  >= HOP_UNSET-1 also catches the perm path's
-            # clamped "delivered by a sender of unknown depth" value
+            # infection depth over broadcast-infected nodes ONLY: a node
+            # healed by sync (or delivered by a sender of unknown depth,
+            # the >= HOP_UNSET-1 clamp) has no defined depth and becomes
+            # NaN — percentiles are taken over real depths and reported
+            # alongside the coverage fraction, never a sentinel value
             hops_f = per_universe(jnp.where(
-                nxt.hops >= HOP_UNSET - 1, jnp.int32(cfg.max_ticks),
-                nxt.hops
-            ).astype(jnp.float32))
-            h50 = jnp.percentile(hops_f, 50, axis=1)
-            h99 = jnp.percentile(hops_f, 99, axis=1)
-        else:  # hops untracked: report the "never infected" sentinel
-            h50 = h99 = jnp.full(((S or 1),), cfg.max_ticks, jnp.float32)
+                nxt.hops >= HOP_UNSET - 1, jnp.nan,
+                nxt.hops.astype(jnp.float32),
+            ))
+            h50 = jnp.nanpercentile(hops_f, 50, axis=1)
+            h99 = jnp.nanpercentile(hops_f, 99, axis=1)
+            hcov = jnp.mean(~jnp.isnan(hops_f), axis=1)
+        else:  # hops untracked: no measurement at all
+            h50 = h99 = jnp.full(((S or 1),), jnp.nan, jnp.float32)
+            hcov = jnp.zeros(((S or 1),), jnp.float32)
         stats = (
             conv,
             jnp.mean(msgs_f, axis=1),
             jnp.percentile(msgs_f, 99, axis=1),
             h50,
             h99,
+            hcov,
         )
         if S is None:  # legacy scalar outputs for the vmap path
             stats = tuple(x[0] for x in stats)
@@ -303,11 +307,11 @@ def run_epidemic_seeds(cfg: EpidemicConfig, n_seeds: int = 16, seed: int = 0):
 
     t0 = time.perf_counter()
     flags, means, p99s = [], [], []  # each: list of [S, C] arrays
-    h50s, h99s = [], []
+    h50s, h99s, hcovs = [], [], []
     ticks_done = 0
     state = init
     while ticks_done < cfg.max_ticks:
-        state, (conv, m_mean, m_p99, h_p50, h_p99) = _scan_chunk(
+        state, (conv, m_mean, m_p99, h_p50, h_p99, h_cov) = _scan_chunk(
             state, key, target, flat_cfg
         )
         conv = np.asarray(conv).T  # scan stacks [C, S] -> [S, C]
@@ -316,12 +320,14 @@ def run_epidemic_seeds(cfg: EpidemicConfig, n_seeds: int = 16, seed: int = 0):
         p99s.append(np.asarray(m_p99).T)
         h50s.append(np.asarray(h_p50).T)
         h99s.append(np.asarray(h_p99).T)
+        hcovs.append(np.asarray(h_cov).T)
         ticks_done += cfg.chunk_ticks
         if conv[:, -1].all():
             break
     wall = time.perf_counter() - t0
     return _epidemic_stats(
-        cfg, n_seeds, flags, means, p99s, h50s, h99s, wall, ticks_done
+        cfg, n_seeds, flags, means, p99s, h50s, h99s, hcovs, wall,
+        ticks_done,
     )
 
 
@@ -341,10 +347,10 @@ def _run_epidemic_seeds_vmap(cfg: EpidemicConfig, n_seeds: int, seed: int):
 
     t0 = time.perf_counter()
     flags, means, p99s = [], [], []  # each: list of [S, C] arrays
-    h50s, h99s = [], []
+    h50s, h99s, hcovs = [], [], []
     ticks_done = 0
     while ticks_done < cfg.max_ticks:
-        states, (conv, m_mean, m_p99, h_p50, h_p99) = chunk(
+        states, (conv, m_mean, m_p99, h_p50, h_p99, h_cov) = chunk(
             states, keys, target
         )
         conv = np.asarray(conv)  # [S, C] (vmap leads with the seed axis)
@@ -353,25 +359,43 @@ def _run_epidemic_seeds_vmap(cfg: EpidemicConfig, n_seeds: int, seed: int):
         p99s.append(np.asarray(m_p99))
         h50s.append(np.asarray(h_p50))
         h99s.append(np.asarray(h_p99))
+        hcovs.append(np.asarray(h_cov))
         ticks_done += cfg.chunk_ticks
         if conv[:, -1].all():
             break
     wall = time.perf_counter() - t0
     return _epidemic_stats(
-        cfg, n_seeds, flags, means, p99s, h50s, h99s, wall, ticks_done
+        cfg, n_seeds, flags, means, p99s, h50s, h99s, hcovs, wall,
+        ticks_done,
     )
 
 
-def _epidemic_stats(cfg, n_seeds, flags, means, p99s, h50s, h99s, wall,
-                    ticks_done):
-    """Fold per-chunk [S, C] stat arrays into the result dict."""
+def _epidemic_stats(cfg, n_seeds, flags, means, p99s, h50s, h99s, hcovs,
+                    wall, ticks_done):
+    """Fold per-chunk [S, C] stat arrays into the result dict.
+
+    Hop percentiles are measured over broadcast-infected nodes only; a
+    percentile whose rank exceeds the measured coverage (e.g. a p99
+    when only 97% of nodes were infected via broadcast) is reported as
+    None, never a sentinel.  ``hops_broadcast_frac`` carries the
+    coverage so the reader can see why.
+    """
     allflags = np.concatenate(flags, axis=1)  # [S, T]
     allmeans = np.concatenate(means, axis=1)
     allp99s = np.concatenate(p99s, axis=1)
     allh50s = np.concatenate(h50s, axis=1)
     allh99s = np.concatenate(h99s, axis=1)
+    allhcovs = np.concatenate(hcovs, axis=1)
     converged, first_idx, first = seed_convergence(allflags)
     rows = np.arange(n_seeds)
+    hcov = float(allhcovs[rows, first_idx].mean()) if cfg.track_hops else None
+
+    def hop_stat(vals, needed_cov):
+        if not cfg.track_hops or hcov is None or hcov < needed_cov:
+            return None
+        v = float(np.nanmean(vals[rows, first_idx]))
+        return None if np.isnan(v) else v
+
     return {
         "n_nodes": cfg.n_nodes,
         "n_seeds": n_seeds,
@@ -380,14 +404,9 @@ def _epidemic_stats(cfg, n_seeds, flags, means, p99s, h50s, h99s, wall,
         "ticks_p99": float(np.percentile(first, 99)),
         "msgs_per_node_mean": float(allmeans[rows, first_idx].mean()),
         "msgs_per_node_p99": float(allp99s[rows, first_idx].mean()),
-        "hops_p50": (
-            float(allh50s[rows, first_idx].mean())
-            if cfg.track_hops else None
-        ),
-        "hops_p99": (
-            float(allh99s[rows, first_idx].mean())
-            if cfg.track_hops else None
-        ),
+        "hops_p50": hop_stat(allh50s, 0.50),
+        "hops_p99": hop_stat(allh99s, 0.99),
+        "hops_broadcast_frac": hcov,
         "wall_s": wall,
         "ticks_run": ticks_done,
     }

@@ -248,6 +248,10 @@ async def agent_trace(
 def diff_traces(sim: Dict, agents: Dict) -> Dict:
     """Join the two traces into one recorded diff."""
     def ratio(a, b):
+        # a hop percentile can be None (measured coverage below the
+        # percentile rank — sim/epidemic.py hop_stat); no ratio then
+        if a is None or b is None:
+            return None
         return round(a / max(b, 1e-9), 3)
 
     return {

@@ -180,3 +180,94 @@ def test_applies_genuinely_overlap(tmp_path):
             await a.stop()
 
     asyncio.run(main())
+
+
+def test_write_priority_ordering_under_held_lock():
+    """SplitPool write-tier parity (agent.rs:614-765): with the writer
+    held, queued waiters acquire in priority order — client write
+    (HIGH, write_priority) before replication apply (NORMAL,
+    write_normal) before maintenance (LOW, write_low) — regardless of
+    arrival order."""
+    import threading
+    import time
+
+    from corrosion_tpu.agent.locks import (
+        PRIO_HIGH,
+        PRIO_LOW,
+        PRIO_NORMAL,
+        PriorityLock,
+    )
+
+    lock = PriorityLock()
+    order = []
+    started = []
+
+    def waiter(prio, name):
+        started.append(name)
+        with lock.prio(prio, name):
+            order.append(name)
+
+    with lock.prio(PRIO_NORMAL, "holder"):
+        threads = []
+        # arrival order deliberately inverted vs priority
+        for prio, name in ((PRIO_LOW, "maintenance"),
+                           (PRIO_NORMAL, "apply"),
+                           (PRIO_HIGH, "client-write")):
+            t = threading.Thread(target=waiter, args=(prio, name))
+            t.start()
+            threads.append(t)
+            # let each enqueue before the next arrives
+            deadline = time.monotonic() + 2.0
+            while len(started) < len(threads):
+                if time.monotonic() > deadline:
+                    raise AssertionError("waiter failed to start")
+                time.sleep(0.005)
+        time.sleep(0.05)  # all three blocked on the held lock
+    for t in threads:
+        t.join(timeout=5)
+    assert order == ["client-write", "apply", "maintenance"]
+
+
+def test_storage_tiers_route_like_the_reference(tmp_path):
+    """The actual storage paths carry the reference's tiers: write_tx
+    (client) HIGH, apply_tx (replication) NORMAL, compaction LOW —
+    under a held writer, a queued client write beats a queued apply."""
+    import threading
+    import time
+
+    from corrosion_tpu.agent.locks import PRIO_LOW
+    from corrosion_tpu.agent.storage import CrConn
+    from corrosion_tpu.agent.schema import apply_schema
+
+    st = CrConn(str(tmp_path / "t.db"))
+    apply_schema(st, TEST_SCHEMA)
+    order = []
+
+    def client_write():
+        with st.write_tx() as conn:
+            conn.execute(
+                "INSERT INTO tests (id, text) VALUES (1, 'hi')"
+            )
+        order.append("client")
+
+    def replication_apply():
+        with st.apply_tx():
+            pass
+        order.append("apply")
+
+    def maintenance():
+        with st._lock.prio(PRIO_LOW, "maintenance"):
+            pass
+        order.append("maintenance")
+
+    with st._lock.prio(PRIO_LOW, "holder"):
+        ts = []
+        for fn in (maintenance, replication_apply, client_write):
+            t = threading.Thread(target=fn)
+            t.start()
+            ts.append(t)
+            time.sleep(0.05)  # enqueue in reverse-priority order
+    for t in ts:
+        t.join(timeout=5)
+    assert order == ["client", "apply", "maintenance"]
+    st.conn.close()

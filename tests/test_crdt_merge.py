@@ -138,19 +138,3 @@ def test_codec_layout_validation():
         KeyCodec(cl_bits=20, ver_bits=24, val_bits=24)
 
 
-def test_pallas_merge_matches_jnp():
-    """The pallas merge kernel (SURVEY §7.1's hot-merge kernel) is
-    bit-identical to the jnp reference, including non-block-aligned row
-    counts (padding path)."""
-    import jax
-
-    from corrosion_tpu.ops.merge import pallas_merge_cells
-
-    key = jax.random.PRNGKey(3)
-    for n in (1000, 256, 300):
-        states = jax.random.randint(
-            key, (4, n, 8), 0, 1 << 30, dtype=jnp.int32
-        )
-        a = merge_cells(states)
-        b = pallas_merge_cells(states, block_rows=256)
-        assert bool(jnp.array_equal(a, b)), n
