@@ -12,15 +12,19 @@ Design differences (deliberate):
   database is the SQL parser (the reference rewrites ASTs with
   ``sqlite3-parser``);
 * incremental maintenance is pk-scoped like the reference's candidate
-  rewrite (``pubsub.rs:602-737,1432-1707``), but achieved through query
-  nesting instead of AST surgery: when a subscription reads ONE
-  replicated table, projects that table's primary key columns, and uses
-  no global operator (DISTINCT / GROUP BY / LIMIT / set ops / windows),
-  a change batch evaluates ``SELECT * FROM (<orig>) WHERE (pk cols) IN
-  (VALUES ...candidates...)`` — sqlite's subquery flattening pushes the
-  predicate onto the base table's pk index, so the work is proportional
-  to the candidate rows, not the table.  Materialized rows are keyed by
-  pk, yielding true ``update`` events.  Ineligible queries keep the
+  rewrite (``pubsub.rs:602-737,1432-1707``), achieved through the same
+  core move — every referenced table's primary key columns are added to
+  the projection as hidden ``__corro_pk_<table>_<i>`` aliases — but via
+  top-level text splicing + query nesting instead of full AST surgery.
+  A change batch on table t evaluates ``SELECT * FROM (<rewritten>)
+  WHERE (t's hidden pk cols) IN (VALUES ...candidates...)``: sqlite's
+  subquery flattening pushes the predicate onto t's pk index, so the
+  work is proportional to the candidate rows — including multi-table
+  JOIN subscriptions, where each changed table scopes its own delta
+  (the analogue of the reference's per-table temp-pk-table scoping).
+  Result rows are identity-keyed by the joined pk tuple, yielding true
+  ``update`` events.  Ineligible queries (aggregates, DISTINCT, LIMIT,
+  subqueries, set ops, windows, self-joins) keep the
   re-evaluate-and-diff path (correct, not incremental);
 * per-subscription state (sql, rows, change log) persists in its own
   sqlite file under ``subs_path`` and is restored on boot
@@ -61,12 +65,128 @@ DELTA_MAX_PKS = 2048
 _GLOBAL_WORDS = frozenset(
     (
         "DISTINCT", "GROUP", "HAVING", "UNION", "INTERSECT", "EXCEPT",
-        "LIMIT", "OFFSET", "OVER", "WITH", "JOIN",
+        "LIMIT", "OFFSET", "OVER", "WITH",
         # aggregates
         "COUNT", "SUM", "AVG", "TOTAL", "MAX", "MIN", "GROUP_CONCAT",
         "STRING_AGG",
+        # join forms the textual item parser doesn't model
+        "USING", "NATURAL",
     )
 )
+
+# outer-join words disqualify the delta path outright: an outer join
+# can TRANSITION a result row to its NULL-extended form when the inner
+# side's match disappears, and a pk-IN scope on the inner table cannot
+# see that new row (its pk columns are NULL there)
+_OUTER_WORDS = frozenset(("LEFT", "RIGHT", "FULL", "OUTER"))
+_ITEM_STOP_WORDS = frozenset(("ON", "WHERE", "ORDER", "AND", "OR"))
+
+
+def _scan_top_level(sql: str):
+    """Yield (index, char, depth) for chars outside string literals,
+    with paren depth tracked."""
+    depth = 0
+    in_str: Optional[str] = None
+    for i, ch in enumerate(sql):
+        if in_str:
+            if ch == in_str:
+                in_str = None
+            continue
+        if ch in ("'", '"'):
+            in_str = ch
+            continue
+        if ch == "(":
+            depth += 1
+            continue
+        if ch == ")":
+            depth -= 1
+            continue
+        yield i, ch, depth
+
+
+def _top_level_word(sql: str, word: str, start: int = 0) -> int:
+    """Index of the first depth-0 occurrence of ``word`` as a bare word
+    outside strings, or -1."""
+    up = sql.upper()
+    w = word.upper()
+    for i, ch, depth in _scan_top_level(sql):
+        if depth != 0 or i < start:
+            continue
+        if up.startswith(w, i) and (i == 0 or not up[i - 1].isalnum()):
+            end = i + len(w)
+            if end == len(sql) or not (up[end].isalnum() or up[end] == "_"):
+                return i
+    return -1
+
+
+def from_items(nsql: str) -> Optional[List[Tuple[str, str]]]:
+    """Top-level from-items of a normalized single SELECT as
+    ``(table, alias)`` pairs, or None when the shape is out of scope
+    (subquery in FROM, USING joins, quoted exotica).  The textual
+    counterpart of the reference's table extraction
+    (``pubsub.rs:1813-2107``)."""
+    fi = _top_level_word(nsql, "FROM")
+    if fi < 0:
+        return None
+    end = len(nsql)
+    for stop in ("WHERE", "ORDER", "GROUP", "LIMIT", "HAVING", "WINDOW"):
+        si = _top_level_word(nsql, stop, fi + 4)
+        if 0 <= si < end:
+            end = si
+    clause = nsql[fi + 4:end].strip()
+    if "(" in clause:
+        return None  # subquery or function in FROM
+    if any(w in _OUTER_WORDS
+           for w in re.findall(r"[A-Za-z_]+", clause.upper())):
+        return None  # outer joins: see _OUTER_WORDS
+    # split items on top-level commas and inner-JOIN connectors
+    parts = re.split(
+        r"(?:,|\b(?:INNER|CROSS)?\s*\bJOIN\b)",
+        clause, flags=re.IGNORECASE,
+    )
+    items: List[Tuple[str, str]] = []
+    for part in parts:
+        # keep only the item itself (strip any ON condition)
+        m = re.match(r"\s*(.*?)\s*(?:\bON\b.*)?$", part,
+                     flags=re.IGNORECASE | re.DOTALL)
+        piece = m.group(1) if m else part.strip()
+        if not piece:
+            continue
+        toks = piece.replace('"', "").split()
+        if not toks:
+            return None
+        table = toks[0]
+        alias = table
+        rest = [t for t in toks[1:] if t.upper() != "AS"]
+        if rest:
+            if len(rest) > 1 or rest[0].upper() in _ITEM_STOP_WORDS:
+                return None
+            alias = rest[0]
+        if not re.fullmatch(r"\w+", table) or not re.fullmatch(
+            r"\w+", alias
+        ):
+            return None
+        items.append((table, alias))
+    return items or None
+
+
+def splice_pk_cols(nsql: str, items: List[Tuple[str, str]],
+                   pk_cols: Dict[str, List[str]]) -> Tuple[str, int]:
+    """Rewrite the SELECT to append every from-item's pk columns as
+    hidden ``__corro_pk_<alias>_<i>`` aliases (the reference's
+    ``__corro_pk`` projection tagging, ``pubsub.rs:602-737``).  Returns
+    (rewritten sql, number of hidden columns)."""
+    fi = _top_level_word(nsql, "FROM")
+    extras = []
+    for table, alias in items:
+        for i, col in enumerate(pk_cols[table]):
+            extras.append(
+                f'"{alias}"."{col}" AS __corro_pk_{alias}_{i}'
+            )
+    return (
+        nsql[:fi].rstrip() + ", " + ", ".join(extras) + " " + nsql[fi:],
+        len(extras),
+    )
 
 
 def normalize_sql(sql: str) -> str:
@@ -133,11 +253,16 @@ class SubscriptionHandle:
         self._closed = False
         self._streams: List[queue.Queue] = []
         # pk-scoped incremental evaluation (set by the manager when the
-        # query qualifies): the single table, its pk column indices in
-        # the projection, and an identity index pk-hex -> [identities]
-        self.single_table: Optional[str] = None
-        self.pk_proj_idx: Optional[List[int]] = None
-        self.by_pk: Dict[str, List[str]] = {}
+        # query qualifies): the rewritten query with hidden
+        # __corro_pk_* columns, the from-items in projection order, the
+        # hidden-column index ranges per table, and the identity index
+        # (table, pk-hex) -> [identities]
+        self.exec_sql: Optional[str] = None
+        self.n_hidden = 0
+        self.pk_items: Optional[List[Tuple[str, str]]] = None
+        self.pk_idx: Dict[str, List[int]] = {}  # table -> exec col idx
+        self.by_pk: Dict[Tuple[str, str], List[str]] = {}
+        self.pk_of: Dict[str, Dict[str, str]] = {}  # identity -> hexes
         self._db = sqlite3.connect(db_path, check_same_thread=False)
         self._db.executescript(
             """
@@ -159,7 +284,7 @@ CREATE TABLE IF NOT EXISTS changes (
 
     @property
     def incremental(self) -> bool:
-        return self.pk_proj_idx is not None
+        return self.pk_items is not None
 
     # -- persistence -----------------------------------------------------
 
@@ -188,16 +313,30 @@ CREATE TABLE IF NOT EXISTS changes (
         for identity, row_id, cells, pk in rows:
             self.rows[identity] = (row_id, json.loads(cells))
             self.last_row_id = max(self.last_row_id, row_id)
-            if pk is not None:
-                self.by_pk.setdefault(pk, []).append(identity)
+            if pk is not None and self.incremental:
+                if pk.startswith("{"):
+                    hexes = json.loads(pk)
+                else:  # legacy single-table plain hex
+                    hexes = {self.pk_items[0][0]: pk}
+                self.pk_of[identity] = hexes
+                for t, h in hexes.items():
+                    self.by_pk.setdefault((t, h), []).append(identity)
         return bool(rows) or self.last_change_id > 0
 
     def _persist_rows(self, upserts, deletes, pks=None) -> None:
+        def encode_pk(i):
+            hexes = (pks or {}).get(i)
+            if not hexes:
+                return None
+            if len(hexes) == 1:
+                return next(iter(hexes.values()))  # legacy plain hex
+            return json.dumps(hexes, sort_keys=True)
+
         self._db.executemany(
             "INSERT OR REPLACE INTO rows (identity, row_id, cells, pk) "
             "VALUES (?, ?, ?, ?)",
             [
-                (i, rid, json.dumps(c), (pks or {}).get(i))
+                (i, rid, json.dumps(c), encode_pk(i))
                 for i, (rid, c) in upserts.items()
             ],
         )
@@ -231,27 +370,38 @@ CREATE TABLE IF NOT EXISTS changes (
         return f"{h}:{occurrence}"
 
     def _pk_keyed(self, rows):
-        """identity -> cells and identity -> pk-hex for a result set,
-        with identities keyed by the projected primary key (stable
-        across evaluations: enables true update events)."""
+        """identity -> user cells and identity -> {table: pk-hex} for an
+        exec-query result set: identities key on the joined tuple of
+        every from-item's hidden pk columns (stable across evaluations
+        — true update events; the single-table identity is the plain
+        ``hex:occ`` the old format used, so persisted state carries
+        over)."""
         new_ids: Dict[str, list] = {}
-        pks_of: Dict[str, str] = {}
+        pks_of: Dict[str, Dict[str, str]] = {}
         counts: Dict[str, int] = {}
+        n_user = None
         for r in rows:
-            cells = jsonable_row(r)
-            pk_hex = pack_values([r[i] for i in self.pk_proj_idx]).hex()
-            occ = counts.get(pk_hex, 0)
-            counts[pk_hex] = occ + 1
-            identity = f"{pk_hex}:{occ}"
+            if n_user is None:
+                n_user = len(r) - self.n_hidden
+            cells = jsonable_row(r[:n_user])
+            hexes = {
+                t: pack_values([r[i] for i in self.pk_idx[t]]).hex()
+                for t, _a in self.pk_items
+            }
+            joined = "|".join(hexes[t] for t, _a in self.pk_items)
+            occ = counts.get(joined, 0)
+            counts[joined] = occ + 1
+            identity = f"{joined}:{occ}"
             new_ids[identity] = cells
-            pks_of[identity] = pk_hex
+            pks_of[identity] = hexes
         return new_ids, pks_of
 
     def _apply_diff(self, new_ids, pks_of, scope_old, initial,
-                    cand_hexes=None) -> None:
+                    cand_keys=None) -> None:
         """Diff ``new_ids`` against ``scope_old`` (the materialized rows
         the evaluation could have produced), persist, emit events.
-        Caller holds ``self._lock``."""
+        ``cand_keys``: the (table, pk-hex) scope keys of a delta round,
+        None for a full refresh.  Caller holds ``self._lock``."""
         upserts: Dict[str, Tuple[int, list]] = {}
         events = []
         for identity, cells in new_ids.items():
@@ -280,15 +430,27 @@ CREATE TABLE IF NOT EXISTS changes (
         for i in deletes:
             self.rows.pop(i, None)
         if self.incremental:
-            if cand_hexes is None:
+            if cand_keys is None:
                 self.by_pk = {}
+                self.pk_of = {}
             else:
-                for h in cand_hexes:
-                    self.by_pk.pop(h, None)
-            for identity, pk_hex in pks_of.items():
-                lst = self.by_pk.setdefault(pk_hex, [])
-                if identity not in lst:
-                    lst.append(identity)
+                for i in deletes:
+                    # drop the row from EVERY table's index (a delta
+                    # scoped on one table deletes rows the other
+                    # tables' entries still reference); prune emptied
+                    # keys or delete churn grows by_pk without bound
+                    for t, h in self.pk_of.pop(i, {}).items():
+                        lst = self.by_pk.get((t, h))
+                        if lst and i in lst:
+                            lst.remove(i)
+                        if lst is not None and not lst:
+                            del self.by_pk[(t, h)]
+            for identity, hexes in pks_of.items():
+                self.pk_of[identity] = hexes
+                for t, h in hexes.items():
+                    lst = self.by_pk.setdefault((t, h), [])
+                    if identity not in lst:
+                        lst.append(identity)
         self._persist_rows(upserts, deletes, pks_of)
         for kind, rid, cells, cid in events:
             self._persist_change(cid, kind, rid, cells)
@@ -298,13 +460,18 @@ CREATE TABLE IF NOT EXISTS changes (
 
     def refresh(self, initial: bool = False) -> None:
         """Re-evaluate the whole query and emit diff events."""
+        if self.incremental:
+            cols, rows = self.manager.agent.storage.read_query(
+                self.exec_sql
+            )
+            with self._lock:
+                self.columns = cols[: len(cols) - self.n_hidden]
+                new_ids, pks_of = self._pk_keyed(rows)
+                self._apply_diff(new_ids, pks_of, dict(self.rows), initial)
+            return
         cols, rows = self.manager.agent.storage.read_query(self.sql)
         with self._lock:
             self.columns = cols
-            if self.incremental:
-                new_ids, pks_of = self._pk_keyed(rows)
-                self._apply_diff(new_ids, pks_of, dict(self.rows), initial)
-                return
             new_ids = {}
             counts: Dict[str, int] = {}
             for r in rows:
@@ -315,35 +482,47 @@ CREATE TABLE IF NOT EXISTS changes (
                 new_ids[self._identity(cells, occ)] = cells
             self._apply_diff(new_ids, {}, dict(self.rows), initial)
 
-    def delta(self, pks: Set[bytes]) -> None:
+    def delta(self, table_pks: Dict[str, Set[bytes]]) -> None:
         """Pk-scoped incremental evaluation (the candidate path,
         ``pubsub.rs:1432-1707``): work proportional to the candidate
-        rows, not the table."""
-        if not pks:
-            return
-        pk_names = [self.columns[i] for i in self.pk_proj_idx]
-        cols_sql = ", ".join(f'"{c}"' for c in pk_names)
-        row_ph = "(" + ", ".join("?" for _ in pk_names) + ")"
-        values = ", ".join(row_ph for _ in pks)
-        sql = (
-            f"SELECT * FROM ({self.sql}) "
-            f"WHERE ({cols_sql}) IN (VALUES {values})"
-        )
-        params = [v for pk in pks for v in unpack_values(pk)]
-        _, rows = self.manager.agent.storage.read_query(sql, params)
-        cand_hexes = {pk.hex() for pk in pks}
-        with self._lock:
-            new_ids, pks_of = self._pk_keyed(rows)
-            scope_old = {
-                i: self.rows[i]
-                for h in cand_hexes
-                for i in self.by_pk.get(h, [])
-                if i in self.rows
-            }
-            self._apply_diff(
-                new_ids, pks_of, scope_old, initial=False,
-                cand_hexes=cand_hexes,
+        rows, not the table.  Each changed table scopes its own
+        evaluation through its hidden pk columns — the join analogue of
+        the reference's per-table temp-pk-table re-evaluation."""
+        for table, pks in table_pks.items():
+            if not pks or table not in self.pk_idx:
+                continue
+            idx = self.pk_idx[table]
+            cols_sql = ", ".join(
+                f"__corro_pk_{self._alias_of(table)}_{i}"
+                for i in range(len(idx))
             )
+            row_ph = "(" + ", ".join("?" for _ in idx) + ")"
+            values = ", ".join(row_ph for _ in pks)
+            sql = (
+                f"SELECT * FROM ({self.exec_sql}) "
+                f"WHERE ({cols_sql}) IN (VALUES {values})"
+            )
+            params = [v for pk in pks for v in unpack_values(pk)]
+            _, rows = self.manager.agent.storage.read_query(sql, params)
+            cand_keys = {(table, pk.hex()) for pk in pks}
+            with self._lock:
+                new_ids, pks_of = self._pk_keyed(rows)
+                scope_old = {
+                    i: self.rows[i]
+                    for k in cand_keys
+                    for i in self.by_pk.get(k, [])
+                    if i in self.rows
+                }
+                self._apply_diff(
+                    new_ids, pks_of, scope_old, initial=False,
+                    cand_keys=cand_keys,
+                )
+
+    def _alias_of(self, table: str) -> str:
+        for t, a in self.pk_items or ():
+            if t == table:
+                return a
+        raise KeyError(table)
 
     def _fanout(self, event: dict) -> None:
         self.manager.agent.metrics.counter("corro_subs_events_total")
@@ -426,7 +605,7 @@ class SubsManager:
         self._by_sql: Dict[str, str] = {}
         self._lock = threading.RLock()
         self._pending: Set[str] = set()
-        self._pending_pks: Dict[str, Set[bytes]] = {}
+        self._pending_pks: Dict[str, Dict[str, Set[bytes]]] = {}
         self._draining = False
         self._worker_died = False
         self._update_streams: Dict[str, List[queue.Queue]] = {}
@@ -518,70 +697,94 @@ class SubsManager:
     def _detect_incremental(self, handle: SubscriptionHandle, nsql: str,
                             tables: Set[str],
                             raw_tables: Set[str]) -> None:
-        """Qualify a query for pk-scoped delta evaluation.  Requirements
-        (conservative — a miss costs the optimization, never
-        correctness):
+        """Qualify a query for pk-scoped delta evaluation by appending
+        hidden ``__corro_pk_*`` columns for every from-item (the
+        reference's projection tagging, ``pubsub.rs:602-737``).
+        Requirements (conservative — a miss costs the optimization,
+        never correctness):
 
-        * exactly one replicated table, referenced exactly once (no
-          self-joins), one SELECT (no subqueries — a same-table scalar
-          subquery would make rows interdependent);
-        * no global operator or aggregate word;
-        * the table's pk columns appear in the projection under their
-          own names, and the delta filter on them provably reaches the
-          base table's index (EXPLAIN QUERY PLAN shows a SEARCH, never a
-          SCAN — this also rejects ``expr AS pkname`` aliases).
-
-        Remaining caveat, documented: aliasing a DIFFERENT indexed
-        column to a pk column's name (``SELECT other AS id``) defeats
-        detection; such queries should not name non-pk columns after pk
-        columns.
+        * a single top-level SELECT (no subqueries — a correlated or
+          same-table subquery would make rows interdependent), no
+          global operator / aggregate / set op / window / LIMIT;
+        * a from-clause of inner-joined (plain/INNER/CROSS/comma)
+          replicated tables, each referenced once (no self-joins; no
+          outer joins — a row transitioning to its NULL-extended form
+          escapes the inner table's pk filter; no local lookup tables —
+          their changes aren't notified);
+        * the per-table delta filter provably reaches that table's
+          index (EXPLAIN QUERY PLAN shows a SEARCH, never a SCAN, of
+          the scoped table).
         """
-        if len(tables) != 1 or len(raw_tables) != 1:
-            # raw_tables counts non-replicated tables too: a comma-join
-            # against a local lookup table would yield several result
-            # rows per pk in unguaranteed order — not delta-safe
-            return
         up = nsql.upper()
         words = re.findall(r"[A-Za-z_]+", up)
         if words.count("SELECT") != 1:
             return
         if any(w in _GLOBAL_WORDS for w in words):
             return
-        t = next(iter(tables))
-        if words.count(t.upper()) != 1:
-            return  # table referenced more than once (self-join)
-        info = self.agent.storage._tables.get(t)
-        if info is None:
+        items = from_items(nsql)
+        if not items:
             return
-        try:
-            cols, _ = self.agent.storage.read_query(
-                f"SELECT * FROM ({nsql}) LIMIT 0"
-            )
-        except sqlite3.Error:
+        names = [t for t, _a in items]
+        if len(set(names)) != len(names):
+            return  # self-join
+        if set(names) != raw_tables or not set(names) <= set(tables):
+            # every table the query reads must be a replicated from-item
+            # (raw_tables catches local lookup tables, whose changes
+            # would never re-trigger evaluation)
             return
-        lower = [c.lower() for c in cols]
-        idx: List[int] = []
-        for p in info.pk_cols:
-            if p.lower() not in lower:
+        infos = {}
+        for t in names:
+            info = self.agent.storage._tables.get(t)
+            if info is None:
                 return
-            idx.append(lower.index(p.lower()))
-        # the filter must reach the base table's index; an expression
-        # aliased to the pk name (or any failed pushdown) plans as SCAN
-        pk_names = ", ".join(f'"{cols[i]}"' for i in idx)
-        row_ph = "(" + ", ".join("?" for _ in idx) + ")"
+            infos[t] = list(info.pk_cols)
         try:
-            _, plan = self.agent.storage.read_query(
-                "EXPLAIN QUERY PLAN SELECT * FROM "
-                f"({nsql}) WHERE ({pk_names}) IN (VALUES {row_ph})",
-                [None] * len(idx),
+            exec_sql, n_hidden = splice_pk_cols(nsql, items, infos)
+            cols, _ = self.agent.storage.read_query(
+                f"SELECT * FROM ({exec_sql}) LIMIT 0"
             )
-        except sqlite3.Error:
+        except (sqlite3.Error, ValueError):
             return
-        plan_text = " ".join(str(c) for row in plan for c in row)
-        if f"SEARCH {t}" not in plan_text or f"SCAN {t}" in plan_text:
-            return
-        handle.single_table = t
-        handle.pk_proj_idx = idx
+        # hidden-column projection indices per table
+        pk_idx: Dict[str, List[int]] = {}
+        pos = len(cols) - n_hidden
+        for t, _a in items:
+            pk_idx[t] = list(range(pos, pos + len(infos[t])))
+            pos += len(infos[t])
+        # every table's delta filter must reach ITS index (plans name
+        # the alias when one is used)
+        for t, a in items:
+            idx = pk_idx[t]
+            cols_sql = ", ".join(
+                f"__corro_pk_{a}_{i}" for i in range(len(idx))
+            )
+            row_ph = "(" + ", ".join("?" for _ in idx) + ")"
+            try:
+                _, plan = self.agent.storage.read_query(
+                    "EXPLAIN QUERY PLAN SELECT * FROM "
+                    f"({exec_sql}) WHERE ({cols_sql}) IN "
+                    f"(VALUES {row_ph})",
+                    [None] * len(idx),
+                )
+            except sqlite3.Error:
+                return
+            plan_text = " ".join(str(c) for row in plan for c in row)
+
+            # word-boundary matching: table "item" must not match the
+            # plan line of its sibling "items" in the same join plan
+            def in_plan(op, name):
+                return re.search(
+                    rf"{op} {re.escape(name)}\b", plan_text
+                ) is not None
+
+            searched = in_plan("SEARCH", a) or in_plan("SEARCH", t)
+            scanned = in_plan("SCAN", a) if a != t else in_plan("SCAN", t)
+            if not searched or scanned:
+                return
+        handle.exec_sql = exec_sql
+        handle.n_hidden = n_hidden
+        handle.pk_items = items
+        handle.pk_idx = pk_idx
 
     def get(self, sub_id: str) -> Optional[SubscriptionHandle]:
         with self._lock:
@@ -616,10 +819,14 @@ class SubsManager:
             touched.setdefault(ch.table, []).append(ch)
         with self._lock:
             for h in self._subs.values():
-                if h.incremental and h.single_table in touched:
-                    self._pending_pks.setdefault(h.id, set()).update(
-                        ch.pk for ch in touched[h.single_table]
-                    )
+                if h.incremental:
+                    hit = [t for t, _a in h.pk_items if t in touched]
+                    if hit:
+                        per = self._pending_pks.setdefault(h.id, {})
+                        for t in hit:
+                            per.setdefault(t, set()).update(
+                                ch.pk for ch in touched[t]
+                            )
                 elif any(t in h.tables for t in touched):
                     self._pending.add(h.id)
         for table, chs in touched.items():
@@ -668,10 +875,11 @@ class SubsManager:
                     self._draining = False
 
     def _drain_round(
-        self, pending: Set[str], pending_pks: Dict[str, Set[bytes]]
+        self, pending: Set[str],
+        pending_pks: Dict[str, Dict[str, Set[bytes]]],
     ) -> None:
         """Process one popped batch of candidate work."""
-        for sub_id, pks in pending_pks.items():
+        for sub_id, table_pks in pending_pks.items():
             if sub_id in pending:
                 continue  # a full refresh covers the candidates
             h = self._subs.get(sub_id)
@@ -679,11 +887,12 @@ class SubsManager:
                 continue
             # the delta path needs the projection (first refresh) and
             # loses to a full pass beyond DELTA_MAX_PKS candidates
-            if not h.columns or len(pks) > DELTA_MAX_PKS:
+            total = sum(len(p) for p in table_pks.values())
+            if not h.columns or total > DELTA_MAX_PKS:
                 pending.add(sub_id)
                 continue
             try:
-                h.delta(pks)
+                h.delta(table_pks)
             except sqlite3.Error:
                 # correct but expensive; counted so a systemic
                 # cause (e.g. busy storms) is visible in metrics

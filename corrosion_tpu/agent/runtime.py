@@ -468,11 +468,18 @@ class Agent:
                     self.incarnation = inc + 1
                     self._persist_incarnation()
                 continue
-            if ts > self._swim_ts.get(actor, 0):
-                # renewed identity generation: same replacement rule as
-                # the foca wire (swim_foca._ingest_update) — the fresh
-                # incarnation space must override a stale DOWN record,
-                # so drop the old member before the upsert
+            known_ts = self._swim_ts.get(actor, 0)
+            if 0 < ts < known_ts:
+                # a real but STALE identity generation: discard, or an
+                # old DOWN record would override the renewed member by
+                # incarnation (swim_foca._ingest_update's guard).
+                # ts == 0 means "generation unknown" (legacy peer) and
+                # falls through to plain incarnation rules
+                continue
+            if ts > known_ts:
+                # renewed identity generation: the fresh incarnation
+                # space must override a stale DOWN record, so drop the
+                # old member before the upsert
                 self._swim_ts[actor] = ts
                 if self.members.get(actor) is not None:
                     self.members.remove(actor)
