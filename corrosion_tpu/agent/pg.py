@@ -10,10 +10,16 @@ transaction (``corro-pg/src/lib.rs:545``).
 
 Implementation notes:
 
-* SQL passes through with a light PG→SQLite translation ($N params →
-  ?, ``::type`` casts stripped, a few function renames) — the reference
-  does a full sqlparser→sqlite3-parser AST translation; ours leans on
-  the large shared SQL dialect instead.
+* SQL goes through the tokenizer-based PG→SQLite translation
+  (``agent/pgsql.py``): $N params → ?, ``::type`` casts, ``E''`` and
+  dollar-quoted strings, function/keyword mapping, comment stripping —
+  every rewrite token-aware, never inside literals or identifiers.
+  The reference does a full sqlparser→sqlite3-parser AST translation;
+  ours leans on the large shared SQL dialect plus this token pass.
+* the extended protocol honors Execute row limits with portal
+  suspension (PortalSuspended / resume), and SSLRequest upgrades the
+  stream to TLS when the agent has a cert configured (corro-pg TLS
+  parity).
 * parameters bind TYPED: the Parse message's declared OIDs (and binary
   format codes) decode ints as ints, floats as floats, bytea as bytes —
   so a PG-written row stores the same sqlite value a HTTP-written row
@@ -148,56 +154,13 @@ class _Buffer:
         return v
 
 
-_CAST_RE = re.compile(r"::[a-zA-Z_][a-zA-Z0-9_]*(\[\])?")
-_FUNC_MAP = {
-    "now()": "datetime('now')",
-    "current_timestamp": "datetime('now')",
-}
-
-
-def translate_query(sql: str) -> Tuple[str, List[int]]:
-    """Light PG→SQLite translation, string-literal aware.
-
-    Returns (sql, param_order): each ``$N`` becomes ``?`` and
-    ``param_order`` records N per placeholder, so callers can bind
-    out-of-order / repeated parameter references correctly.
-    """
-    out: List[str] = []
-    order: List[int] = []
-    i, n = 0, len(sql)
-    while i < n:
-        ch = sql[i]
-        if ch == "'":
-            j = i + 1
-            while j < n:
-                if sql[j] == "'":
-                    if j + 1 < n and sql[j + 1] == "'":
-                        j += 2
-                        continue
-                    break
-                j += 1
-            out.append(sql[i : j + 1])
-            i = j + 1
-            continue
-        if ch == "$" and i + 1 < n and sql[i + 1].isdigit():
-            j = i + 1
-            while j < n and sql[j].isdigit():
-                j += 1
-            order.append(int(sql[i + 1 : j]))
-            out.append("?")
-            i = j
-            continue
-        if ch == ":" and i + 1 < n and sql[i + 1] == ":":
-            m = _CAST_RE.match(sql, i)
-            if m:
-                i = m.end()
-                continue
-        out.append(ch)
-        i += 1
-    text = "".join(out)
-    for k, v in _FUNC_MAP.items():
-        text = re.sub(re.escape(k), v, text, flags=re.IGNORECASE)
-    return text, order
+# PG→SQLite translation: the tokenizer pass in agent/pgsql.py (the
+# token-aware successor of the old regex translation; the reference
+# does a full sqlparser→sqlite3-parser AST rewrite)
+from corrosion_tpu.agent.pgsql import (  # noqa: E402
+    split_statements as _split_statements,
+    translate_query,
+)
 
 
 def translate_sql(sql: str) -> str:
@@ -523,6 +486,25 @@ async def serve_pg(agent: "Agent", host: str = "127.0.0.1", port: int = 0):
     return server
 
 
+def _pg_ssl_context(agent: "Agent"):
+    """Per-agent cached server SSLContext (cert/key are read from disk
+    once, not per connection)."""
+    cfg = agent.config
+    if not (cfg.tls_cert_file and cfg.tls_key_file):
+        return None
+    ctx = getattr(agent, "_pg_ssl_ctx", None)
+    if ctx is None:
+        from corrosion_tpu.agent.tls import server_context
+
+        ctx = server_context(
+            cfg.tls_cert_file, cfg.tls_key_file,
+            ca_file=cfg.tls_ca_file,
+            require_client=cfg.tls_client_required,
+        )
+        agent._pg_ssl_ctx = ctx
+    return ctx
+
+
 async def _handle_conn(agent: "Agent", reader: asyncio.StreamReader,
                        writer: asyncio.StreamWriter) -> None:
     session = _Session(agent)
@@ -535,8 +517,16 @@ async def _handle_conn(agent: "Agent", reader: asyncio.StreamReader,
             body = await reader.readexactly(length - 4)
             (proto,) = struct.unpack_from(">I", body, 0)
             if proto == SSL_REQUEST:
-                writer.write(b"N")  # no TLS
-                await writer.drain()
+                ctx = _pg_ssl_context(agent)
+                if ctx is not None:
+                    # corro-pg TLS parity: accept and upgrade in place
+                    # (the agent's cert/key also serve the PG listener)
+                    writer.write(b"S")
+                    await writer.drain()
+                    await writer.start_tls(ctx)
+                else:
+                    writer.write(b"N")  # no TLS configured
+                    await writer.drain()
                 continue
             if proto == CANCEL_REQUEST:
                 return
@@ -754,6 +744,17 @@ def _describe(writer, session: _Session, b: _Buffer) -> None:
     if entry is None or entry["stmt"] not in session.stmts:
         _ext_error(writer, session, "34000", f"unknown portal {name!r}")
         return
+    if entry.get("pending") is not None:
+        # describing a SUSPENDED portal must not re-execute (that
+        # would emit a RowDescription mid-result-set and strand a
+        # duplicate cached copy); answer from the in-flight result
+        cols = entry["pending"][0]
+        if cols:
+            _row_description(writer, cols, _result_oids(
+                entry["pending"][1], len(cols)))
+        else:
+            writer.write(_msg(b"n"))
+        return
     raw = session.stmts[entry["stmt"]][0]
     if _is_write(translate_sql(raw)):
         entry["described"] = True
@@ -776,12 +777,17 @@ def _describe(writer, session: _Session, b: _Buffer) -> None:
 
 async def _execute_portal(writer, session: _Session, b: _Buffer) -> None:
     portal = b.string()
-    b.int32()  # row limit (0 = all); portals are always drained fully
+    max_rows = b.int32()  # 0 = no limit
     entry = session.portals.get(portal)
     if entry is None or entry["stmt"] not in session.stmts:
         _ext_error(writer, session, "34000", f"unknown portal {portal!r}")
         return
-    if entry["cached"] is not None:
+    if entry.get("pending") is not None:
+        # resuming a suspended portal: continue the SAME result set,
+        # no new RowDescription (corro-pg portal max-row suspension)
+        cols, rows, rc, tag = entry["pending"]
+        entry["pending"] = None
+    elif entry["cached"] is not None:
         cols, rows, rc, tag = entry["cached"]
         entry["cached"] = None
     else:
@@ -798,35 +804,13 @@ async def _execute_portal(writer, session: _Session, b: _Buffer) -> None:
     if cols:
         if not entry["described"]:
             _row_description(writer, cols, _result_oids(rows, len(cols)))
+            entry["described"] = True  # once per portal result set
+        if max_rows > 0 and len(rows) > max_rows:
+            _data_rows(writer, rows[:max_rows])
+            entry["pending"] = (cols, rows[max_rows:], rc, tag)
+            writer.write(_msg(b"s"))  # PortalSuspended
+            return
         _data_rows(writer, rows)
     writer.write(_msg(b"C", _cstr(tag)))
 
 
-def _split_statements(query: str) -> List[str]:
-    """Split on top-level semicolons (string-literal aware)."""
-    parts: List[str] = []
-    buf: List[str] = []
-    in_str = False
-    i = 0
-    while i < len(query):
-        ch = query[i]
-        if in_str:
-            buf.append(ch)
-            if ch == "'":
-                if i + 1 < len(query) and query[i + 1] == "'":
-                    buf.append("'")
-                    i += 1
-                else:
-                    in_str = False
-        elif ch == "'":
-            in_str = True
-            buf.append(ch)
-        elif ch == ";":
-            parts.append("".join(buf))
-            buf = []
-        else:
-            buf.append(ch)
-        i += 1
-    if buf:
-        parts.append("".join(buf))
-    return parts

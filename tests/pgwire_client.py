@@ -10,8 +10,25 @@ from typing import List, Optional, Tuple
 
 class PgClient:
     def __init__(self, host: str, port: int, user: str = "test",
-                 database: str = "db", timeout: float = 10.0):
+                 database: str = "db", timeout: float = 10.0,
+                 tls: bool = False, ca_file: Optional[str] = None):
         self.sock = socket.create_connection((host, port), timeout=timeout)
+        if tls:
+            # SSLRequest then upgrade, like libpq sslmode=require
+            import ssl
+
+            self.sock.sendall(struct.pack(">II", 8, 80877103))
+            answer = self.sock.recv(1)
+            if answer != b"S":
+                raise ConnectionError(f"server refused TLS: {answer!r}")
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            if ca_file:
+                ctx.load_verify_locations(ca_file)
+                ctx.check_hostname = False
+            else:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            self.sock = ctx.wrap_socket(self.sock)
         params = b""
         for k, v in (("user", user), ("database", database)):
             params += k.encode() + b"\x00" + v.encode() + b"\x00"
@@ -131,6 +148,48 @@ class PgClient:
             elif tag == b"Z":
                 self.txn_status = payload.decode()
         return cols, rows, tag_out, err
+
+    def execute_limited(self, sql: str, max_rows: int,
+                        rounds: int = 10):
+        """Parse/Bind once, then Execute with a row limit repeatedly
+        until CommandComplete — exercising PortalSuspended ('s').
+        Returns (rows_per_round, suspensions, final_tag, err)."""
+        self._send(b"P", b"\x00" + sql.encode() + b"\x00"
+                   + struct.pack(">h", 0))
+        self._send(b"B", b"\x00\x00" + struct.pack(">h", 0)
+                   + struct.pack(">h", 0) + struct.pack(">h", 0))
+        self._send(b"D", b"P\x00")
+        rows_per_round: List[int] = []
+        suspensions = 0
+        final_tag: Optional[str] = None
+        err: Optional[str] = None
+        for _ in range(rounds):
+            self._send(b"E", b"\x00" + struct.pack(">i", max_rows))
+            self._send(b"H")  # flush
+            count = 0
+            done = False
+            while True:
+                tag, payload = self._recv_msg()
+                if tag == b"D":
+                    count += 1
+                elif tag == b"s":
+                    suspensions += 1
+                    break
+                elif tag == b"C":
+                    final_tag = payload.rstrip(b"\x00").decode()
+                    done = True
+                    break
+                elif tag == b"E":
+                    err = self._parse_error(payload)
+                    done = True
+                    break
+            rows_per_round.append(count)
+            if done:
+                break
+        self._send(b"S")
+        for tag, payload in self._messages_until(b"Z"):
+            pass
+        return rows_per_round, suspensions, final_tag, err
 
     def typed_query(self, sql: str, params: Tuple = (),
                     param_oids: Tuple = (), binary: bool = False):

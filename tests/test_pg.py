@@ -422,3 +422,127 @@ def test_pg_stop_aborts_idle_sessions(run):
                 pass
 
     run(main())
+
+
+def test_pg_tls_handshake_and_query(run, tmp_path):
+    """SSLRequest is answered 'S' when the agent has TLS configured and
+    the whole session (startup, writes, reads) runs over the encrypted
+    stream (corro-pg TLS parity)."""
+    from corrosion_tpu.agent.tls import generate_ca, generate_server_cert
+
+    d = str(tmp_path)
+    ca_cert, ca_key = generate_ca(d)
+    srv_cert, srv_key = generate_server_cert(
+        d, ca_cert, ca_key, ["127.0.0.1", "localhost"]
+    )
+
+    async def main():
+        a = await launch_test_agent(
+            pg_port=0, tls_cert_file=srv_cert, tls_key_file=srv_key,
+            tls_ca_file=ca_cert,
+        )
+        try:
+            def drive():
+                c = PgClient(*a.pg_addr, tls=True, ca_file=ca_cert)
+                _, _, tags, errs = c.query(
+                    "INSERT INTO tests (id, text) VALUES (5, 'tls')"
+                )
+                assert not errs and tags == ["INSERT 0 1"]
+                _, rows, _, errs = c.query(
+                    "SELECT text FROM tests WHERE id = 5"
+                )
+                assert not errs and rows == [["tls"]]
+                c.close()
+                # a client that skips SSLRequest entirely still works
+                c2 = PgClient(*a.pg_addr)
+                _, rows, _, errs = c2.query(
+                    "SELECT text FROM tests WHERE id = 5"
+                )
+                assert not errs and rows == [["tls"]]
+                c2.close()
+
+            await asyncio.to_thread(drive)
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_portal_suspension(run):
+    """Execute with a row limit drains the portal in chunks:
+    PortalSuspended after each partial round, CommandComplete at the
+    end, no duplicate RowDescription (corro-pg portal max-row
+    suspension)."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            a.execute_transaction([
+                ["INSERT INTO tests (id, text) VALUES (?, ?)",
+                 [i, f"r{i}"]]
+                for i in range(10)
+            ])
+
+            def drive():
+                c = PgClient(*a.pg_addr)
+                rounds, suspensions, tag, err = c.execute_limited(
+                    "SELECT id FROM tests ORDER BY id", max_rows=3
+                )
+                assert err is None
+                assert rounds == [3, 3, 3, 1]
+                assert suspensions == 3
+                assert tag == "SELECT 10"
+                # session still healthy afterwards
+                _, rows, _, errs = c.query("SELECT count(*) FROM tests")
+                assert not errs and rows == [["10"]]
+                c.close()
+
+            await asyncio.to_thread(drive)
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_tokenizer_translation():
+    """The tokenizer pass never rewrites inside literals/identifiers
+    and handles the PG-isms the regex pass could not."""
+    from corrosion_tpu.agent.pgsql import split_statements, translate_query
+
+    t = lambda s: translate_query(s)[0]
+    # $N params with order, repeated and out-of-order
+    sql, order = translate_query(
+        "SELECT * FROM t WHERE a = $2 AND b = $1 AND c = $2")
+    assert sql == "SELECT * FROM t WHERE a = ? AND b = ? AND c = ?"
+    assert order == [2, 1, 2]
+    # casts dropped, incl. array casts — but never inside strings
+    assert t("SELECT x::int8, '::text literal'") == (
+        "SELECT x, '::text literal'")
+    assert t("SELECT y::text[] FROM t") == "SELECT y FROM t"
+    # function mapping only on real call sites / bare keywords
+    assert t("SELECT now()") == "SELECT datetime('now')"
+    assert t("SELECT current_timestamp") == "SELECT datetime('now')"
+    assert t("SELECT 'now()' AS s") == "SELECT 'now()' AS s"
+    assert t('SELECT "current_timestamp" FROM t') == (
+        'SELECT "current_timestamp" FROM t')
+    # E-strings decode; dollar-quotes become standard literals
+    assert t(r"SELECT E'a\nb'") == "SELECT 'a\nb'"
+    assert t("SELECT $tag$it's here$tag$") == "SELECT 'it''s here'"
+    # ILIKE maps; the word inside an identifier does not
+    assert t("SELECT * FROM t WHERE a ILIKE 'x%'") == (
+        "SELECT * FROM t WHERE a LIKE 'x%'")
+    # comments stripped, even with semicolons inside
+    assert split_statements(
+        "SELECT 1; -- trailing; comment\nSELECT 2"
+    )[1].strip().startswith("SELECT 2")
+    assert t("SELECT /* c1 ; */ 1").split() == ["SELECT", "1"]
+
+
+
+def test_pg_estring_unicode_and_octal_escapes():
+    from corrosion_tpu.agent.pgsql import translate_query
+
+    t = lambda s: translate_query(s)[0]
+    assert t(r"SELECT E'\u00e9'") == "SELECT 'é'"
+    assert t(r"SELECT E'\U0001F600'") == "SELECT '\U0001F600'"
+    assert t(r"SELECT E'\101\102'") == "SELECT 'AB'"
+    assert t(r"SELECT E'\x41'") == "SELECT 'A'"
