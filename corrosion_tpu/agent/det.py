@@ -231,7 +231,7 @@ class DetCluster:
     def close(self) -> None:
         for a in self.agents:
             try:
-                a.storage.conn.close()
+                a.storage.close()  # main conn + RO pool
             except Exception:
                 pass
         if self._own_dir:

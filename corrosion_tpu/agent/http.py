@@ -192,20 +192,7 @@ def _make_handler(agent: "Agent"):
             self._json(200, {"tables": agent.apply_schema_sql(sql)})
 
         def _metrics(self):
-            extra = []
-            with agent.storage._lock:
-                for t in agent.storage.tables:
-                    (n,) = agent.storage.conn.execute(
-                        f'SELECT COUNT(*) FROM "{t}"'
-                    ).fetchone()
-                    extra.append(("corro_table_rows", float(n), {"table": t}))
-                extra.append(
-                    ("corro_db_version", float(agent.storage.db_version()), {})
-                )
-            extra.append(
-                ("corro_members_alive", float(len(agent.members.alive())), {})
-            )
-            body = agent.metrics.render(extra).encode()
+            body = agent.metrics.render(agent.metric_gauges()).encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(body)))

@@ -93,10 +93,17 @@ class Metrics:
                     )
                     out.append(fmt(name, key, float(len(buf)), "_count"))
                     out.append(fmt(name, key, float(sum(buf)), "_sum"))
+        # group extras by name: strict parsers reject a repeated
+        # "# TYPE" line (one per label-variant would be one per table)
+        grouped: Dict[str, List[Tuple[LabelKV, float]]] = {}
         for name, v, labels in extra_gauges:
-            key = tuple(sorted(labels.items()))
+            grouped.setdefault(name, []).append(
+                (tuple(sorted(labels.items())), v)
+            )
+        for name in sorted(grouped):
             out.append(f"# TYPE {name} gauge")
-            out.append(fmt(name, key, v))
+            for key, v in grouped[name]:
+                out.append(fmt(name, key, v))
         return "\n".join(out) + "\n"
 
 

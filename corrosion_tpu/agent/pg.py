@@ -496,10 +496,12 @@ def _pg_ssl_context(agent: "Agent"):
     if ctx is None:
         from corrosion_tpu.agent.tls import server_context
 
+        # client-cert verification is PG's own knob (corro-pg
+        # verify_client) — gossip mTLS must not lock SQL clients out
         ctx = server_context(
             cfg.tls_cert_file, cfg.tls_key_file,
             ca_file=cfg.tls_ca_file,
-            require_client=cfg.tls_client_required,
+            require_client=cfg.pg_tls_verify_client,
         )
         agent._pg_ssl_ctx = ctx
     return ctx

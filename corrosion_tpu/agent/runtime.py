@@ -108,6 +108,10 @@ class AgentConfig:
     trace_export_path: Optional[str] = None
     pg_port: Optional[int] = None  # PostgreSQL wire protocol (None = off)
     pg_host: Optional[str] = None  # PG bind host (None = api_host)
+    # PG TLS client-cert verification is its OWN knob (corro-pg
+    # verify_client): gossip mTLS must not lock psql-style clients out
+    # of the SQL port
+    pg_tls_verify_client: bool = False
     maintenance_interval: float = 60.0
     wal_truncate_pages: int = 250_000  # ~1 GB at 4 KiB pages
     vacuum_free_pages: int = 10_000
@@ -397,6 +401,89 @@ class Agent:
     # ------------------------------------------------------------------
     # member persistence (__corro_members parity)
     # ------------------------------------------------------------------
+
+    def metric_gauges(self) -> List[tuple]:
+        """Scrape-time gauges matching the reference's metrics loop
+        (``agent/metrics.rs:18-108`` collect_metrics + pool/transport
+        emit_metrics): per-table row counts, per-actor buffered-change
+        rows and bookkeeping-gap sums, db/WAL sizes and freelist, queue
+        depths, and aggregate transport ConnStats."""
+        extra: List[tuple] = []
+        with self.storage._lock:
+            for t in self.storage.tables:
+                (n,) = self.storage.conn.execute(
+                    f'SELECT COUNT(*) FROM "{t}"'
+                ).fetchone()
+                extra.append(("corro_table_rows", float(n), {"table": t}))
+            extra.append(
+                ("corro_db_version", float(self.storage.db_version()), {})
+            )
+            for actor, n in self.storage.conn.execute(
+                "SELECT actor_id, COUNT(*) FROM __corro_buffered_changes"
+                " GROUP BY actor_id"
+            ):
+                extra.append((
+                    "corro_db_buffered_changes_rows", float(n),
+                    {"actor_id": bytes(actor).hex()},
+                ))
+            (freelist,) = self.storage.conn.execute(
+                "PRAGMA freelist_count"
+            ).fetchone()
+            extra.append(("corro_db_freelist_pages", float(freelist), {}))
+            # version-gap sums per actor (corro.db.gaps.sum parity):
+            # the bookie's RangeSets mutate under the storage lock, so
+            # read them under it too
+            for actor, booked in self.bookie.actors().items():
+                gap_sum = sum(e - s + 1 for s, e in booked.needed.spans())
+                if gap_sum:
+                    extra.append((
+                        "corro_db_gaps_sum", float(gap_sum),
+                        {"actor_id": actor.hex()},
+                    ))
+        for name, path in (
+            ("corro_db_size_bytes", self.config.db_path),
+            ("corro_db_wal_size_bytes", self.config.db_path + "-wal"),
+        ):
+            try:
+                extra.append((name, float(os.stat(path).st_size), {}))
+            except OSError:
+                pass
+        extra.append(
+            ("corro_members_alive", float(len(self.members.alive())), {})
+        )
+        # channel/queue depths (channel.rs metered-channel parity)
+        extra.append(
+            ("corro_change_queue_depth", float(len(self._ingest)), {})
+        )
+        extra.append((
+            "corro_bcast_queue_depth",
+            float(self._bcast_queue.qsize()), {},
+        ))
+        if self.subs is not None:
+            with self.subs._lock:
+                depth = len(self.subs._pending) + sum(
+                    len(p) for per in self.subs._pending_pks.values()
+                    for p in per.values()
+                )
+            extra.append(("corro_subs_pending_depth", float(depth), {}))
+        # transport ConnStats aggregates (transport.rs:235-419 export)
+        if self.transport is not None:
+            stats = list(self.transport.stats.values())
+            extra.append(
+                ("corro_transport_peers", float(len(stats)), {})
+            )
+            for field in ("connects", "bytes_sent", "frames_sent",
+                          "failures"):
+                extra.append((
+                    f"corro_transport_{field}",
+                    float(sum(getattr(s, field) for s in stats)), {},
+                ))
+            rtts = [s.rtt_min_ms for s in stats if s.rtt_min_ms is not None]
+            if rtts:
+                extra.append(
+                    ("corro_transport_rtt_min_ms", float(min(rtts)), {})
+                )
+        return extra
 
     def _members_table(self) -> None:
         self.storage.conn.execute(
@@ -1455,30 +1542,14 @@ class Agent:
                             f"PRAGMA incremental_vacuum({freelist // 2})"
                         )
                         self.metrics.counter("corro_db_vacuums")
-                    # db-size gauges (agent/metrics.rs:18-108 set)
-                    page_count, page_size = (
-                        self.storage.conn.execute(
-                            "PRAGMA page_count"
-                        ).fetchone()[0],
-                        self.storage.conn.execute(
-                            "PRAGMA page_size"
-                        ).fetchone()[0],
-                    )
-                    self.metrics.gauge(
-                        "corro_db_size_bytes", page_count * page_size
-                    )
-                    self.metrics.gauge("corro_db_freelist_pages", freelist)
+                    # db/queue gauges moved to scrape time
+                    # (metric_gauges): one owner per series name, and
+                    # a scrape reads current values instead of stale
+                    # maintenance-tick snapshots
                     if wal_pages is not None:
                         self.metrics.gauge(
                             "corro_db_wal_pages", wal_pages
                         )
-                # queue-depth gauges (channel.rs:53-95 metered channels)
-                self.metrics.gauge(
-                    "corro_change_queue_depth", len(self._ingest)
-                )
-                self.metrics.gauge(
-                    "corro_bcast_queue_depth", self._bcast_queue.qsize()
-                )
                 self.metrics.gauge(
                     "corro_members_ring0", len(self.members.ring0())
                 )

@@ -319,6 +319,37 @@ def test_metrics_endpoint_and_backoff(run):
             assert 'corro_gossip_datagrams_received_total{kind="' in text
             assert "corro_gossip_datagrams_sent_total" in text
             assert 'corro_http_requests_total{endpoint="/metrics"}' in text
+            # strict-exposition well-formedness: every TYPE line and
+            # series unique (promtool/Prometheus reject duplicates)
+            seen_types, seen_series = set(), set()
+            for ln in text.splitlines():
+                if ln.startswith("# TYPE"):
+                    name = ln.split()[2]
+                    assert name not in seen_types, f"dup TYPE {name}"
+                    seen_types.add(name)
+                elif ln and not ln.startswith("#"):
+                    series = ln.rsplit(" ", 1)[0]
+                    assert series not in seen_series, f"dup {series}"
+                    seen_series.add(series)
+            # round-4 breadth (collect_metrics parity, docs/telemetry.md)
+            assert "corro_db_size_bytes" in text
+            assert "corro_db_wal_size_bytes" in text
+            assert "corro_db_freelist_pages" in text
+            assert "corro_change_queue_depth" in text
+            assert "corro_bcast_queue_depth" in text
+            assert "corro_subs_pending_depth" in text
+            assert "corro_transport_peers" in text
+            assert "corro_transport_bytes_sent" in text
+            # A sent the change to B over a cached uni conn, so A's
+            # aggregate ConnStats are nonzero
+            url_a = f"http://{a.api_addr[0]}:{a.api_addr[1]}/metrics"
+            with urllib.request.urlopen(url_a, timeout=5) as r:
+                text_a = r.read().decode()
+            for ln in text_a.splitlines():
+                if ln.startswith("corro_transport_connects"):
+                    assert float(ln.split()[-1]) >= 1.0
+                if ln.startswith("corro_transport_bytes_sent"):
+                    assert float(ln.split()[-1]) > 0.0
         finally:
             await b.stop()
             await a.stop()
