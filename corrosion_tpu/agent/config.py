@@ -106,6 +106,9 @@ def load_config(path: Optional[str] = None, **overrides) -> AgentConfig:
         pg_host, pg_port = _split_addr(pg["addr"])
         kwargs["pg_host"] = pg_host
         kwargs["pg_port"] = pg_port
+        # [api.pg] verify_client (corro-pg verify_client): PG's own
+        # client-cert knob, independent of gossip mTLS
+        kwargs["pg_tls_verify_client"] = bool(pg.get("verify_client"))
     # [telemetry.traces] path: append finished spans as OTLP-flavored
     # JSON lines (the reference exports via OTLP; config.rs telemetry)
     traces = data.get("telemetry", {}).get("traces")

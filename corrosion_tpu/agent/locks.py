@@ -102,20 +102,33 @@ class PriorityLock:
         self.default_label = default_label
         self._local = threading.local()  # per-thread entry-id stack
 
-    def acquire(self, priority: int = PRIO_NORMAL) -> None:
+    def acquire(self, priority: int = PRIO_NORMAL,
+                timeout: Optional[float] = None) -> bool:
+        """Acquire at ``priority``; with ``timeout`` give up after that
+        many seconds and return False (best-effort readers — e.g. the
+        metrics scrape — must degrade to stale data, not block behind
+        a long writer)."""
         me = threading.get_ident()
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             if self._owner == me:
                 self._count += 1
-                return
+                return True
             self._waiting[priority] += 1
             try:
                 while self._owner is not None or any(
                     self._waiting[p] for p in range(priority)
                 ):
-                    self._cv.wait()
+                    remaining = (
+                        None if deadline is None
+                        else deadline - time.monotonic()
+                    )
+                    if remaining is not None and remaining <= 0:
+                        return False
+                    self._cv.wait(remaining)
                 self._owner = me
                 self._count = 1
+                return True
             finally:
                 self._waiting[priority] -= 1
 

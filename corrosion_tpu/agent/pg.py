@@ -174,6 +174,62 @@ def _is_write(sql: str) -> bool:
     )
 
 
+def _returning_columns(tsql: str, agent) -> Optional[List[str]]:
+    """Column names a write's RETURNING clause will produce, or None
+    when there is no RETURNING clause.  Token-derived (never matches
+    inside literals): each item contributes its alias, else its last
+    identifier; ``*`` expands to the target table's columns."""
+    from corrosion_tpu.agent.pgsql import tokenize
+
+    try:
+        tokens = [t for t in tokenize(tsql) if t[0] not in ("ws", "comment")]
+    except Exception:
+        return None
+    idx = next(
+        (i for i, (k, txt) in enumerate(tokens)
+         if k == "word" and txt.upper() == "RETURNING"),
+        None,
+    )
+    if idx is None:
+        return None
+    # split the tail into comma-separated items (RETURNING is last in
+    # sqlite's grammar, so the tail IS the list)
+    items: List[List[Tuple[str, str]]] = [[]]
+    for k, txt in tokens[idx + 1:]:
+        if k == "op" and txt == ",":
+            items.append([])
+        else:
+            items[-1].append((k, txt))
+    cols: List[str] = []
+    for item in items:
+        if not item:
+            continue
+        if len(item) == 1 and item[0][1] == "*":
+            # expand from the statement's target table (word after
+            # INSERT INTO / UPDATE / DELETE FROM)
+            words = [t for t in tokens if t[0] == "word"]
+            table = None
+            for i, (_k, w) in enumerate(words):
+                up = w.upper()
+                if up in ("INTO", "UPDATE") or (
+                    up == "FROM"
+                    and i > 0 and words[i - 1][1].upper() == "DELETE"
+                ):
+                    if i + 1 < len(words):
+                        table = words[i + 1][1]
+                    break
+            info = agent.storage._tables.get(table) if table else None
+            if info is None:
+                cols.append("*")
+            else:
+                cols.extend(list(info.pk_cols) + list(info.data_cols))
+            continue
+        # alias (AS name / trailing bare word), else last identifier
+        names = [txt for k, txt in item if k in ("word", "qident")]
+        cols.append(names[-1].strip('"') if names else "?column?")
+    return cols
+
+
 def _tag_for(sql: str, rowcount: int, nrows: int) -> str:
     word = sql.lstrip().split(None, 1)[0].upper() if sql.strip() else ""
     if word == "SELECT" or word == "WITH":
@@ -416,11 +472,27 @@ class _Session:
         if _is_write(tsql):
             stmt = [tsql, list(params)] if params else [tsql]
             if self.in_txn:
+                if _returning_columns(tsql, self.agent) is not None:
+                    # writes inside BEGIN are buffered until COMMIT, so
+                    # RETURNING rows don't exist yet — failing fast
+                    # beats silently returning none (ORMs would read a
+                    # missing primary key)
+                    raise ValueError(
+                        "RETURNING inside an explicit transaction is "
+                        "not supported (writes are buffered until "
+                        "COMMIT); run the statement in autocommit"
+                    )
                 self.txn_writes.append(stmt)
                 # rowcount unknown until commit; report optimistically
                 return [], [], 1, _tag_for(tsql, 1, 0)
             out = self.agent.execute_transaction([stmt])
-            rc = out["results"][0].get("rows_affected", 0)
+            res = out["results"][0]
+            rc = res.get("rows_affected", 0)
+            # INSERT/UPDATE/DELETE ... RETURNING (the ORM write shape):
+            # the versioned write path surfaces the produced rows
+            if "rows" in res:
+                cols, rows = res["columns"], res["rows"]
+                return cols, rows, rc, _tag_for(tsql, max(rc, len(rows)), 0)
             return [], [], rc, _tag_for(tsql, rc, 0)
         cols, rows = self.agent.storage.read_query(tsql, params)
         return cols, rows, len(rows), _tag_for(tsql, -1, len(rows))
@@ -736,6 +808,13 @@ def _describe(writer, session: _Session, b: _Buffer) -> None:
                     return
             except Exception:
                 pass
+        if tsql and _is_write(tsql):
+            ret_cols = _returning_columns(tsql, session.agent)
+            if ret_cols:
+                _row_description(
+                    writer, ret_cols, [TEXT_OID] * len(ret_cols)
+                )
+                return
         writer.write(_msg(b"n"))
         return
     # Describe(portal): params are bound, so the query can run NOW —
@@ -758,9 +837,18 @@ def _describe(writer, session: _Session, b: _Buffer) -> None:
             writer.write(_msg(b"n"))
         return
     raw = session.stmts[entry["stmt"]][0]
-    if _is_write(translate_sql(raw)):
-        entry["described"] = True
-        writer.write(_msg(b"n"))  # writes produce no rows
+    tsql_w = translate_sql(raw)
+    if _is_write(tsql_w):
+        # a RETURNING write's row shape is derivable from the clause
+        # without executing — drivers decide their fetch path from
+        # this Describe answer, so it must be RowDescription, not
+        # NoData (real PG behaves the same)
+        ret_cols = _returning_columns(tsql_w, session.agent)
+        if ret_cols:
+            _row_description(writer, ret_cols, [TEXT_OID] * len(ret_cols))
+            entry["described"] = True
+        else:
+            writer.write(_msg(b"n"))
         return
     try:
         cols, rows, rc, tag = session.execute(raw, tuple(entry["values"]))

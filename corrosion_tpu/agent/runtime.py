@@ -409,37 +409,52 @@ class Agent:
         rows and bookkeeping-gap sums, db/WAL sizes and freelist, queue
         depths, and aggregate transport ConnStats."""
         extra: List[tuple] = []
-        with self.storage._lock:
-            for t in self.storage.tables:
-                (n,) = self.storage.conn.execute(
-                    f'SELECT COUNT(*) FROM "{t}"'
-                ).fetchone()
-                extra.append(("corro_table_rows", float(n), {"table": t}))
-            extra.append(
-                ("corro_db_version", float(self.storage.db_version()), {})
+        # committed-state reads ride the READ pool: a scrape must not
+        # hold the write lock across full-table COUNT(*) scans and
+        # stall PRIO_HIGH client writes (the reference's metrics loop
+        # reads through its read pool too)
+        for t in self.storage.tables:
+            _, rows = self.storage.read_query(
+                f'SELECT COUNT(*) FROM "{t}"'
             )
-            for actor, n in self.storage.conn.execute(
-                "SELECT actor_id, COUNT(*) FROM __corro_buffered_changes"
-                " GROUP BY actor_id"
-            ):
-                extra.append((
-                    "corro_db_buffered_changes_rows", float(n),
-                    {"actor_id": bytes(actor).hex()},
-                ))
-            (freelist,) = self.storage.conn.execute(
-                "PRAGMA freelist_count"
-            ).fetchone()
-            extra.append(("corro_db_freelist_pages", float(freelist), {}))
-            # version-gap sums per actor (corro.db.gaps.sum parity):
-            # the bookie's RangeSets mutate under the storage lock, so
-            # read them under it too
-            for actor, booked in self.bookie.actors().items():
-                gap_sum = sum(e - s + 1 for s, e in booked.needed.spans())
-                if gap_sum:
-                    extra.append((
-                        "corro_db_gaps_sum", float(gap_sum),
-                        {"actor_id": actor.hex()},
-                    ))
+            extra.append(
+                ("corro_table_rows", float(rows[0][0]), {"table": t})
+            )
+        _, rows = self.storage.read_query(
+            "SELECT actor_id, COUNT(*) FROM __corro_buffered_changes"
+            " GROUP BY actor_id"
+        )
+        for actor, n in rows:
+            extra.append((
+                "corro_db_buffered_changes_rows", float(n),
+                {"actor_id": bytes(actor).hex()},
+            ))
+        _, rows = self.storage.read_query("PRAGMA freelist_count")
+        extra.append(
+            ("corro_db_freelist_pages", float(rows[0][0]), {})
+        )
+        _, rows = self.storage.read_query(
+            "SELECT value FROM __corro_state WHERE key='db_version'"
+        )
+        extra.append(("corro_db_version", float(rows[0][0]), {}))
+        # version-gap sums per actor (corro.db.gaps.sum parity): the
+        # bookie's RangeSets mutate under the storage lock.  Best
+        # effort — a scrape must not queue behind a long write, so if
+        # the lock isn't free quickly the gap series is simply omitted
+        # this round (the next scrape catches up)
+        if self.storage._lock.acquire(PRIO_LOW, timeout=0.25):
+            try:
+                for actor, booked in self.bookie.actors().items():
+                    gap_sum = sum(
+                        e - s + 1 for s, e in booked.needed.spans()
+                    )
+                    if gap_sum:
+                        extra.append((
+                            "corro_db_gaps_sum", float(gap_sum),
+                            {"actor_id": actor.hex()},
+                        ))
+            finally:
+                self.storage._lock.release()
         for name, path in (
             ("corro_db_size_bytes", self.config.db_path),
             ("corro_db_wal_size_bytes", self.config.db_path + "-wal"),
@@ -889,7 +904,19 @@ class Agent:
                     else:
                         sql, params = stmt[0], stmt[1] if len(stmt) > 1 else ()
                     cur = conn.execute(sql, params)
-                    results.append({"rows_affected": cur.rowcount})
+                    res = {"rows_affected": cur.rowcount}
+                    if cur.description is not None:
+                        # RETURNING clause (ORM-style writes): surface
+                        # the produced rows alongside the write result,
+                        # JSON-safe (a BLOB column must not 500 the
+                        # HTTP response after the write committed)
+                        from corrosion_tpu.agent.pack import jsonable_row
+
+                        res["columns"] = [d[0] for d in cur.description]
+                        res["rows"] = [
+                            jsonable_row(r) for r in cur.fetchall()
+                        ]
+                    results.append(res)
                 n_changes = self.storage._state("seq")
                 if n_changes > 0:
                     version = booked.last() + 1

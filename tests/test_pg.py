@@ -546,3 +546,93 @@ def test_pg_estring_unicode_and_octal_escapes():
     assert t(r"SELECT E'\U0001F600'") == "SELECT '\U0001F600'"
     assert t(r"SELECT E'\101\102'") == "SELECT 'AB'"
     assert t(r"SELECT E'\x41'") == "SELECT 'A'"
+
+
+def test_pg_insert_returning(run):
+    """INSERT ... RETURNING flows through the versioned write path and
+    returns the produced rows (the ORM write shape), on both the simple
+    and the extended protocol; the write still broadcasts."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                c = PgClient(*a.pg_addr)
+                cols, rows, tags, errs = c.query(
+                    "INSERT INTO tests (id, text) VALUES (11, 'r')"
+                    " RETURNING id, text"
+                )
+                assert not errs, errs
+                assert cols == ["id", "text"] and rows == [["11", "r"]]
+                assert tags == ["INSERT 0 1"]
+                # extended protocol with a parameter
+                cols, rows, tag, err = c.prepared(
+                    "INSERT INTO tests (id, text) VALUES ($1, $2)"
+                    " RETURNING id", (12, "s"),
+                )
+                assert err is None and rows == [["12"]], (rows, err)
+                # UPDATE ... RETURNING
+                cols, rows, tags, errs = c.query(
+                    "UPDATE tests SET text = 'up' WHERE id = 11"
+                    " RETURNING text"
+                )
+                assert not errs and rows == [["up"]]
+                c.close()
+
+            await asyncio.to_thread(drive)
+            # versioned: both writes allocated versions
+            assert a.bookie.for_actor(a.actor_id).last() == 3
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_returning_describe_and_txn_limits(run):
+    """RETURNING edges: Describe announces the row shape before Execute
+    (drivers choose their fetch path from it), and RETURNING inside an
+    explicit transaction fails fast instead of silently dropping rows
+    (writes buffer until COMMIT)."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                from corrosion_tpu.agent.pg import _returning_columns
+
+                assert _returning_columns(
+                    "INSERT INTO tests (id) VALUES (1) RETURNING id, text",
+                    a,
+                ) == ["id", "text"]
+                assert _returning_columns(
+                    "UPDATE tests SET text='x' RETURNING *", a
+                ) == ["id", "text"]
+                assert _returning_columns(
+                    "DELETE FROM tests RETURNING id AS gone", a
+                ) == ["gone"]
+                assert _returning_columns(
+                    "INSERT INTO tests (id) VALUES (1)", a) is None
+                assert _returning_columns(
+                    "INSERT INTO tests (text) VALUES ('RETURNING x')", a
+                ) is None
+
+                c = PgClient(*a.pg_addr)
+                # extended protocol: the T frame arrives at Describe
+                # time and Execute returns the row
+                cols, rows, tag, err = c.prepared(
+                    "INSERT INTO tests (id, text) VALUES ($1, $2)"
+                    " RETURNING id", (21, "d"),
+                )
+                assert err is None and cols == ["id"] and rows == [["21"]]
+                # explicit txn: fail fast
+                c.query("BEGIN")
+                _, _, _, errs = c.query(
+                    "INSERT INTO tests (id) VALUES (22) RETURNING id"
+                )
+                assert errs and "RETURNING" in errs[0]
+                c.query("ROLLBACK")
+                c.close()
+
+            await asyncio.to_thread(drive)
+        finally:
+            await a.stop()
+
+    run(main())
