@@ -115,6 +115,7 @@ class PriorityLock:
                 self._count += 1
                 return True
             self._waiting[priority] += 1
+            timed_out = False
             try:
                 while self._owner is not None or any(
                     self._waiting[p] for p in range(priority)
@@ -124,6 +125,7 @@ class PriorityLock:
                         else deadline - time.monotonic()
                     )
                     if remaining is not None and remaining <= 0:
+                        timed_out = True
                         return False
                     self._cv.wait(remaining)
                 self._owner = me
@@ -131,6 +133,12 @@ class PriorityLock:
                 return True
             finally:
                 self._waiting[priority] -= 1
+                if timed_out:
+                    # our _waiting slot may have gated lower tiers past
+                    # a notify_all they consumed by re-waiting; now that
+                    # the slot is gone, wake them so nobody sleeps on a
+                    # free lock
+                    self._cv.notify_all()
 
     def release(self) -> None:
         with self._cv:

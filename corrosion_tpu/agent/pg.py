@@ -192,11 +192,17 @@ def _returning_columns(tsql: str, agent) -> Optional[List[str]]:
     )
     if idx is None:
         return None
-    # split the tail into comma-separated items (RETURNING is last in
-    # sqlite's grammar, so the tail IS the list)
+    # split the tail into comma-separated items at paren depth 0
+    # (RETURNING is last in sqlite's grammar, so the tail IS the list;
+    # a comma inside coalesce(a, b) is NOT a separator)
     items: List[List[Tuple[str, str]]] = [[]]
+    depth = 0
     for k, txt in tokens[idx + 1:]:
-        if k == "op" and txt == ",":
+        if k == "op" and txt == "(":
+            depth += 1
+        elif k == "op" and txt == ")":
+            depth -= 1
+        if k == "op" and txt == "," and depth == 0:
             items.append([])
         else:
             items[-1].append((k, txt))
@@ -205,29 +211,41 @@ def _returning_columns(tsql: str, agent) -> Optional[List[str]]:
         if not item:
             continue
         if len(item) == 1 and item[0][1] == "*":
-            # expand from the statement's target table (word after
-            # INSERT INTO / UPDATE / DELETE FROM)
-            words = [t for t in tokens if t[0] == "word"]
+            # expand from the statement's target table (token after
+            # INSERT INTO / UPDATE / DELETE FROM; quoted names count)
+            names_toks = [
+                (k, txt) for k, txt in tokens if k in ("word", "qident")
+            ]
             table = None
-            for i, (_k, w) in enumerate(words):
+            for i, (_k, w) in enumerate(names_toks):
                 up = w.upper()
                 if up in ("INTO", "UPDATE") or (
-                    up == "FROM"
-                    and i > 0 and words[i - 1][1].upper() == "DELETE"
+                    up == "FROM" and i > 0
+                    and names_toks[i - 1][1].upper() == "DELETE"
                 ):
-                    if i + 1 < len(words):
-                        table = words[i + 1][1]
+                    if i + 1 < len(names_toks):
+                        table = names_toks[i + 1][1].strip('"')
                     break
-            info = agent.storage._tables.get(table) if table else None
-            if info is None:
-                cols.append("*")
-            else:
-                cols.extend(list(info.pk_cols) + list(info.data_cols))
+            cols.extend(_star_columns(agent, table))
             continue
         # alias (AS name / trailing bare word), else last identifier
         names = [txt for k, txt in item if k in ("word", "qident")]
         cols.append(names[-1].strip('"') if names else "?column?")
     return cols
+
+
+def _star_columns(agent, table: Optional[str]) -> List[str]:
+    """RETURNING * expansion in SQLite's DECLARATION order (pk-first
+    reordering would mislabel the DataRow fields)."""
+    if table and table in agent.storage.tables:
+        try:
+            _, rows = agent.storage.read_query(
+                f'PRAGMA table_info("{table}")'
+            )
+            return [r[1] for r in rows]
+        except Exception:
+            pass
+    return ["*"]
 
 
 def _tag_for(sql: str, rowcount: int, nrows: int) -> str:

@@ -904,18 +904,24 @@ class Agent:
                     else:
                         sql, params = stmt[0], stmt[1] if len(stmt) > 1 else ()
                     cur = conn.execute(sql, params)
-                    res = {"rows_affected": cur.rowcount}
                     if cur.description is not None:
                         # RETURNING clause (ORM-style writes): surface
                         # the produced rows alongside the write result,
                         # JSON-safe (a BLOB column must not 500 the
-                        # HTTP response after the write committed)
+                        # HTTP response after the write committed).
+                        # fetchall() FIRST — sqlite3 only counts
+                        # affected rows as RETURNING rows are stepped,
+                        # so rowcount is 0 until the fetch completes
                         from corrosion_tpu.agent.pack import jsonable_row
 
-                        res["columns"] = [d[0] for d in cur.description]
-                        res["rows"] = [
-                            jsonable_row(r) for r in cur.fetchall()
-                        ]
+                        fetched = cur.fetchall()
+                        res = {
+                            "rows_affected": cur.rowcount,
+                            "columns": [d[0] for d in cur.description],
+                            "rows": [jsonable_row(r) for r in fetched],
+                        }
+                    else:
+                        res = {"rows_affected": cur.rowcount}
                     results.append(res)
                 n_changes = self.storage._state("seq")
                 if n_changes > 0:

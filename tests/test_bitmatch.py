@@ -55,6 +55,24 @@ def test_bitmatch_seed_sensitivity(tmp_path):
     assert r["bitmatch"], r
 
 
+def test_bitmatch_across_parameter_grid(tmp_path):
+    """The equality is not an artifact of one parameter point: vary
+    fanout, retransmission budget, and backoff — every combination
+    must still match tick for tick."""
+    grid = [
+        dict(fanout=2, max_transmissions=3, backoff_ticks=1.0),
+        dict(fanout=5, max_transmissions=8, backoff_ticks=0.0),
+        dict(fanout=3, max_transmissions=5, backoff_ticks=4.0),
+    ]
+    for i, params in enumerate(grid):
+        (tmp_path / f"g{i}").mkdir()
+        r = run_bitmatch(
+            24, writes=1, seed=i,
+            base_dir=str(tmp_path / f"g{i}"), **params,
+        )
+        assert r["bitmatch"], (params, r)
+
+
 def test_det_sim_trace_differs_across_seeds():
     """The PRNG wiring is live, not vacuous: different seeds give
     different delivery schedules."""

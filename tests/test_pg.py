@@ -636,3 +636,55 @@ def test_pg_returning_describe_and_txn_limits(run):
             await a.stop()
 
     run(main())
+
+
+def test_pg_returning_edge_shapes(run):
+    """RETURNING column derivation: declaration-order * expansion,
+    quoted table names, function calls with internal commas, and a
+    correct rows_affected count."""
+    async def main():
+        schema = (
+            "CREATE TABLE IF NOT EXISTS oddpk ("
+            " name TEXT NOT NULL DEFAULT '', id INTEGER NOT NULL"
+            " PRIMARY KEY);"
+        )
+        from corrosion_tpu.agent.testing import TEST_SCHEMA
+
+        a = await launch_test_agent(pg_port=0, schema=TEST_SCHEMA + schema)
+        try:
+            from corrosion_tpu.agent.pg import _returning_columns
+
+            # declaration order, not pk-first
+            assert _returning_columns(
+                "INSERT INTO oddpk (id) VALUES (1) RETURNING *", a
+            ) == ["name", "id"]
+            assert _returning_columns(
+                'INSERT INTO "oddpk" (id) VALUES (1) RETURNING *', a
+            ) == ["name", "id"]
+            # comma inside a function call is not a separator
+            assert _returning_columns(
+                "INSERT INTO tests (id) VALUES (1)"
+                " RETURNING coalesce(id, 0), text", a
+            ) == ["id", "text"]
+
+            def drive():
+                c = PgClient(*a.pg_addr)
+                # Describe columns match the Execute rows for *
+                cols, rows, tag, err = c.prepared(
+                    "INSERT INTO oddpk (name, id) VALUES ($1, $2)"
+                    " RETURNING *", ("n1", 41),
+                )
+                assert err is None and cols == ["name", "id"]
+                assert rows == [["n1", "41"]]
+                # rows_affected counts fetched RETURNING rows
+                cols, rows, tags, errs = c.query(
+                    "UPDATE oddpk SET name = 'x' RETURNING id"
+                )
+                assert not errs and tags == ["UPDATE 1"], (tags, errs)
+                c.close()
+
+            await asyncio.to_thread(drive)
+        finally:
+            await a.stop()
+
+    run(main())
