@@ -10,7 +10,6 @@ a UDS: ``ping``, ``sync generate`` (dump the sync handshake state),
 from __future__ import annotations
 
 import asyncio
-import json
 import os
 from typing import TYPE_CHECKING
 

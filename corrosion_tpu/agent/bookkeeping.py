@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from corrosion_tpu.types.hlc import Timestamp
 from corrosion_tpu.utils.ranges import RangeSet

@@ -20,7 +20,7 @@ from __future__ import annotations
 import fcntl
 import os
 import time
-from typing import List, Optional
+from typing import List
 
 PENDING_BYTE = 0x40000000
 RESERVED_BYTE = PENDING_BYTE + 1

@@ -20,7 +20,7 @@ that packed blobs are self-describing and roundtrip exactly.
 from __future__ import annotations
 
 import struct
-from typing import Iterable, List, Tuple
+from typing import Iterable, List
 
 SqlValue = object  # None | int | float | str | bytes
 

@@ -34,8 +34,11 @@ Implementation notes:
   pg_attribute, pg_type + information_schema tables/columns) rebuilt
   from the live schema — the sqlite answer to the reference's
   ``corro-pg/src/vtab/`` virtual tables.
-* BEGIN/COMMIT group writes into ONE replication version (buffered until
-  COMMIT); reads always see committed state.
+* BEGIN/COMMIT group writes into ONE replication version (buffered
+  until COMMIT); reads inside the transaction see its own buffered
+  writes (READ COMMITTED read-your-writes, evaluated in a rolled-back
+  sandbox — no lock held across client round trips), other sessions
+  see committed state only.
 """
 
 from __future__ import annotations
@@ -236,15 +239,11 @@ def _returning_columns(tsql: str, agent) -> Optional[List[str]]:
 
 def _star_columns(agent, table: Optional[str]) -> List[str]:
     """RETURNING * expansion in SQLite's DECLARATION order (pk-first
-    reordering would mislabel the DataRow fields)."""
-    if table and table in agent.storage.tables:
-        try:
-            _, rows = agent.storage.read_query(
-                f'PRAGMA table_info("{table}")'
-            )
-            return [r[1] for r in rows]
-        except Exception:
-            pass
+    reordering would mislabel the DataRow fields).  Served from the
+    TableInfo cache — no per-statement PRAGMA round trip."""
+    info = agent.storage._tables.get(table) if table else None
+    if info is not None and info.all_cols:
+        return list(info.all_cols)
     return ["*"]
 
 
@@ -512,7 +511,21 @@ class _Session:
                 cols, rows = res["columns"], res["rows"]
                 return cols, rows, rc, _tag_for(tsql, max(rc, len(rows)), 0)
             return [], [], rc, _tag_for(tsql, rc, 0)
-        cols, rows = self.agent.storage.read_query(tsql, params)
+        head = tsql.lstrip().split(None, 1)
+        is_select = bool(head) and head[0].upper() in (
+            "SELECT", "WITH", "VALUES",
+        )
+        if is_select and self.in_txn and self.txn_writes:
+            # read-your-writes inside BEGIN..COMMIT: evaluate against a
+            # rolled-back sandbox that replays the buffered writes (the
+            # ORM insert-then-select-by-pk shape).  Only genuine
+            # queries take this path — a PRAGMA on the shared RW
+            # connection would outlive the rollback
+            cols, rows = self.agent.storage.speculative_read(
+                self.txn_writes, tsql, params
+            )
+        else:
+            cols, rows = self.agent.storage.read_query(tsql, params)
         return cols, rows, len(rows), _tag_for(tsql, -1, len(rows))
 
     def _user_tables(self) -> set:

@@ -33,7 +33,7 @@ Translations applied:
 from __future__ import annotations
 
 import re
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 _OPS = ("::", "<=", ">=", "<>", "!=", "||", ":=")
 _WORD_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")

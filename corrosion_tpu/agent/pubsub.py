@@ -48,10 +48,9 @@ import sqlite3
 import threading
 import time
 import uuid
-from typing import Dict, Iterable, List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from corrosion_tpu.agent.pack import jsonable_row, pack_values, unpack_values
-from corrosion_tpu.types.change import SENTINEL_CID
 from corrosion_tpu.types.changeset import ChangeV1
 
 DEBOUNCE_S = 0.05

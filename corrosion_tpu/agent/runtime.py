@@ -36,7 +36,7 @@ from corrosion_tpu.agent.locks import PRIO_HIGH, PRIO_LOW
 from corrosion_tpu.agent.bookkeeping import Bookie
 from corrosion_tpu.agent.members import Member, Members, MemberState
 from corrosion_tpu.agent.schema import apply_schema
-from corrosion_tpu.agent.storage import CrConn
+from corrosion_tpu.agent.storage import CrConn, unpack_stmt
 from corrosion_tpu.types import (
     ActorId,
     ChangeV1,
@@ -899,10 +899,7 @@ class Agent:
         with self.storage._lock.prio(PRIO_HIGH, "write", kind="write"):
             with self.storage.write_tx() as conn:
                 for stmt in statements:
-                    if isinstance(stmt, str):
-                        sql, params = stmt, ()
-                    else:
-                        sql, params = stmt[0], stmt[1] if len(stmt) > 1 else ()
+                    sql, params = unpack_stmt(stmt)
                     cur = conn.execute(sql, params)
                     if cur.description is not None:
                         # RETURNING clause (ORM-style writes): surface

@@ -47,6 +47,15 @@ from corrosion_tpu.types.change import Change, SENTINEL_CID
 _IDENT_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
 
 
+def unpack_stmt(stmt) -> Tuple[str, Sequence]:
+    """One buffered statement → (sql, params).  Shared by the commit
+    replay (runtime.execute_transaction) and the speculative sandbox so
+    the two can never diverge on the statement shape."""
+    if isinstance(stmt, str):
+        return stmt, ()
+    return stmt[0], stmt[1] if len(stmt) > 1 else ()
+
+
 def _ident(name: str) -> str:
     if not _IDENT_RE.match(name):
         raise ValueError(f"invalid identifier: {name!r}")
@@ -58,6 +67,7 @@ class TableInfo:
     name: str
     pk_cols: Tuple[str, ...]
     data_cols: Tuple[str, ...]  # non-pk columns
+    all_cols: Tuple[str, ...] = ()  # DECLARATION order (RETURNING *)
 
 
 def register_udfs(conn: sqlite3.Connection) -> None:
@@ -256,7 +266,10 @@ class CrConn:
         data = tuple(r[1] for r in info if not r[5])
         if not pk:
             raise ValueError(f"CRR table {table} must have a primary key")
-        return TableInfo(name=table, pk_cols=pk, data_cols=data)
+        return TableInfo(
+            name=table, pk_cols=pk, data_cols=data,
+            all_cols=tuple(r[1] for r in info),
+        )
 
     @property
     def tables(self) -> Dict[str, TableInfo]:
@@ -672,6 +685,40 @@ END;
             if wrote:
                 self._set_state("db_version", pending)
             self.conn.execute("COMMIT")
+
+    def speculative_read(self, writes: Sequence, sql: str,
+                         params: Sequence = ()):
+        """Evaluate ``sql`` as if ``writes`` had been applied, then roll
+        everything back — read-your-writes for a buffered interactive
+        transaction (the PG session's BEGIN..COMMIT, which holds no
+        lock across client round trips; PG's READ COMMITTED lets later
+        committed state show between reads).
+
+        The sandbox mirrors ``write_tx``'s state setup so the CRR
+        triggers fire normally; ROLLBACK reverts data, clock tables and
+        ``__corro_state`` alike (all same-database rows).  Cost is
+        O(buffered writes) per read, bounded by the transaction size.
+        """
+        from corrosion_tpu.agent.locks import PRIO_HIGH
+
+        with self._lock.prio(PRIO_HIGH, "speculative-read", kind="write"):
+            self.conn.execute("BEGIN")
+            try:
+                pending = self._state("db_version") + 1
+                self._set_state("pending_db_version", pending)
+                self._set_state("seq", 0)
+                for stmt in writes:
+                    w_sql, w_params = unpack_stmt(stmt)
+                    self.conn.execute(w_sql, w_params)
+                cur = self.conn.execute(sql, tuple(params))
+                cols = [d[0] for d in cur.description or []]
+                rows = cur.fetchall()
+                return cols, rows
+            finally:
+                # a constraint abort may have auto-rolled-back already;
+                # a second ROLLBACK would mask the real error
+                if self.conn.in_transaction:
+                    self.conn.execute("ROLLBACK")
 
     def execute(self, sql: str, params: Sequence = ()):
         """Run one write statement in its own transaction."""

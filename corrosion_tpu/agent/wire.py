@@ -29,7 +29,7 @@ from __future__ import annotations
 import base64
 import json
 import struct
-from typing import Iterable, List, Optional, Tuple
+from typing import List, Optional
 
 from corrosion_tpu.types.base import CrsqlDbVersion, CrsqlSeq, Version
 from corrosion_tpu.types.change import Change

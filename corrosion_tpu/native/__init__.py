@@ -18,7 +18,6 @@ import subprocess
 import sys
 import sysconfig
 import threading
-from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "_corrosion_native.cc")

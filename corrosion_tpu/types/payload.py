@@ -13,7 +13,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from corrosion_tpu.types.actor import ActorId, ClusterId
-from corrosion_tpu.types.base import CrsqlSeq, Version
+from corrosion_tpu.types.base import Version
 from corrosion_tpu.types.changeset import ChangeV1
 from corrosion_tpu.types.hlc import Timestamp
 from corrosion_tpu.utils.ranges import RangeSet

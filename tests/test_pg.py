@@ -688,3 +688,86 @@ def test_pg_returning_edge_shapes(run):
             await a.stop()
 
     run(main())
+
+
+def test_pg_transaction_read_your_writes(run):
+    """Reads inside BEGIN..COMMIT see the transaction's own buffered
+    writes (READ COMMITTED read-your-writes, the ORM
+    insert-then-select shape), other sessions see nothing until
+    COMMIT, and ROLLBACK leaves no trace."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                c = PgClient(*a.pg_addr)
+                c2 = PgClient(*a.pg_addr)
+                c.query("BEGIN")
+                c.query("INSERT INTO tests (id, text) VALUES (1, 'mine')")
+                # same session sees the pending write
+                _, rows, _, errs = c.query(
+                    "SELECT text FROM tests WHERE id = 1"
+                )
+                assert not errs and rows == [["mine"]], (rows, errs)
+                # an UPDATE of the pending row is visible too
+                c.query("UPDATE tests SET text = 'mine2' WHERE id = 1")
+                _, rows, _, errs = c.query(
+                    "SELECT text FROM tests WHERE id = 1"
+                )
+                assert not errs and rows == [["mine2"]]
+                # other sessions see committed state only
+                _, rows2, _, _ = c2.query("SELECT count(*) FROM tests")
+                assert rows2 == [["0"]]
+                c.query("ROLLBACK")
+                _, rows, _, _ = c.query("SELECT count(*) FROM tests")
+                assert rows == [["0"]]
+                # commit path: durable + single version
+                c.query("BEGIN")
+                c.query("INSERT INTO tests (id, text) VALUES (2, 'kept')")
+                _, rows, _, _ = c.query(
+                    "SELECT text FROM tests WHERE id = 2"
+                )
+                assert rows == [["kept"]]
+                c.query("COMMIT")
+                _, rows2, _, _ = c2.query(
+                    "SELECT text FROM tests WHERE id = 2"
+                )
+                assert rows2 == [["kept"]]
+                c.close()
+                c2.close()
+
+            await asyncio.to_thread(drive)
+            assert a.bookie.for_actor(a.actor_id).last() == 1
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_pragma_in_txn_stays_off_write_conn(run):
+    """A PRAGMA inside BEGIN..COMMIT must not ride the speculative
+    sandbox onto the shared RW connection (connection-scoped settings
+    would survive the rollback)."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            (before,) = a.storage.conn.execute(
+                "PRAGMA synchronous"
+            ).fetchone()
+
+            def drive():
+                c = PgClient(*a.pg_addr)
+                c.query("BEGIN")
+                c.query("INSERT INTO tests (id, text) VALUES (1, 'x')")
+                c.query("PRAGMA synchronous = OFF")
+                c.query("COMMIT")
+                c.close()
+
+            await asyncio.to_thread(drive)
+            (after,) = a.storage.conn.execute(
+                "PRAGMA synchronous"
+            ).fetchone()
+            assert after == before, (before, after)
+        finally:
+            await a.stop()
+
+    run(main())
