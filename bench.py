@@ -152,7 +152,10 @@ def _sweep_point(n: int, s: dict) -> dict:
             else round(s["msgs_per_node_mean"] * ratio, 2)
         ),
         # hop stats are measured over broadcast-infected nodes or null
-        # (never the old max_ticks sentinel); the coverage says why
+        # (never the old max_ticks sentinel); the coverage says why a
+        # percentile is unavailable — p50 stays measured at large N
+        # where 5% loss + partitions pull coverage under the p99 rank
+        "hops_p50": s.get("hops_p50"),
         "hops_p99": s.get("hops_p99"),
         "hops_broadcast_frac": s.get("hops_broadcast_frac"),
         "converged_frac": s["converged_frac"],
@@ -233,6 +236,7 @@ def _timed_sim(name: str, run, n_seeds: int, headline: bool = False,
         "ticks_p99": None if not (ticks_p99 < float("inf")) else ticks_p99,
         "ticks_p50": stats.get("ticks_p50"),
         "msgs_per_node_mean": round(stats["msgs_per_node_mean"], 1),
+        "hops_p50": stats.get("hops_p50"),
         "hops_p99": stats.get("hops_p99"),
         "hops_broadcast_frac": stats.get("hops_broadcast_frac"),
         "converged_frac": stats["converged_frac"],

@@ -170,11 +170,36 @@ def translate_sql(sql: str) -> str:
     return translate_query(sql)[0]
 
 
+_WRITE_WORDS = frozenset((
+    "INSERT", "UPDATE", "DELETE", "REPLACE", "CREATE", "DROP", "ALTER",
+))
+
+
 def _is_write(sql: str) -> bool:
     head = sql.lstrip().split(None, 1)
-    return bool(head) and head[0].upper() in (
-        "INSERT", "UPDATE", "DELETE", "REPLACE", "CREATE", "DROP", "ALTER",
-    )
+    if not head:
+        return False
+    first = head[0].upper()
+    if first in _WRITE_WORDS:
+        return True
+    if first != "WITH":
+        return False
+    # CTE-led DML (WITH ... INSERT/UPDATE/DELETE) is a write: find a
+    # top-level write word outside parens/literals (token-aware)
+    from corrosion_tpu.agent.pgsql import tokenize
+
+    try:
+        depth = 0
+        for k, txt in tokenize(sql):
+            if k == "op" and txt == "(":
+                depth += 1
+            elif k == "op" and txt == ")":
+                depth -= 1
+            elif k == "word" and depth == 0 and txt.upper() in _WRITE_WORDS:
+                return True
+    except Exception:
+        pass
+    return False
 
 
 def _returning_columns(tsql: str, agent) -> Optional[List[str]]:
@@ -240,10 +265,12 @@ def _returning_columns(tsql: str, agent) -> Optional[List[str]]:
 def _star_columns(agent, table: Optional[str]) -> List[str]:
     """RETURNING * expansion in SQLite's DECLARATION order (pk-first
     reordering would mislabel the DataRow fields).  Served from the
-    TableInfo cache — no per-statement PRAGMA round trip."""
-    info = agent.storage._tables.get(table) if table else None
-    if info is not None and info.all_cols:
-        return list(info.all_cols)
+    schema-version-keyed column cache so wire DDL (ALTER TABLE over
+    PG) is picked up without a per-statement table_info scan."""
+    if table:
+        cols = agent.storage.declared_columns(table)
+        if cols:
+            return list(cols)
     return ["*"]
 
 
@@ -511,7 +538,10 @@ class _Session:
                 cols, rows = res["columns"], res["rows"]
                 return cols, rows, rc, _tag_for(tsql, max(rc, len(rows)), 0)
             return [], [], rc, _tag_for(tsql, rc, 0)
-        head = tsql.lstrip().split(None, 1)
+        # classify with leading parens stripped so a parenthesized
+        # compound ("(SELECT ...) UNION ...") gets the same visibility
+        # as its bare form; _is_write above already claimed CTE-led DML
+        head = tsql.lstrip(" (").split(None, 1)
         is_select = bool(head) and head[0].upper() in (
             "SELECT", "WITH", "VALUES",
         )

@@ -275,6 +275,29 @@ class CrConn:
     def tables(self) -> Dict[str, TableInfo]:
         return dict(self._tables)
 
+    def declared_columns(self, table: str) -> Tuple[str, ...]:
+        """A table's columns in DECLARATION order, cached per sqlite
+        ``schema_version`` (which bumps on any DDL — runtime ALTERs
+        over the wire invalidate the cache; one scalar PRAGMA per
+        call otherwise)."""
+        _, rows = self.read_query("PRAGMA schema_version")
+        sv = rows[0][0]
+        cached_sv, cols_by_table = getattr(
+            self, "_declared_cols_cache", (None, {})
+        )
+        if cached_sv != sv:
+            cols_by_table = {}
+            self._declared_cols_cache = (sv, cols_by_table)
+        if table not in cols_by_table:
+            try:
+                _, info = self.read_query(
+                    f'PRAGMA table_info("{_ident(table)}")'
+                )
+            except (sqlite3.Error, ValueError):
+                return ()
+            cols_by_table[table] = tuple(r[1] for r in info)
+        return cols_by_table[table]
+
     # ------------------------------------------------------------------
     # site interning
     # ------------------------------------------------------------------

