@@ -581,14 +581,17 @@ class Agent:
             if ts > known_ts:
                 # renewed identity generation: the fresh incarnation
                 # space must override a stale DOWN record, so drop the
-                # old member before the upsert
+                # old member — and its suspicion timer: the new
+                # generation must not inherit the old one's deadline
                 self._swim_ts[actor] = ts
                 if self.members.get(actor) is not None:
                     self.members.remove(actor)
+                self._suspects.pop(actor, None)
             if self.members.upsert(
                 actor, (host, port), MemberState(state), inc
             ):
                 self._swim_update_tx[actor] = 0  # fresh news
+                self.note_member_state(actor, MemberState(state))
 
     def _send_udp(self, addr: Tuple[str, int], msg: dict) -> None:
         if self._udp:
@@ -832,20 +835,35 @@ class Agent:
             self.config.suspicion_mult,
         )
 
+    def note_member_state(self, actor: bytes, state: MemberState) -> None:
+        """Arm/clear the local suspicion timer for a member-record
+        change (SWIM deadlines are PER NODE — foca starts one on every
+        member that hears a suspicion).  Shared by both wire ingest
+        paths so they cannot diverge."""
+        if state is MemberState.SUSPECT:
+            self._suspects.setdefault(actor, time.monotonic())
+        else:
+            self._suspects.pop(actor, None)
+
+    def _reap_suspects(self) -> None:
+        """One suspicion-deadline pass (extracted so tests can drive
+        it without the loop's cadence)."""
+        now = time.monotonic()
+        deadline = self._suspect_deadline()
+        for actor, since in list(self._suspects.items()):
+            if now - since >= deadline:
+                m = self.members.get(actor)
+                if m and m.state is MemberState.SUSPECT:
+                    self.members.upsert(
+                        actor, m.addr, MemberState.DOWN, m.incarnation
+                    )
+                    self._swim_update_tx[actor] = 0  # fresh news
+                self._suspects.pop(actor, None)
+
     async def _suspect_reaper(self) -> None:
         while True:
             await asyncio.sleep(self.config.probe_interval)
-            now = time.monotonic()
-            deadline = self._suspect_deadline()
-            for actor, since in list(self._suspects.items()):
-                if now - since >= deadline:
-                    m = self.members.get(actor)
-                    if m and m.state is MemberState.SUSPECT:
-                        self.members.upsert(
-                            actor, m.addr, MemberState.DOWN, m.incarnation
-                        )
-                        self._swim_update_tx[actor] = 0  # fresh news
-                    self._suspects.pop(actor, None)
+            self._reap_suspects()
 
     # ------------------------------------------------------------------
     # local writes + broadcast

@@ -216,20 +216,32 @@ def _ingest_update(agent: "Agent", fm: foca.FocaMember) -> None:
             agent._persist_incarnation()
         return
     known_ts = agent._swim_ts.get(fm.actor.id)
-    if known_ts is not None and fm.actor.ts < known_ts:
-        return  # stale identity generation
-    if known_ts is None or fm.actor.ts > known_ts:
+    # ts == 0 means the SENDER never learned this identity's generation
+    # (e.g. pre-seeded membership): apply by plain incarnation rules —
+    # dropping those would starve dissemination of exactly the
+    # suspicion/down records failure detection rides on.  Only a REAL
+    # but older ts is a stale generation.
+    if 0 < fm.actor.ts < (known_ts or 0):
+        return
+    if fm.actor.ts > 0 and (known_ts is None or fm.actor.ts > known_ts):
         # new member or renewed identity: fresh incarnation space
-        # replaces whatever record (possibly DOWN) we held
+        # replaces whatever record (possibly DOWN) we held — and any
+        # suspicion timer the OLD generation had armed
         agent._swim_ts[fm.actor.id] = fm.actor.ts
         if known_ts is not None:
             agent.members.remove(fm.actor.id)
+            agent._suspects.pop(fm.actor.id, None)
     if agent.members.upsert(
         fm.actor.id, fm.actor.addr, _WIRE_TO_STATE[fm.state],
         fm.incarnation,
     ):
-        # a changed record is fresh news: back into the gossip backlog
+        # a changed record is fresh news: back into the gossip backlog,
+        # and the shared per-node suspicion-timer bookkeeping runs
+        # (foca: every member that LEARNS a suspicion starts its own
+        # deadline — detection must not serialize behind the
+        # first-hand suspecter's gossip)
         agent._swim_update_tx[fm.actor.id] = 0
+        agent.note_member_state(fm.actor.id, _WIRE_TO_STATE[fm.state])
 
 
 def handle_datagram(agent: "Agent", data: bytes, addr) -> None:

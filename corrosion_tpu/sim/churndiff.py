@@ -1,0 +1,193 @@
+"""SWIM churn calibration: the model's failure-detection latency vs
+REAL agents.
+
+Round-3 review: the churn bench's detection latency "has no reference
+anchor to validate against".  This harness supplies the anchor: boot N
+real agents with ACTIVE SWIM probing (binary foca datagrams on
+loopback), crash one, and measure the wall time until every survivor
+holds a DOWN record; then relaunch it from the same data dir and
+measure rejoin propagation.  The sim side runs the vmapped SWIM model
+(``models/swim.py``) under the SAME cluster-size-scaled parameters
+(``utils/swimscale.py``), and both sides are compared in PROBE-PERIOD
+units — the model's tick is one probe interval by construction.
+
+What matches by design: the suspicion deadline (both sides scale it as
+``suspicion_mult * ceil(log10(n+1))`` probe periods — the host's
+configured floor is set to 0 here so the scaled term governs) and the
+dissemination mechanics (freshness-prioritized piggyback with decay on
+both sides).  The residual is the host's timer jitter and the fact
+that a host probe round-trip is wall-asynchronous where the model's is
+tick-synchronous.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Dict, Optional
+
+
+async def host_churn_trace(
+    n: int = 64,
+    probe_interval: float = 0.15,
+    timeout: float = 60.0,
+    base_dir: Optional[str] = None,
+) -> Dict:
+    """Crash + rejoin cycle on N real agents; latencies in probe-period
+    units (directly comparable to model ticks)."""
+    from corrosion_tpu.agent.members import MemberState
+    from corrosion_tpu.agent.testing import (
+        launch_test_agent,
+        seed_full_membership,
+        wait_for,
+    )
+
+    agents = []
+    common = dict(
+        probe_interval=probe_interval,
+        probe_timeout=probe_interval * 0.8,
+        suspect_timeout=0.0,  # floor off: the scaled deadline governs
+        # quiesce everything that is not membership
+        sync_interval_min=3600.0,
+        sync_interval_max=7200.0,
+        maintenance_interval=3600.0,
+        subs_enabled=False,
+        api_port=None,
+        uni_cache_size=8,
+    )
+    try:
+        for i in range(n):
+            agents.append(await launch_test_agent(
+                bootstrap=[],
+                tmpdir=None if base_dir is None else f"{base_dir}/n{i}",
+                **common,
+            ))
+        seed_full_membership(agents)
+        # let a few probe rounds pass so the cluster is steady
+        await asyncio.sleep(probe_interval * 4)
+
+        victim = agents[-1]
+        victim_actor = victim.actor_id
+        victim_dir = victim.config.db_path.rsplit("/", 1)[0]
+        survivors = agents[:-1]
+
+        t0 = time.perf_counter()
+        await victim.stop(graceful=False)  # crash
+
+        def down_everywhere():
+            for a in survivors:
+                m = a.members.get(victim_actor)
+                if m is None or m.state is not MemberState.DOWN:
+                    return False
+            return True
+
+        await wait_for(down_everywhere, timeout=timeout, interval=0.02)
+        detect_wall = time.perf_counter() - t0
+
+        # rejoin: same data dir = same identity, renewed generation
+        t1 = time.perf_counter()
+        reborn = await launch_test_agent(
+            tmpdir=victim_dir,
+            bootstrap=[
+                f"{survivors[0].gossip_addr[0]}:"
+                f"{survivors[0].gossip_addr[1]}"
+            ],
+            **common,
+        )
+        agents[-1] = reborn
+        assert reborn.actor_id == victim_actor
+
+        def alive_everywhere():
+            for a in survivors:
+                m = a.members.get(victim_actor)
+                if m is None or m.state is not MemberState.ALIVE:
+                    return False
+            return True
+
+        await wait_for(alive_everywhere, timeout=timeout, interval=0.02)
+        rejoin_wall = time.perf_counter() - t1
+
+        return {
+            "runtime": "agents",
+            "n_nodes": n,
+            "probe_interval_s": probe_interval,
+            "detect_wall_s": round(detect_wall, 3),
+            "rejoin_wall_s": round(rejoin_wall, 3),
+            "detect_probe_periods": round(detect_wall / probe_interval, 1),
+            "rejoin_probe_periods": round(rejoin_wall / probe_interval, 1),
+            "conditions": {
+                "wire": "binary foca datagrams over UDP loopback",
+                "suspicion": "scaled deadline only (floor 0)",
+                "membership": "pre-seeded; sync/maintenance quiesced",
+            },
+        }
+    finally:
+        await asyncio.gather(
+            *(a.stop() for a in agents), return_exceptions=True
+        )
+
+
+def model_churn_trace(n: int = 64) -> Dict:
+    """The SWIM model's churn cycle under the same scaled parameters;
+    latencies already in ticks (= probe periods)."""
+    from corrosion_tpu.sim.churn import ChurnConfig, run_churn
+
+    stats = run_churn(ChurnConfig(n_nodes=n))
+    return {
+        "runtime": "tpu-sim",
+        "n_nodes": n,
+        "detect_ticks": stats["detect_latency"],
+        "rejoin_ticks": stats["rejoin_latency"],
+        "msgs_per_node_per_tick": round(stats["msgs_per_node_per_tick"], 2),
+    }
+
+
+async def run_churndiff(
+    n: int = 64,
+    probe_interval: float = 0.15,
+    out_path: Optional[str] = None,
+    base_dir: Optional[str] = None,
+) -> Dict:
+    host = await host_churn_trace(
+        n, probe_interval=probe_interval, base_dir=base_dir
+    )
+    model = model_churn_trace(n)
+
+    def ratio(a, b):
+        if a is None or b is None or not b:
+            return None
+        return round(a / b, 2)
+
+    result = {
+        "n_nodes": n,
+        "host": host,
+        "model": model,
+        "diff": {
+            "detect_ratio_host_over_model": ratio(
+                host["detect_probe_periods"], model["detect_ticks"]
+            ),
+            "rejoin_ratio_host_over_model": ratio(
+                host["rejoin_probe_periods"], model["rejoin_ticks"]
+            ),
+            "residual_note": (
+                "the host pays a real probe-failure chain before "
+                "marking suspect (direct timeout 0.8 periods + "
+                "indirect probes 1.6 periods) plus reaper-granularity "
+                "rounding and a last-straggler dissemination tail, "
+                "where the model marks suspicion in the failed "
+                "probe's own tick — so host/model detect ratios land "
+                "around 1.7-2.0 (single-run; the tail is the variance "
+                "driver), bounding the model as a documented "
+                "optimistic floor rather than a tick-exact latency "
+                "claim.  Building this anchor caught two real host "
+                "bugs: ts=0 piggybacked records were dropped as stale "
+                "generations, and gossip-learned suspicions never "
+                "started the local suspicion timer"
+            ),
+        },
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1, allow_nan=False)
+    return result

@@ -1,0 +1,69 @@
+"""Churn calibration harness: the SWIM model's failure-detection
+latency anchored against real agents (sim/churndiff.py)."""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.sim.churndiff import run_churndiff
+
+
+def test_churndiff_small_cluster():
+    """Detection and rejoin on real agents complete and land within a
+    small multiple of the model's tick counts (the host pays a real
+    probe-timeout chain the model folds into one tick)."""
+    r = asyncio.run(run_churndiff(12, probe_interval=0.12))
+    h, m, d = r["host"], r["model"], r["diff"]
+    assert m["detect_ticks"] is not None
+    assert h["detect_probe_periods"] > 0
+    # loose, load-tolerant bounds: the model is an optimistic floor,
+    # the host must not be an order of magnitude beyond it
+    assert d["detect_ratio_host_over_model"] is not None
+    assert 0.5 <= d["detect_ratio_host_over_model"] <= 6.0, d
+    assert d["rejoin_ratio_host_over_model"] is not None
+    assert 0.2 <= d["rejoin_ratio_host_over_model"] <= 8.0, d
+
+
+def test_gossip_learned_suspicion_promotes_to_down():
+    """A node that learns a SUSPECT record via gossip runs its own
+    suspicion deadline (foca per-node timers): it promotes the member
+    to DOWN without ever probing it itself."""
+    from corrosion_tpu.agent.members import MemberState
+    from corrosion_tpu.agent.testing import launch_test_agent, wait_for
+
+    async def main():
+        # observer with probing effectively OFF: it can only learn via
+        # ingest, so the DOWN transition must come from its own timer
+        a = await launch_test_agent(
+            probe_interval=3600.0, suspect_timeout=0.3
+        )
+        try:
+            from corrosion_tpu.bridge import foca
+            from corrosion_tpu.agent import swim_foca
+
+            peer = foca.FocaActor(
+                id=b"\x77" * 16, addr=("127.0.0.1", 1), ts=5,
+                cluster_id=0,
+            )
+            swim_foca._ingest_update(a, foca.FocaMember(
+                actor=peer, incarnation=0, state=foca.STATE_SUSPECT,
+            ))
+            m = a.members.get(peer.id)
+            assert m is not None and m.state is MemberState.SUSPECT
+            assert peer.id in a._suspects  # local timer armed
+            # age the timer past the deadline and run one reaper pass:
+            # the gossip-learned suspicion promotes to DOWN without
+            # this node ever probing the member
+            import time as t
+
+            a._suspects[peer.id] = (
+                t.monotonic() - a._suspect_deadline() - 1.0
+            )
+            a._reap_suspects()
+            m = a.members.get(peer.id)
+            assert m is not None and m.state is MemberState.DOWN
+            assert peer.id not in a._suspects
+        finally:
+            await a.stop()
+
+    asyncio.run(main())
