@@ -137,6 +137,8 @@ def load_config(path: Optional[str] = None, **overrides) -> AgentConfig:
         "probe_interval",
         "probe_timeout",
         "suspect_timeout",
+        "gossip_interval",
+        "gossip_fanout",
         "num_indirect_probes",
         "fanout",
         "max_transmissions",

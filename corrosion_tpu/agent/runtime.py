@@ -79,6 +79,10 @@ class AgentConfig:
     # perf knobs (reference defaults in config.rs / broadcast mod)
     probe_interval: float = 0.4
     probe_timeout: float = 0.35
+    # periodic membership gossip cadence (foca periodic_gossip; the
+    # WAN preset gossips faster than it probes). 0 disables.
+    gossip_interval: float = 0.2
+    gossip_fanout: int = 3  # targets per gossip round (foca num_members)
     suspect_timeout: float = 2.0  # floor; scaled up with cluster size
     suspicion_mult: int = 4  # suspicion deadline growth multiplier
     num_indirect_probes: int = 3
@@ -300,6 +304,7 @@ class Agent:
             asyncio.create_task(self._announce_loop()),
             asyncio.create_task(self._probe_loop()),
             asyncio.create_task(self._suspect_reaper()),
+            asyncio.create_task(self._gossip_loop()),
             asyncio.create_task(self._broadcast_loop()),
             asyncio.create_task(self._change_loop()),
             asyncio.create_task(self._sync_loop()),
@@ -759,6 +764,29 @@ class Agent:
         self._swim_ts.clear()
         self._swim_update_tx.clear()
         return announced
+
+    async def _gossip_loop(self) -> None:
+        """Periodic membership gossip (foca periodic_gossip, enabled by
+        the reference's WAN preset): a pure update-carrier round on a
+        cadence faster than probing, skipped entirely once the backlog
+        has decayed — the quiet-cluster cost is zero datagrams."""
+        interval = self.config.gossip_interval
+        if interval <= 0 or self.config.swim_wire != "foca":
+            return
+        from corrosion_tpu.agent import swim_foca
+
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                sent = swim_foca.gossip_round(
+                    self, self.config.gossip_fanout
+                )
+                if sent:
+                    self.metrics.counter(
+                        "corro_gossip_rounds_total"
+                    )
+            except Exception:
+                self.metrics.counter("corro_gossip_round_errors_total")
 
     async def _probe_loop(self) -> None:
         while True:

@@ -75,21 +75,37 @@ def _nil_actor(agent: "Agent", addr: Tuple[str, int]) -> foca.FocaActor:
     )
 
 
+def _backlog_limit(agent: "Agent", n_members: int) -> int:
+    """The shared decay budget: one update rides at most this many
+    sends after it last changed (both the piggyback selection and the
+    gossip-round skip-check key on it)."""
+    from corrosion_tpu.utils.swimscale import scaled_update_retransmissions
+
+    return scaled_update_retransmissions(n_members + 1)
+
+
+def backlog_has_fresh(agent: "Agent") -> bool:
+    """True while any member update still has retransmission budget."""
+    members = agent.members.all()
+    limit = _backlog_limit(agent, len(members))
+    return any(
+        agent._swim_update_tx.get(m.actor_id, 0) < limit for m in members
+    )
+
+
 def piggyback(agent: "Agent", k: int = 5) -> List[foca.FocaMember]:
     """Self entry + up to k freshest (least-transmitted) member
     updates.  Transmission counts persist on the agent and an entry
     decays out of the backlog after the cluster-size-scaled
     retransmission limit — foca's update queue policy (reset to fresh
     whenever the record changes)."""
-    from corrosion_tpu.utils.swimscale import scaled_update_retransmissions
-
     out = [foca.FocaMember(
         actor=self_actor(agent),
         incarnation=agent.incarnation,
         state=foca.STATE_ALIVE,
     )]
     members = agent.members.all()
-    limit = scaled_update_retransmissions(len(members) + 1)
+    limit = _backlog_limit(agent, len(members))
     members.sort(
         key=lambda m: agent._swim_update_tx.get(m.actor_id, 0)
     )
@@ -187,6 +203,26 @@ def ping_req(agent: "Agent", helper, target, nonce: int) -> None:
             peer=_member_actor(agent, target.actor_id, target.addr),
         ),
     )
+
+
+def gossip_round(agent: "Agent", k_targets: int = 3) -> int:
+    """One periodic-gossip round (foca ``Config.periodic_gossip``, on
+    in the WAN preset the reference uses): send a pure update-carrier
+    ``Gossip`` datagram to a few random alive members — dissemination
+    must not ride only on probe/ack piggyback, whose volume shrinks
+    exactly when the cluster is quiet.  Skips the round entirely when
+    the update backlog has fully decayed (nothing fresh to carry).
+    Returns the number of datagrams sent."""
+    if not backlog_has_fresh(agent):
+        return 0
+    alive = agent.members.alive()
+    if not alive:
+        return 0
+    targets = agent._rng.sample(alive, min(k_targets, len(alive)))
+    for m in targets:
+        send(agent, m.addr, _member_actor(agent, m.actor_id, m.addr),
+             foca.FocaMessage(tag=foca.GOSSIP))
+    return len(targets)
 
 
 def leave(agent: "Agent") -> None:

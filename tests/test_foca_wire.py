@@ -371,3 +371,53 @@ def test_turn_undead_renews_identity(run, tmp_path):
             await a.stop()
 
     run(main())
+
+
+def test_periodic_gossip_spreads_without_probing(run, tmp_path):
+    """foca periodic_gossip parity: with probing quiesced, a membership
+    update still disseminates on the dedicated gossip cadence; once the
+    backlog decays, a quiet cluster sends zero gossip datagrams."""
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+
+    async def main():
+        from corrosion_tpu.agent.members import MemberState
+
+        common = dict(probe_interval=3600.0, gossip_interval=0.05)
+        a = await launch_test_agent(tmpdir=str(tmp_path / "a"), **common)
+        b = await launch_test_agent(
+            tmpdir=str(tmp_path / "b"),
+            bootstrap=[f"{a.gossip_addr[0]}:{a.gossip_addr[1]}"],
+            **common,
+        )
+        try:
+            await wait_for(
+                lambda: a.members.alive() and b.members.alive(), timeout=10
+            )
+            # plant a third-party SUSPECT record at a; no probes run, so
+            # only the gossip loop can carry it to b
+            ghost = b"\x99" * 16
+            a.members.upsert(ghost, ("127.0.0.1", 9), MemberState.SUSPECT, 3)
+            a._swim_update_tx[ghost] = 0
+            await wait_for(
+                lambda: (m := b.members.get(ghost)) is not None
+                and m.state is MemberState.SUSPECT
+                and m.incarnation == 3,
+                timeout=10,
+            )
+            # decay: once every entry exhausts its retransmit budget the
+            # loop goes silent (skip rounds entirely)
+            sent_before = a.metrics.get_counter(
+                "corro_gossip_datagrams_sent_total"
+            )
+            await asyncio.sleep(1.0)
+            mid = a.metrics.get_counter("corro_gossip_datagrams_sent_total")
+            await asyncio.sleep(0.5)
+            late = a.metrics.get_counter("corro_gossip_datagrams_sent_total")
+            assert late == mid, "quiet cluster must stop gossiping"
+            assert sent_before > 0
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())
