@@ -42,6 +42,7 @@ Cited reference behavior: fanout sampling and sent_to exclusion
 
 from __future__ import annotations
 
+import asyncio
 import random
 import shutil
 import tempfile
@@ -98,6 +99,43 @@ class DetParams:
     backoff_ticks: float = 2.5
     seed: int = 0
     max_ticks: int = 512
+    # headline-protocol extensions (all off by default so the base
+    # fanout+backoff bit-match keeps its original shape):
+    # per-message delivery drop probability; drawn from the SENDER's
+    # PRNG stream, one uniform per target in sample order — the sender
+    # still records sent_to and counts the message (it cannot know the
+    # frame died downstream), so loss is healed by retransmissions and
+    # anti-entropy, exactly the live semantics
+    loss: float = 0.0
+    # >0 enables ring0-first fanout: peers in the same aligned block of
+    # this width are the <6ms RTT tier (rtt 1ms vs 50ms elsewhere) —
+    # the deterministic form of the sim kernel's contiguous-block ring0
+    ring0_size: int = 0
+    # >0 runs one anti-entropy round (every agent, index order) at the
+    # end of each tick t where t % sync_interval == sync_interval - 1,
+    # matching the JAX kernel's cadence (sim/epidemic.py epidemic_tick)
+    sync_interval: int = 0
+    sync_peers: int = 3
+
+
+RING0_RTT_MS = 1.0
+FAR_RTT_MS = 50.0
+
+
+class _CollectWriter:
+    """Fake StreamWriter: collects the frames _serve_need writes."""
+
+    def __init__(self):
+        self.chunks: List[bytes] = []
+
+    def write(self, b: bytes) -> None:
+        self.chunks.append(b)
+
+    async def drain(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
 
 
 class DetCluster:
@@ -114,8 +152,9 @@ class DetCluster:
                 schema_sql=TEST_SCHEMA,
                 fanout=params.fanout,
                 max_transmissions=params.max_transmissions,
+                sync_peers=params.sync_peers,
                 subs_enabled=False,
-                ring0_enabled=False,
+                ring0_enabled=params.ring0_size > 0,
                 debug_hops=False,
             )
             a = Agent(cfg)
@@ -132,6 +171,16 @@ class DetCluster:
             for b in self.agents:
                 if a is not b:
                     a.members.upsert(b.actor_id, ("det", 0))
+        if params.ring0_size > 0:
+            # deterministic RTT tiers: same aligned block → ring0
+            for i, a in enumerate(self.agents):
+                for j, b in enumerate(self.agents):
+                    if a is b:
+                        continue
+                    same = i // params.ring0_size == j // params.ring0_size
+                    a.members.record_rtt(
+                        b.actor_id, RING0_RTT_MS if same else FAR_RTT_MS
+                    )
         self._index_of: Dict[bytes, int] = {
             a.actor_id: i for i, a in enumerate(self.agents)
         }
@@ -139,6 +188,7 @@ class DetCluster:
             {} for _ in range(params.n_nodes)
         ]
         self.msgs = [0] * params.n_nodes
+        self.sync_msgs = [0] * params.n_nodes
         self.tick_no = 0
 
     # -- workload ------------------------------------------------------
@@ -171,6 +221,7 @@ class DetCluster:
 
     def tick(self) -> int:
         """One protocol round; returns the number of messages sent."""
+        p = self.params
         t = self.tick_no
         self._drain_queues()
         deliveries: List[Tuple[int, bytes]] = []
@@ -180,25 +231,36 @@ class DetCluster:
                 e = entries[key]
                 if e.next_due > t or e.remaining < 1:
                     continue
+                # ring0-first exactly when the live loop does: a LOCAL
+                # payload's first transmission (runtime.py flush():
+                # ring0_enabled and local and not sent_to)
+                local = e.cv.actor_id.bytes == a.actor_id
                 targets = a.members.sample(
-                    self.params.fanout, a._rng,
-                    ring0_first=False, exclude=e.sent_to,
+                    p.fanout, a._rng,
+                    ring0_first=(
+                        p.ring0_size > 0 and local and not e.sent_to
+                    ),
+                    exclude=e.sent_to,
                 )
                 if not targets:
                     # coverage exhausted: every alive peer already got it
                     del entries[key]
                     continue
                 for m in targets:
-                    deliveries.append((self._index_of[m.actor_id], e.frame))
                     e.sent_to.add(m.actor_id)
+                    # one loss draw per target, in sample order, from
+                    # the sender's stream (the shared-stream invariant)
+                    if p.loss > 0.0 and a._rng.random() < p.loss:
+                        continue
+                    deliveries.append((self._index_of[m.actor_id], e.frame))
                 self.msgs[i] += len(targets)
                 e.remaining -= 1
                 if e.remaining < 1:
                     del entries[key]
                 else:
-                    send_count = self.params.max_transmissions - e.remaining
+                    send_count = p.max_transmissions - e.remaining
                     e.next_due = t + det_backoff_gap(
-                        self.params.backoff_ticks, send_count
+                        p.backoff_ticks, send_count
                     )
         # delivery phase: the real wire + ingest path, applied after all
         # sends so same-tick deliveries can't influence same-tick sends
@@ -209,8 +271,78 @@ class DetCluster:
                 cv = a.decode_uni_frame(payload)
                 if cv is not None:
                     a.handle_change(cv, ChangeSource.BROADCAST)
+        # anti-entropy phase on the kernel's cadence
+        # (sim/epidemic.py: tick % sync_interval == sync_interval - 1),
+        # after deliveries so sync sees this tick's learned state
+        if p.sync_interval > 0 and t % p.sync_interval == p.sync_interval - 1:
+            for i in range(p.n_nodes):
+                self._det_sync_round(i, t)
         self.tick_no += 1
         return sent
+
+    # -- deterministic anti-entropy ------------------------------------
+
+    def _det_sync_round(self, i: int, tick: int) -> None:
+        """One client sync round for agent ``i`` — the synchronous form
+        of ``parallel_sync`` (runtime.py:1681): REAL ``generate_sync``
+        states, REAL ``_choose_sync_peers`` (consuming the agent's det
+        PRNG stream), REAL cross-peer ``_allocate_needs``, REAL
+        ``_serve_need`` on the server (down to the speedy frame bytes)
+        and REAL ``handle_change(…, SYNC)`` ingest on the client.  What
+        the scheduler replaces is the socket/timing layer: handshakes
+        are direct state reads, ``last_sync_ts`` advances in ticks, and
+        clients run sequentially in index order (each fully ingesting
+        before the next starts, so one sync tick can chain heals —
+        matching the replay's sequential model).
+
+        Message accounting (``sync_msgs``): 2 handshake frames per side
+        per session (BiPayload+Clock / State+Clock), plus the client's
+        Request frames and the server's served changeset frames — all
+        counted from the real frames where frames exist.
+        """
+        a = self.agents[i]
+        ours = a.generate_sync()
+        chosen = a._choose_sync_peers(ours)
+        if not chosen:
+            return
+        sessions = []
+        for m in chosen:
+            j = self._index_of[m.actor_id]
+            sessions.append({
+                "member": m,
+                "theirs": self.agents[j].generate_sync(),
+                "j": j,
+            })
+            self.sync_msgs[i] += 2  # BiPayload + Clock
+            self.sync_msgs[j] += 2  # State + Clock
+        a._allocate_needs(sessions, ours)
+        for s in sessions:
+            server = self.agents[s["j"]]
+            batches = list(a._request_batches(s["needs"]))
+            served: List = []
+            if batches:
+                w = _CollectWriter()
+                sess = {"chunk": server.SYNC_CHUNK_MAX}
+
+                async def serve_all():
+                    for batch in batches:
+                        for actor, needs in batch:
+                            for need in needs:
+                                await server._serve_need(
+                                    w, actor.bytes, need, sess
+                                )
+
+                # one private event loop per session (not per need)
+                asyncio.run(serve_all())
+                reader = speedy.FrameReader()
+                for payload in reader.feed(b"".join(w.chunks)):
+                    served.append(speedy.decode_sync_message(payload))
+            self.sync_msgs[i] += len(batches)
+            self.sync_msgs[s["j"]] += len(served)
+            for msg in served:
+                if hasattr(msg, "actor_id"):  # ChangeV1
+                    a.handle_change(msg, ChangeSource.SYNC)
+            a.members.update_sync_ts(s["member"].actor_id, float(tick))
 
     def quiescent(self) -> bool:
         return all(not e for e in self._entries) and all(
@@ -254,6 +386,7 @@ def run_det_epidemic(
         (write_id, f"det-{write_id}"),
     )
     base_msgs = list(cluster.msgs)
+    base_sync = list(cluster.sync_msgs)
     trace = []
     converged_tick = None
     for _ in range(p.max_ticks):
@@ -262,10 +395,18 @@ def run_det_epidemic(
         trace.append({
             "infected": infected,
             "msgs": [m - b for m, b in zip(cluster.msgs, base_msgs)],
+            "sync_msgs": [
+                m - b for m, b in zip(cluster.sync_msgs, base_sync)
+            ],
         })
         if converged_tick is None and len(infected) == p.n_nodes:
             converged_tick = len(trace) - 1  # relative to epidemic start
-        if cluster.quiescent():
+        # with anti-entropy on, quiescence of the broadcast layer can
+        # precede convergence (loss-orphaned nodes heal at the next
+        # sync tick) — run until BOTH
+        if cluster.quiescent() and (
+            p.sync_interval <= 0 or converged_tick is not None
+        ):
             break
     return {
         "origin": origin,

@@ -184,19 +184,33 @@ def _is_write(sql: str) -> bool:
         return True
     if first != "WITH":
         return False
-    # CTE-led DML (WITH ... INSERT/UPDATE/DELETE) is a write: find a
-    # top-level write word outside parens/literals (token-aware)
+    # CTE-led DML (WITH ... INSERT/UPDATE/DELETE) is a write: the DML
+    # head follows the ')' closing the last CTE body at depth 0.  Only
+    # INSERT/UPDATE/DELETE can head a statement after a CTE list, and
+    # all three are reserved words in PG (unusable as bare aliases) —
+    # so a write word in any other position (the function call in
+    # WITH x AS (SELECT 1) SELECT replace(a, '1', '2'), or the alias
+    # in SELECT (a+b) replace) is never one.  The call-opening check
+    # guards the residual insert(...) extension-function shape.
     from corrosion_tpu.agent.pgsql import tokenize
 
     try:
         depth = 0
-        for k, txt in tokenize(sql):
+        prev = None  # previous significant token text
+        toks = [t for t in tokenize(sql) if t[0] not in ("ws", "comment")]
+        for j, (k, txt) in enumerate(toks):
             if k == "op" and txt == "(":
                 depth += 1
             elif k == "op" and txt == ")":
                 depth -= 1
-            elif k == "word" and depth == 0 and txt.upper() in _WRITE_WORDS:
+            elif (
+                k == "word" and depth == 0
+                and txt.upper() in ("INSERT", "UPDATE", "DELETE")
+                and prev == ")"
+                and not (j + 1 < len(toks) and toks[j + 1][1] == "(")
+            ):
                 return True
+            prev = txt
     except Exception:
         pass
     return False

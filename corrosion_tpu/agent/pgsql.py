@@ -244,17 +244,51 @@ def translate_query(sql: str) -> Tuple[str, List[int]]:
             order.append(int(text[1:]))
             out.append("?")
         elif kind == "op" and text == "::":
-            # drop the cast: the type word (+ optional []) goes too
+            # drop the cast — and the FULL PG type name: a word or
+            # "qident" head, an optional second word (double PRECISION,
+            # character VARYING), an optional (precision[,scale]) group,
+            # an optional WITH/WITHOUT TIME ZONE tail, an optional []
             j = next_code(i)
-            if j >= 0 and tokens[j][0] == "word":
-                k = next_code(j)
+            if j >= 0 and tokens[j][0] in ("word", "qident"):
+                end = j
+                k = next_code(end)
+                # schema-qualified type names (pg_catalog.int4): hop
+                # each .qualifier before the shape suffixes
+                while (
+                    k >= 0 and tokens[k][1] == "."
+                    and (m := next_code(k)) >= 0
+                    and tokens[m][0] in ("word", "qident")
+                ):
+                    end, k = m, next_code(m)
+                if (
+                    k >= 0 and tokens[k][0] == "word"
+                    and tokens[k][1].lower() in ("precision", "varying")
+                ):
+                    end, k = k, next_code(k)
+                if k >= 0 and tokens[k][1] == "(":
+                    depth, m = 1, k
+                    while depth and (m := next_code(m)) >= 0:
+                        if tokens[m][1] == "(":
+                            depth += 1
+                        elif tokens[m][1] == ")":
+                            depth -= 1
+                    if depth == 0:
+                        end, k = m, next_code(m)
+                if (
+                    k >= 0 and tokens[k][0] == "word"
+                    and tokens[k][1].lower() in ("with", "without")
+                    and (m := next_code(k)) >= 0
+                    and tokens[m][1].lower() == "time"
+                    and (m2 := next_code(m)) >= 0
+                    and tokens[m2][1].lower() == "zone"
+                ):
+                    end, k = m2, next_code(m2)
                 if (
                     k >= 0 and tokens[k][1] == "["
                     and (m := next_code(k)) >= 0 and tokens[m][1] == "]"
                 ):
-                    i = m + 1
-                    continue
-                i = j + 1
+                    end = m
+                i = end + 1
                 continue
             out.append(text)
         elif kind == "estr":

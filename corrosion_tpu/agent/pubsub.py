@@ -750,8 +750,10 @@ class SubsManager:
         for t, _a in items:
             pk_idx[t] = list(range(pos, pos + len(infos[t])))
             pos += len(infos[t])
-        # every table's delta filter must reach ITS index (plans name
-        # the alias when one is used)
+        # every delta plan must reach EVERY from-item's index: a sibling
+        # with no index on its join column would SCAN once per changed
+        # row, costing O(sibling) per delta — worse than the full
+        # refresh this path replaces (plans name the alias when used)
         for t, a in items:
             idx = pk_idx[t]
             cols_sql = ", ".join(
@@ -776,10 +778,10 @@ class SubsManager:
                     rf"{op} {re.escape(name)}\b", plan_text
                 ) is not None
 
-            searched = in_plan("SEARCH", a) or in_plan("SEARCH", t)
-            scanned = in_plan("SCAN", a) if a != t else in_plan("SCAN", t)
-            if not searched or scanned:
-                return
+            for t2, a2 in items:
+                searched = in_plan("SEARCH", a2) or in_plan("SEARCH", t2)
+                if not searched or in_plan("SCAN", a2):
+                    return
         handle.exec_sql = exec_sql
         handle.n_hidden = n_hidden
         handle.pk_items = items

@@ -79,3 +79,91 @@ def test_det_sim_trace_differs_across_seeds():
     a = det_sim_epidemic(DetParams(n_nodes=16, seed=0), origin=0)
     b = det_sim_epidemic(DetParams(n_nodes=16, seed=7), origin=0)
     assert a["ticks"] != b["ticks"]
+
+
+# -- the HEADLINE protocol shape: ring0 + loss + anti-entropy sync -----
+
+
+def test_bitmatch_headline_protocol(tmp_path):
+    """The north-star clause: the exactness proof covers the SAME
+    protocol the benchmark runs — ring0-first fanout, 5% per-message
+    loss, anti-entropy sync every 8 ticks — not a simplified one.
+    Infected sets, per-node broadcast msgs AND per-node sync msgs must
+    be equal tick for tick, across two writes with carried-over PRNG,
+    last-sync and tick-offset state."""
+    r = run_bitmatch(
+        32, writes=2, seed=0, loss=0.05, ring0_size=8, sync_interval=8,
+        base_dir=str(tmp_path),
+    )
+    assert r["bitmatch"], r
+    for w in r["per_write"]:
+        assert w["converged_tick_sim"] == w["converged_tick_agents"]
+        assert w["first_mismatch_tick"] is None
+    # sync traffic actually flowed (handshakes at minimum)
+    assert all(w["sync_msgs_total"] > 0 for w in r["per_write"])
+
+
+def test_bitmatch_headline_loss_actually_drops(tmp_path):
+    """With heavy loss and NO sync, coverage at quiescence falls short
+    of N on some seeds — proving the loss mask is live on the agent
+    side (not silently ignored) while both sides still bit-match."""
+    short = dict(writes=1, fanout=2, max_transmissions=2)
+    orphaned = False
+    for seed in range(4):
+        (tmp_path / f"s{seed}").mkdir()
+        r = run_bitmatch(
+            24, seed=seed, loss=0.6, base_dir=str(tmp_path / f"s{seed}"),
+            **short,
+        )
+        assert r["bitmatch"], (seed, r)
+        if r["per_write"][0]["converged_tick_agents"] is None:
+            orphaned = True
+    assert orphaned, "60% loss never orphaned a node — loss mask dead?"
+
+
+def test_bitmatch_sync_heals_loss_orphans(tmp_path):
+    """Same heavy-loss shape WITH sync: every epidemic now converges
+    (anti-entropy heals what loss dropped), and the traces still match
+    exactly — pinning the det sync round against the sim's replay."""
+    for seed in range(2):
+        (tmp_path / f"s{seed}").mkdir()
+        r = run_bitmatch(
+            24, writes=1, seed=seed, loss=0.6, fanout=2,
+            max_transmissions=2, sync_interval=4,
+            base_dir=str(tmp_path / f"s{seed}"),
+        )
+        assert r["bitmatch"], (seed, r)
+        assert r["per_write"][0]["converged_tick_agents"] is not None
+
+
+def test_bitmatch_detects_loss_skew(tmp_path):
+    """Negative control for the headline shape: a loss-rate difference
+    desynchronizes the delivery schedule and must surface as a
+    mismatch."""
+    params = DetParams(n_nodes=24, seed=2, loss=0.05, ring0_size=8,
+                       sync_interval=8)
+    cluster = DetCluster(params, base_dir=str(tmp_path))
+    try:
+        agents_trace = run_det_epidemic(cluster, origin=0, write_id=0)
+    finally:
+        cluster.close()
+    skewed = DetParams(n_nodes=24, seed=2, loss=0.25, ring0_size=8,
+                       sync_interval=8)
+    sim_trace = det_sim_epidemic(skewed, origin=0)
+    d = diff_det_traces(sim_trace, agents_trace)
+    assert not d["match"]
+
+
+def test_bitmatch_detects_sync_skew(tmp_path):
+    """Negative control: replaying with a different sync cadence must
+    mismatch (sync msgs diverge at the first differing sync tick)."""
+    params = DetParams(n_nodes=24, seed=0, loss=0.3, sync_interval=4)
+    cluster = DetCluster(params, base_dir=str(tmp_path))
+    try:
+        agents_trace = run_det_epidemic(cluster, origin=0, write_id=0)
+    finally:
+        cluster.close()
+    skewed = DetParams(n_nodes=24, seed=0, loss=0.3, sync_interval=6)
+    sim_trace = det_sim_epidemic(skewed, origin=0)
+    d = diff_det_traces(sim_trace, agents_trace)
+    assert not d["match"]

@@ -535,6 +535,39 @@ def test_pg_tokenizer_translation():
         "SELECT 1; -- trailing; comment\nSELECT 2"
     )[1].strip().startswith("SELECT 2")
     assert t("SELECT /* c1 ; */ 1").split() == ["SELECT", "1"]
+    # multi-word / parenthesized / quoted type names vanish whole
+    assert t("SELECT x::double precision FROM t") == "SELECT x FROM t"
+    assert t("SELECT x::numeric(10,2) FROM t") == "SELECT x FROM t"
+    assert t("SELECT x::character varying(20) FROM t") == "SELECT x FROM t"
+    assert t("SELECT x::timestamp with time zone FROM t") == (
+        "SELECT x FROM t")
+    assert t("SELECT x::time(3) without time zone FROM t") == (
+        "SELECT x FROM t")
+    assert t('SELECT x::"SomeType" FROM t') == "SELECT x FROM t"
+    # schema-qualified type names vanish whole too (pg_dump/ORM shape)
+    assert t("SELECT x::pg_catalog.int4 FROM t") == "SELECT x FROM t"
+    assert t('SELECT x::myschema."MyType"[] FROM t') == "SELECT x FROM t"
+    # ...but bare words that merely FOLLOW a cast survive
+    assert t("SELECT x::int zone FROM t") == "SELECT x zone FROM t"
+
+
+def test_pg_is_write_classification():
+    """WITH-led statements: DML heads after the last CTE body are
+    writes; a write-word used as a function call is not (the round-4
+    advisor's replace() case)."""
+    from corrosion_tpu.agent.pg import _is_write
+
+    assert _is_write("INSERT INTO t VALUES (1)")
+    assert _is_write("WITH x AS (SELECT 1) INSERT INTO t SELECT * FROM x")
+    assert _is_write("WITH x AS (SELECT 1), y AS (SELECT 2) DELETE FROM t")
+    assert _is_write("with q as (select 1) update t set a = 1")
+    assert not _is_write("SELECT 1")
+    assert not _is_write("WITH x AS (SELECT 1) SELECT * FROM x")
+    assert not _is_write(
+        "WITH x AS (SELECT 1) SELECT replace(a, '1', '2') FROM t"
+    )
+    # REPLACE as a bare column alias (not reserved in PG) is not DML
+    assert not _is_write("WITH x AS (SELECT 1) SELECT (a + b) replace FROM t")
 
 
 

@@ -478,6 +478,13 @@ def test_incremental_eligibility(run):
             assert not sub(
                 "SELECT a.id FROM tests a JOIN tests b ON a.id = b.id"
             ).incremental
+            # join on an UNINDEXED column: the sibling table's side of
+            # the delta plan is a SCAN, so each changed row would cost
+            # O(sibling) — must fall back to full refresh
+            assert not sub(
+                "SELECT tests.id FROM tests "
+                "JOIN tests2 ON tests.text = tests2.text"
+            ).incremental
             # comma join against a NON-replicated local table: several
             # result rows per pk in unguaranteed order — must not
             # qualify even though only one *replicated* table is read
