@@ -73,11 +73,12 @@ _GLOBAL_WORDS = frozenset(
     )
 )
 
-# outer-join words disqualify the delta path outright: an outer join
-# can TRANSITION a result row to its NULL-extended form when the inner
-# side's match disappears, and a pk-IN scope on the inner table cannot
-# see that new row (its pk columns are NULL there)
-_OUTER_WORDS = frozenset(("LEFT", "RIGHT", "FULL", "OUTER"))
+# RIGHT/FULL joins disqualify the delta path outright: they break the
+# anchor property (the FIRST from-item's rows can then be NULL-extended,
+# so no non-NULL pk tuple identifies every result row).  LEFT joins are
+# handled: a change on a NULLABLE (left-joined) alias re-scopes through
+# the anchor (see SubscriptionHandle._delta_nullable).
+_OUTER_DISQUALIFY = frozenset(("RIGHT", "FULL"))
 _ITEM_STOP_WORDS = frozenset(("ON", "WHERE", "ORDER", "AND", "OR"))
 
 
@@ -118,58 +119,142 @@ def _top_level_word(sql: str, word: str, start: int = 0) -> int:
     return -1
 
 
-def from_items(nsql: str) -> Optional[List[Tuple[str, str]]]:
-    """Top-level from-items of a normalized single SELECT as
-    ``(table, alias)`` pairs, or None when the shape is out of scope
-    (subquery in FROM, USING joins, quoted exotica).  The textual
-    counterpart of the reference's table extraction
-    (``pubsub.rs:1813-2107``)."""
+def _mask_strings(s: str) -> str:
+    """Copy of ``s`` with string-literal/quoted content blanked, so
+    word regexes cannot match inside literals."""
+    out = [" "] * len(s)
+    for i, ch, _depth in _scan_top_level(s):
+        out[i] = ch
+    return "".join(out)
+
+
+_CONN_RE = re.compile(
+    r",|\bLEFT\s+OUTER\s+JOIN\b|\bLEFT\s+JOIN\b"
+    r"|\b(?:INNER\s+|CROSS\s+)?JOIN\b",
+    re.IGNORECASE,
+)
+
+
+def from_items_ex(nsql: str):
+    """Top-level from-items of a normalized single SELECT.
+
+    Returns ``(items, conn_spans)`` where ``items`` is a list of
+    ``(table, alias, nullable)`` triples — ``nullable`` marks an item
+    introduced by a LEFT [OUTER] JOIN, whose columns can be
+    NULL-extended in the result — and ``conn_spans[j]`` is the absolute
+    (start, end) span in ``nsql`` of the connector introducing item j
+    (None for the anchor), used to build the inner-join scope variant
+    for nullable deltas.  ``(None, None)`` when the shape is out of
+    scope (subquery in FROM, USING/NATURAL/RIGHT/FULL joins, quoted
+    exotica).  The textual counterpart of the reference's table
+    extraction (``pubsub.rs:1813-2107``)."""
     fi = _top_level_word(nsql, "FROM")
     if fi < 0:
-        return None
+        return None, None
     end = len(nsql)
     for stop in ("WHERE", "ORDER", "GROUP", "LIMIT", "HAVING", "WINDOW"):
         si = _top_level_word(nsql, stop, fi + 4)
         if 0 <= si < end:
             end = si
-    clause = nsql[fi + 4:end].strip()
+    cstart = fi + 4
+    clause = nsql[cstart:end]
     if "(" in clause:
-        return None  # subquery or function in FROM
-    if any(w in _OUTER_WORDS
-           for w in re.findall(r"[A-Za-z_]+", clause.upper())):
-        return None  # outer joins: see _OUTER_WORDS
-    # split items on top-level commas and inner-JOIN connectors
-    parts = re.split(
-        r"(?:,|\b(?:INNER|CROSS)?\s*\bJOIN\b)",
-        clause, flags=re.IGNORECASE,
-    )
-    items: List[Tuple[str, str]] = []
-    for part in parts:
-        # keep only the item itself (strip any ON condition)
-        m = re.match(r"\s*(.*?)\s*(?:\bON\b.*)?$", part,
-                     flags=re.IGNORECASE | re.DOTALL)
-        piece = m.group(1) if m else part.strip()
+        return None, None  # subquery or function in FROM
+    masked = _mask_strings(clause)
+    if any(w in _OUTER_DISQUALIFY
+           for w in re.findall(r"[A-Za-z_]+", masked.upper())):
+        return None, None  # RIGHT/FULL: anchor property broken
+    conns = list(_CONN_RE.finditer(masked))
+    # item segments live between consecutive connectors
+    bounds = []
+    prev = 0
+    for m in conns:
+        bounds.append((prev, m.start()))
+        prev = m.end()
+    bounds.append((prev, len(clause)))
+    items: List[Tuple[str, str, bool]] = []
+    conn_spans: List[Optional[Tuple[int, int]]] = []
+    for j, (s, e) in enumerate(bounds):
+        seg = clause[s:e]
+        seg_masked = masked[s:e]
+        # keep only the item itself (strip any ON condition; located on
+        # the masked copy so an 'ON' inside a literal cannot match)
+        mo = re.search(r"\bON\b", seg_masked, flags=re.IGNORECASE)
+        piece = (seg[: mo.start()] if mo else seg).strip()
         if not piece:
+            if j == 0:
+                return None, None  # leading connector
             continue
         toks = piece.replace('"', "").split()
         if not toks:
-            return None
+            return None, None
         table = toks[0]
         alias = table
         rest = [t for t in toks[1:] if t.upper() != "AS"]
         if rest:
             if len(rest) > 1 or rest[0].upper() in _ITEM_STOP_WORDS:
-                return None
+                return None, None
             alias = rest[0]
         if not re.fullmatch(r"\w+", table) or not re.fullmatch(
             r"\w+", alias
         ):
+            return None, None
+        conn = conns[j - 1] if j > 0 else None
+        nullable = bool(
+            conn and conn.group(0).upper().startswith("LEFT")
+        )
+        items.append((table, alias, nullable))
+        conn_spans.append(
+            (cstart + conn.start(), cstart + conn.end()) if conn else None
+        )
+    if not items:
+        return None, None
+    return items, conn_spans
+
+
+def from_items(nsql: str) -> Optional[List[Tuple[str, str, bool]]]:
+    """`from_items_ex` without the connector spans."""
+    items, _spans = from_items_ex(nsql)
+    return items
+
+
+def group_by_exprs(nsql: str) -> Optional[List[str]]:
+    """The GROUP BY expressions of a normalized single SELECT, when
+    every one is a bare column or alias.column reference (the shapes
+    the scoped re-aggregation can key on); None otherwise or when there
+    is no GROUP BY."""
+    gi = _top_level_word(nsql, "GROUP")
+    if gi < 0:
+        return None
+    m = re.match(r"GROUP\s+BY\b", nsql[gi:], flags=re.IGNORECASE)
+    if not m:
+        return None
+    start = gi + m.end()
+    end = len(nsql)
+    for stop in ("HAVING", "ORDER", "LIMIT", "WINDOW"):
+        si = _top_level_word(nsql, stop, start)
+        if 0 <= si < end:
+            end = si
+    exprs = [e.strip() for e in nsql[start:end].split(",")]
+    for e in exprs:
+        if not re.fullmatch(r"\w+(\.\w+)?", e):
             return None
-        items.append((table, alias))
-    return items or None
+    return exprs or None
 
 
-def splice_pk_cols(nsql: str, items: List[Tuple[str, str]],
+def from_clause_text(nsql: str) -> str:
+    """The text of the top-level FROM clause (between FROM and the
+    first top-level stop word)."""
+    fi = _top_level_word(nsql, "FROM")
+    end = len(nsql)
+    for stop in ("WHERE", "ORDER", "GROUP", "LIMIT", "HAVING", "WINDOW"):
+        si = _top_level_word(nsql, stop, fi + 4)
+        if 0 <= si < end:
+            end = si
+    return nsql[fi + 4:end].strip()
+
+
+def splice_pk_cols(nsql: str, items: List[Tuple[str, str, bool]],
                    pk_cols: Dict[str, List[str]]) -> Tuple[str, int]:
     """Rewrite the SELECT to append every from-item's pk columns as
     hidden ``__corro_pk_<alias>_<i>`` aliases (the reference's
@@ -177,7 +262,7 @@ def splice_pk_cols(nsql: str, items: List[Tuple[str, str]],
     (rewritten sql, number of hidden columns)."""
     fi = _top_level_word(nsql, "FROM")
     extras = []
-    for table, alias in items:
+    for table, alias, _nullable in items:
         for i, col in enumerate(pk_cols[table]):
             extras.append(
                 f'"{alias}"."{col}" AS __corro_pk_{alias}_{i}'
@@ -254,14 +339,41 @@ class SubscriptionHandle:
         # pk-scoped incremental evaluation (set by the manager when the
         # query qualifies): the rewritten query with hidden
         # __corro_pk_* columns, the from-items in projection order, the
-        # hidden-column index ranges per table, and the identity index
-        # (table, pk-hex) -> [identities]
+        # hidden-column index ranges per ALIAS (a self-join has one
+        # scope per occurrence), and the identity index
+        # (alias, pk-hex) -> [identities]
         self.exec_sql: Optional[str] = None
         self.n_hidden = 0
-        self.pk_items: Optional[List[Tuple[str, str]]] = None
-        self.pk_idx: Dict[str, List[int]] = {}  # table -> exec col idx
+        self.pk_items: Optional[List[Tuple[str, str, bool]]] = None
+        self.pk_idx: Dict[str, List[int]] = {}  # alias -> exec col idx
         self.by_pk: Dict[Tuple[str, str], List[str]] = {}
         self.pk_of: Dict[str, Dict[str, str]] = {}  # identity -> hexes
+        # nullable alias -> (harvest select, scope-cols sql): the
+        # affected-anchor harvest for LEFT-joined tables.  sqlite
+        # cannot push a pk-IN predicate through a LEFT JOIN's nullable
+        # side, and the user WHERE can hide a transition, so the
+        # harvest selects the ANCHOR's pk columns over the from-clause
+        # with that one connector flipped LEFT JOIN -> JOIN and NO user
+        # WHERE — a superset of the affected anchors
+        self.harvest_sql: Dict[str, Tuple[str, str]] = {}
+        # aliases whose scoped delta cannot reach an index: a change on
+        # their table falls back to one full refresh for the round
+        self.full_refresh_aliases: Set[str] = set()
+        # single-table GROUP BY aggregate mode: the group-key tuple is
+        # the row identity; a delta probes the changed pks' CURRENT
+        # groups (no user WHERE — it can hide a membership change),
+        # unions them with the pks' previously-recorded groups (the
+        # pk_groups side table), and re-aggregates only those groups
+        # (the reference's scoped re-aggregation, pubsub.rs:1432-1707)
+        self.agg = False
+        self.agg_probe_sql: Optional[str] = None
+        self.agg_pk_cols_sql = ""
+        self.agg_n_grp = 0
+        # (prefix, suffix, per-group conjunction): the scoped re-agg
+        # splices its group predicate INTO the query's own WHERE ahead
+        # of GROUP BY — sqlite does not push outer predicates into an
+        # aggregate subquery, so wrapping would re-scan the table
+        self.agg_scope_parts: Optional[Tuple[str, str, str]] = None
         self._db = sqlite3.connect(db_path, check_same_thread=False)
         self._db.executescript(
             """
@@ -271,6 +383,8 @@ CREATE TABLE IF NOT EXISTS rows (
 CREATE TABLE IF NOT EXISTS changes (
   change_id INTEGER PRIMARY KEY, kind TEXT NOT NULL,
   row_id INTEGER NOT NULL, cells TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS pk_groups (
+  pk TEXT PRIMARY KEY, grp TEXT NOT NULL);
 """
         )
         have = {r[1] for r in self._db.execute("PRAGMA table_info(rows)")}
@@ -315,8 +429,8 @@ CREATE TABLE IF NOT EXISTS changes (
             if pk is not None and self.incremental:
                 if pk.startswith("{"):
                     hexes = json.loads(pk)
-                else:  # legacy single-table plain hex
-                    hexes = {self.pk_items[0][0]: pk}
+                else:  # legacy single-table plain hex (alias == table)
+                    hexes = {self.pk_items[0][1]: pk}
                 self.pk_of[identity] = hexes
                 for t, h in hexes.items():
                     self.by_pk.setdefault((t, h), []).append(identity)
@@ -327,7 +441,8 @@ CREATE TABLE IF NOT EXISTS changes (
             hexes = (pks or {}).get(i)
             if not hexes:
                 return None
-            if len(hexes) == 1:
+            first = self.pk_items[0][1] if self.pk_items else None
+            if len(hexes) == 1 and next(iter(hexes)) == first:
                 return next(iter(hexes.values()))  # legacy plain hex
             return json.dumps(hexes, sort_keys=True)
 
@@ -369,12 +484,13 @@ CREATE TABLE IF NOT EXISTS changes (
         return f"{h}:{occurrence}"
 
     def _pk_keyed(self, rows):
-        """identity -> user cells and identity -> {table: pk-hex} for an
+        """identity -> user cells and identity -> {alias: pk-hex} for an
         exec-query result set: identities key on the joined tuple of
         every from-item's hidden pk columns (stable across evaluations
         — true update events; the single-table identity is the plain
         ``hex:occ`` the old format used, so persisted state carries
-        over)."""
+        over).  A NULL-extended left-join side packs its NULL pk values
+        like any others — the anchor side keeps the identity unique."""
         new_ids: Dict[str, list] = {}
         pks_of: Dict[str, Dict[str, str]] = {}
         counts: Dict[str, int] = {}
@@ -384,16 +500,52 @@ CREATE TABLE IF NOT EXISTS changes (
                 n_user = len(r) - self.n_hidden
             cells = jsonable_row(r[:n_user])
             hexes = {
-                t: pack_values([r[i] for i in self.pk_idx[t]]).hex()
-                for t, _a in self.pk_items
+                a: pack_values([r[i] for i in self.pk_idx[a]]).hex()
+                for _t, a, _n in self.pk_items
             }
-            joined = "|".join(hexes[t] for t, _a in self.pk_items)
+            joined = "|".join(hexes[a] for _t, a, _n in self.pk_items)
             occ = counts.get(joined, 0)
             counts[joined] = occ + 1
             identity = f"{joined}:{occ}"
             new_ids[identity] = cells
             pks_of[identity] = hexes
         return new_ids, pks_of
+
+    def _grp_keyed(self, rows):
+        """identity -> user cells and identity -> pseudo-alias hexes
+        for an aggregate exec result: one row per group, identity keyed
+        on the packed group-key tuple (stable — count changes arrive as
+        in-place updates)."""
+        new_ids: Dict[str, list] = {}
+        pks_of: Dict[str, Dict[str, str]] = {}
+        n_user = None
+        for r in rows:
+            if n_user is None:
+                n_user = len(r) - self.agg_n_grp
+            cells = jsonable_row(r[:n_user])
+            h = pack_values(list(r[n_user:])).hex()
+            identity = f"{h}:0"
+            new_ids[identity] = cells
+            pks_of[identity] = {"__corro_grp": h}
+        return new_ids, pks_of
+
+    def _rebuild_pk_groups(self) -> None:
+        """Recompute the pk -> group side map wholesale (boot/refresh:
+        rows may have moved groups while the map wasn't maintained).
+        Caller holds ``self._lock``; caller commits."""
+        _, rows = self.manager.agent.storage.read_query(self.agg_probe_sql)
+        n = self.agg_n_grp
+        self._db.execute("DELETE FROM pk_groups")
+        self._db.executemany(
+            "INSERT OR REPLACE INTO pk_groups VALUES (?, ?)",
+            [
+                (
+                    pack_values(list(r[n:])).hex(),
+                    pack_values(list(r[:n])).hex(),
+                )
+                for r in rows
+            ],
+        )
 
     def _apply_diff(self, new_ids, pks_of, scope_old, initial,
                     cand_keys=None) -> None:
@@ -459,6 +611,17 @@ CREATE TABLE IF NOT EXISTS changes (
 
     def refresh(self, initial: bool = False) -> None:
         """Re-evaluate the whole query and emit diff events."""
+        if self.incremental and self.agg:
+            cols, rows = self.manager.agent.storage.read_query(
+                self.exec_sql
+            )
+            with self._lock:
+                self.columns = cols[: len(cols) - self.agg_n_grp]
+                new_ids, pks_of = self._grp_keyed(rows)
+                self._apply_diff(new_ids, pks_of, dict(self.rows), initial)
+                self._rebuild_pk_groups()
+                self._db.commit()
+            return
         if self.incremental:
             cols, rows = self.manager.agent.storage.read_query(
                 self.exec_sql
@@ -485,43 +648,193 @@ CREATE TABLE IF NOT EXISTS changes (
         """Pk-scoped incremental evaluation (the candidate path,
         ``pubsub.rs:1432-1707``): work proportional to the candidate
         rows, not the table.  Each changed table scopes its own
-        evaluation through its hidden pk columns — the join analogue of
-        the reference's per-table temp-pk-table re-evaluation."""
+        evaluation through its hidden pk columns, ONCE PER OCCURRENCE —
+        a self-join re-evaluates each aliased occurrence separately —
+        the join analogue of the reference's per-table temp-pk-table
+        re-evaluation.  A change on a NULLABLE (left-joined) alias
+        re-scopes through the anchor instead (``_delta_nullable``)."""
+        if self.agg:
+            pks = table_pks.get(self.pk_items[0][0])
+            if pks:
+                self._delta_agg(pks)
+            return
+        work = []
+        anchor_alias = self.pk_items[0][1] if self.pk_items else None
         for table, pks in table_pks.items():
-            if not pks or table not in self.pk_idx:
+            if not pks:
                 continue
-            idx = self.pk_idx[table]
-            cols_sql = ", ".join(
-                f"__corro_pk_{self._alias_of(table)}_{i}"
-                for i in range(len(idx))
-            )
-            row_ph = "(" + ", ".join("?" for _ in idx) + ")"
-            values = ", ".join(row_ph for _ in pks)
-            sql = (
-                f"SELECT * FROM ({self.exec_sql}) "
-                f"WHERE ({cols_sql}) IN (VALUES {values})"
-            )
-            params = [v for pk in pks for v in unpack_values(pk)]
-            _, rows = self.manager.agent.storage.read_query(sql, params)
-            cand_keys = {(table, pk.hex()) for pk in pks}
-            with self._lock:
-                new_ids, pks_of = self._pk_keyed(rows)
-                scope_old = {
-                    i: self.rows[i]
-                    for k in cand_keys
-                    for i in self.by_pk.get(k, [])
-                    if i in self.rows
-                }
-                self._apply_diff(
-                    new_ids, pks_of, scope_old, initial=False,
-                    cand_keys=cand_keys,
-                )
+            for _t, alias, nullable in self.pk_items or ():
+                if _t != table:
+                    continue
+                if alias in self.full_refresh_aliases or (
+                    # a nullable delta re-scopes THROUGH the anchor, so
+                    # a degraded anchor degrades it too
+                    nullable and anchor_alias in self.full_refresh_aliases
+                ):
+                    # the scoped plan cannot reach an index: one full
+                    # refresh covers the whole round
+                    self.refresh()
+                    return
+                work.append((alias, nullable, pks))
+        for alias, nullable, pks in work:
+            if nullable:
+                self._delta_nullable(alias, pks)
+            else:
+                self._delta_scoped(alias, pks)
 
-    def _alias_of(self, table: str) -> str:
-        for t, a in self.pk_items or ():
-            if t == table:
-                return a
-        raise KeyError(table)
+    def _scope_rows(self, alias: str, pk_values: List[tuple]):
+        """Evaluate the exec query scoped to ``alias``'s pk tuples."""
+        idx = self.pk_idx[alias]
+        cols_sql = ", ".join(
+            f"__corro_pk_{alias}_{i}" for i in range(len(idx))
+        )
+        row_ph = "(" + ", ".join("?" for _ in idx) + ")"
+        values = ", ".join(row_ph for _ in pk_values)
+        sql = (
+            f"SELECT * FROM ({self.exec_sql}) "
+            f"WHERE ({cols_sql}) IN (VALUES {values})"
+        )
+        params = [v for vals in pk_values for v in vals]
+        _, rows = self.manager.agent.storage.read_query(sql, params)
+        return rows
+
+    def _delta_scoped(self, alias: str, pks: Set[bytes]) -> None:
+        """One alias's direct pk-scoped delta round."""
+        rows = self._scope_rows(alias, [tuple(unpack_values(p)) for p in pks])
+        cand_keys = {(alias, pk.hex()) for pk in pks}
+        with self._lock:
+            new_ids, pks_of = self._pk_keyed(rows)
+            scope_old = {
+                i: self.rows[i]
+                for k in cand_keys
+                for i in self.by_pk.get(k, [])
+                if i in self.rows
+            }
+            self._apply_diff(
+                new_ids, pks_of, scope_old, initial=False,
+                cand_keys=cand_keys,
+            )
+
+    def _delta_nullable(self, alias: str, pks: Set[bytes]) -> None:
+        """Delta for a change on a LEFT-joined (nullable) alias.
+
+        A pk-IN scope on the nullable side cannot see NULL-extension
+        transitions: deleting the matched inner row must RE-EMIT the
+        outer row NULL-extended, and inserting a first match must
+        RETRACT it — both outside the changed pks' scope (their hidden
+        pk columns are NULL there).  So the delta runs in two stages
+        (the reference re-scopes through its per-table temp pk tables,
+        ``pubsub.rs:602-737``):
+
+        1. harvest the ANCHOR pks affected by the change — from the
+           currently-JOINING rows (the harvest query: anchor pks over
+           the from-clause with this alias's connector flipped to an
+           inner join and NO user WHERE, since the WHERE can hide a
+           transition) plus the previously-materialized rows that
+           referenced the changed pks (``by_pk``);
+        2. run a normal anchor-scoped delta for those anchor pks, which
+           recomputes the affected outer rows in full — matched,
+           filtered away, or NULL-extended.
+        """
+        anchor = self.pk_items[0][1]
+        anchor_vals: Dict[tuple, None] = {}  # ordered de-dup
+        harvest, scope_cols = self.harvest_sql[alias]
+        pk_values = [tuple(unpack_values(p)) for p in pks]
+        row_ph = "(" + ", ".join("?" for _ in pk_values[0]) + ")"
+        values = ", ".join(row_ph for _ in pk_values)
+        sql = f"{harvest} WHERE ({scope_cols}) IN (VALUES {values})"
+        params = [v for vals in pk_values for v in vals]
+        _, rows = self.manager.agent.storage.read_query(sql, params)
+        for r in rows:
+            anchor_vals[tuple(r)] = None
+        with self._lock:
+            for pk in pks:
+                for i in self.by_pk.get((alias, pk.hex()), ()):
+                    h = self.pk_of.get(i, {}).get(anchor)
+                    if h is not None:
+                        anchor_vals[tuple(unpack_values(bytes.fromhex(h)))] \
+                            = None
+        if not anchor_vals:
+            return
+        if len(anchor_vals) > DELTA_MAX_PKS:
+            self.refresh()
+            return
+        self._delta_scoped(
+            anchor, {pack_values(list(v)) for v in anchor_vals}
+        )
+
+    def _delta_agg(self, pks: Set[bytes]) -> None:
+        """Scoped re-aggregation for a change batch on the aggregate's
+        table.
+
+        Affected groups = the changed rows' CURRENT groups (probed
+        without the user WHERE, which can hide a membership change)
+        UNION the groups those pks were last seen in (``pk_groups``) —
+        a row that moved groups dirties both.  Only those groups are
+        re-aggregated; a group whose last row left (or that fails
+        HAVING) disappears from the scoped result and is emitted as a
+        delete."""
+        storage = self.manager.agent.storage
+        pk_values = [tuple(unpack_values(p)) for p in pks]
+        row_ph = "(" + ", ".join("?" for _ in pk_values[0]) + ")"
+        values = ", ".join(row_ph for _ in pk_values)
+        _, rows = storage.read_query(
+            f"{self.agg_probe_sql} WHERE ({self.agg_pk_cols_sql}) IN "
+            f"(VALUES {values})",
+            [v for vals in pk_values for v in vals],
+        )
+        n = self.agg_n_grp
+        current = {
+            pack_values(list(r[n:])).hex(): tuple(r[:n]) for r in rows
+        }
+        affected: Dict[str, tuple] = {}
+        with self._lock:
+            for pk in pks:
+                ph = pk.hex()
+                old = self._db.execute(
+                    "SELECT grp FROM pk_groups WHERE pk = ?", (ph,)
+                ).fetchone()
+                if old is not None:
+                    affected[old[0]] = tuple(
+                        unpack_values(bytes.fromhex(old[0]))
+                    )
+                grp = current.get(ph)
+                if grp is not None:
+                    gh = pack_values(list(grp)).hex()
+                    affected[gh] = grp
+                    self._db.execute(
+                        "INSERT OR REPLACE INTO pk_groups VALUES (?, ?)",
+                        (ph, gh),
+                    )
+                else:
+                    self._db.execute(
+                        "DELETE FROM pk_groups WHERE pk = ?", (ph,)
+                    )
+        if not affected:
+            self._db.commit()
+            return
+        # group keys can be NULL (one NULL group per GROUP BY), and
+        # NULL never matches IN — scope with IS conjunctions, spliced
+        # into the query's own WHERE (see agg_scope_parts)
+        prefix, suffix, conj = self.agg_scope_parts
+        pred = " OR ".join(conj for _ in affected)
+        _, rows2 = storage.read_query(
+            prefix + pred + suffix,
+            [v for grp in affected.values() for v in grp],
+        )
+        cand_keys = {("__corro_grp", h) for h in affected}
+        with self._lock:
+            new_ids, pks_of = self._grp_keyed(rows2)
+            scope_old = {
+                i: self.rows[i]
+                for k in cand_keys
+                for i in self.by_pk.get(k, [])
+                if i in self.rows
+            }
+            self._apply_diff(
+                new_ids, pks_of, scope_old, initial=False,
+                cand_keys=cand_keys,
+            )
 
     def _fanout(self, event: dict) -> None:
         self.manager.agent.metrics.counter("corro_subs_events_total")
@@ -719,13 +1032,20 @@ class SubsManager:
         if words.count("SELECT") != 1:
             return
         if any(w in _GLOBAL_WORDS for w in words):
+            # one escape hatch: single-table GROUP BY aggregates get
+            # scoped re-aggregation instead of full refresh
+            self._detect_incremental_agg(handle, nsql, tables,
+                                         raw_tables, words)
             return
-        items = from_items(nsql)
+        items, conn_spans = from_items_ex(nsql)
         if not items:
             return
-        names = [t for t, _a in items]
-        if len(set(names)) != len(names):
-            return  # self-join
+        names = [t for t, _a, _n in items]
+        aliases = [a for _t, a, _n in items]
+        if len(set(aliases)) != len(aliases):
+            return  # ambiguous occurrence scoping
+        if any(a.startswith("__corro_") for a in aliases):
+            return  # would collide with the hidden-column namespace
         if set(names) != raw_tables or not set(names) <= set(tables):
             # every table the query reads must be a replicated from-item
             # (raw_tables catches local lookup tables, whose changes
@@ -744,48 +1064,205 @@ class SubsManager:
             )
         except (sqlite3.Error, ValueError):
             return
-        # hidden-column projection indices per table
-        pk_idx: Dict[str, List[int]] = {}
-        pos = len(cols) - n_hidden
-        for t, _a in items:
-            pk_idx[t] = list(range(pos, pos + len(infos[t])))
-            pos += len(infos[t])
-        # every delta plan must reach EVERY from-item's index: a sibling
-        # with no index on its join column would SCAN once per changed
-        # row, costing O(sibling) per delta — worse than the full
-        # refresh this path replaces (plans name the alias when used)
-        for t, a in items:
-            idx = pk_idx[t]
-            cols_sql = ", ".join(
-                f"__corro_pk_{a}_{i}" for i in range(len(idx))
+        # per-nullable-alias affected-anchor harvests: anchor pk
+        # columns over the from-clause with that one connector flipped
+        # LEFT JOIN -> JOIN, no user WHERE (see harvest_sql)
+        anchor_t, anchor_a, _ = items[0]
+        harvest_sql: Dict[str, Tuple[str, str]] = {}
+        for j, (t, a, nullable) in enumerate(items):
+            if not nullable:
+                continue
+            s, e = conn_spans[j]
+            variant = nsql[:s] + "JOIN" + nsql[e:]
+            anchor_cols = ", ".join(
+                f'"{anchor_a}"."{c}"' for c in infos[anchor_t]
             )
-            row_ph = "(" + ", ".join("?" for _ in idx) + ")"
+            scope_cols = ", ".join(
+                f'"{a}"."{c}"' for c in infos[t]
+            )
+            harvest = (
+                f"SELECT {anchor_cols} FROM {from_clause_text(variant)}"
+            )
             try:
-                _, plan = self.agent.storage.read_query(
-                    "EXPLAIN QUERY PLAN SELECT * FROM "
-                    f"({exec_sql}) WHERE ({cols_sql}) IN "
-                    f"(VALUES {row_ph})",
-                    [None] * len(idx),
-                )
+                self.agent.storage.read_query(f"{harvest} LIMIT 0")
             except sqlite3.Error:
                 return
-            plan_text = " ".join(str(c) for row in plan for c in row)
+            harvest_sql[a] = (harvest, scope_cols)
+        # hidden-column projection indices per ALIAS (a self-join
+        # scopes each occurrence separately)
+        pk_idx: Dict[str, List[int]] = {}
+        pos = len(cols) - n_hidden
+        for t, a, _n in items:
+            pk_idx[a] = list(range(pos, pos + len(infos[t])))
+            pos += len(infos[t])
+        # every delta plan must reach an index — a sibling with no
+        # index on its join column would SCAN once per changed row,
+        # costing O(sibling) per delta, worse than the full refresh
+        # this path replaces (plans name the alias when used).  An
+        # alias whose plan cannot reach an index DEGRADES individually:
+        # changes on its table trigger one full refresh for the round
+        # while the other aliases keep their scoped deltas.  If every
+        # alias degrades the query is not incremental at all.
+        full_refresh_aliases: Set[str] = set()
 
+        def plan_of(sql: str, n_params: int):
+            try:
+                _, plan = self.agent.storage.read_query(
+                    f"EXPLAIN QUERY PLAN {sql}", [None] * n_params
+                )
+            except sqlite3.Error:
+                return None
+            return " ".join(str(c) for row in plan for c in row)
+
+        def in_plan(plan_text, op, name):
             # word-boundary matching: table "item" must not match the
             # plan line of its sibling "items" in the same join plan
-            def in_plan(op, name):
-                return re.search(
-                    rf"{op} {re.escape(name)}\b", plan_text
-                ) is not None
+            return re.search(
+                rf"{op} {re.escape(name)}\b", plan_text
+            ) is not None
 
-            for t2, a2 in items:
-                searched = in_plan("SEARCH", a2) or in_plan("SEARCH", t2)
-                if not searched or in_plan("SCAN", a2):
-                    return
+        for t, a, nullable in items:
+            idx = pk_idx[a]
+            if nullable:
+                # the harvest is what this alias's delta runs; sqlite
+                # may legally OMIT unused left-joined siblings from it
+                # (absent is fine, SCAN is not), but the scoped alias
+                # itself must SEARCH
+                harvest, scope_cols = harvest_sql[a]
+                row_ph = "(" + ", ".join("?" for _ in idx) + ")"
+                plan_text = plan_of(
+                    f"{harvest} WHERE ({scope_cols}) IN "
+                    f"(VALUES {row_ph})",
+                    len(idx),
+                )
+                ok = (
+                    plan_text is not None
+                    and in_plan(plan_text, "SEARCH", a)
+                    and not any(
+                        in_plan(plan_text, "SCAN", a2)
+                        for _t2, a2, _n2 in items
+                    )
+                )
+            else:
+                cols_sql = ", ".join(
+                    f"__corro_pk_{a}_{i}" for i in range(len(idx))
+                )
+                row_ph = "(" + ", ".join("?" for _ in idx) + ")"
+                plan_text = plan_of(
+                    f"SELECT * FROM ({exec_sql}) WHERE ({cols_sql}) "
+                    f"IN (VALUES {row_ph})",
+                    len(idx),
+                )
+                ok = plan_text is not None and all(
+                    in_plan(plan_text, "SEARCH", a2)
+                    and not in_plan(plan_text, "SCAN", a2)
+                    for _t2, a2, _n2 in items
+                )
+            if not ok:
+                full_refresh_aliases.add(a)
+        if len(full_refresh_aliases) == len(items):
+            return
         handle.exec_sql = exec_sql
+        handle.harvest_sql = harvest_sql
+        handle.full_refresh_aliases = full_refresh_aliases
         handle.n_hidden = n_hidden
         handle.pk_items = items
         handle.pk_idx = pk_idx
+
+    def _detect_incremental_agg(self, handle: SubscriptionHandle,
+                                nsql: str, tables: Set[str],
+                                raw_tables: Set[str],
+                                words: List[str]) -> None:
+        """Qualify a single-table GROUP BY aggregate for scoped
+        re-aggregation (``_delta_agg``).  Requirements:
+
+        * GROUP BY on bare/qualified column names of ONE replicated
+          from-item (HAVING and ORDER BY are fine — they ride inside
+          the re-aggregated exec query);
+        * no DISTINCT / set ops / windows / CTEs / LIMIT — their row
+          content or membership depends on rows outside any group
+          scope;
+        * the group-scoped evaluation provably reaches an index on the
+          group column(s) (EXPLAIN shows SEARCH, never SCAN).
+        """
+        for w in ("DISTINCT", "UNION", "INTERSECT", "EXCEPT", "LIMIT",
+                  "OFFSET", "OVER", "WITH", "WINDOW", "USING",
+                  "NATURAL"):
+            if w in words:
+                return
+        grp_exprs = group_by_exprs(nsql)
+        if not grp_exprs:
+            return
+        items, _spans = from_items_ex(nsql)
+        if not items or len(items) != 1 or items[0][2]:
+            return
+        table, alias, _n = items[0]
+        if alias.startswith("__corro_"):
+            return
+        if {table} != raw_tables or table not in tables:
+            return
+        info = self.agent.storage._tables.get(table)
+        if info is None:
+            return
+        for e in grp_exprs:
+            if "." in e and e.split(".", 1)[0] != alias:
+                return
+        n_grp = len(grp_exprs)
+        fi = _top_level_word(nsql, "FROM")
+        extras = ", ".join(
+            f"{e} AS __corro_grp_{i}" for i, e in enumerate(grp_exprs)
+        )
+        exec_sql = (
+            nsql[:fi].rstrip() + ", " + extras + " " + nsql[fi:]
+        )
+        pk_cols_sql = ", ".join(
+            f'"{alias}"."{c}"' for c in info.pk_cols
+        )
+        probe = (
+            f"SELECT {', '.join(grp_exprs)}, {pk_cols_sql} "
+            f"FROM {from_clause_text(nsql)}"
+        )
+        # the scoped re-agg splices its predicate into the query's own
+        # WHERE (ahead of GROUP BY): sqlite does not push an outer
+        # predicate into an aggregate subquery
+        gi = _top_level_word(exec_sql, "GROUP")
+        wi = _top_level_word(exec_sql, "WHERE")
+        if wi >= 0:
+            # parenthesize the user WHERE: a top-level OR would
+            # otherwise bind tighter than the appended AND and leak
+            # unaffected groups into the scoped re-aggregation
+            prefix = (
+                exec_sql[:wi] + "WHERE (" + exec_sql[wi + 5:gi].strip()
+                + ") AND ("
+            )
+        else:
+            prefix = exec_sql[:gi] + "WHERE ("
+        suffix = ") " + exec_sql[gi:]
+        conj = "(" + " AND ".join(f"({e} IS ?)" for e in grp_exprs) + ")"
+        try:
+            self.agent.storage.read_query(
+                f"SELECT * FROM ({exec_sql}) LIMIT 0"
+            )
+            self.agent.storage.read_query(f"{probe} LIMIT 0")
+            _, plan = self.agent.storage.read_query(
+                f"EXPLAIN QUERY PLAN {prefix}{conj}{suffix}",
+                [None] * n_grp,
+            )
+        except sqlite3.Error:
+            return
+        plan_text = " ".join(str(c) for row in plan for c in row)
+        if not re.search(
+            rf"SEARCH {re.escape(alias)}\b", plan_text
+        ) or re.search(rf"SCAN {re.escape(alias)}\b", plan_text):
+            return
+        handle.agg = True
+        handle.exec_sql = exec_sql
+        handle.agg_probe_sql = probe
+        handle.agg_pk_cols_sql = pk_cols_sql
+        handle.agg_n_grp = n_grp
+        handle.agg_scope_parts = (prefix, suffix, conj)
+        handle.pk_items = [items[0]]
+        handle.pk_idx = {}
 
     def get(self, sub_id: str) -> Optional[SubscriptionHandle]:
         with self._lock:
@@ -821,7 +1298,7 @@ class SubsManager:
         with self._lock:
             for h in self._subs.values():
                 if h.incremental:
-                    hit = [t for t, _a in h.pk_items if t in touched]
+                    hit = {t for t, _a, _n in h.pk_items if t in touched}
                     if hit:
                         per = self._pending_pks.setdefault(h.id, {})
                         for t in hit:

@@ -51,6 +51,10 @@ class TableSchema:
 @dataclass(frozen=True)
 class Schema:
     tables: Dict[str, TableSchema]
+    # non-unique secondary indexes: name -> CREATE INDEX sql
+    # (schema.rs applies these alongside tables; unique ones are
+    # rejected by constrain())
+    indexes: Dict[str, str] = None  # type: ignore[assignment]
 
 
 def parse_schema(sql: str) -> Schema:
@@ -86,7 +90,17 @@ def _introspect(conn: sqlite3.Connection) -> Schema:
                 )
             )
         tables[name] = TableSchema(name=name, columns=tuple(cols), sql=create_sql)
-    return Schema(tables=tables)
+    # CRR bookkeeping lives in "<table>__corro_*" tables/indexes —
+    # substring match, or re-applying a schema would drop them
+    indexes = dict(
+        conn.execute(
+            "SELECT name, sql FROM sqlite_master WHERE type='index' "
+            "AND sql IS NOT NULL AND name NOT LIKE 'sqlite_%' "
+            "AND name NOT LIKE '%\\_\\_corro\\_%' ESCAPE '\\' "
+            "AND tbl_name NOT LIKE '%\\_\\_corro\\_%' ESCAPE '\\'"
+        ).fetchall()
+    )
+    return Schema(tables=tables, indexes=indexes)
 
 
 def constrain(schema: Schema, scratch_sql: str) -> None:
@@ -176,4 +190,15 @@ def apply_schema(cr_conn, sql: str) -> List[str]:
         if added:
             # refresh triggers to cover the new columns
             cr_conn.as_crr(name)
+    # secondary (non-unique) indexes follow the schema file like
+    # tables do (schema.rs:276-530): new ones are created, removed or
+    # redefined ones are dropped (+ recreated)
+    for iname, isql in sorted((target.indexes or {}).items()):
+        if live.indexes.get(iname) == isql:
+            continue
+        if iname in (live.indexes or {}):
+            cr_conn.conn.execute(f'DROP INDEX IF EXISTS "{iname}"')
+        cr_conn.conn.execute(isql)
+    for iname in sorted(set(live.indexes or {}) - set(target.indexes or {})):
+        cr_conn.conn.execute(f'DROP INDEX IF EXISTS "{iname}"')
     return touched

@@ -208,3 +208,323 @@ def ratio_for(calib: Dict, n: int) -> Optional[float]:
         return None
     best = min(pts, key=lambda p: abs(p["n"] - n))
     return best["exact_over_perm"]
+
+
+# ---------------------------------------------------------------------------
+# Bitpacked exact sampler at headline scale (N = 64k-100k)
+# ---------------------------------------------------------------------------
+#
+# The scores-based kernel above draws an [C, N] uniform matrix per sender
+# chunk — O(N^2) PRNG draws per tick, ~10^10 at N=100k: unusable.  The
+# headline protocol caps every sender's per-payload ``sent_to`` at
+# ``max_transmissions * fanout`` (+ the origin's ring0 block) entries,
+# a vanishing fraction of N, so exact uniform WITHOUT-replacement
+# sampling is cheap by FULL-TUPLE REJECTION: draw k iid uniforms per
+# sender, accept only if all k are distinct, not self, and not in
+# ``sent_to``; redraw whole tuples until every active sender accepts
+# (a lax.while_loop; acceptance is ~1 - k*excl/N ≈ 99.9% at 100k, so
+# it settles in 1-2 rounds).  Conditioning iid tuples on validity makes
+# accepted tuples exactly uniform over ordered distinct allowed
+# k-tuples — the distribution of the agents' ``Members.sample`` /
+# ``random.sample`` (uniformity exact up to jax.random.randint's
+# ~2^-32 modulo bias on non-power-of-2 N).
+#
+# ``sent_to`` is BITPACKED: [N, ceil(N/8)] uint8 — 1.25 GB at 100k,
+# well inside one chip's HBM.  Membership tests are gathers of one byte
+# per candidate; marking is a scatter-add of the bit value (each bit is
+# set at most once per payload — a previously-sent target is never
+# re-drawn — so add == or).
+#
+# The rest of the tick is the HEADLINE protocol of ``sim/epidemic.py``
+# reduced to single-payload state (one writer, so [N]-bool infection is
+# equivalent to the [N, R] row state): per-message loss, partition
+# blocks until heal_tick, periodic anti-entropy pulls with the same
+# session message accounting, retransmit budget with backoff, and the
+# agents' ring0 semantics (the origin's FIRST transmission reaches its
+# whole <6ms tier; reference ``broadcast/mod.rs:586-702``) seeded at
+# init.  This is the measurement VERDICT r4 asked for: the exact
+# sampler's msgs/node AT 100k, not a ratio extrapolated from 16k.
+
+
+@dataclass(frozen=True)
+class HeadlineExactConfig:
+    n_nodes: int
+    fanout: int = 4
+    ring0_size: int = 256  # origin first-transmission tier (0 = off)
+    max_transmissions: int = 8
+    backoff_ticks: float = 0.0
+    loss: float = 0.0
+    partition_blocks: int = 1
+    heal_tick: int = 0
+    sync_interval: int = 0
+    sync_peers: int = 1
+    handshake_msgs: int = 2  # sync session accounting (models/sync.py)
+    max_ticks: int = 192
+    chunk_ticks: int = 16
+
+    def __post_init__(self):
+        # rejection sampling needs the excluded set to stay far below N
+        # (it also guarantees coverage never exhausts, so the retire
+        # path of the small-N kernels cannot trigger)
+        # worst case: the origin (budget*k sends + its ring0 tier); at
+        # 2x headroom the full-tuple acceptance is still >=25%/round
+        excl = self.max_transmissions * self.fanout + self.ring0_size + 1
+        if self.n_nodes < 2 * excl:
+            raise ValueError(
+                f"n_nodes={self.n_nodes} too small for rejection "
+                f"sampling (excluded set can reach {excl}); use the "
+                "scores-based ExactConfig kernel below N≈1k"
+            )
+
+
+class PackedExactState(NamedTuple):
+    infected: jnp.ndarray  # [N] bool
+    tx: jnp.ndarray  # [N] int32 remaining transmissions
+    next_send: jnp.ndarray  # [N] int32
+    sent: jnp.ndarray  # [N, ceil(N/8)] uint8 bitpacked sent_to
+    msgs: jnp.ndarray  # [N] int32 (broadcast + sync session msgs)
+    tick: jnp.ndarray  # scalar int32
+
+
+def packed_exact_init(
+    cfg: HeadlineExactConfig, key, writer: int = 0
+) -> PackedExactState:
+    n = cfg.n_nodes
+    nb = -(-n // 8)
+    infected = jnp.zeros((n,), bool).at[writer].set(True)
+    tx = jnp.zeros((n,), jnp.int32).at[writer].set(cfg.max_transmissions)
+    next_send = jnp.zeros((n,), jnp.int32)
+    sent = jnp.zeros((n, nb), jnp.uint8)
+    msgs = jnp.zeros((n,), jnp.int32)
+    if cfg.ring0_size > 1:
+        # the origin's first flush goes to its ENTIRE ring0 tier plus k
+        # global picks (agents: Members.sample ring0_first).  Seed the
+        # tier here: mark sent_to, charge msgs, deliver per-peer under
+        # loss; tick 0's normal send then draws the k global picks
+        # (ring0 excluded via sent_to) and consumes the budget once —
+        # together they are exactly the det-mode first transmission.
+        idx = jnp.arange(n, dtype=jnp.int32)
+        block = jnp.minimum(cfg.ring0_size, n)
+        in_tier = (idx // block == writer // block) & (idx != writer)
+        delivered = in_tier
+        if cfg.loss > 0.0:
+            keep = jax.random.uniform(key, (n,)) >= cfg.loss
+            delivered = in_tier & keep
+        infected = infected | delivered
+        tx = jnp.where(delivered, cfg.max_transmissions, tx)
+        next_send = jnp.where(delivered, 1, next_send)
+        # writer's sent bits for the whole tier (marked on send)
+        byte = idx // 8
+        bit = (jnp.uint8(1) << (idx % 8).astype(jnp.uint8))
+        row = jnp.zeros((nb,), jnp.uint8).at[
+            jnp.where(in_tier, byte, nb)
+        ].add(jnp.where(in_tier, bit, jnp.uint8(0)), mode="drop")
+        sent = sent.at[writer].set(row)
+        msgs = msgs.at[writer].add(in_tier.sum().astype(jnp.int32))
+    return PackedExactState(
+        infected, tx, next_send, sent, msgs, jnp.zeros((), jnp.int32)
+    )
+
+
+def _partition_of(cfg: HeadlineExactConfig):
+    if cfg.partition_blocks <= 1:
+        return None
+    idx = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
+    return idx * cfg.partition_blocks // cfg.n_nodes
+
+
+def _sent_bit(sent, rows, targets):
+    """Broadcasted bool: is ``targets``'s bit set in ``rows``' packed
+    sent_to rows?"""
+    byte = sent[rows, targets // 8]
+    return ((byte >> (targets % 8).astype(jnp.uint8)) & 1).astype(bool)
+
+
+def packed_exact_tick(
+    state: PackedExactState, key, cfg: HeadlineExactConfig
+) -> PackedExactState:
+    n, k = cfg.n_nodes, cfg.fanout
+    nb = state.sent.shape[1]
+    infected, tx, next_send, sent, msgs, tick = state
+    idx = jnp.arange(n, dtype=jnp.int32)
+    active = infected & (tx > 0) & (next_send <= tick)
+    part = _partition_of(cfg)
+    part_active = tick < cfg.heal_tick
+
+    k_draw, k_loss, k_sync = jax.random.split(key, 3)
+
+    def invalid_rows(cand):
+        """[N] bool: row's k-tuple has a self/sent/duplicate hit."""
+        self_hit = cand == idx[:, None]
+        sent_hit = _sent_bit(sent, idx[:, None], cand)
+        dup = jnp.zeros((n,), bool)
+        for a in range(k):
+            for b in range(a + 1, k):
+                dup |= cand[:, a] == cand[:, b]
+        return jnp.any(self_hit | sent_hit, axis=1) | dup
+
+    cand = jax.random.randint(jax.random.fold_in(k_draw, 0), (n, k), 0, n)
+    bad = invalid_rows(cand) & active
+
+    def cond(carry):
+        _, bad, _ = carry
+        return jnp.any(bad)
+
+    def body(carry):
+        cand, bad, r = carry
+        fresh = jax.random.randint(
+            jax.random.fold_in(k_draw, r), (n, k), 0, n
+        )
+        cand = jnp.where(bad[:, None], fresh, cand)
+        return cand, invalid_rows(cand) & bad, r + 1
+
+    cand, _, _ = jax.lax.while_loop(
+        cond, body, (cand, bad, jnp.int32(1))
+    )
+
+    delivered = jnp.broadcast_to(active[:, None], (n, k))
+    if cfg.loss > 0.0:
+        delivered &= jax.random.uniform(k_loss, (n, k)) >= cfg.loss
+    if part is not None:
+        delivered &= ~((part[:, None] != part[cand]) & part_active)
+
+    new_infected = infected.at[
+        jnp.where(delivered, cand, n).reshape(-1)
+    ].set(True, mode="drop")
+
+    # mark on send (loss/partition invisible to the sender): one bit per
+    # (sender, target); each target is fresh, so add == or
+    mark_cols = jnp.where(active[:, None], cand // 8, nb).reshape(-1)
+    mark_rows = jnp.repeat(idx, k)
+    mark_bits = (jnp.uint8(1) << (cand % 8).astype(jnp.uint8)).reshape(-1)
+    new_sent = sent.at[mark_rows, mark_cols].add(mark_bits, mode="drop")
+    msgs = msgs + jnp.where(active, k, 0)
+
+    # budget/backoff — det/agent semantics (coverage never exhausts at
+    # rejection scale, so the retire path does not exist here)
+    tx = jnp.where(active, tx - 1, tx)
+    send_count = cfg.max_transmissions - tx
+    gap = jnp.maximum(
+        1, jnp.round(cfg.backoff_ticks * send_count).astype(jnp.int32)
+    )
+    next_send = jnp.where(active, tick + gap, next_send)
+    learned = new_infected & ~infected
+    tx = jnp.where(learned, cfg.max_transmissions, tx)
+    next_send = jnp.where(learned, tick + 1, next_send)
+
+    # anti-entropy pull on the kernel cadence (models/sync.py sync_step
+    # reduced to single-payload: a reachable infected peer heals the
+    # client; session accounting = handshake split + one chunk per
+    # serving session)
+    if cfg.sync_interval > 0:
+        def do_sync(args):
+            infected, msgs = args
+            p = cfg.sync_peers
+            peers = jax.random.randint(k_sync, (n, p), 0, n)
+            reachable = jnp.ones((n, p), bool)
+            if part is not None:
+                reachable &= ~((part[:, None] != part[peers]) & part_active)
+            ahead = infected[peers] & ~infected[:, None] & reachable
+            healed = jnp.any(ahead, axis=1)
+            client_pay = (
+                jnp.sum(reachable, axis=1) * (cfg.handshake_msgs // 2)
+            ).astype(jnp.int32)
+            per_server = (
+                (cfg.handshake_msgs - cfg.handshake_msgs // 2)
+                * reachable + ahead
+            ).astype(jnp.int32)
+            server_pay = (
+                jnp.zeros((n,), jnp.int32)
+                .at[peers.reshape(-1)]
+                .add(per_server.reshape(-1))
+            )
+            return infected | healed, msgs + client_pay + server_pay
+
+        new_infected, msgs = jax.lax.cond(
+            tick % cfg.sync_interval == cfg.sync_interval - 1,
+            do_sync,
+            lambda args: args,
+            (new_infected, msgs),
+        )
+
+    return PackedExactState(
+        new_infected, tx, next_send, new_sent, msgs, tick + 1
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _packed_scan_chunk(state: PackedExactState, seed_key,
+                       cfg: HeadlineExactConfig):
+    """cfg.chunk_ticks rounds per dispatch; per-tick (converged,
+    msgs_mean, msgs_p99) so each seed's stats are read at its OWN
+    convergence tick."""
+
+    def body(st, _):
+        nxt = packed_exact_tick(
+            st, jax.random.fold_in(seed_key, st.tick), cfg
+        )
+        msgs_f = nxt.msgs.astype(jnp.float32)
+        return nxt, (
+            jnp.all(nxt.infected),
+            jnp.mean(msgs_f),
+            jnp.percentile(msgs_f, 99),
+        )
+
+    return jax.lax.scan(body, state, xs=None, length=cfg.chunk_ticks)
+
+
+def run_exact_headline(
+    cfg: HeadlineExactConfig, n_seeds: int = 4, seed: int = 0
+) -> Dict:
+    """Sequential-seed exact-sampler epidemics at headline scale.
+
+    Returns the same stat keys as ``run_epidemic_seeds`` (msgs/ticks at
+    each seed's own convergence tick) with ``delivery_model: exact``.
+    Seeds run sequentially — the [N, N/8] ``sent_to`` bitmap is per-run
+    state and seed-flattening would multiply it by S.
+    """
+    t0 = time.perf_counter()
+    firsts: List[float] = []
+    means: List[float] = []
+    p99s: List[float] = []
+    converged = 0
+    for s in range(n_seeds):
+        key = jax.random.PRNGKey(seed * 10_007 + s)
+        state = packed_exact_init(cfg, jax.random.fold_in(key, 2**20))
+        flags: List[np.ndarray] = []
+        mm: List[np.ndarray] = []
+        mp: List[np.ndarray] = []
+        ticks_done = 0
+        while ticks_done < cfg.max_ticks:
+            state, (conv, m_mean, m_p99) = _packed_scan_chunk(
+                state, key, cfg
+            )
+            flags.append(np.asarray(conv))
+            mm.append(np.asarray(m_mean))
+            mp.append(np.asarray(m_p99))
+            ticks_done += cfg.chunk_ticks
+            if flags[-1][-1]:
+                break
+        allflags = np.concatenate(flags)
+        allmm = np.concatenate(mm)
+        allmp = np.concatenate(mp)
+        if allflags.any():
+            fi = int(allflags.argmax())
+            converged += 1
+            firsts.append(fi + 1)
+        else:
+            fi = len(allflags) - 1
+            firsts.append(float("inf"))
+        means.append(float(allmm[fi]))
+        p99s.append(float(allmp[fi]))
+    return {
+        "n_nodes": cfg.n_nodes,
+        "n_seeds": n_seeds,
+        "delivery_model": "exact",
+        "converged_frac": converged / n_seeds,
+        "ticks_p50": float(np.percentile(firsts, 50)),
+        "ticks_p99": float(np.percentile(firsts, 99)),
+        "msgs_per_node_mean": float(np.mean(means)),
+        "msgs_per_node_p99": float(np.mean(p99s)),
+        "wall_s": time.perf_counter() - t0,
+    }

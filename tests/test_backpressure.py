@@ -271,3 +271,48 @@ def test_storage_tiers_route_like_the_reference(tmp_path):
         t.join(timeout=5)
     assert order == ["client", "apply", "maintenance"]
     st.conn.close()
+
+
+def test_apply_schema_migrates_indexes(tmp_path):
+    """Secondary (non-unique) indexes in the schema file are applied
+    like tables (schema.rs:276-530): created, redefined on change,
+    dropped on removal — so group/join columns can actually be
+    indexed for the matcher's scoped plans."""
+    from corrosion_tpu.agent.schema import apply_schema
+    from corrosion_tpu.agent.storage import CrConn
+
+    base = """
+    CREATE TABLE t (id INTEGER NOT NULL PRIMARY KEY,
+                    a TEXT NOT NULL DEFAULT '',
+                    b TEXT NOT NULL DEFAULT '');
+    """
+    st = CrConn(str(tmp_path / "i.db"))
+    apply_schema(st, base + "CREATE INDEX t_a ON t (a);"
+                            "CREATE INDEX t_b ON t (b);")
+
+    def live():
+        return dict(st.conn.execute(
+            "SELECT name, sql FROM sqlite_master WHERE type='index' "
+            "AND sql IS NOT NULL AND name LIKE 't\\_%' ESCAPE '\\' "
+            "AND name NOT LIKE '%\\_\\_corro\\_%' ESCAPE '\\'"
+        ).fetchall())
+
+    n_crr_idx = len(st.conn.execute(
+        "SELECT name FROM sqlite_master WHERE type='index' "
+        "AND name LIKE '%\\_\\_corro\\_%' ESCAPE '\\'"
+    ).fetchall())
+    assert n_crr_idx > 0  # bookkeeping indexes exist...
+
+    idx = live()
+    assert set(idx) == {"t_a", "t_b"}
+    # redefine one, drop the other
+    apply_schema(st, base + "CREATE INDEX t_a ON t (a, b);")
+    idx = live()
+    assert set(idx) == {"t_a"}
+    assert "a, b" in idx["t_a"]
+    # ...and re-applying never drops them
+    assert len(st.conn.execute(
+        "SELECT name FROM sqlite_master WHERE type='index' "
+        "AND name LIKE '%\\_\\_corro\\_%' ESCAPE '\\'"
+    ).fetchall()) == n_crr_idx
+    st.conn.close()

@@ -1,0 +1,106 @@
+"""Bitpacked exact-sampler kernel (sim/calibrate.py, headline scale).
+
+The rejection sampler must agree with the scores-based exact kernel
+under matched conditions (same protocol, different algorithm, same
+distribution), and its bitpacked ``sent_to`` bookkeeping must be
+self-consistent (msgs == popcount of marked bits when no sync traffic
+is charged).
+"""
+
+import numpy as np
+import pytest
+
+from corrosion_tpu.sim.calibrate import (
+    ExactConfig,
+    HeadlineExactConfig,
+    packed_exact_init,
+    packed_exact_tick,
+    run_exact,
+    run_exact_headline,
+)
+
+
+def test_matches_scores_kernel_distribution():
+    """Same protocol, two independent exact samplers: msgs/node means
+    must agree within a few percent at N=1000 (both uniform
+    without-replacement with sent_to exclusion, no loss/sync/ring0)."""
+    cfg = HeadlineExactConfig(
+        n_nodes=1000, fanout=4, ring0_size=0, max_transmissions=8,
+        max_ticks=64, chunk_ticks=8,
+    )
+    packed = run_exact_headline(cfg, n_seeds=3, seed=0)
+    scores = [
+        run_exact(
+            ExactConfig(n_nodes=1000, fanout=4, max_transmissions=8,
+                        max_ticks=64),
+            seed=s,
+        )["msgs_per_node_mean"]
+        for s in range(3)
+    ]
+    assert packed["converged_frac"] == 1.0
+    assert packed["msgs_per_node_mean"] == pytest.approx(
+        float(np.mean(scores)), rel=0.06
+    )
+
+
+def test_msgs_equals_popcount_of_sent_bits():
+    """Every charged broadcast message marks exactly one sent_to bit
+    (and vice versa): per-node msgs == popcount of the node's packed
+    row.  Ring0 seeding included (the origin's tier is marked+charged
+    at init); sync off so no session messages pollute the invariant."""
+    import jax
+
+    cfg = HeadlineExactConfig(
+        n_nodes=1200, fanout=4, ring0_size=64, max_transmissions=4,
+        loss=0.1, max_ticks=32, chunk_ticks=8,
+    )
+    key = jax.random.PRNGKey(7)
+    state = packed_exact_init(cfg, jax.random.fold_in(key, 99))
+    for t in range(10):
+        state = packed_exact_tick(state, jax.random.fold_in(key, t), cfg)
+    msgs = np.asarray(state.msgs)
+    pop = np.unpackbits(
+        np.asarray(state.sent), axis=1, bitorder="little"
+    ).sum(axis=1)
+    assert (msgs == pop).all()
+    assert msgs[0] >= 63  # origin charged its ring0 tier
+
+
+def test_partition_isolates_without_sync():
+    """While the partition is active and sync is off, no cross-block
+    infection can occur — pins the partition mask."""
+    import jax
+
+    cfg = HeadlineExactConfig(
+        n_nodes=512, fanout=4, ring0_size=0, max_transmissions=8,
+        partition_blocks=2, heal_tick=1000, sync_interval=0,
+        max_ticks=32, chunk_ticks=8,
+    )
+    key = jax.random.PRNGKey(0)
+    state = packed_exact_init(cfg, jax.random.fold_in(key, 99))
+    for t in range(12):
+        state = packed_exact_tick(state, jax.random.fold_in(key, t), cfg)
+    infected = np.asarray(state.infected)
+    assert infected[: 256].any()
+    assert not infected[256:].any()
+
+
+def test_sync_heals_partition_after_heal_tick():
+    """The full headline shape (loss + partition + heal + sync)
+    converges; convergence cannot precede the heal tick."""
+    cfg = HeadlineExactConfig(
+        n_nodes=2000, fanout=4, ring0_size=256, max_transmissions=8,
+        loss=0.05, partition_blocks=2, heal_tick=12,
+        sync_interval=8, sync_peers=1, max_ticks=96, chunk_ticks=8,
+    )
+    r = run_exact_headline(cfg, n_seeds=2, seed=0)
+    assert r["converged_frac"] == 1.0
+    assert r["ticks_p50"] > 12
+
+
+def test_rejection_guard_rejects_tiny_n():
+    """The config refuses N where the excluded set could approach N
+    (rejection sampling would stall; the scores kernel owns that
+    regime)."""
+    with pytest.raises(ValueError):
+        HeadlineExactConfig(n_nodes=64, fanout=4, ring0_size=0)

@@ -7,11 +7,15 @@ subscription, then the exact event sequence — snapshot row, a new
 matching service arriving as an insert, a removed service as a delete,
 and an address change updating the rendered JSON.
 
-One documented divergence: the reference's AST matcher keys join rows
-by the concatenated base-table pks and emits the address change as an
-in-place UPDATE; our fallback path keys by row content, so the same
-change arrives as delete(old)+insert(new).  Both leave identical
-materialized rows.
+Since round 5 the 4-table LEFT-JOIN subscription qualifies for the
+pk-scoped incremental path: join rows key on the concatenated
+base-table pks exactly like the reference's AST matcher, so the
+address change arrives as an in-place UPDATE of the same row id —
+the reference's own event shape.  (Changes on the left-joined
+machine* tables degrade to a full refresh because the reverse join
+path ``machines.id = consul_services.instance_id`` has no index —
+``full_refresh_aliases`` — but consul_services changes, which drive
+this scenario, stay scoped.)
 """
 
 import asyncio
@@ -150,20 +154,18 @@ def test_matcher_reference_diff_scenario():
             assert ev["change"][1] == 1
             assert ev["change"][3] == 2
 
-            # address change re-renders service-3's JSON.  Reference
-            # emits an in-place Update (pk-keyed join rows); our
-            # fallback path re-keys by content: delete(old)+insert(new)
-            # with identical final materialization.
+            # address change re-renders service-3's JSON: an in-place
+            # Update of the same row id (pk-keyed join rows), exactly
+            # the reference's event
             a.execute_transaction([
                 ["UPDATE consul_services SET address = '127.0.0.2'"
                  " WHERE node = 'test-hostname' AND id = 'service-3'"]
             ])
-            kinds = {}
-            for _ in range(2):
-                ev = await asyncio.to_thread(next, gen)
-                kinds[ev["change"][0]] = ev["change"][2][0]
-            assert set(kinds) == {"delete", "insert"}
-            assert json.loads(kinds["insert"]) == json.loads(
+            ev = await asyncio.to_thread(next, gen)
+            assert ev["change"][0] == "update"
+            assert ev["change"][1] == 2
+            assert ev["change"][3] == 3
+            assert json.loads(ev["change"][2][0]) == json.loads(
                 _expected("/1", "m-3", address="127.0.0.2"))
             # final state: exactly one row, the updated service-3
             assert len(handle.rows) == 1

@@ -381,6 +381,427 @@ def test_join_subscription_incremental_delta(run):
     run(main())
 
 
+def test_self_join_subscription_incremental(run):
+    """A self-join on indexed columns qualifies; a 1-row change
+    re-evaluates each aliased occurrence with ONE scoped statement per
+    occurrence — never a full re-query (occurrence-tagged aliases,
+    pubsub.rs:602-737)."""
+    async def main():
+        a = await launch_test_agent()
+        try:
+            a.execute_transaction([
+                ["INSERT INTO tests (id, text) VALUES (1, 'a')"],
+                ["INSERT INTO tests (id, text) VALUES (2, 'b')"],
+            ])
+            sub = a.subs.subscribe(
+                "SELECT l.id, r.text FROM tests l JOIN tests r"
+                " ON l.id = r.id"
+            )
+            assert sub.incremental and not sub.full_refresh_aliases
+            assert sorted(c for _, c in sub.rows.values()) == [
+                [1, "a"], [2, "b"]
+            ]
+            await asyncio.sleep(0.1)
+            await wait_for(a.subs.idle, timeout=15)
+
+            statements = []
+            orig = a.storage.read_query
+
+            def counting(sql, params=()):
+                statements.append(sql)
+                return orig(sql, params)
+
+            a.storage.read_query = counting
+            try:
+                before = sub.last_change_id
+                a.execute_transaction([
+                    ["INSERT INTO tests (id, text) VALUES (3, 'c')"]
+                ])
+                await wait_for(
+                    lambda: sub.last_change_id > before, timeout=15
+                )
+                await wait_for(a.subs.idle, timeout=15)
+            finally:
+                a.storage.read_query = orig
+            deltas = [s for s in statements if "__corro_pk_" in s]
+            fulls = [
+                s for s in statements
+                if s.strip().upper().startswith("SELECT")
+                and "__corro_pk_" not in s
+                and "EXPLAIN" not in s.upper()
+            ]
+            # one scoped delta per occurrence (aliases l and r)
+            assert len(deltas) == 2, statements
+            assert not fulls, statements
+            assert sorted(c for _, c in sub.rows.values()) == [
+                [1, "a"], [2, "b"], [3, "c"]
+            ]
+            # delete removes the row through both occurrences
+            a.execute_transaction([["DELETE FROM tests WHERE id = 2"]])
+            await wait_for(
+                lambda: sorted(
+                    c for _, c in list(sub.rows.values())
+                ) == [[1, "a"], [3, "c"]],
+                timeout=15,
+            )
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_left_join_subscription_incremental(run):
+    """LEFT JOIN: a 1-row change on the NULLABLE side runs one anchor
+    harvest + one anchor-scoped delta (never a full re-query), and
+    NULL-extension transitions are emitted in both directions."""
+    async def main():
+        a = await launch_test_agent()
+        try:
+            a.execute_transaction([
+                ["INSERT INTO tests (id, text) VALUES (1, 'a')"],
+                ["INSERT INTO tests (id, text) VALUES (2, 'b')"],
+                ["INSERT INTO tests2 (id, text) VALUES (1, 'x')"],
+            ])
+            sub = a.subs.subscribe(
+                "SELECT tests.id, tests2.text FROM tests"
+                " LEFT JOIN tests2 ON tests.id = tests2.id"
+            )
+            assert sub.incremental and not sub.full_refresh_aliases
+            assert sorted(c for _, c in sub.rows.values()) == [
+                [1, "x"], [2, None]
+            ]
+            await asyncio.sleep(0.1)
+            await wait_for(a.subs.idle, timeout=15)
+
+            statements = []
+            orig = a.storage.read_query
+
+            def counting(sql, params=()):
+                statements.append(sql)
+                return orig(sql, params)
+
+            a.storage.read_query = counting
+            try:
+                before = sub.last_change_id
+                # inner-side insert: row 2 transitions NULL -> matched
+                a.execute_transaction([
+                    ["INSERT INTO tests2 (id, text) VALUES (2, 'y')"]
+                ])
+                await wait_for(
+                    lambda: sub.last_change_id > before, timeout=15
+                )
+                await wait_for(a.subs.idle, timeout=15)
+            finally:
+                a.storage.read_query = orig
+            scoped = [s for s in statements if "__corro_pk_" in s]
+            harvests = [
+                s for s in statements
+                if s.strip().upper().startswith("SELECT")
+                and "__corro_pk_" not in s
+                and "EXPLAIN" not in s.upper()
+            ]
+            # one harvest (affected anchors) + one anchor-scoped delta
+            assert len(harvests) == 1, statements
+            assert len(scoped) == 1, statements
+            assert sorted(c for _, c in sub.rows.values()) == [
+                [1, "x"], [2, "y"]
+            ]
+            # inner-side delete: matched -> NULL-extended again
+            a.execute_transaction([["DELETE FROM tests2 WHERE id = 1"]])
+            await wait_for(
+                lambda: sorted(
+                    c for _, c in list(sub.rows.values())
+                ) == [[1, None], [2, "y"]],
+                timeout=15,
+            )
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_left_join_subscription_restore_after_restart(run):
+    """LEFT-JOIN sub state (incl. NULL-extension identities) survives a
+    restart, and a transition applied while down is caught up."""
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="corro-ljsub-")
+
+    async def main():
+        a = await launch_test_agent(tmpdir=d)
+        try:
+            a.execute_transaction([
+                ["INSERT INTO tests (id, text) VALUES (1, 'a')"],
+            ])
+            h = a.subs.subscribe(
+                "SELECT tests.id, tests2.text FROM tests"
+                " LEFT JOIN tests2 ON tests.id = tests2.id"
+            )
+            assert h.incremental
+            assert sorted(c for _, c in h.rows.values()) == [[1, None]]
+        finally:
+            await a.stop()
+
+        a2 = await launch_test_agent(tmpdir=d)
+        try:
+            subs = a2.subs.list()
+            h2 = a2.subs.get(subs[0]["id"])
+            assert h2.incremental
+            # the boot refresh catches up; the NULL-extension identity
+            # restored from disk still transitions correctly
+            before = h2.last_change_id
+            a2.execute_transaction([
+                ["INSERT INTO tests2 (id, text) VALUES (1, 'z')"]
+            ])
+            await wait_for(
+                lambda: sorted(
+                    c for _, c in list(h2.rows.values())) == [[1, "z"]],
+                timeout=15,
+            )
+            assert h2.last_change_id > before
+        finally:
+            await a2.stop()
+
+    run(main())
+
+
+AGG_SCHEMA = """
+CREATE TABLE emps (
+  id INTEGER NOT NULL PRIMARY KEY,
+  dept TEXT,
+  salary INTEGER NOT NULL DEFAULT 0
+);
+CREATE INDEX emps_dept ON emps (dept);
+"""
+
+
+def test_aggregate_subscription_incremental(run):
+    """Single-table GROUP BY: a 1-row change probes the changed pks'
+    groups and re-aggregates ONLY those groups (one probe + one scoped
+    re-agg, never a full re-query); count changes arrive as in-place
+    updates of the group row; group moves retract/extend both groups;
+    the NULL group works (IS-scoping, not IN)."""
+    async def main():
+        a = await launch_test_agent(schema=AGG_SCHEMA)
+        try:
+            a.execute_transaction([
+                ["INSERT INTO emps (id, dept, salary) VALUES (1, 'eng', 10)"],
+                ["INSERT INTO emps (id, dept, salary) VALUES (2, 'eng', 20)"],
+                ["INSERT INTO emps (id, dept, salary) VALUES (3, 'ops', 5)"],
+            ])
+            sub = a.subs.subscribe(
+                "SELECT dept, count(*), sum(salary) FROM emps"
+                " GROUP BY dept"
+            )
+            assert sub.incremental and sub.agg
+            assert sorted(c for _, c in sub.rows.values()) == [
+                ["eng", 2, 30], ["ops", 1, 5]
+            ]
+            await asyncio.sleep(0.1)
+            await wait_for(a.subs.idle, timeout=15)
+
+            gen = sub.stream()
+            while "eoq" not in next(gen):
+                pass
+            statements = []
+            orig = a.storage.read_query
+
+            def counting(sql, params=()):
+                statements.append(sql)
+                return orig(sql, params)
+
+            a.storage.read_query = counting
+            try:
+                before = sub.last_change_id
+                a.execute_transaction([
+                    ["INSERT INTO emps (id, dept, salary)"
+                     " VALUES (4, 'eng', 30)"]
+                ])
+                await wait_for(
+                    lambda: sub.last_change_id > before, timeout=15
+                )
+                await wait_for(a.subs.idle, timeout=15)
+            finally:
+                a.storage.read_query = orig
+            sels = [
+                s for s in statements
+                if s.strip().upper().startswith("SELECT")
+                and "EXPLAIN" not in s.upper()
+            ]
+            probes = [s for s in sels if "VALUES" in s]
+            scoped = [s for s in sels if "__corro_grp_" in s]
+            fulls = [s for s in sels if s not in probes and s not in scoped]
+            assert len(probes) == 1 and len(scoped) == 1, statements
+            assert not fulls, statements
+            # the count change is an in-place UPDATE of the group row
+            ev = await asyncio.to_thread(next, gen)
+            assert ev["change"][0] == "update"
+            assert ev["change"][2] == ["eng", 3, 60]
+
+            # group move: ops loses its only row -> delete; eng grows
+            a.execute_transaction([
+                ["UPDATE emps SET dept = 'eng' WHERE id = 3"]
+            ])
+            await wait_for(
+                lambda: sorted(c for _, c in list(sub.rows.values()))
+                == [["eng", 4, 65]],
+                timeout=15,
+            )
+            # NULL group: IS-scoping finds it where IN could not
+            a.execute_transaction([
+                ["UPDATE emps SET dept = NULL WHERE id = 4"]
+            ])
+            await wait_for(
+                lambda: sorted(
+                    (c for _, c in list(sub.rows.values())),
+                    key=str,
+                ) == sorted([[None, 1, 30], ["eng", 3, 35]], key=str),
+                timeout=15,
+            )
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_aggregate_subscription_or_where_precedence(run):
+    """A top-level OR in the user WHERE is parenthesized before the
+    group-scope AND is appended — a change touching only an unrelated
+    group must not leak other groups into the scoped re-aggregation
+    (which would emit spurious inserts / partial aggregates)."""
+    async def main():
+        a = await launch_test_agent(schema=AGG_SCHEMA)
+        try:
+            a.execute_transaction([
+                ["INSERT INTO emps (id, dept) VALUES (1, 'eng')"],
+                ["INSERT INTO emps (id, dept) VALUES (2, 'ops')"],
+                ["INSERT INTO emps (id, dept) VALUES (3, 'misc')"],
+            ])
+            h = a.subs.subscribe(
+                "SELECT dept, count(*) FROM emps"
+                " WHERE dept = 'eng' OR dept = 'ops' GROUP BY dept"
+            )
+            assert h.agg
+            assert sorted(c for _, c in h.rows.values()) == [
+                ["eng", 1], ["ops", 1]
+            ]
+            before = h.last_change_id
+            a.execute_transaction([
+                ["INSERT INTO emps (id, dept) VALUES (4, 'misc')"]
+            ])
+            await asyncio.sleep(0.3)
+            await wait_for(a.subs.idle, timeout=15)
+            assert h.last_change_id == before
+            assert sorted(c for _, c in h.rows.values()) == [
+                ["eng", 1], ["ops", 1]
+            ]
+            a.execute_transaction([
+                ["INSERT INTO emps (id, dept) VALUES (5, 'ops')"]
+            ])
+            await wait_for(
+                lambda: sorted(c for _, c in list(h.rows.values()))
+                == [["eng", 1], ["ops", 2]],
+                timeout=15,
+            )
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_aggregate_subscription_having_and_restore(run):
+    """HAVING rides inside the scoped re-aggregation (a group failing
+    it disappears); aggregate sub state survives restart and catches
+    up changes applied while down."""
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="corro-aggsub-")
+
+    async def main():
+        a = await launch_test_agent(tmpdir=d, schema=AGG_SCHEMA)
+        try:
+            h = a.subs.subscribe(
+                "SELECT dept, count(*) FROM emps GROUP BY dept"
+                " HAVING count(*) > 1"
+            )
+            assert h.incremental and h.agg
+            a.execute_transaction([
+                ["INSERT INTO emps (id, dept) VALUES (1, 'x')"],
+                ["INSERT INTO emps (id, dept) VALUES (2, 'x')"],
+                ["INSERT INTO emps (id, dept) VALUES (3, 'y')"],
+            ])
+            await wait_for(
+                lambda: sorted(c for _, c in list(h.rows.values()))
+                == [["x", 2]],
+                timeout=15,
+            )
+            # dropping below the HAVING floor deletes the group row
+            a.execute_transaction([["DELETE FROM emps WHERE id = 2"]])
+            await wait_for(lambda: len(h.rows) == 0, timeout=15)
+            a.execute_transaction([
+                ["INSERT INTO emps (id, dept) VALUES (4, 'y')"]
+            ])
+            await wait_for(
+                lambda: sorted(c for _, c in list(h.rows.values()))
+                == [["y", 2]],
+                timeout=15,
+            )
+        finally:
+            await a.stop()
+
+        a2 = await launch_test_agent(tmpdir=d, schema=AGG_SCHEMA)
+        try:
+            subs = a2.subs.list()
+            h2 = a2.subs.get(subs[0]["id"])
+            assert h2.incremental and h2.agg
+            assert sorted(c for _, c in h2.rows.values()) == [["y", 2]]
+            # deltas keep working post-restore (pk_groups map rebuilt)
+            a2.execute_transaction([
+                ["INSERT INTO emps (id, dept) VALUES (5, 'y')"]
+            ])
+            await wait_for(
+                lambda: sorted(c for _, c in list(h2.rows.values()))
+                == [["y", 3]],
+                timeout=15,
+            )
+        finally:
+            await a2.stop()
+
+    run(main())
+
+
+def test_aggregate_eligibility():
+    """Which aggregate shapes qualify: indexed single-table GROUP BY
+    yes; unindexed group column, global aggregates (no GROUP BY),
+    DISTINCT and LIMIT no — they stay on the correct full-refresh
+    path."""
+    async def main():
+        a = await launch_test_agent(schema=AGG_SCHEMA)
+        try:
+            def sub(sql):
+                return a.subs.subscribe(sql)
+
+            assert sub(
+                "SELECT dept, count(*) FROM emps GROUP BY dept"
+            ).agg
+            # salary has no index -> scoped re-agg would scan
+            assert not sub(
+                "SELECT salary, count(*) FROM emps GROUP BY salary"
+            ).incremental
+            # no GROUP BY: one global group, scope is the whole table
+            assert not sub("SELECT count(*) FROM emps").incremental
+            assert not sub(
+                "SELECT DISTINCT dept, count(*) FROM emps GROUP BY dept"
+            ).incremental
+            assert not sub(
+                "SELECT dept, count(*) FROM emps GROUP BY dept LIMIT 5"
+            ).incremental
+        finally:
+            await a.stop()
+
+    asyncio.run(main())
+
+
 def test_join_subscription_restore_after_restart(run):
     """Join-sub state (multi-table pk index) survives restart; a change
     applied while down is caught up by the boot refresh."""
@@ -451,10 +872,11 @@ def test_incremental_eligibility(run):
             # pk not projected by the USER: the hidden __corro_pk_*
             # splice provides the identity now — eligible
             assert sub("SELECT text FROM tests").incremental
-            # aggregate -> row content depends on other rows
-            assert not sub(
+            # GROUP BY on an indexed column: scoped re-aggregation
+            # qualifies since round 5 (test_aggregate_* pin behavior)
+            assert sub(
                 "SELECT id, count(*) FROM tests GROUP BY id"
-            ).incremental
+            ).agg
             # subquery -> two SELECTs
             assert not sub(
                 "SELECT id, text FROM tests "
@@ -467,17 +889,27 @@ def test_incremental_eligibility(run):
                 "JOIN tests2 ON tests.id = tests2.id"
             )
             assert j.incremental
-            assert {t for t, _ in j.pk_items} == {"tests", "tests2"}
-            # outer joins: NULL-extension transitions escape the scoped
-            # pk filter — must not qualify
-            assert not sub(
+            assert {t for t, _a, _n in j.pk_items} == {"tests", "tests2"}
+            # LEFT JOIN on an indexed column: eligible since round 5 —
+            # inner-side changes re-scope through the anchor
+            lj = sub(
                 "SELECT tests.id, tests2.text FROM tests "
                 "LEFT JOIN tests2 ON tests.id = tests2.id"
-            ).incremental
-            # self-join: same table twice, pk scope is ambiguous
+            )
+            assert lj.incremental
+            assert [n for _t, _a, n in lj.pk_items] == [False, True]
+            # RIGHT/FULL: the anchor property breaks — not eligible
             assert not sub(
-                "SELECT a.id FROM tests a JOIN tests b ON a.id = b.id"
+                "SELECT tests.id FROM tests "
+                "RIGHT JOIN tests2 ON tests.id = tests2.id"
             ).incremental
+            # self-join: eligible since round 5 — each aliased
+            # occurrence scopes its own delta
+            sj = sub(
+                "SELECT a.id FROM tests a JOIN tests b ON a.id = b.id"
+            )
+            assert sj.incremental
+            assert sorted(sj.pk_idx) == ["a", "b"]
             # join on an UNINDEXED column: the sibling table's side of
             # the delta plan is a SCAN, so each changed row would cost
             # O(sibling) — must fall back to full refresh
