@@ -44,9 +44,14 @@ Implementation notes:
 from __future__ import annotations
 
 import asyncio
+import itertools
 import re
+import secrets
 import struct
+import threading
 from typing import Dict, List, Optional, Tuple, TYPE_CHECKING
+
+from corrosion_tpu.agent.sqlstate import PgError, SQLSTATE, sqlstate_for
 
 if TYPE_CHECKING:
     from corrosion_tpu.agent.runtime import Agent
@@ -336,7 +341,10 @@ def build_catalog(agent: "Agent"):
 
     from corrosion_tpu.agent.storage import register_udfs
 
-    cat = sqlite3.connect(":memory:")
+    # sessions execute off-loop (asyncio.to_thread), so the cached
+    # catalog connection is used from varying worker threads; access
+    # is serialized per statement by the session round-trip
+    cat = sqlite3.connect(":memory:", check_same_thread=False)
     register_udfs(cat)  # current_database() etc. inside catalog queries
     cat.executescript(
         """
@@ -478,10 +486,29 @@ def _catalog_for(agent: "Agent"):
     if hit and hit[0] == key:
         return hit[1]
     cat = build_catalog(agent)
-    if hit:
-        hit[1].close()
+    # the stale connection is NOT closed here: another session's
+    # off-loop catalog query may still be executing on it (sessions run
+    # in worker threads since CancelRequest support); the in-memory db
+    # is reclaimed when the last reference drops
     agent._pg_catalog = (key, cat)
     return cat
+
+
+_GUC_DEFAULTS = {
+    "server_version": "14.9",
+    "server_encoding": "UTF8",
+    "client_encoding": "UTF8",
+    "datestyle": "ISO, MDY",
+    "timezone": "UTC",
+    "standard_conforming_strings": "on",
+    "integer_datetimes": "on",
+    "search_path": '"$user", public',
+    "application_name": "",
+    "transaction_isolation": "read committed",
+    "statement_timeout": "0",
+    "default_transaction_isolation": "read committed",
+    "max_identifier_length": "63",
+}
 
 
 class _Session:
@@ -494,9 +521,42 @@ class _Session:
         self.in_txn = False
         self.txn_failed = False
         self.txn_writes: List[list] = []
+        # savepoint stack: (name, buffered-write index at creation) —
+        # ROLLBACK TO truncates the buffer back to the mark
+        self.savepoints: List[Tuple[str, int]] = []
+        # session GUCs (SET/SHOW/RESET); defaults overlay
+        self.gucs: Dict[str, str] = {}
         # extended-protocol error recovery: after an error, further
         # Parse/Bind/Describe/Execute are discarded until Sync
         self.skip_until_sync = False
+        # CancelRequest support: the read connection currently
+        # executing this session's query, interruptible from any
+        # thread.  The lock closes the return-to-pool race: read_query
+        # clears the slot (under this lock) BEFORE the pooled reader is
+        # handed to another session, so cancel() can never interrupt a
+        # different session's query.
+        self._active_conn = None
+        self._cancel_lock = threading.Lock()
+        self.backend_pid = 0
+        self.backend_secret = 0
+
+    # -- cancellation ----------------------------------------------------
+
+    def _track_conn(self, conn) -> None:
+        with self._cancel_lock:
+            self._active_conn = conn
+
+    def cancel(self) -> None:
+        """Interrupt the in-flight query, if any (CancelRequest:
+        affects only the current statement; a cancel that lands
+        between statements is a no-op, same as real PG's race)."""
+        with self._cancel_lock:
+            conn = self._active_conn
+            if conn is not None:
+                try:
+                    conn.interrupt()
+                except Exception:
+                    pass
 
     # -- execution -------------------------------------------------------
 
@@ -504,21 +564,71 @@ class _Session:
         """Returns (columns, rows, rowcount, tag)."""
         raw = sql.strip().rstrip(";")
         word = raw.split(None, 1)[0].upper() if raw else ""
+        up_words = raw.upper().split()
         if word == "BEGIN" or word == "START":
-            self.in_txn, self.txn_failed, self.txn_writes = True, False, []
+            self.in_txn, self.txn_failed = True, False
+            self.txn_writes, self.savepoints = [], []
             return [], [], 0, "BEGIN"
         if word == "COMMIT" or word == "END":
             writes, self.txn_writes = self.txn_writes, []
-            self.in_txn = False
+            self.in_txn, self.savepoints = False, []
             if self.txn_failed:
                 self.txn_failed = False
                 return [], [], 0, "ROLLBACK"
             if writes:
                 self.agent.execute_transaction(writes)
             return [], [], 0, "COMMIT"
+        if word == "ROLLBACK" and "TO" in up_words[1:3]:
+            # ROLLBACK [WORK] TO [SAVEPOINT] name: truncate the write
+            # buffer to the mark and CLEAR the failed state (PG lets
+            # the transaction continue past the savepoint)
+            name = raw.split()[-1].lower()
+            for i in range(len(self.savepoints) - 1, -1, -1):
+                if self.savepoints[i][0] == name:
+                    del self.txn_writes[self.savepoints[i][1]:]
+                    del self.savepoints[i + 1:]
+                    self.txn_failed = False
+                    return [], [], 0, "ROLLBACK"
+            raise PgError(
+                SQLSTATE["invalid_savepoint_specification"],
+                f'savepoint "{name}" does not exist',
+            )
         if word == "ROLLBACK":
-            self.in_txn, self.txn_failed, self.txn_writes = False, False, []
+            self.in_txn, self.txn_failed = False, False
+            self.txn_writes, self.savepoints = [], []
             return [], [], 0, "ROLLBACK"
+        if self.txn_failed:
+            # 25P02: everything except COMMIT/ROLLBACK is refused until
+            # the failed transaction block ends (real PG behavior)
+            raise PgError(
+                SQLSTATE["in_failed_sql_transaction"],
+                "current transaction is aborted, commands ignored "
+                "until end of transaction block",
+            )
+        if word == "SAVEPOINT":
+            if not self.in_txn:
+                raise PgError(
+                    SQLSTATE["no_active_sql_transaction"],
+                    "SAVEPOINT can only be used in transaction blocks",
+                )
+            parts = raw.split()
+            if len(parts) != 2:
+                raise PgError(SQLSTATE["syntax_error"],
+                              "syntax error in SAVEPOINT")
+            self.savepoints.append((parts[1].lower(), len(self.txn_writes)))
+            return [], [], 0, "SAVEPOINT"
+        if word == "RELEASE":
+            name = raw.split()[-1].lower()
+            for i in range(len(self.savepoints) - 1, -1, -1):
+                if self.savepoints[i][0] == name:
+                    del self.savepoints[i:]
+                    return [], [], 0, "RELEASE"
+            raise PgError(
+                SQLSTATE["invalid_savepoint_specification"],
+                f'savepoint "{name}" does not exist',
+            )
+        if word in ("SET", "RESET", "SHOW"):
+            return self._guc_statement(word, raw)
         if not raw:
             return [], [], 0, ""
 
@@ -535,10 +645,11 @@ class _Session:
                     # RETURNING rows don't exist yet — failing fast
                     # beats silently returning none (ORMs would read a
                     # missing primary key)
-                    raise ValueError(
+                    raise PgError(
+                        SQLSTATE["feature_not_supported"],
                         "RETURNING inside an explicit transaction is "
                         "not supported (writes are buffered until "
-                        "COMMIT); run the statement in autocommit"
+                        "COMMIT); run the statement in autocommit",
                     )
                 self.txn_writes.append(stmt)
                 # rowcount unknown until commit; report optimistically
@@ -569,8 +680,78 @@ class _Session:
                 self.txn_writes, tsql, params
             )
         else:
-            cols, rows = self.agent.storage.read_query(tsql, params)
+            # the tracked connection makes this read interruptible by a
+            # concurrent CancelRequest ("interrupted" maps to 57014)
+            cols, rows = self.agent.storage.read_query(
+                tsql, params, on_conn=self._track_conn
+            )
         return cols, rows, len(rows), _tag_for(tsql, -1, len(rows))
+
+    def _guc_statement(self, word: str, raw: str):
+        """SET / RESET / SHOW against the session's GUC store (real
+        session state, not a canned reply: SET is visible to later
+        SHOWs, RESET restores the default, SHOW ALL lists)."""
+        body = raw.split(None, 1)[1].strip() if " " in raw else ""
+        if word == "SET":
+            # SET [SESSION|LOCAL] name {TO|=} value
+            m = re.match(
+                r"(?:SESSION\s+|LOCAL\s+)?([A-Za-z_][\w.]*)\s*"
+                r"(?:=|\bTO\b)\s*(.+)$",
+                body, flags=re.IGNORECASE | re.DOTALL,
+            )
+            if not m:
+                # SET TIME ZONE 'x' / bare forms
+                m2 = re.match(r"TIME\s+ZONE\s+(.+)$", body,
+                              flags=re.IGNORECASE)
+                if m2:
+                    self.gucs["timezone"] = m2.group(1).strip().strip("'")
+                    return [], [], 0, "SET"
+                raise PgError(SQLSTATE["syntax_error"],
+                              f"syntax error in SET: {raw!r}")
+            name = m.group(1).lower()
+            val = m.group(2).strip()
+            if val.upper() == "DEFAULT":
+                self.gucs.pop(name, None)
+            elif name == "search_path":
+                # the one comma-LIST parameter clients actually SET:
+                # normalize item spacing and quoting per element
+                self.gucs[name] = ", ".join(
+                    p.strip().strip("'") for p in val.split(",")
+                )
+            else:
+                # scalar: strip one level of quoting whole, so a value
+                # containing commas ('svc,primary') survives verbatim
+                if len(val) >= 2 and val[0] == val[-1] == "'":
+                    val = val[1:-1].replace("''", "'")
+                self.gucs[name] = val
+            return [], [], 0, "SET"
+        if word == "RESET":
+            if body.upper() == "ALL":
+                self.gucs.clear()
+            else:
+                self.gucs.pop(body.lower(), None)
+            return [], [], 0, "RESET"
+        # SHOW
+        name = body.lower()
+        if name == "all":
+            rows = sorted(
+                {**_GUC_DEFAULTS, **self.gucs}.items()
+            )
+            return (
+                ["name", "setting", "description"],
+                [(k, v, "") for k, v in rows],
+                len(rows),
+                f"SELECT {len(rows)}",
+            )
+        if name in ("transaction isolation level",):
+            name = "transaction_isolation"
+        val = self.gucs.get(name, _GUC_DEFAULTS.get(name))
+        if val is None:
+            raise PgError(
+                SQLSTATE["undefined_object"],
+                f'unrecognized configuration parameter "{name}"',
+            )
+        return [name], [(val,)], 1, "SELECT 1"
 
     def _user_tables(self) -> set:
         return {t.lower() for t in self.agent.storage.tables}
@@ -580,10 +761,6 @@ class _Session:
         # version()/current_database()/current_schema() are real SQL
         # functions (storage.register_udfs), so they work in any
         # expression context through the normal execution path
-        if low.startswith("set ") or low.startswith("reset "):
-            return [], [], 0, "SET"
-        if low.startswith("show "):
-            return ["setting"], [("",)], 1, "SELECT 1"
         # unqualified catalog routing must not fire on string literals
         # ("... WHERE note LIKE '%pg_class%'") and only reroutes reads
         no_literals = re.sub(r"'[^']*'", "''", low)
@@ -654,10 +831,21 @@ def _pg_ssl_context(agent: "Agent"):
     return ctx
 
 
+_pg_pid_counter = itertools.count(1)
+
+
+def _cancel_registry(agent: "Agent") -> Dict[Tuple[int, int], "_Session"]:
+    reg = getattr(agent, "_pg_cancel_registry", None)
+    if reg is None:
+        reg = agent._pg_cancel_registry = {}
+    return reg
+
+
 async def _handle_conn(agent: "Agent", reader: asyncio.StreamReader,
                        writer: asyncio.StreamWriter) -> None:
     session = _Session(agent)
     agent.metrics.counter("corro_pg_connections_total")
+    cancel_key = None
     try:
         # --- startup ----------------------------------------------------
         while True:
@@ -678,20 +866,39 @@ async def _handle_conn(agent: "Agent", reader: asyncio.StreamReader,
                     await writer.drain()
                 continue
             if proto == CANCEL_REQUEST:
+                # cancel-key connection (lib.rs:667-747 parity): look
+                # up the (pid, secret) pair and interrupt that
+                # session's in-flight query; never answer
+                pid, secret = struct.unpack_from(">II", body, 4)
+                target = _cancel_registry(agent).get((pid, secret))
+                if target is not None:
+                    target.cancel()
+                    agent.metrics.counter("corro_pg_cancels_total")
                 return
             if proto != PROTO_V3:
-                _error(writer, "08P01", f"unsupported protocol {proto}")
+                _error(writer, SQLSTATE["protocol_violation"],
+                       f"unsupported protocol {proto}")
                 return
             break
         writer.write(_msg(b"R", struct.pack(">I", 0)))  # AuthenticationOk
-        for k, v in (
-            ("server_version", "14.9"),
-            ("server_encoding", "UTF8"),
-            ("client_encoding", "UTF8"),
-            ("DateStyle", "ISO"),
+        # ParameterStatus values come from the ONE GUC table SHOW reads
+        for k, key in (
+            ("server_version", "server_version"),
+            ("server_encoding", "server_encoding"),
+            ("client_encoding", "client_encoding"),
+            ("DateStyle", "datestyle"),
+            ("standard_conforming_strings", "standard_conforming_strings"),
+            ("integer_datetimes", "integer_datetimes"),
+            ("TimeZone", "timezone"),
         ):
-            writer.write(_msg(b"S", _cstr(k) + _cstr(v)))
-        writer.write(_msg(b"K", struct.pack(">II", 0, 0)))
+            writer.write(_msg(b"S", _cstr(k) + _cstr(_GUC_DEFAULTS[key])))
+        # a REAL cancellation key: a later CancelRequest bearing it
+        # interrupts this session's running query
+        session.backend_pid = next(_pg_pid_counter)
+        session.backend_secret = secrets.randbits(31)
+        cancel_key = (session.backend_pid, session.backend_secret)
+        _cancel_registry(agent)[cancel_key] = session
+        writer.write(_msg(b"K", struct.pack(">II", *cancel_key)))
         _ready(writer, session)
         await writer.drain()
 
@@ -718,7 +925,7 @@ async def _handle_conn(agent: "Agent", reader: asyncio.StreamReader,
             elif tag == b"B":
                 _bind(writer, session, _Buffer(body))
             elif tag == b"D":
-                _describe(writer, session, _Buffer(body))
+                await _describe(writer, session, _Buffer(body))
             elif tag == b"E":
                 await _execute_portal(writer, session, _Buffer(body))
             elif tag == b"C":
@@ -738,6 +945,8 @@ async def _handle_conn(agent: "Agent", reader: asyncio.StreamReader,
     except (asyncio.IncompleteReadError, ConnectionError):
         return
     finally:
+        if cancel_key is not None:
+            _cancel_registry(agent).pop(cancel_key, None)
         writer.close()
 
 
@@ -798,11 +1007,15 @@ async def _simple_query(writer, session: _Session, query: str) -> None:
         return
     for part in parts:
         try:
-            cols, rows, rc, tag = session.execute(part)
+            # off-loop so a concurrent CancelRequest (its own
+            # connection, same event loop) can interrupt this query
+            cols, rows, rc, tag = await asyncio.to_thread(
+                session.execute, part
+            )
         except Exception as e:
             if session.in_txn:
                 session.txn_failed = True
-            _error(writer, "42601", str(e))
+            _error(writer, sqlstate_for(e), str(e))
             break
         if cols:
             _row_description(writer, cols, _result_oids(rows, len(cols)))
@@ -853,7 +1066,7 @@ def _bind(writer, session: _Session, b: _Buffer) -> None:
     writer.write(_msg(b"2"))
 
 
-def _describe(writer, session: _Session, b: _Buffer) -> None:
+async def _describe(writer, session: _Session, b: _Buffer) -> None:
     kind, name = b.read(1), b.string()
     if kind == b"S":
         if name not in session.stmts:
@@ -926,11 +1139,13 @@ def _describe(writer, session: _Session, b: _Buffer) -> None:
             writer.write(_msg(b"n"))
         return
     try:
-        cols, rows, rc, tag = session.execute(raw, tuple(entry["values"]))
+        cols, rows, rc, tag = await asyncio.to_thread(
+            session.execute, raw, tuple(entry["values"])
+        )
     except Exception as e:
         if session.in_txn:
             session.txn_failed = True
-        _ext_error(writer, session, "42601", str(e))
+        _ext_error(writer, session, sqlstate_for(e), str(e))
         return
     entry["described"] = True
     entry["cached"] = (cols, rows, rc, tag)
@@ -958,13 +1173,13 @@ async def _execute_portal(writer, session: _Session, b: _Buffer) -> None:
     else:
         raw = session.stmts[entry["stmt"]][0]
         try:
-            cols, rows, rc, tag = session.execute(
-                raw, tuple(entry["values"])
+            cols, rows, rc, tag = await asyncio.to_thread(
+                session.execute, raw, tuple(entry["values"])
             )
         except Exception as e:
             if session.in_txn:
                 session.txn_failed = True
-            _ext_error(writer, session, "42601", str(e))
+            _ext_error(writer, session, sqlstate_for(e), str(e))
             return
     if cols:
         if not entry["described"]:

@@ -188,14 +188,23 @@ class CrConn:
                     self._ro_free.append(conn)
                     self._ro_cv.notify()
 
-    def read_query(self, sql: str, params: Sequence = ()):
+    def read_query(self, sql: str, params: Sequence = (), on_conn=None):
         """Run a query on a pooled read-only connection.  Writes through
         this path fail with a sqlite 'readonly' error instead of
-        corrupting version accounting."""
+        corrupting version accounting.  ``on_conn`` (called with the
+        checked-out connection, then with None on completion) lets a
+        caller interrupt a long-running read — the PG front-end's
+        CancelRequest path."""
         with self.reader() as conn:
-            cur = conn.execute(sql, params)
-            cols = [d[0] for d in cur.description or []]
-            return cols, cur.fetchall()
+            if on_conn is not None:
+                on_conn(conn)
+            try:
+                cur = conn.execute(sql, params)
+                cols = [d[0] for d in cur.description or []]
+                return cols, cur.fetchall()
+            finally:
+                if on_conn is not None:
+                    on_conn(None)
 
     # ------------------------------------------------------------------
     # metadata

@@ -38,11 +38,15 @@ class PgClient:
         self._buf = b""
         # read until ReadyForQuery
         self.params: dict = {}
+        self.backend_key: Optional[Tuple[int, int]] = None
         for tag, payload in self._messages_until(b"Z"):
             if tag == b"S":
                 k, v = payload.split(b"\x00")[:2]
                 self.params[k.decode()] = v.decode()
+            elif tag == b"K":
+                self.backend_key = struct.unpack(">II", payload)
         self.txn_status = None
+        self.last_error_codes: List[str] = []
 
     # -- plumbing --------------------------------------------------------
 
@@ -82,6 +86,7 @@ class PgClient:
         rows: List[list] = []
         tags: List[str] = []
         errors: List[str] = []
+        self.last_error_codes = []
         for tag, payload in self._messages_until(b"Z"):
             if tag == b"T":
                 cols = self._parse_rowdesc(payload)
@@ -91,9 +96,21 @@ class PgClient:
                 tags.append(payload.rstrip(b"\x00").decode())
             elif tag == b"E":
                 errors.append(self._parse_error(payload))
+                self.last_error_codes.append(
+                    self._parse_error_fields(payload).get("C", "")
+                )
             elif tag == b"Z":
                 self.txn_status = payload.decode()
         return cols, rows, tags, errors
+
+    @staticmethod
+    def cancel_request(host: str, port: int, key: Tuple[int, int]) -> None:
+        """Fire a CancelRequest on its own connection (libpq shape)."""
+        s = socket.create_connection((host, port), timeout=10.0)
+        try:
+            s.sendall(struct.pack(">IIII", 16, 80877102, *key))
+        finally:
+            s.close()
 
     # -- extended protocol -----------------------------------------------
 
@@ -283,11 +300,17 @@ class PgClient:
 
     @staticmethod
     def _parse_error(payload: bytes) -> str:
+        return PgClient._parse_error_fields(payload).get(
+            "M", "unknown error"
+        )
+
+    @staticmethod
+    def _parse_error_fields(payload: bytes) -> dict:
         fields = {}
         for part in payload.split(b"\x00"):
             if part:
                 fields[chr(part[0])] = part[1:].decode()
-        return fields.get("M", "unknown error")
+        return fields
 
     def close(self) -> None:
         try:

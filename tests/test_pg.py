@@ -857,3 +857,161 @@ def test_pg_cte_dml_and_paren_select_in_txn(run):
             await a.stop()
 
     run(main())
+
+
+def test_pg_savepoints(run):
+    """SAVEPOINT / ROLLBACK TO / RELEASE against the buffered-write
+    transaction model: ROLLBACK TO discards writes made after the mark
+    and clears a failed state; RELEASE drops the mark; unknown names
+    carry SQLSTATE 3B001."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                c = PgClient(*a.pg_addr)
+                c.query("BEGIN")
+                c.query("INSERT INTO tests (id, text) VALUES (1, 'keep')")
+                _, _, tags, errs = c.query("SAVEPOINT sp1")
+                assert tags == ["SAVEPOINT"] and not errs
+                c.query("INSERT INTO tests (id, text) VALUES (2, 'drop')")
+                _, _, tags, errs = c.query("ROLLBACK TO SAVEPOINT sp1")
+                assert tags == ["ROLLBACK"] and not errs
+                # a failed statement aborts the txn; ROLLBACK TO heals it
+                c.query("SAVEPOINT sp2")
+                _, _, _, errs = c.query("SELECT nonsense_fn()")
+                assert errs
+                _, _, _, errs = c.query("SELECT 1")
+                assert errs and c.last_error_codes == ["25P02"]
+                _, _, tags, errs = c.query("ROLLBACK TO sp2")
+                assert not errs
+                cols, rows, _, errs = c.query("SELECT 1")
+                assert not errs and rows == [["1"]]
+                # unknown savepoint
+                _, _, _, errs = c.query("ROLLBACK TO nope")
+                assert errs and c.last_error_codes == ["3B001"]
+                c.query("ROLLBACK")  # heal the 3B001-failed txn
+                c.query("BEGIN")
+                c.query("INSERT INTO tests (id, text) VALUES (3, 'keep2')")
+                c.query("SAVEPOINT sp3")
+                _, _, tags, errs = c.query("RELEASE SAVEPOINT sp3")
+                assert tags == ["RELEASE"] and not errs
+                c.query("COMMIT")
+                c.close()
+
+            await asyncio.to_thread(drive)
+            _, rows = a.storage.read_query(
+                "SELECT id, text FROM tests ORDER BY id")
+            assert [tuple(r) for r in rows] == [(3, "keep2")]
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_sqlstate_codes(run):
+    """Errors carry the SQLSTATE a real server would send, not a
+    catch-all syntax error (sql_state.rs parity)."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                c = PgClient(*a.pg_addr)
+                cases = [
+                    ("SELECT * FROM no_such_tbl", "42P01"),
+                    ("SELECT no_such_col FROM tests", "42703"),
+                    ("SELECT no_such_fn(1)", "42883"),
+                    ("SELECT FROM WHERE", "42601"),
+                ]
+                for sql, code in cases:
+                    _, _, _, errs = c.query(sql)
+                    assert errs and c.last_error_codes == [code], (
+                        sql, c.last_error_codes)
+                # constraint violations
+                c.query("INSERT INTO tests (id, text) VALUES (1, 'x')")
+                _, _, _, errs = c.query(
+                    "INSERT INTO tests (id, text) VALUES (1, 'dup')")
+                assert errs and c.last_error_codes == ["23505"]
+                c.close()
+
+            await asyncio.to_thread(drive)
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_gucs_set_show_reset(run):
+    """SET/SHOW/RESET are real session state: a SET value round-trips
+    through SHOW, RESET restores the default, unknown parameters carry
+    42704, SHOW ALL lists."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                c = PgClient(*a.pg_addr)
+                _, rows, _, errs = c.query("SHOW server_version")
+                assert not errs and rows == [["14.9"]]
+                _, _, tags, _ = c.query("SET application_name = 'myapp'")
+                assert tags == ["SET"]
+                _, rows, _, _ = c.query("SHOW application_name")
+                assert rows == [["myapp"]]
+                c.query("SET search_path TO myschema, public")
+                _, rows, _, _ = c.query("SHOW search_path")
+                assert rows == [["myschema, public"]]
+                c.query("RESET application_name")
+                _, rows, _, _ = c.query("SHOW application_name")
+                assert rows == [[""]]
+                _, _, _, errs = c.query("SHOW no_such_guc")
+                assert errs and c.last_error_codes == ["42704"]
+                cols, rows, _, errs = c.query("SHOW ALL")
+                assert not errs and cols == ["name", "setting", "description"]
+                assert any(r[0] == "server_version" for r in rows)
+                c.close()
+
+            await asyncio.to_thread(drive)
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_cancel_request(run):
+    """A CancelRequest bearing the BackendKeyData key interrupts the
+    session's in-flight query, which fails with 57014; the session
+    survives and keeps serving."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                import threading
+                import time
+
+                c = PgClient(*a.pg_addr)
+                assert c.backend_key and c.backend_key != (0, 0)
+
+                def fire_cancel():
+                    time.sleep(0.4)
+                    PgClient.cancel_request(*a.pg_addr, c.backend_key)
+
+                t = threading.Thread(target=fire_cancel)
+                t.start()
+                # a deliberately slow query (recursive CTE spin)
+                _, _, _, errs = c.query(
+                    "WITH RECURSIVE spin(n) AS ("
+                    " SELECT 1 UNION ALL SELECT n + 1 FROM spin"
+                    " WHERE n < 300000000)"
+                    " SELECT count(*) FROM spin"
+                )
+                t.join()
+                assert errs, "query was not cancelled"
+                assert c.last_error_codes == ["57014"], c.last_error_codes
+                # session still usable
+                _, rows, _, errs = c.query("SELECT 41 + 1")
+                assert not errs and rows == [["42"]]
+                c.close()
+
+            await asyncio.to_thread(drive)
+        finally:
+            await a.stop()
+
+    run(main())
