@@ -10,12 +10,18 @@ transaction (``corro-pg/src/lib.rs:545``).
 
 Implementation notes:
 
-* SQL goes through the tokenizer-based PG→SQLite translation
-  (``agent/pgsql.py``): $N params → ?, ``::type`` casts, ``E''`` and
-  dollar-quoted strings, function/keyword mapping, comment stripping —
-  every rewrite token-aware, never inside literals or identifiers.
-  The reference does a full sqlparser→sqlite3-parser AST translation;
-  ours leans on the large shared SQL dialect plus this token pass.
+* SQL is parsed AST-FIRST by the recursive-descent statement parser
+  (``agent/pgparse.py`` — the architecture of the reference's
+  sqlparser→sqlite3-parser walk): statement class, catalog routing,
+  RETURNING names, ON CONFLICT and command tags all come from the
+  grammar, and $N order / casts / E'' strings / function mapping are
+  applied by the shared token transforms during emission.  Statements
+  outside the grammar fall back to the whole-string token pass
+  (``agent/pgsql.py``), counted by corro_pg_parse_fallbacks_total.
+* errors carry real SQLSTATEs (``agent/sqlstate.py``); SAVEPOINT /
+  ROLLBACK TO / RELEASE work against the buffered transaction model;
+  SET/SHOW/RESET are session GUCs; CancelRequest with a real
+  BackendKeyData key interrupts the in-flight query (57014).
 * the extended protocol honors Execute row limits with portal
   suspension (PortalSuspended / resume), and SSLRequest upgrades the
   stream to TLS when the agent has a cert configured (corro-pg TLS
@@ -51,6 +57,7 @@ import struct
 import threading
 from typing import Dict, List, Optional, Tuple, TYPE_CHECKING
 
+from corrosion_tpu.agent import pgparse
 from corrosion_tpu.agent.sqlstate import PgError, SQLSTATE, sqlstate_for
 
 if TYPE_CHECKING:
@@ -221,10 +228,28 @@ def _is_write(sql: str) -> bool:
     return False
 
 
+def _ast_returning_columns(raw: str, agent) -> Optional[List[str]]:
+    """RETURNING column names from the AST (grammar-grounded), or None
+    when the statement is outside the grammar / not a write / has no
+    RETURNING clause.  Used by Describe so drivers see the row shape
+    without executing."""
+    try:
+        node = pgparse.parse_statement(raw)
+    except pgparse.Unsupported:
+        return None
+    if not isinstance(node, (pgparse.Insert, pgparse.Update,
+                             pgparse.Delete)):
+        return None
+    return pgparse.returning_names(
+        node, lambda t: _star_columns(agent, t)
+    )
+
+
 def _returning_columns(tsql: str, agent) -> Optional[List[str]]:
     """Column names a write's RETURNING clause will produce, or None
-    when there is no RETURNING clause.  Token-derived (never matches
-    inside literals): each item contributes its alias, else its last
+    when there is no RETURNING clause.  Token-derived FALLBACK for
+    statements the parser does not cover (never matches inside
+    literals): each item contributes its alias, else its last
     identifier; ``*`` expands to the target table's columns."""
     from corrosion_tpu.agent.pgsql import tokenize
 
@@ -494,6 +519,20 @@ def _catalog_for(agent: "Agent"):
     return cat
 
 
+def _catalog_query(agent: "Agent", tsql: str, params) -> Tuple[list, list]:
+    """Run one SELECT against the rendered catalog under the agent's
+    catalog lock: sessions execute in worker threads, and one shared
+    sqlite connection must not see concurrent cursors (sqlite3's
+    serialized mode is a build option, not a guarantee)."""
+    lock = getattr(agent, "_pg_catalog_lock", None)
+    if lock is None:
+        lock = agent._pg_catalog_lock = threading.Lock()
+    with lock:
+        cur = _catalog_for(agent).execute(tsql, params)
+        cols = [d[0] for d in cur.description or []]
+        return cur.fetchall(), cols
+
+
 _GUC_DEFAULTS = {
     "server_version": "14.9",
     "server_encoding": "UTF8",
@@ -539,6 +578,9 @@ class _Session:
         self._cancel_lock = threading.Lock()
         self.backend_pid = 0
         self.backend_secret = 0
+        # raw sql -> parsed AST node (None = outside the grammar);
+        # bounded FIFO — prepare-once/execute-many must not re-parse
+        self._ast_cache: Dict[str, object] = {}
 
     # -- cancellation ----------------------------------------------------
 
@@ -632,37 +674,47 @@ class _Session:
         if not raw:
             return [], [], 0, ""
 
+        # AST-first: the recursive-descent parser (agent/pgparse.py)
+        # grounds classification, catalog routing, RETURNING names and
+        # command tags in grammar; statements outside its grammar fall
+        # back to the token-pass pipeline below (counted).  Parsed
+        # nodes are cached per session — prepare-once/execute-many is
+        # the extended protocol's hot path
+        if raw in self._ast_cache:
+            node = self._ast_cache[raw]
+        else:
+            node = None
+            try:
+                node = pgparse.parse_statement(raw)
+            except pgparse.Unsupported:
+                self.agent.metrics.counter(
+                    "corro_pg_parse_fallbacks_total"
+                )
+            if len(self._ast_cache) >= 256:
+                self._ast_cache.pop(next(iter(self._ast_cache)))
+            self._ast_cache[raw] = node
+        if node is not None:
+            try:
+                return self._execute_ast(node, params)
+            except pgparse.Unsupported:
+                self.agent.metrics.counter(
+                    "corro_pg_parse_fallbacks_total"
+                )
+
         canned = self._canned(raw, params)
         if canned is not None:
             return canned
 
-        tsql = translate_sql(raw)
+        tsql, order = translate_query(raw)
+        # $N -> ? is positional in ? space: remap the bound values
+        # into occurrence order (repeated/out-of-order $N refs)
+        if order:
+            params = self._remap(params, order)
         if _is_write(tsql):
-            stmt = [tsql, list(params)] if params else [tsql]
-            if self.in_txn:
-                if _returning_columns(tsql, self.agent) is not None:
-                    # writes inside BEGIN are buffered until COMMIT, so
-                    # RETURNING rows don't exist yet — failing fast
-                    # beats silently returning none (ORMs would read a
-                    # missing primary key)
-                    raise PgError(
-                        SQLSTATE["feature_not_supported"],
-                        "RETURNING inside an explicit transaction is "
-                        "not supported (writes are buffered until "
-                        "COMMIT); run the statement in autocommit",
-                    )
-                self.txn_writes.append(stmt)
-                # rowcount unknown until commit; report optimistically
-                return [], [], 1, _tag_for(tsql, 1, 0)
-            out = self.agent.execute_transaction([stmt])
-            res = out["results"][0]
-            rc = res.get("rows_affected", 0)
-            # INSERT/UPDATE/DELETE ... RETURNING (the ORM write shape):
-            # the versioned write path surfaces the produced rows
-            if "rows" in res:
-                cols, rows = res["columns"], res["rows"]
-                return cols, rows, rc, _tag_for(tsql, max(rc, len(rows)), 0)
-            return [], [], rc, _tag_for(tsql, rc, 0)
+            return self._run_write(
+                tsql, params, lambda n: _tag_for(tsql, n, 0),
+                _returning_columns(tsql, self.agent) is not None,
+            )
         # classify with leading parens stripped so a parenthesized
         # compound ("(SELECT ...) UNION ...") gets the same visibility
         # as its bare form; _is_write above already claimed CTE-led DML
@@ -687,25 +739,149 @@ class _Session:
             )
         return cols, rows, len(rows), _tag_for(tsql, -1, len(rows))
 
+    def _remap(self, params: Tuple, order: List[int]) -> Tuple:
+        if not order:
+            return ()
+        if max(order) > len(params):
+            raise PgError(
+                SQLSTATE["undefined_parameter"],
+                f"there is no parameter ${max(order)}",
+            )
+        return tuple(params[i - 1] for i in order)
+
+    def _execute_ast(self, node, params: Tuple):
+        """Execute a parsed statement: routing, classification, tags
+        and RETURNING names all come from the AST."""
+        refs = pgparse.table_refs(node)
+        # catalog routing: a qualified pg_catalog./information_schema.
+        # ref always routes; an unqualified known catalog-table ref
+        # routes unless shadowed by a user table of the same name
+        user = self._user_tables()
+        # (unqualified information_schema names deliberately do NOT
+        # route: unlike pg_catalog, that schema is not on PG's default
+        # search_path, so bare "columns" must stay a user-table ref)
+        route_catalog = any(
+            q.schema in ("pg_catalog", "information_schema")
+            or (
+                q.schema is None
+                and q.base in _CATALOG_TABLES
+                and q.base not in user
+            )
+            for q in refs
+        )
+        is_write = isinstance(
+            node, (pgparse.Insert, pgparse.Update, pgparse.Delete)
+        )
+        if route_catalog:
+            if is_write:
+                if node.table.base in _CATALOG_TABLES or node.table.schema \
+                        in ("pg_catalog", "information_schema"):
+                    raise PgError(
+                        SQLSTATE["insufficient_privilege"],
+                        "catalog tables are read-only",
+                    )
+                # a user-table write whose SOURCE reads the catalog:
+                # the catalog lives in a separate rendered db, so the
+                # two cannot join in one statement
+                raise PgError(
+                    SQLSTATE["feature_not_supported"],
+                    "mixing catalog reads into a write statement is "
+                    "not supported",
+                )
+            tsql, order = pgparse.emit(
+                node,
+                strip_schemas=(
+                    "public", "pg_catalog", "information_schema"
+                ),
+            )
+            rows, cols = _catalog_query(
+                self.agent, tsql, self._remap(params, order)
+            )
+            return cols, rows, len(rows), f"SELECT {len(rows)}"
+
+        tsql, order = pgparse.emit(node)
+        bound = self._remap(params, order)
+        if is_write:
+            tag_head = type(node).__name__.upper()
+            return self._run_write(
+                tsql, bound,
+                lambda n: (f"INSERT 0 {n}" if tag_head == "INSERT"
+                           else f"{tag_head} {n}"),
+                node.returning is not None,
+            )
+        # Select / VALUES
+        if self.in_txn and self.txn_writes:
+            cols, rows = self.agent.storage.speculative_read(
+                self.txn_writes, tsql, bound
+            )
+        else:
+            cols, rows = self.agent.storage.read_query(
+                tsql, bound, on_conn=self._track_conn
+            )
+        return cols, rows, len(rows), f"SELECT {len(rows)}"
+
+    def _run_write(self, tsql: str, bound, tag, has_returning: bool):
+        """The shared write path for BOTH pipelines (AST + fallback):
+        buffered inside BEGIN, versioned execute_transaction outside;
+        ``tag`` maps the affected-row count to the command tag."""
+        stmt = [tsql, list(bound)] if bound else [tsql]
+        if self.in_txn:
+            if has_returning:
+                # writes inside BEGIN are buffered until COMMIT, so
+                # RETURNING rows don't exist yet — failing fast beats
+                # silently returning none (ORMs would read a missing
+                # primary key)
+                raise PgError(
+                    SQLSTATE["feature_not_supported"],
+                    "RETURNING inside an explicit transaction is "
+                    "not supported (writes are buffered until "
+                    "COMMIT); run the statement in autocommit",
+                )
+            self.txn_writes.append(stmt)
+            # rowcount unknown until commit; report optimistically
+            return [], [], 1, tag(1)
+        out = self.agent.execute_transaction([stmt])
+        res = out["results"][0]
+        rc = res.get("rows_affected", 0)
+        if "rows" in res:
+            # INSERT/UPDATE/DELETE ... RETURNING (the ORM write
+            # shape): the versioned write path surfaces the rows
+            cols, rows = res["columns"], res["rows"]
+            return cols, rows, rc, tag(max(rc, len(rows)))
+        return [], [], rc, tag(rc)
+
     def _guc_statement(self, word: str, raw: str):
         """SET / RESET / SHOW against the session's GUC store (real
         session state, not a canned reply: SET is visible to later
         SHOWs, RESET restores the default, SHOW ALL lists)."""
         body = raw.split(None, 1)[1].strip() if " " in raw else ""
         if word == "SET":
-            # SET [SESSION|LOCAL] name {TO|=} value
+            # scope prefixes first, so SET LOCAL TIME ZONE etc. parse
+            body = re.sub(r"^(?:SESSION|LOCAL)\s+", "", body,
+                          flags=re.IGNORECASE)
+            up = body.upper()
+            # transaction-characteristics / role forms drivers and
+            # poolers send at setup: accepted as no-ops — the storage
+            # is single-writer READ COMMITTED with one implicit role
+            if up.startswith((
+                "TRANSACTION", "CHARACTERISTICS AS", "CONSTRAINTS",
+                "ROLE", "AUTHORIZATION",
+            )):
+                return [], [], 0, "SET"
+            m3 = re.match(r"NAMES\s+(.+)$", body, flags=re.IGNORECASE)
+            if m3:
+                self.gucs["client_encoding"] = m3.group(1).strip().strip("'")
+                return [], [], 0, "SET"
+            m2 = re.match(r"TIME\s+ZONE\s+(.+)$", body, flags=re.IGNORECASE)
+            if m2:
+                self.gucs["timezone"] = m2.group(1).strip().strip("'")
+                return [], [], 0, "SET"
+            # SET name {TO|=} value
             m = re.match(
-                r"(?:SESSION\s+|LOCAL\s+)?([A-Za-z_][\w.]*)\s*"
-                r"(?:=|\bTO\b)\s*(.+)$",
+                r"([A-Za-z_][\w.]*)\s*(?:=|\bTO\b)\s*(.+)$",
                 body, flags=re.IGNORECASE | re.DOTALL,
             )
             if not m:
-                # SET TIME ZONE 'x' / bare forms
-                m2 = re.match(r"TIME\s+ZONE\s+(.+)$", body,
-                              flags=re.IGNORECASE)
-                if m2:
-                    self.gucs["timezone"] = m2.group(1).strip().strip("'")
-                    return [], [], 0, "SET"
                 raise PgError(SQLSTATE["syntax_error"],
                               f"syntax error in SET: {raw!r}")
             name = m.group(1).lower()
@@ -733,6 +909,8 @@ class _Session:
             return [], [], 0, "RESET"
         # SHOW
         name = body.lower()
+        if name == "time zone":
+            name = "timezone"
         if name == "all":
             rows = sorted(
                 {**_GUC_DEFAULTS, **self.gucs}.items()
@@ -757,6 +935,12 @@ class _Session:
         return {t.lower() for t in self.agent.storage.tables}
 
     def _canned(self, raw: str, params: Tuple = ()):
+        """Catalog routing for the token-pass FALLBACK pipeline only —
+        statements the recursive-descent parser handles never get here
+        (their routing is AST-based in ``_execute_ast``).  The old
+        SET/SHOW regex probes are gone (real GUC statements now); this
+        residue keeps catalog queries working for shapes outside the
+        grammar."""
         low = " ".join(raw.lower().split())
         # version()/current_database()/current_schema() are real SQL
         # functions (storage.register_udfs), so they work in any
@@ -781,10 +965,11 @@ class _Session:
             # including unqualified references: pg_catalog is always on
             # a real server's search_path, so drivers routinely write
             # bare "FROM pg_database"
-            tsql = _SCHEMA_PREFIX_RE.sub("", translate_sql(raw))
-            cur = _catalog_for(self.agent).execute(tsql, params)
-            cols = [d[0] for d in cur.description or []]
-            rows = cur.fetchall()
+            t, order = translate_query(raw)
+            tsql = _SCHEMA_PREFIX_RE.sub("", t)
+            if order:
+                params = self._remap(params, order)
+            rows, cols = _catalog_query(self.agent, tsql, params)
             return cols, rows, len(rows), f"SELECT {len(rows)}"
         return None
 
@@ -1097,7 +1282,10 @@ async def _describe(writer, session: _Session, b: _Buffer) -> None:
             except Exception:
                 pass
         if tsql and _is_write(tsql):
-            ret_cols = _returning_columns(tsql, session.agent)
+            ret_cols = (
+                _ast_returning_columns(raw, session.agent)
+                or _returning_columns(tsql, session.agent)
+            )
             if ret_cols:
                 _row_description(
                     writer, ret_cols, [TEXT_OID] * len(ret_cols)
@@ -1130,8 +1318,12 @@ async def _describe(writer, session: _Session, b: _Buffer) -> None:
         # a RETURNING write's row shape is derivable from the clause
         # without executing — drivers decide their fetch path from
         # this Describe answer, so it must be RowDescription, not
-        # NoData (real PG behaves the same)
-        ret_cols = _returning_columns(tsql_w, session.agent)
+        # NoData (real PG behaves the same); grammar-derived names
+        # first, token heuristic for out-of-grammar statements
+        ret_cols = (
+            _ast_returning_columns(raw, session.agent)
+            or _returning_columns(tsql_w, session.agent)
+        )
         if ret_cols:
             _row_description(writer, ret_cols, [TEXT_OID] * len(ret_cols))
             entry["described"] = True

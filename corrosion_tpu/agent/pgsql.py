@@ -229,6 +229,16 @@ def translate_query(sql: str) -> Tuple[str, List[int]]:
         return sql, []
     out: List[str] = []
     order: List[int] = []
+    transform_tokens(tokens, out, order)
+    return "".join(out), order
+
+
+def transform_tokens(tokens: List[Tuple[str, str]], out: List[str],
+                     order: List[int]) -> None:
+    """The PG→SQLite token transforms over one token run, appending
+    text to ``out`` and $N indices to ``order``.  Shared by the whole-
+    string :func:`translate_query` and the AST emitter
+    (``agent/pgparse.py``), which applies it per expression slice."""
 
     def next_code(k: int) -> int:
         """Index of the next non-ws/comment token after k, or -1."""
@@ -324,7 +334,6 @@ def translate_query(sql: str) -> Tuple[str, List[int]]:
         else:
             out.append(text)
         i += 1
-    return "".join(out), order
 
 
 def split_statements(query: str) -> List[str]:

@@ -947,6 +947,21 @@ class Agent:
                 for stmt in statements:
                     sql, params = unpack_stmt(stmt)
                     cur = conn.execute(sql, params)
+                    head = sql.lstrip().split(None, 1)
+                    is_dml = bool(head) and head[0].upper() in (
+                        "INSERT", "UPDATE", "DELETE", "REPLACE", "WITH",
+                    )
+                    if cur.rowcount < 0 and cur.description is None \
+                            and is_dml:
+                        # sqlite3 reports -1 for INSERT..SELECT and
+                        # friends; changes() has the statement's true
+                        # direct count (triggers excluded).  DML-gated:
+                        # for DDL, changes() still holds the PREVIOUS
+                        # statement's count
+                        cur = conn.execute("SELECT changes()")
+                        n = cur.fetchone()[0]
+                        results.append({"rows_affected": n})
+                        continue
                     if cur.description is not None:
                         # RETURNING clause (ORM-style writes): surface
                         # the produced rows alongside the write result,

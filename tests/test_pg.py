@@ -1015,3 +1015,90 @@ def test_pg_cancel_request(run):
             await a.stop()
 
     run(main())
+
+
+def test_pg_orm_shaped_flows(run):
+    """The verdict's named ORM shapes, end-to-end on the wire without
+    regex probes: prepared INSERT..RETURNING with casts, upsert with
+    excluded., CTE-led DML with a correct command tag, schema-qualified
+    names, FOR UPDATE dropped."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                c = PgClient(*a.pg_addr)
+                # prepared INSERT .. RETURNING with casts
+                cols, rows, tag, err = c.prepared(
+                    "INSERT INTO tests (id, text)"
+                    " VALUES ($1::int8, $2::character varying(40))"
+                    " RETURNING id, text",
+                    (7, "cast me"),
+                )
+                assert err is None and tag == "INSERT 0 1"
+                assert rows == [["7", "cast me"]]
+                # upsert via excluded. (SQLAlchemy/ActiveRecord shape)
+                cols, rows, tag, err = c.prepared(
+                    "INSERT INTO tests (id, text) VALUES ($1, $2)"
+                    " ON CONFLICT (id) DO UPDATE SET text = excluded.text"
+                    " RETURNING id, text",
+                    (7, "upserted"),
+                )
+                assert err is None and rows == [["7", "upserted"]]
+                # CTE-led DML: proper INSERT tag (grammar, not regex)
+                _, _, tags, errs = c.query(
+                    "WITH v AS (SELECT 8 AS id)"
+                    " INSERT INTO public.tests (id, text)"
+                    " SELECT id, 'cte' FROM v")
+                assert not errs and tags == ["INSERT 0 1"]
+                # SELECT ... FOR UPDATE (row-lock clause dropped)
+                _, rows, _, errs = c.query(
+                    "SELECT id FROM tests WHERE id = 8 FOR UPDATE")
+                assert not errs and rows == [["8"]]
+                c.close()
+
+            await asyncio.to_thread(drive)
+            assert a.metrics.get_counter(
+                "corro_pg_parse_fallbacks_total") in (0.0, None)
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_driver_setup_statements(run):
+    """Driver/ORM session-setup shapes: SET TRANSACTION / SESSION
+    CHARACTERISTICS / NAMES are accepted; SHOW TIME ZONE answers; a
+    recursive CTE named like a catalog table stays a user query."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                c = PgClient(*a.pg_addr)
+                for sql in (
+                    "SET TRANSACTION ISOLATION LEVEL READ COMMITTED",
+                    "SET SESSION CHARACTERISTICS AS TRANSACTION"
+                    " ISOLATION LEVEL SERIALIZABLE",
+                    "SET NAMES 'UTF8'",
+                ):
+                    _, _, tags, errs = c.query(sql)
+                    assert not errs and tags == ["SET"], (sql, errs)
+                _, rows, _, errs = c.query("SHOW TIME ZONE")
+                assert not errs and rows == [["UTC"]]
+                # inside a txn too (SQLAlchemy fires it after BEGIN)
+                c.query("BEGIN")
+                _, _, tags, errs = c.query(
+                    "SET TRANSACTION ISOLATION LEVEL REPEATABLE READ")
+                assert not errs and tags == ["SET"]
+                c.query("COMMIT")
+                _, rows, _, errs = c.query(
+                    "WITH RECURSIVE pg_class(n) AS ("
+                    " SELECT 1 UNION ALL SELECT n + 1 FROM pg_class"
+                    " WHERE n < 3) SELECT count(*) FROM pg_class")
+                assert not errs and rows == [["3"]], (rows, errs)
+                c.close()
+
+            await asyncio.to_thread(drive)
+        finally:
+            await a.stop()
+
+    run(main())

@@ -1,0 +1,168 @@
+"""Recursive-descent PG parser + SQLite emitter (agent/pgparse.py).
+
+Pins the grammar's reach (what parses and what deliberately falls
+back), the AST queries the session layer relies on (table refs for
+catalog routing, RETURNING names, statement class), and the emitted
+SQLite SQL with $N parameter order.
+"""
+
+import pytest
+
+from corrosion_tpu.agent.pgparse import (
+    Delete,
+    Insert,
+    Select,
+    Unsupported,
+    Update,
+    emit,
+    parse_statement,
+    returning_names,
+    table_refs,
+)
+
+
+def _emit(sql, strip=("public",)):
+    return emit(parse_statement(sql), strip_schemas=strip)
+
+
+def test_roundtrip_and_param_order():
+    out, order = _emit(
+        "SELECT a, b AS x FROM t WHERE a > $2 AND b = $1 LIMIT $3")
+    assert out == (
+        "SELECT a, b AS x FROM t WHERE a > ? AND b = ? LIMIT ?"
+    )
+    assert order == [2, 1, 3]
+
+
+def test_pg_isms_translate_inside_expressions():
+    out, _ = _emit(r"SELECT x::pg_catalog.int8, E'a\nb', now() FROM t")
+    assert "::" not in out and "E'" not in out
+    assert "datetime('now')" in out
+    out, _ = _emit("SELECT a FROM t WHERE a ILIKE $1")
+    assert " LIKE " in out
+
+
+def test_statement_classes():
+    assert isinstance(parse_statement("SELECT 1"), Select)
+    assert isinstance(
+        parse_statement("WITH v AS (SELECT 1) INSERT INTO t SELECT * FROM v"),
+        Insert,
+    )
+    assert isinstance(parse_statement("UPDATE t SET a = 1"), Update)
+    assert isinstance(parse_statement("DELETE FROM t WHERE a = 1"), Delete)
+    assert isinstance(parse_statement("VALUES (1), (2)"), Select)
+
+
+def test_table_refs_reach_subqueries_and_ctes():
+    node = parse_statement(
+        "WITH c AS (SELECT * FROM cte_src) "
+        "SELECT (SELECT max(x) FROM sub1), a FROM main "
+        "JOIN j1 ON j1.id = main.id "
+        "WHERE main.x IN (SELECT y FROM sub2) "
+        "UNION SELECT b, 1 FROM other"
+    )
+    names = {".".join(q.parts) for q in table_refs(node)}
+    assert names == {"cte_src", "sub1", "main", "j1", "sub2", "other"}
+    # CTE names shadow same-named tables
+    node = parse_statement("WITH t AS (SELECT 1) SELECT * FROM t")
+    assert table_refs(node) == []
+
+
+def test_returning_names_and_star():
+    node = parse_statement(
+        "INSERT INTO t (a) VALUES (1) RETURNING id, a AS alpha, b + 1")
+    names = returning_names(node, lambda tbl: ["x", "y"])
+    assert names[:2] == ["id", "alpha"]
+    node = parse_statement("DELETE FROM t RETURNING *")
+    assert returning_names(node, lambda tbl: ["c1", "c2"]) == ["c1", "c2"]
+    assert returning_names(parse_statement("SELECT 1"), None) is None
+
+
+def test_on_conflict_shapes():
+    out, _ = _emit(
+        "INSERT INTO t (a, b) VALUES ($1, $2) "
+        "ON CONFLICT (a) DO UPDATE SET b = excluded.b WHERE t.c > 0")
+    assert "ON CONFLICT (a) DO UPDATE SET" in out
+    out, _ = _emit("INSERT INTO t (a) VALUES (1) ON CONFLICT DO NOTHING")
+    assert out.endswith("ON CONFLICT DO NOTHING")
+
+
+def test_locking_clause_dropped():
+    out, _ = _emit("SELECT a FROM t WHERE id = $1 FOR UPDATE SKIP LOCKED")
+    assert "FOR" not in out and out.endswith("?")
+
+
+def test_schema_stripping():
+    out, _ = _emit("SELECT a FROM public.t JOIN public.u ON t.x = u.x")
+    assert "public." not in out
+    out, _ = _emit(
+        "SELECT relname FROM pg_catalog.pg_class",
+        strip=("public", "pg_catalog", "information_schema"),
+    )
+    assert out == "SELECT relname FROM pg_class"
+
+
+def test_unsupported_shapes_fall_back():
+    for sql in (
+        "SELECT DISTINCT ON (a) a, b FROM t",
+        "SELECT * FROM t NATURAL JOIN u",
+        "SELECT * FROM t TABLESAMPLE BERNOULLI (10)",
+        "SELECT a FROM generate_series(1, 10)",
+        "COPY t FROM STDIN",
+        "SELECT * FROM (t JOIN u ON t.id = u.id)",
+        "DECLARE c CURSOR FOR SELECT 1",
+    ):
+        with pytest.raises(Unsupported):
+            parse_statement(sql)
+    # DELETE USING parses but the emitter refuses (no sqlite form)
+    node = parse_statement("DELETE FROM t USING u WHERE t.id = u.id")
+    with pytest.raises(Unsupported):
+        emit(node)
+
+
+def test_case_and_builtin_syntax_forms():
+    out, _ = _emit(
+        "SELECT CASE WHEN a > 1 THEN 'big' ELSE 'small' END, "
+        "count(*) FILTER (WHERE a > 0) FROM t GROUP BY b")
+    assert "CASE WHEN" in out and "END" in out
+    out, _ = _emit("SELECT a FROM t ORDER BY a DESC NULLS LAST")
+    assert "NULLS LAST" in out  # sqlite 3.30+ accepts it natively
+
+
+def test_update_from_and_compound():
+    out, _ = _emit(
+        "UPDATE t AS tt SET a = u.b FROM u WHERE u.id = tt.id "
+        "RETURNING tt.a")
+    assert out.startswith("UPDATE t AS tt SET")
+    assert " FROM u WHERE" in out
+    out, _ = _emit(
+        "SELECT a FROM t UNION ALL SELECT b FROM u "
+        "INTERSECT SELECT c FROM v ORDER BY 1 LIMIT 3")
+    assert "UNION ALL" in out and "INTERSECT" in out
+    assert out.endswith("ORDER BY 1 LIMIT 3")
+
+
+def test_upsert_after_select_source_gets_where():
+    """sqlite requires WHERE before ON CONFLICT on a SELECT source
+    (parser-ambiguity rule); the emitter injects WHERE true."""
+    out, _ = _emit(
+        "INSERT INTO t (a) SELECT a FROM u ON CONFLICT (a) DO NOTHING")
+    assert "WHERE true ON CONFLICT" in out
+    out, _ = _emit(
+        "INSERT INTO t (a) SELECT a FROM u WHERE a > 0 "
+        "ON CONFLICT (a) DO NOTHING")
+    assert "WHERE a > 0 ON CONFLICT" in out
+
+
+def test_recursive_cte_self_reference_not_a_table_ref():
+    """WITH RECURSIVE: the self-reference is the CTE, not a table —
+    a catalog-sounding name must not leak into routing refs."""
+    node = parse_statement(
+        "WITH RECURSIVE pg_class(n) AS ("
+        " SELECT 1 UNION ALL SELECT n + 1 FROM pg_class WHERE n < 5)"
+        " SELECT * FROM pg_class")
+    assert table_refs(node) == []
+    # non-recursive WITH: the body's same-named ref IS the real table
+    node = parse_statement(
+        "WITH t AS (SELECT * FROM t) SELECT * FROM t")
+    assert [q.base for q in table_refs(node)] == ["t"]
