@@ -52,6 +52,11 @@ class Metrics:
         with self._lock:
             return self._counters.get(name, {}).get(key, 0.0)
 
+    def get_counter_sum(self, name: str) -> float:
+        """Sum of a counter across ALL its label variants."""
+        with self._lock:
+            return sum(self._counters.get(name, {}).values())
+
     # -- exposition ------------------------------------------------------
 
     def render(self, extra_gauges: Iterable[Tuple[str, float, dict]] = ()) -> str:

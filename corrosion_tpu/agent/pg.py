@@ -810,6 +810,8 @@ class _Session:
                 node.returning is not None,
             )
         # Select / VALUES
+        self.agent.metrics.counter(
+            "corro_pg_statements_total", kind="read")
         if self.in_txn and self.txn_writes:
             cols, rows = self.agent.storage.speculative_read(
                 self.txn_writes, tsql, bound
@@ -824,6 +826,8 @@ class _Session:
         """The shared write path for BOTH pipelines (AST + fallback):
         buffered inside BEGIN, versioned execute_transaction outside;
         ``tag`` maps the affected-row count to the command tag."""
+        self.agent.metrics.counter(
+            "corro_pg_statements_total", kind="write")
         stmt = [tsql, list(bound)] if bound else [tsql]
         if self.in_txn:
             if has_returning:
@@ -854,6 +858,8 @@ class _Session:
         """SET / RESET / SHOW against the session's GUC store (real
         session state, not a canned reply: SET is visible to later
         SHOWs, RESET restores the default, SHOW ALL lists)."""
+        self.agent.metrics.counter(
+            "corro_pg_statements_total", kind="utility")
         body = raw.split(None, 1)[1].strip() if " " in raw else ""
         if word == "SET":
             # scope prefixes first, so SET LOCAL TIME ZONE etc. parse

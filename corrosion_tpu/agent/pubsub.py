@@ -611,6 +611,7 @@ CREATE TABLE IF NOT EXISTS pk_groups (
 
     def refresh(self, initial: bool = False) -> None:
         """Re-evaluate the whole query and emit diff events."""
+        self.manager.agent.metrics.counter("corro_subs_refresh_total")
         if self.incremental and self.agg:
             cols, rows = self.manager.agent.storage.read_query(
                 self.exec_sql
@@ -653,6 +654,7 @@ CREATE TABLE IF NOT EXISTS pk_groups (
         the join analogue of the reference's per-table temp-pk-table
         re-evaluation.  A change on a NULLABLE (left-joined) alias
         re-scopes through the anchor instead (``_delta_nullable``)."""
+        self.manager.agent.metrics.counter("corro_subs_delta_rounds_total")
         if self.agg:
             pks = table_pks.get(self.pk_items[0][0])
             if pks:

@@ -253,6 +253,8 @@ class Agent:
         for version, db_version, last_seq, ts in pending:
             self._queue_local_broadcast(version, db_version, last_seq, ts)
         for cv in pending_cvs:
+            self.metrics.counter(
+                "corro_channel_sends_total", channel="bcast")
             self._bcast_queue.put_nowait(
                 (cv, self.config.max_transmissions, 0)
             )
@@ -468,9 +470,16 @@ class Agent:
                 extra.append((name, float(os.stat(path).st_size), {}))
             except OSError:
                 pass
-        extra.append(
-            ("corro_members_alive", float(len(self.members.alive())), {})
-        )
+        extra.extend([
+            ("corro_members_alive", float(len(self.members.alive())), {}),
+            ("corro_members_suspect", float(sum(
+                1 for m in self.members.all()
+                if m.state is MemberState.SUSPECT)), {}),
+            ("corro_members_down", float(sum(
+                1 for m in self.members.all()
+                if m.state is MemberState.DOWN)), {}),
+            ("corro_members_ring0", float(len(self.members.ring0())), {}),
+        ])
         # channel/queue depths (channel.rs metered-channel parity)
         extra.append(
             ("corro_change_queue_depth", float(len(self._ingest)), {})
@@ -612,7 +621,11 @@ class Agent:
                 # anything bigger belongs on a uni-stream
                 self.metrics.counter("corro_udp_oversize_dropped_total")
                 return
-            self.metrics.counter("corro_gossip_datagrams_sent_total")
+            self.metrics.counter(
+                "corro_gossip_datagrams_sent_total",
+                kind=(msg.get("k") if msg.get("k") in _SWIM_KINDS
+                      else "other"),
+            )
             self._udp.sendto(data, tuple(addr))
 
     def _next_probe_number(self) -> int:
@@ -942,7 +955,8 @@ class Agent:
         # apply_version must not race generate_sync's locked snapshot.
         # HIGH tier: client writes ride write_priority() in the
         # reference (api/public/mod.rs:59)
-        with self.storage._lock.prio(PRIO_HIGH, "write", kind="write"):
+        with self.metrics.timed("corro_write_tx_seconds"), \
+                self.storage._lock.prio(PRIO_HIGH, "write", kind="write"):
             with self.storage.write_tx() as conn:
                 for stmt in statements:
                     sql, params = unpack_stmt(stmt)
@@ -1078,6 +1092,7 @@ class Agent:
                 self._pre_start_cvs.append(cv)
                 return
             loop = self._loop
+        self.metrics.counter("corro_channel_sends_total", channel="bcast")
         loop.call_soon_threadsafe(
             self._bcast_queue.put_nowait,
             (cv, self.config.max_transmissions, 0),
@@ -1110,6 +1125,8 @@ class Agent:
             cv = ChangeV1(actor_id=ActorId(self.actor_id), changeset=cs)
             if self.on_change is not None:
                 self.on_change(cv)
+            self.metrics.counter(
+                "corro_channel_sends_total", channel="bcast")
             self._bcast_queue.put_nowait(
                 (cv, self.config.max_transmissions, 0)
             )
@@ -1172,6 +1189,9 @@ class Agent:
                         cfg.max_transmissions - remaining + 1
                     )
                     pending.append((due, frame, cv, remaining - 1, sent_to))
+            self.metrics.counter("corro_broadcast_flushes_total")
+            self.metrics.gauge(
+                "corro_broadcast_pending_depth", float(len(pending)))
             sends = 0
             for dest, entries in by_dest.items():
                 blob = b"".join(frame for frame, _, _ in entries)
@@ -1278,6 +1298,10 @@ class Agent:
         if len(self._ingest) >= self.config.processing_queue_len:
             self._ingest.popleft()
             self.metrics.counter("corro_changes_dropped_total")
+            self.metrics.counter(
+                "corro_channel_drops_total", channel="changes")
+        self.metrics.counter(
+            "corro_channel_sends_total", channel="changes")
         self._ingest.append((cv, source))
         if source is ChangeSource.SYNC:
             n = len(cv.changeset.changes) if cv.changeset.is_full else 0
@@ -1362,6 +1386,9 @@ class Agent:
             return
         for cv, source, news in results:
             if news and source is ChangeSource.BROADCAST:
+                self.metrics.counter("corro_broadcast_rebroadcast_total")
+                self.metrics.counter(
+                    "corro_channel_sends_total", channel="bcast")
                 self._bcast_queue.put_nowait(
                     (cv, self.config.max_transmissions,
                      self._rebroadcast_hop(cv))
@@ -1448,6 +1475,9 @@ class Agent:
         )
         if (rebroadcast and news and source is ChangeSource.BROADCAST
                 and self._loop):
+            self.metrics.counter("corro_broadcast_rebroadcast_total")
+            self.metrics.counter(
+                "corro_channel_sends_total", channel="bcast")
             self._bcast_queue.put_nowait(
                 (cv, self.config.max_transmissions,
                  self._rebroadcast_hop(cv))
@@ -1710,18 +1740,31 @@ class Agent:
         with self.metrics.timed("corro_sync_client_round_seconds"), \
                 tracing.span("sync.client_round", peers=len(members)) as sp:
             self.metrics.counter("corro_trace_spans_total")
-            sessions = [
-                s
-                for s in await asyncio.gather(
-                    *(self._sync_handshake(m) for m in members),
-                    return_exceptions=True,
-                )
-                if isinstance(s, dict)
-            ]
+            attempts = await asyncio.gather(
+                *(self._sync_handshake(m) for m in members),
+                return_exceptions=True,
+            )
+            sessions = [s for s in attempts if isinstance(s, dict)]
+            self.metrics.counter(
+                "corro_sync_handshakes_total", len(attempts))
+            failed = len(attempts) - len(sessions)
+            if failed:
+                self.metrics.counter(
+                    "corro_sync_handshake_failures_total", failed)
             if not sessions:
+                self.metrics.counter("corro_sync_empty_rounds_total")
                 return 0
             try:
                 self._allocate_needs(sessions, ours)
+                for sess in sessions:
+                    for _actor, needs in sess["needs"].items():
+                        for nd in needs:
+                            self.metrics.counter(
+                                "corro_sync_needs_requested_total",
+                                kind=nd.kind if nd.kind in (
+                                    "full", "partial", "empty"
+                                ) else "other",
+                            )
             except BaseException:
                 # one malformed peer state must not leak the other sessions
                 for s in sessions:

@@ -135,7 +135,10 @@ def send(agent: "Agent", addr: Tuple[str, int], dst: foca.FocaActor,
         updates=piggyback(agent) if updates is None else updates,
     )
     data = foca.encode_datagram(d)
-    agent.metrics.counter("corro_gossip_datagrams_sent_total")
+    agent.metrics.counter(
+        "corro_gossip_datagrams_sent_total",
+        kind=foca_kind_label(message.tag),
+    )
     agent._udp.sendto(data, tuple(addr))
 
 

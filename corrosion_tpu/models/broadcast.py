@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from corrosion_tpu.models.common import partition_ok
+from corrosion_tpu.ops.merge import merge_keys, scatter_merge
 
 
 @dataclass(frozen=True)
@@ -141,7 +142,9 @@ def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
         masked = jnp.where(ok, targets, n)  # [N, K]
         new_rows = rows
         for j in range(k):
-            new_rows = new_rows.at[masked[:, j]].max(rows, mode="drop")
+            # delivery IS the CRDT join: scatter-max of the senders'
+            # packed keys into the receivers' rows (ops/merge.py)
+            new_rows = scatter_merge(new_rows, masked[:, j], rows)
         learned = jnp.any(new_rows != rows, axis=1)
         cand = None
         if hops is not None:
@@ -308,7 +311,7 @@ def _deliver_perm(rows, active, hops, key_t, key_l, params: BroadcastParams,
                 (partition_id.astype(jnp.int32) != g[:, r_width + 1])
                 & partition_active
             )
-        new_rows = jnp.maximum(
+        new_rows = merge_keys(
             new_rows, jnp.where(valid[:, None], g[:, :r_width], rows)
         )
         cand = jnp.minimum(cand, jnp.where(valid, sh, HOP_UNSET))

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from corrosion_tpu.models.broadcast import BroadcastParams
+from corrosion_tpu.ops.merge import merge_keys
 
 def _shard_map(f, mesh, in_specs, out_specs):
     """shard_map across jax versions: the promoted jax.shard_map (>=0.8,
@@ -104,7 +105,7 @@ def sharded_broadcast_step(mesh, params: BroadcastParams):
             valid = active_all[sender]
             if params.loss > 0.0:
                 valid &= ~drop[my_idx, j]
-            new_rows_l = jnp.maximum(
+            new_rows_l = merge_keys(
                 new_rows_l,
                 jnp.where(valid[:, None], rows_all[sender], rows_l),
             )
@@ -237,7 +238,7 @@ def sharded_broadcast_step_ring(mesh, params: BroadcastParams,
             ok = slot >= 0
             if params.loss > 0.0:
                 ok &= ~drop[my_idx, j]
-            new_rows_l = jnp.maximum(
+            new_rows_l = merge_keys(
                 new_rows_l,
                 jnp.where(
                     ok[:, None], recv_rows[jnp.maximum(slot, 0)], rows_l

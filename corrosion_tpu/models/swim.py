@@ -105,15 +105,18 @@ def swim_init(n_nodes: int) -> SwimState:
 
 
 @partial(jax.jit, static_argnames=("params",))
-def swim_step(state: SwimState, key, tick, params: SwimParams, alive):
+def swim_step(state: SwimState, key, tick, params: SwimParams, alive,
+              revived=None):
     """One protocol period for all N nodes at once.
 
     alive: [N] bool ground truth (the churn schedule); dead nodes never
-    ack, send, or gossip.  Returns the next SwimState.
+    ack, send, or gossip.  revived: optional [N] bool — nodes coming
+    back THIS tick, which run the rejoin announce below.  Returns the
+    next SwimState.
     """
     n = params.n_nodes
     (k_probe, k_loss1, k_loss2, k_help, k_hloss, k_gt, k_ge, k_gloss,
-     k_tu) = jax.random.split(key, 9)
+     k_tu, k_ann, k_aloss) = jax.random.split(key, 11)
     view, suspect_since, inc, msgs, update_tx = state
     view_in = view  # for end-of-tick change detection (backlog reset)
 
@@ -121,6 +124,35 @@ def swim_step(state: SwimState, key, tick, params: SwimParams, alive):
         if params.loss > 0.0:
             return jax.random.uniform(k, shape) >= params.loss
         return jnp.ones(shape, dtype=bool)
+
+    # --- rejoin announce (host boot parity) -------------------------------
+    # A reviving node does NOT wait to discover its own DOWN record via
+    # gossip/TurnUndead: it bumps its incarnation past its own last
+    # record and ANNOUNCES to one random seed member, whose merged
+    # record becomes top-freshness gossip next tick — the model twin of
+    # launch-with-bootstrap -> announce/announce_ack (swim_foca
+    # _swim_announce).  Without this path the model's rejoin ran ~1.6x
+    # the host's (CHURNDIFF r4 rejoin ratio 0.62).
+    if revived is not None:
+        rows0 = jnp.arange(n)
+        seed = rand_peers(k_ann, n, (n,))
+        inc = jnp.where(
+            revived,
+            jnp.maximum(inc, key_inc(view[rows0, rows0])) + 1,
+            inc,
+        )
+        rec = member_key(inc, ALIVE)
+        view = view.at[rows0, rows0].set(
+            jnp.where(revived, rec, view[rows0, rows0])
+        )
+        ann_ok = (
+            revived & alive & alive[seed]
+            & lossy(k_aloss, (n, 2)).all(axis=1)  # announce + ack legs
+        )
+        view = view.at[seed, rows0].max(jnp.where(ann_ok, rec, 0))
+        # msgs: the announce (if the node is up) + the ack coming back
+        msgs = msgs + revived.astype(jnp.int32)
+        msgs = msgs.at[seed].add(ann_ok.astype(jnp.int32))
 
     # --- direct probe -----------------------------------------------------
     target = rand_peers(k_probe, n, (n,))  # [N]
@@ -206,6 +238,41 @@ def swim_step(state: SwimState, key, tick, params: SwimParams, alive):
     update_tx = update_tx.at[
         jnp.arange(n)[:, None], ge
     ].add(sent_round.astype(jnp.int32))
+
+    # --- probe/ack piggyback dissemination (host parity) ------------------
+    # every ping datagram carries the prober's freshest entries and
+    # every ack carries the target's (swim_foca _piggyback rides on
+    # probe/ack exchanges); same backlog selection, same decay charges,
+    # no extra messages (the ping/ack msgs are already counted above)
+    rows2 = jnp.arange(n)
+    # ping direction: prober i -> target[i], delivered iff the ping was
+    pb_flat = jnp.where(
+        ping_ok[:, None] & sendable,
+        target[:, None] * n + ge, n * n,
+    ).reshape(-1)
+    pb_payload = view[rows2[:, None], ge]
+    view = (
+        view.reshape(-1).at[pb_flat].max(
+            pb_payload.reshape(-1), mode="drop")
+    ).reshape(n, n)
+    update_tx = update_tx.at[rows2[:, None], ge].add(
+        (sendable & alive[:, None]).astype(jnp.int32)
+    )
+    # ack direction: target[i] -> prober i, delivered iff the ack was
+    ge_t = ge[target]  # [N, M] the target's freshest entries
+    sendable_t = sendable[target]
+    ack_flat = jnp.where(
+        ack_ok[:, None] & sendable_t,
+        rows2[:, None] * n + ge_t, n * n,
+    ).reshape(-1)
+    ack_payload = view[target[:, None], ge_t]
+    view = (
+        view.reshape(-1).at[ack_flat].max(
+            ack_payload.reshape(-1), mode="drop")
+    ).reshape(n, n)
+    update_tx = update_tx.at[target[:, None], ge_t].add(
+        (ping_ok[:, None] & sendable_t).astype(jnp.int32)
+    )
 
     # --- refutation / renewal --------------------------------------------
     # a live node that sees itself non-alive in its own merged row bumps

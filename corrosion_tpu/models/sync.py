@@ -100,10 +100,14 @@ def sync_step(rows, msgs_sent, key, params: SyncParams,
     served_cells = jnp.sum(
         (peer_rows > rows[:, None, :]) & reachable[:, :, None], axis=2
     )  # [N, P] cells each peer is ahead on
-    merged = jnp.max(
-        jnp.where(reachable[:, :, None], peer_rows, rows[:, None, :]), axis=1
+    from corrosion_tpu.ops.merge import merge_cells, merge_keys
+
+    merged = merge_cells(
+        jnp.where(
+            reachable[:, :, None], peer_rows, rows[:, None, :]
+        ).swapaxes(0, 1)
     )
-    new_rows = jnp.maximum(rows, merged)
+    new_rows = merge_keys(rows, merged)
 
     chunks = -(-served_cells // params.cells_per_chunk)  # [N, P] ceil div
     msgs = session_msgs(

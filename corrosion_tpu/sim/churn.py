@@ -32,10 +32,15 @@ from corrosion_tpu.models.swim import (
 class ChurnConfig:
     n_nodes: int = 64
     params: SwimParams = None  # type: ignore[assignment]
-    kill_tick: int = 4  # when the victim dies
-    revive_tick: int = 40  # when it comes back
+    kill_tick: int = 4  # when the victim dies (offset within a cycle)
+    revive_tick: int = 40  # when it comes back (offset within a cycle)
     victim: int = 1
     max_ticks: int = 128
+    # repeated join/suspect/leave cycles (BASELINE config #2): cycle c
+    # kills victim (victim + c) % n at c*cycle_period + kill_tick and
+    # revives it at + revive_tick.  cycles=1 is the legacy single cycle.
+    cycles: int = 1
+    cycle_period: int = 64
     # bigger chunks = fewer host sync points: each chunk call pays a
     # fixed dispatch cost that dwarfs the N=64 compute, and per-tick
     # flags keep the reported latencies exact either way
@@ -55,23 +60,96 @@ class ChurnConfig:
 @partial(jax.jit, static_argnames=("cfg",))
 def _scan_chunk(state, seed_key, start_tick, cfg: ChurnConfig):
     p = cfg.params
+    n = cfg.n_nodes
 
-    def alive_at(t):
-        a = jnp.ones((cfg.n_nodes,), dtype=bool)
-        dead = (t >= cfg.kill_tick) & (t < cfg.revive_tick)
-        return a.at[cfg.victim].set(~dead)
+    def schedule(t):
+        """(alive [N], revived [N], victim scalar) at tick t."""
+        if cfg.cycles <= 1:
+            victim = jnp.int32(cfg.victim)
+            off = t
+        else:
+            cyc = jnp.minimum(t // cfg.cycle_period, cfg.cycles - 1)
+            off = t - cyc * cfg.cycle_period
+            victim = (cfg.victim + cyc) % n
+        dead = (off >= cfg.kill_tick) & (off < cfg.revive_tick)
+        alive = jnp.ones((n,), dtype=bool).at[victim].set(~dead)
+        revived = jnp.zeros((n,), dtype=bool).at[victim].set(
+            off == cfg.revive_tick
+        )
+        return alive, revived, victim
 
     def body(st, i):
         t = start_tick + i
         key = jax.random.fold_in(seed_key, t)
-        nxt = swim_step(st, key, t, p, alive_at(t))
-        others = jnp.arange(cfg.n_nodes) != cfg.victim
-        col = key_state(nxt.view[:, cfg.victim])
+        alive, revived, victim = schedule(t)
+        nxt = swim_step(st, key, t, p, alive, revived=revived)
+        others = jnp.arange(n) != victim
+        col = key_state(nxt.view[:, victim])
         detected = jnp.all(jnp.where(others, col == DOWN, True))
         rejoined = jnp.all(jnp.where(others, col == ALIVE, True))
         return nxt, (detected, rejoined)
 
     return jax.lax.scan(body, state, jnp.arange(cfg.chunk_ticks))
+
+
+def run_churn_cycles(cfg: ChurnConfig, seed: int = 0):
+    """Repeated join/suspect/leave cycles (BASELINE config #2): returns
+    per-cycle detection/rejoin latencies plus aggregates.  Latencies
+    are in ticks (= probe periods), offsets from each cycle's own
+    kill/revive tick."""
+    assert cfg.cycles >= 1
+    assert cfg.revive_tick < cfg.cycle_period
+    state = swim_init(cfg.n_nodes)
+    seed_key = jax.random.PRNGKey(seed)
+    total = cfg.cycles * cfg.cycle_period + cfg.cycle_period // 2
+    total = -(-total // cfg.chunk_ticks) * cfg.chunk_ticks
+
+    t0 = time.perf_counter()
+    det_flags, rej_flags = [], []
+    ticks = 0
+    while ticks < total:
+        state, (det, rej) = _scan_chunk(state, seed_key, ticks, cfg)
+        det_flags.append(np.asarray(det))
+        rej_flags.append(np.asarray(rej))
+        ticks += cfg.chunk_ticks
+    wall = time.perf_counter() - t0
+    det = np.concatenate(det_flags)
+    rej = np.concatenate(rej_flags)
+
+    def first_true(flags, start, end):
+        w = flags[start:end]
+        return int(w.argmax()) if w.any() else None
+
+    per_cycle = []
+    for c in range(cfg.cycles):
+        lo = c * cfg.cycle_period
+        hi = (c + 1) * cfg.cycle_period if c < cfg.cycles - 1 else ticks
+        d = first_true(det, lo + cfg.kill_tick, hi)
+        r = first_true(rej, lo + cfg.revive_tick, hi)
+        per_cycle.append({
+            "victim": (cfg.victim + c) % cfg.n_nodes,
+            "detect_latency": d,
+            "rejoin_latency": r,
+        })
+    msgs = np.asarray(state.msgs)
+    dets = [c["detect_latency"] for c in per_cycle
+            if c["detect_latency"] is not None]
+    rejs = [c["rejoin_latency"] for c in per_cycle
+            if c["rejoin_latency"] is not None]
+    return {
+        "n_nodes": cfg.n_nodes,
+        "cycles": cfg.cycles,
+        "per_cycle": per_cycle,
+        "detect_latency_mean": (
+            float(np.mean(dets)) if dets else None
+        ),
+        "rejoin_latency_mean": (
+            float(np.mean(rejs)) if rejs else None
+        ),
+        "msgs_per_node_per_tick": float(msgs.mean()) / max(ticks, 1),
+        "wall_s": wall,
+        "ticks_run": ticks,
+    }
 
 
 def run_churn(cfg: ChurnConfig, seed: int = 0):

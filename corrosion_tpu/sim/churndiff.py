@@ -33,9 +33,12 @@ async def host_churn_trace(
     probe_interval: float = 0.15,
     timeout: float = 60.0,
     base_dir: Optional[str] = None,
+    cycles: int = 1,
 ) -> Dict:
-    """Crash + rejoin cycle on N real agents; latencies in probe-period
-    units (directly comparable to model ticks)."""
+    """Repeated crash + rejoin cycles on N real agents (BASELINE
+    config #2's join/suspect/leave cycles — a different victim each
+    cycle); latencies in probe-period units (directly comparable to
+    model ticks)."""
     from corrosion_tpu.agent.members import MemberState
     from corrosion_tpu.agent.testing import (
         launch_test_agent,
@@ -71,55 +74,74 @@ async def host_churn_trace(
         # let a few probe rounds pass so the cluster is steady
         await asyncio.sleep(probe_interval * 4)
 
-        victim = agents[-1]
-        victim_actor = victim.actor_id
-        victim_dir = victim.config.db_path.rsplit("/", 1)[0]
-        survivors = agents[:-1]
+        per_cycle = []
+        for c in range(cycles):
+            vi = n - 1 - c  # a different victim each cycle
+            victim = agents[vi]
+            victim_actor = victim.actor_id
+            victim_dir = victim.config.db_path.rsplit("/", 1)[0]
+            survivors = [a for j, a in enumerate(agents) if j != vi]
 
-        t0 = time.perf_counter()
-        await victim.stop(graceful=False)  # crash
+            t0 = time.perf_counter()
+            await victim.stop(graceful=False)  # crash
 
-        def down_everywhere():
-            for a in survivors:
-                m = a.members.get(victim_actor)
-                if m is None or m.state is not MemberState.DOWN:
-                    return False
-            return True
+            def down_everywhere():
+                for a in survivors:
+                    m = a.members.get(victim_actor)
+                    if m is None or m.state is not MemberState.DOWN:
+                        return False
+                return True
 
-        await wait_for(down_everywhere, timeout=timeout, interval=0.02)
-        detect_wall = time.perf_counter() - t0
+            await wait_for(down_everywhere, timeout=timeout, interval=0.02)
+            detect_wall = time.perf_counter() - t0
 
-        # rejoin: same data dir = same identity, renewed generation
-        t1 = time.perf_counter()
-        reborn = await launch_test_agent(
-            tmpdir=victim_dir,
-            bootstrap=[
-                f"{survivors[0].gossip_addr[0]}:"
-                f"{survivors[0].gossip_addr[1]}"
-            ],
-            **common,
+            # rejoin: same data dir = same identity, renewed generation
+            t1 = time.perf_counter()
+            reborn = await launch_test_agent(
+                tmpdir=victim_dir,
+                bootstrap=[
+                    f"{survivors[0].gossip_addr[0]}:"
+                    f"{survivors[0].gossip_addr[1]}"
+                ],
+                **common,
+            )
+            agents[vi] = reborn
+            assert reborn.actor_id == victim_actor
+
+            def alive_everywhere():
+                for a in survivors:
+                    m = a.members.get(victim_actor)
+                    if m is None or m.state is not MemberState.ALIVE:
+                        return False
+                return True
+
+            await wait_for(alive_everywhere, timeout=timeout, interval=0.02)
+            rejoin_wall = time.perf_counter() - t1
+            per_cycle.append({
+                "detect_wall_s": round(detect_wall, 3),
+                "rejoin_wall_s": round(rejoin_wall, 3),
+                "detect_probe_periods": round(
+                    detect_wall / probe_interval, 1),
+                "rejoin_probe_periods": round(
+                    rejoin_wall / probe_interval, 1),
+            })
+            # settle before the next cycle
+            await asyncio.sleep(probe_interval * 2)
+
+        mean_d = sum(c["detect_probe_periods"] for c in per_cycle) / len(
+            per_cycle
         )
-        agents[-1] = reborn
-        assert reborn.actor_id == victim_actor
-
-        def alive_everywhere():
-            for a in survivors:
-                m = a.members.get(victim_actor)
-                if m is None or m.state is not MemberState.ALIVE:
-                    return False
-            return True
-
-        await wait_for(alive_everywhere, timeout=timeout, interval=0.02)
-        rejoin_wall = time.perf_counter() - t1
-
+        mean_r = sum(c["rejoin_probe_periods"] for c in per_cycle) / len(
+            per_cycle
+        )
         return {
             "runtime": "agents",
             "n_nodes": n,
+            "cycles": cycles,
             "probe_interval_s": probe_interval,
-            "detect_wall_s": round(detect_wall, 3),
-            "rejoin_wall_s": round(rejoin_wall, 3),
-            "detect_probe_periods": round(detect_wall / probe_interval, 1),
-            "rejoin_probe_periods": round(rejoin_wall / probe_interval, 1),
+            "per_cycle": per_cycle,
+            "detect_probe_periods": round(mean_d, 1),
+            "rejoin_probe_periods": round(mean_r, 1),
             "conditions": {
                 "wire": "binary foca datagrams over UDP loopback",
                 "suspicion": "scaled deadline only (floor 0)",
@@ -132,18 +154,41 @@ async def host_churn_trace(
         )
 
 
-def model_churn_trace(n: int = 64) -> Dict:
-    """The SWIM model's churn cycle under the same scaled parameters;
+def model_churn_trace(n: int = 64, cycles: int = 1) -> Dict:
+    """The SWIM model's churn cycles under the same scaled parameters;
     latencies already in ticks (= probe periods)."""
-    from corrosion_tpu.sim.churn import ChurnConfig, run_churn
+    from corrosion_tpu.sim.churn import (
+        ChurnConfig,
+        run_churn,
+        run_churn_cycles,
+    )
 
-    stats = run_churn(ChurnConfig(n_nodes=n))
+    if cycles <= 1:
+        stats = run_churn(ChurnConfig(n_nodes=n))
+        return {
+            "runtime": "tpu-sim",
+            "n_nodes": n,
+            "detect_ticks": stats["detect_latency"],
+            "rejoin_ticks": stats["rejoin_latency"],
+            "msgs_per_node_per_tick": round(
+                stats["msgs_per_node_per_tick"], 2),
+        }
+    # cycle windows sized to the scaled suspicion deadline so a cold
+    # first cycle still resolves inside its own window
+    period = 48 if n <= 64 else 72
+    stats = run_churn_cycles(ChurnConfig(
+        n_nodes=n, cycles=cycles, cycle_period=period,
+        kill_tick=4, revive_tick=period - 16,
+    ))
     return {
         "runtime": "tpu-sim",
         "n_nodes": n,
-        "detect_ticks": stats["detect_latency"],
-        "rejoin_ticks": stats["rejoin_latency"],
-        "msgs_per_node_per_tick": round(stats["msgs_per_node_per_tick"], 2),
+        "cycles": cycles,
+        "per_cycle": stats["per_cycle"],
+        "detect_ticks": stats["detect_latency_mean"],
+        "rejoin_ticks": stats["rejoin_latency_mean"],
+        "msgs_per_node_per_tick": round(
+            stats["msgs_per_node_per_tick"], 2),
     }
 
 
@@ -152,19 +197,43 @@ async def run_churndiff(
     probe_interval: float = 0.15,
     out_path: Optional[str] = None,
     base_dir: Optional[str] = None,
+    cycles: int = 1,
+    timeout: float = 60.0,
 ) -> Dict:
     host = await host_churn_trace(
-        n, probe_interval=probe_interval, base_dir=base_dir
+        n, probe_interval=probe_interval, base_dir=base_dir,
+        cycles=cycles, timeout=timeout,
     )
-    model = model_churn_trace(n)
+    model = model_churn_trace(n, cycles=cycles)
 
     def ratio(a, b):
         if a is None or b is None or not b:
             return None
         return round(a / b, 2)
 
+    # steady-state = cycles after the first: BOTH sides show a colder,
+    # slower first cycle (the update backlog is saturated with the
+    # initial membership records, crowding the victim's suspicion out
+    # of gossip selection), so cycle 0 measures backlog decay, not the
+    # detection pipeline
+    steady = {}
+    if cycles > 1 and "per_cycle" in model:
+        hd = [c["detect_probe_periods"] for c in host["per_cycle"][1:]]
+        hr = [c["rejoin_probe_periods"] for c in host["per_cycle"][1:]]
+        md = [c["detect_latency"] for c in model["per_cycle"][1:]
+              if c["detect_latency"] is not None]
+        mr = [c["rejoin_latency"] for c in model["per_cycle"][1:]
+              if c["rejoin_latency"] is not None]
+        if hd and md:
+            steady["steady_detect_ratio"] = ratio(
+                sum(hd) / len(hd), sum(md) / len(md))
+        if hr and mr:
+            steady["steady_rejoin_ratio"] = ratio(
+                sum(hr) / len(hr), sum(mr) / len(mr))
+
     result = {
         "n_nodes": n,
+        "cycles": cycles,
         "host": host,
         "model": model,
         "diff": {
@@ -174,6 +243,7 @@ async def run_churndiff(
             "rejoin_ratio_host_over_model": ratio(
                 host["rejoin_probe_periods"], model["rejoin_ticks"]
             ),
+            **steady,
             "residual_note": (
                 "with per-node suspicion timers and the periodic "
                 "gossip loop (foca periodic_gossip parity, pinned at "
@@ -182,17 +252,22 @@ async def run_churndiff(
                 "lands within a few percent of the model's tick count "
                 "(ratio ~1.0): the host's real probe-timeout chain "
                 "is roughly offset by the model's synchronous-round "
-                "pessimism.  Rejoin reads FASTER on the host (ratio "
-                "~0.5-0.6): the reborn node announces directly to a "
-                "seed member and its renewed identity rides every "
-                "outgoing datagram immediately, while the model's "
-                "refutation must first be drawn into the per-tick "
-                "gossip selection.  Building this anchor caught three "
-                "real host gaps, all fixed: ts=0 piggybacked records "
-                "dropped as stale generations, gossip-learned "
-                "suspicions never arming the local suspicion timer, "
-                "and dissemination riding only on probe/ack piggyback "
-                "with no dedicated gossip cadence"
+                "pessimism.  The round-4 rejoin residual (0.62, a "
+                "MISSING model path) is closed structurally: the "
+                "model now runs the host's announce-to-seed on "
+                "revival and the probe/ack piggyback channels "
+                "(models/swim.py), lifting the ratio to ~0.75-0.85.  "
+                "What remains is tick granularity, not protocol: the "
+                "model is round-synchronous (a record received this "
+                "tick forwards NEXT tick), while the host forwards "
+                "within the same probe period — about one tick of "
+                "store-and-forward lag on a 2-3 tick propagation.  "
+                "Building this anchor caught three real host gaps, "
+                "all fixed: ts=0 piggybacked records dropped as stale "
+                "generations, gossip-learned suspicions never arming "
+                "the local suspicion timer, and dissemination riding "
+                "only on probe/ack piggyback with no dedicated gossip "
+                "cadence"
             ),
         },
     }
@@ -200,3 +275,25 @@ async def run_churndiff(
         with open(out_path, "w") as f:
             json.dump(result, f, indent=1, allow_nan=False)
     return result
+
+
+def main() -> None:  # pragma: no cover - artifact generator
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=64)
+    ap.add_argument("--cycles", type=int, default=3)
+    ap.add_argument("--probe-interval", type=float, default=0.15)
+    ap.add_argument("--timeout", type=float, default=60.0)
+    ap.add_argument("--out", type=str, default=None)
+    args = ap.parse_args()
+    out = args.out or f"CHURNDIFF_N{args.n}.json"
+    r = asyncio.run(run_churndiff(
+        args.n, probe_interval=args.probe_interval, out_path=out,
+        cycles=args.cycles, timeout=args.timeout,
+    ))
+    print(json.dumps(r["diff"], indent=1))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -407,13 +407,15 @@ def test_periodic_gossip_spreads_without_probing(run, tmp_path):
             )
             # decay: once every entry exhausts its retransmit budget the
             # loop goes silent (skip rounds entirely)
-            sent_before = a.metrics.get_counter(
+            sent_before = a.metrics.get_counter_sum(
                 "corro_gossip_datagrams_sent_total"
             )
             await asyncio.sleep(1.0)
-            mid = a.metrics.get_counter("corro_gossip_datagrams_sent_total")
+            mid = a.metrics.get_counter_sum(
+                "corro_gossip_datagrams_sent_total")
             await asyncio.sleep(0.5)
-            late = a.metrics.get_counter("corro_gossip_datagrams_sent_total")
+            late = a.metrics.get_counter_sum(
+                "corro_gossip_datagrams_sent_total")
             assert late == mid, "quiet cluster must stop gossiping"
             assert sent_before > 0
         finally:
