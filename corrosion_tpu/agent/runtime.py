@@ -60,6 +60,7 @@ from corrosion_tpu.utils.ranges import RangeSet
 # (u32-BE LengthDelimited speedy frames).
 STREAM_UNI = b"U"
 STREAM_BI = b"B"
+STREAM_MUX = b"M"  # multiplexed uni+bi channels (agent/mux.py)
 
 
 class _SlowPeer(Exception):
@@ -127,6 +128,10 @@ class AgentConfig:
     # Calibration harnesses disable it so agents match the simulator's
     # uniform-sampling model (on loopback EVERY peer is ring0).
     ring0_enabled: bool = True
+    # one multiplexed TCP connection per peer for uni + bi channels
+    # (transport.rs single-QUIC-connection parity); off = one
+    # connection per channel class (the round-4 wiring)
+    transport_mux: bool = True
     # LRU cap on cached outbound uni connections (fd budget)
     uni_cache_size: int = 512
     # SWIM datagram format: "foca" = binary foca messages, the wire the
@@ -275,6 +280,7 @@ class Agent:
             metrics=self.metrics, on_rtt=self._record_rtt,
             max_cached=self.config.uni_cache_size,
             ssl_context=tls_client_ctx,
+            mux=self.config.transport_mux,
         )
         # one gossip port for both datagrams (SWIM) and streams, like the
         # reference's single QUIC/UDP endpoint; with an ephemeral port the
@@ -1192,8 +1198,11 @@ class Agent:
             self.metrics.counter("corro_broadcast_flushes_total")
             self.metrics.gauge(
                 "corro_broadcast_pending_depth", float(len(pending)))
-            sends = 0
-            for dest, entries in by_dest.items():
+            # destinations flush CONCURRENTLY: under the shared mux
+            # connection one peer's backpressured drain must not stall
+            # gossip to every other peer (and even on dedicated
+            # connections this overlaps the network round-trips)
+            async def send_one(dest, entries):
                 blob = b"".join(frame for frame, _, _ in entries)
                 await bucket.consume(len(blob))
                 ok = await self.transport.send_uni(
@@ -1204,9 +1213,15 @@ class Agent:
                     # peers stay eligible for retransmission
                     for _, sent_to, actor_id in entries:
                         sent_to.add(actor_id)
-                    sends += len(entries)
-                else:
-                    self.metrics.counter("corro_broadcast_send_failures_total")
+                    return len(entries)
+                self.metrics.counter("corro_broadcast_send_failures_total")
+                return 0
+
+            results = await asyncio.gather(
+                *(send_one(d, e) for d, e in by_dest.items()),
+                return_exceptions=True,
+            )
+            sends = sum(r for r in results if isinstance(r, int))
             if sends:
                 self.metrics.counter("corro_broadcast_sent_total", sends)
             dropped = _drop_most_transmitted(pending, cfg.bcast_max_pending)
@@ -1756,15 +1771,18 @@ class Agent:
                 return 0
             try:
                 self._allocate_needs(sessions, ours)
+                kind_counts: Dict[str, int] = {}
                 for sess in sessions:
                     for _actor, needs in sess["needs"].items():
                         for nd in needs:
-                            self.metrics.counter(
-                                "corro_sync_needs_requested_total",
-                                kind=nd.kind if nd.kind in (
-                                    "full", "partial", "empty"
-                                ) else "other",
-                            )
+                            k = nd.kind if nd.kind in (
+                                "full", "partial", "empty"
+                            ) else "other"
+                            kind_counts[k] = kind_counts.get(k, 0) + 1
+                for k, c in kind_counts.items():
+                    self.metrics.counter(
+                        "corro_sync_needs_requested_total", c, kind=k
+                    )
             except BaseException:
                 # one malformed peer state must not leak the other sessions
                 for s in sessions:
@@ -1859,7 +1877,6 @@ class Agent:
             return None
         try:
             tp = tracing.current_traceparent()
-            writer.write(STREAM_BI)
             writer.write(
                 speedy.frame(
                     speedy.encode_bi_payload(
@@ -2036,6 +2053,10 @@ class Agent:
                 await self._serve_uni(reader, writer)
             elif prelude == STREAM_BI:
                 await self._serve_sync(reader, writer)
+            elif prelude == STREAM_MUX:
+                from corrosion_tpu.agent.mux import serve_mux
+
+                await serve_mux(self, reader, writer)
             else:
                 writer.close()
         except asyncio.CancelledError:
@@ -2044,16 +2065,19 @@ class Agent:
         finally:
             self._conn_tasks.discard(task)
 
+    def _ingest_uni_payloads(self, payloads) -> None:
+        """Deframed uni payloads → ingest queue (shared by the
+        dedicated uni stream server and the mux demux)."""
+        for payload in payloads:
+            cv = self.decode_uni_frame(payload)
+            if cv is not None:
+                self.enqueue_change(cv, ChangeSource.BROADCAST)
+
     async def _serve_uni(self, reader, writer) -> None:
         """Long-lived inbound broadcast stream: speedy UniPayload frames
         (broadcast.rs:37-55) → ingest queue."""
         frames = speedy.FrameReader()
-
-        def ingest(payloads):
-            for payload in payloads:
-                cv = self.decode_uni_frame(payload)
-                if cv is not None:
-                    self.enqueue_change(cv, ChangeSource.BROADCAST)
+        ingest = self._ingest_uni_payloads
 
         try:
             while True:

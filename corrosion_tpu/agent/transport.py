@@ -98,10 +98,20 @@ class UniConnection:
 
 
 class Transport:
-    """Connection-caching sender.  All methods are loop-affine."""
+    """Connection-caching sender.  All methods are loop-affine.
+
+    With ``mux=True`` (default) both reliable channel classes share
+    ONE cached connection per peer (``agent/mux.py``: framed uni + bi
+    channels — the reference's single-QUIC-connection shape), and
+    peers spread over ``LANES`` hashed lanes, each with its own
+    connect semaphore (the 8-client-endpoint spread,
+    transport.rs:55-93).  ``mux=False`` keeps the round-4 wiring: a
+    cached uni connection per peer + a fresh connection per sync
+    session."""
 
     def __init__(self, metrics=None, connect_timeout: float = 2.0,
-                 on_rtt=None, max_cached: int = 512, ssl_context=None):
+                 on_rtt=None, max_cached: int = 512, ssl_context=None,
+                 mux: bool = True):
         self._uni: Dict[Addr, UniConnection] = {}
         self.metrics = metrics
         self.connect_timeout = connect_timeout
@@ -112,6 +122,14 @@ class Transport:
         # close on idle timeout; an unbounded TCP cache leaks fds in
         # large in-process clusters)
         self.max_cached = max_cached
+        self.mux = mux
+        self._muxes: Dict[Addr, "MuxConnection"] = {}
+        # per-lane connect semaphores: a connect storm to many peers
+        # fans across lanes instead of one queue
+        self._lane_sems: Optional[list] = None
+        # per-peer open lock: concurrent first sends to one peer must
+        # share ONE connection, not race N opens
+        self._open_locks: Dict[Addr, asyncio.Lock] = {}
 
     def _stat(self, addr: Addr) -> ConnStats:
         s = self.stats.get(addr)
@@ -150,10 +168,93 @@ class Transport:
         await writer.drain()
         return UniConnection(reader, writer)
 
+    # -- multiplexed path ------------------------------------------------
+
+    def _lane_sem(self, addr: Addr):
+        from corrosion_tpu.agent.mux import LANES, lane_of
+
+        if self._lane_sems is None:
+            self._lane_sems = [asyncio.Semaphore(32) for _ in range(LANES)]
+        return self._lane_sems[lane_of(addr)]
+
+    async def _get_mux(self, addr: Addr) -> "MuxConnection":
+        from corrosion_tpu.agent.mux import STREAM_MUX, MuxConnection
+
+        m = self._muxes.get(addr)
+        if m is not None and not m.closed:
+            # LRU touch
+            self._muxes.pop(addr, None)
+            self._muxes[addr] = m
+            return m
+        if len(self._open_locks) > 4 * self.max_cached:
+            self._open_locks = {
+                a: lk for a, lk in self._open_locks.items() if lk.locked()
+            }
+        open_lock = self._open_locks.setdefault(addr, asyncio.Lock())
+        async with open_lock, self._lane_sem(addr):
+            m = self._muxes.get(addr)
+            if m is not None and not m.closed:
+                return m
+            t0 = time.monotonic()
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(
+                    addr[0], addr[1], ssl=self.ssl_context
+                ),
+                timeout=self.connect_timeout,
+            )
+            rtt = time.monotonic() - t0
+            self._stat(addr).connects += 1
+            self._record_rtt_stat(addr, rtt)
+            if self.on_rtt is not None:
+                self.on_rtt(addr, rtt)
+            if self.metrics is not None:
+                self.metrics.histogram(
+                    "corro_transport_connect_seconds", rtt)
+            writer.write(STREAM_MUX)
+            await writer.drain()
+            m = MuxConnection(reader, writer, metrics=self.metrics)
+            self._muxes[addr] = m
+            excess = len(self._muxes) - self.max_cached
+            if excess > 0:
+                for old_addr in list(self._muxes):
+                    if excess <= 0:
+                        break
+                    old = self._muxes[old_addr]
+                    if old is m or old._channels:
+                        continue  # never evict one with live sessions
+                    self._muxes.pop(old_addr)
+                    old.close()
+                    excess -= 1
+            return m
+
+    def _drop_mux(self, addr: Addr) -> None:
+        m = self._muxes.pop(addr, None)
+        if m is not None:
+            m.close()
+
     async def send_uni(self, addr: Addr, frames: bytes,
                        header: bytes) -> bool:
-        """Write pre-framed bytes on the cached uni connection to addr;
+        """Write pre-framed bytes on the cached uni channel to addr;
         reopen once if the cached connection is dead."""
+        if self.mux:
+            for attempt in (0, 1):
+                try:
+                    m = await self._get_mux(addr)
+                    await m.send_uni(frames)
+                    st = self._stat(addr)
+                    st.bytes_sent += len(frames)
+                    st.frames_sent += 1
+                    return True
+                except (OSError, ConnectionError, asyncio.TimeoutError):
+                    self._drop_mux(addr)
+                    if attempt == 1:
+                        self._stat(addr).failures += 1
+                        if self.metrics is not None:
+                            self.metrics.counter(
+                                "corro_transport_uni_failures_total"
+                            )
+                        return False
+            return False
         for attempt in (0, 1):
             conn = self._uni.get(addr)
             try:
@@ -200,8 +301,20 @@ class Transport:
         return False
 
     async def open_bi(self, addr: Addr):
-        """Fresh (reader, writer) for a sync session — bi-streams are
-        per-session like the reference's open_bi."""
+        """(reader, writer) for a sync session.  Multiplexed: a fresh
+        bi CHANNEL on the peer's shared mux connection (retried once on
+        a dead cache entry); legacy: a fresh connection per session
+        like the reference's open_bi."""
+        if self.mux:
+            for attempt in (0, 1):
+                try:
+                    m = await self._get_mux(addr)
+                    return m.open_channel()
+                except (OSError, ConnectionError, asyncio.TimeoutError):
+                    self._drop_mux(addr)
+                    if attempt == 1:
+                        self._stat(addr).failures += 1
+                        raise
         t0 = time.monotonic()
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(
@@ -214,11 +327,15 @@ class Transport:
         self._record_rtt_stat(addr, rtt)
         if self.on_rtt is not None:
             self.on_rtt(addr, rtt)
+        writer.write(b"B")  # STREAM_BI prelude (runtime dispatch)
         return reader, writer
 
     async def aclose(self) -> None:
         """Graceful close: waits for cached connections to fully close so
         no worker touches a half-torn-down socket during agent stop."""
+        for m in list(self._muxes.values()):
+            m.close()
+        self._muxes.clear()
         conns = list(self._uni.values())
         self._uni.clear()
         for conn in conns:
@@ -239,8 +356,12 @@ class Transport:
         conn = self._uni.pop(addr, None)
         if conn is not None:
             conn.close()
+        self._drop_mux(addr)
 
     def close(self) -> None:
         for conn in self._uni.values():
             conn.close()
         self._uni.clear()
+        for m in self._muxes.values():
+            m.close()
+        self._muxes.clear()
