@@ -80,12 +80,22 @@ def _backlog(reader: asyncio.StreamReader) -> int:
     return len(buf)
 
 
+# same invariant as the legacy speedy path (speedy.py MAX_FRAME_LEN):
+# a hostile length prefix must not become an unbounded allocation
+MAX_MUX_FRAME = 8 * 1024 * 1024
+
+
 async def read_frames(reader: asyncio.StreamReader):
     """The one frame grammar for both sides: yields
-    (class, channel, payload) until EOF/connection loss."""
+    (class, channel, payload) until EOF/connection loss.  A frame
+    claiming more than MAX_MUX_FRAME tears the connection down."""
     while True:
         hdr = await reader.readexactly(_HDR.size)
         cls, ch, ln = _HDR.unpack(hdr)
+        if ln > MAX_MUX_FRAME:
+            raise ConnectionResetError(
+                f"mux frame length {ln} exceeds cap"
+            )
         payload = await reader.readexactly(ln) if ln else b""
         yield cls, ch, payload
 

@@ -161,9 +161,14 @@ def from_items_ex(nsql: str):
     if "(" in clause:
         return None, None  # subquery or function in FROM
     masked = _mask_strings(clause)
-    if any(w in _OUTER_DISQUALIFY
-           for w in re.findall(r"[A-Za-z_]+", masked.upper())):
-        return None, None  # RIGHT/FULL: anchor property broken
+    for w in re.findall(r"[A-Za-z_]+", masked.upper()):
+        if w in _OUTER_DISQUALIFY:
+            return None, None  # RIGHT/FULL: anchor property broken
+        if w in ("NATURAL", "USING"):
+            # join forms whose columns the splitter doesn't model —
+            # without this, "t NATURAL JOIN u" would parse as table t
+            # aliased NATURAL
+            return None, None
     conns = list(_CONN_RE.finditer(masked))
     # item segments live between consecutive connectors
     bounds = []

@@ -23,6 +23,8 @@ functions so QUIC/mTLS or a native codec can replace them.
 
 from __future__ import annotations
 
+import logging
+
 import asyncio
 import os
 import random
@@ -58,6 +60,8 @@ from corrosion_tpu.utils.ranges import RangeSet
 # TCP stream preludes: one byte standing in for QUIC's uni/bi stream
 # types; every byte after it is exactly the reference's stream content
 # (u32-BE LengthDelimited speedy frames).
+logger = logging.getLogger("corrosion_tpu.agent")
+
 STREAM_UNI = b"U"
 STREAM_BI = b"B"
 STREAM_MUX = b"M"  # multiplexed uni+bi channels (agent/mux.py)
@@ -1221,7 +1225,16 @@ class Agent:
                 *(send_one(d, e) for d, e in by_dest.items()),
                 return_exceptions=True,
             )
-            sends = sum(r for r in results if isinstance(r, int))
+            sends = 0
+            for r in results:
+                if isinstance(r, int):
+                    sends += r
+                elif isinstance(r, BaseException):
+                    # an unexpected send-path error must be VISIBLE,
+                    # not filtered out by the gather
+                    self.metrics.counter(
+                        "corro_broadcast_send_failures_total")
+                    logger.warning("broadcast send failed: %r", r)
             if sends:
                 self.metrics.counter("corro_broadcast_sent_total", sends)
             dropped = _drop_most_transmitted(pending, cfg.bcast_max_pending)

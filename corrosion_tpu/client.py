@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -20,6 +21,57 @@ class ClientError(Exception):
     def __init__(self, status: int, message: str):
         super().__init__(f"HTTP {status}: {message}")
         self.status = status
+
+
+class _ConnPool:
+    """Keep-alive HTTP/1.1 connection pool for the request/response
+    calls (the stand-in for the reference's pooled hyper client,
+    ``corro-client/src/lib.rs:51-98``): repeated queries/transactions
+    reuse a warm TCP connection instead of a fresh handshake per call.
+    Streams (subscriptions) hold their connection open and bypass the
+    pool."""
+
+    def __init__(self, host: str, port: int, timeout: float,
+                 size: int = 4):
+        self.host, self.port, self.timeout = host, port, timeout
+        self.size = size
+        self._free: List[http.client.HTTPConnection] = []
+        self._lock = threading.Lock()
+
+    def acquire(self) -> Tuple[http.client.HTTPConnection, bool]:
+        """(connection, was_pooled) — was_pooled means a stale
+        keep-alive is possible and the caller should retry once on a
+        transport error."""
+        with self._lock:
+            if self._free:
+                return self._free.pop(), True
+        return (
+            http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout
+            ),
+            False,
+        )
+
+    def release(self, conn: http.client.HTTPConnection,
+                reusable: bool) -> None:
+        if reusable:
+            with self._lock:
+                if len(self._free) < self.size:
+                    self._free.append(conn)
+                    return
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            conns, self._free = self._free, []
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
 
 
 class SubscriptionStream:
@@ -78,21 +130,68 @@ class CorrosionApiClient:
         self.base = f"http://{addr[0]}:{addr[1]}"
         self.token = token
         self.timeout = timeout
+        self._pool = _ConnPool(addr[0], int(addr[1]), timeout)
+
+    def close(self) -> None:
+        self._pool.close()
 
     # -- plumbing --------------------------------------------------------
 
+    def _headers(self) -> dict:
+        h = {"Content-Type": "application/json"}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        return h
+
     def _request(self, path: str, body=None, method: Optional[str] = None,
                  stream: bool = False):
+        if stream:
+            return self._request_stream(path, body, method)
+        data = json.dumps(body).encode() if body is not None else None
+        meth = method or ("POST" if body is not None else "GET")
+        # one retry for IDEMPOTENT requests only: a pooled keep-alive
+        # connection the server closed between calls fails at request
+        # time.  A POST (e.g. /v1/transactions) is NEVER re-sent — the
+        # request may have been applied before the connection died and
+        # a retry would double-apply (the same rule _with_failover
+        # documents); POSTs take a fresh connection instead
+        idempotent = meth in ("GET", "HEAD")
+        for attempt in (0, 1):
+            conn, was_pooled = self._pool.acquire()
+            try:
+                conn.request(meth, path, body=data,
+                             headers=self._headers())
+                resp = conn.getresponse()
+                payload = resp.read()
+                reusable = not resp.will_close
+            except (http.client.HTTPException, OSError) as e:
+                self._pool.release(conn, reusable=False)
+                if was_pooled and attempt == 0 and idempotent:
+                    continue  # stale keep-alive: one fresh retry
+                raise ClientError(
+                    0, f"cannot reach {self.base}: {e}"
+                ) from None
+            self._pool.release(conn, reusable)
+            if resp.status >= 400:
+                detail = payload.decode(errors="replace")
+                try:
+                    detail = json.loads(detail).get("error", detail)
+                except (ValueError, AttributeError):
+                    pass
+                raise ClientError(resp.status, detail)
+            return json.loads(payload or b"null")
+
+    def _request_stream(self, path: str, body=None,
+                        method: Optional[str] = None):
         req = urllib.request.Request(
             self.base + path,
             data=json.dumps(body).encode() if body is not None else None,
             method=method or ("POST" if body is not None else "GET"),
         )
-        req.add_header("Content-Type", "application/json")
-        if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
+        for k, v in self._headers().items():
+            req.add_header(k, v)
         try:
-            resp = urllib.request.urlopen(req, timeout=self.timeout)
+            return urllib.request.urlopen(req, timeout=self.timeout)
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
             try:
@@ -102,10 +201,6 @@ class CorrosionApiClient:
             raise ClientError(e.code, detail) from None
         except urllib.error.URLError as e:
             raise ClientError(0, f"cannot reach {self.base}: {e.reason}") from None
-        if stream:
-            return resp
-        with resp:
-            return json.loads(resp.read() or b"null")
 
     # -- API -------------------------------------------------------------
 
@@ -195,6 +290,8 @@ class PooledApiClient:
         self._bad: set = set()
         self._pick = 0
         self._resolved_at = 0.0
+        # addr -> cached client (keep-alive pools survive across calls)
+        self._clients: dict = {}
 
     def _dns_resolve(self, host: str) -> List[str]:
         import socket
@@ -218,14 +315,27 @@ class PooledApiClient:
         """The client for the currently-picked healthy address.
         ``_addresses()`` re-resolves (and clears the bad set) whenever
         every known address has been marked bad, so the scan below
-        always finds a usable one."""
+        always finds a usable one.  Clients are CACHED per address so
+        their keep-alive pools actually get reused across calls (a
+        fresh client per call would open a fresh connection every
+        time)."""
         addrs = self._addresses()
         for _ in range(len(addrs)):
             addr = addrs[self._pick % len(addrs)]
             if addr not in self._bad:
-                return CorrosionApiClient(
-                    (addr, self.port), token=self.token, timeout=self.timeout
-                )
+                cached = self._clients.get(addr)
+                if cached is None:
+                    cached = CorrosionApiClient(
+                        (addr, self.port), token=self.token,
+                        timeout=self.timeout,
+                    )
+                    self._clients[addr] = cached
+                    if len(self._clients) > 16:
+                        # evict the oldest cached client (FIFO)
+                        old = next(iter(self._clients))
+                        if old != addr:
+                            self._clients.pop(old).close()
+                return cached
             self._pick += 1
         raise AssertionError("unreachable: _addresses() clears full bad sets")
 

@@ -972,3 +972,48 @@ def test_idle_subscription_gc(run, monkeypatch):
             await a.stop()
 
     run(main())
+
+
+def test_from_items_parser_envelope():
+    """Pin the from-clause parser's reach (r4 weak #7: the envelope
+    was untested): quoted identifiers and literals containing
+    keywords must parse; genuinely out-of-scope shapes return None
+    (costing only the optimization, never correctness)."""
+    from corrosion_tpu.agent.pubsub import from_items, from_items_ex
+
+    # plain / aliased / comma / inner / left variants
+    assert from_items("SELECT * FROM t") == [("t", "t", False)]
+    assert from_items("SELECT * FROM t AS a JOIN u b ON a.x = b.x") == [
+        ("t", "a", False), ("u", "b", False)
+    ]
+    assert from_items("SELECT * FROM t, u") == [
+        ("t", "t", False), ("u", "u", False)
+    ]
+    assert from_items(
+        "SELECT * FROM t LEFT OUTER JOIN u ON t.x = u.x"
+    ) == [("t", "t", False), ("u", "u", True)]
+    # quoted identifiers parse (quotes stripped into the item name)
+    assert from_items('SELECT * FROM "t" JOIN "u" ON "t".x = "u".x') == [
+        ("t", "t", False), ("u", "u", False)
+    ]
+    # a string literal containing keywords must not derail the scan
+    items = from_items(
+        "SELECT * FROM t JOIN u ON u.tag = 'LEFT JOIN v ON' "
+        "WHERE t.id = u.id"
+    )
+    assert items == [("t", "t", False), ("u", "u", False)]
+    # connector spans point at the real connectors
+    items, spans = from_items_ex(
+        "SELECT * FROM t LEFT JOIN u ON t.x = u.x"
+    )
+    assert spans[0] is None
+    s, e = spans[1]
+    assert "LEFT JOIN" in "SELECT * FROM t LEFT JOIN u ON t.x = u.x"[s:e]
+    # out-of-scope shapes: None, not garbage
+    for sql in (
+        "SELECT * FROM (SELECT 1)",
+        "SELECT * FROM t NATURAL JOIN u",
+        "SELECT * FROM t RIGHT JOIN u ON t.x = u.x",
+        "SELECT 1",
+    ):
+        assert from_items(sql) is None, sql
