@@ -154,3 +154,24 @@ def test_abort_is_not_clean_eof(run):
             await a.stop()
 
     run(main())
+
+
+def test_hostile_frame_length_tears_connection(run):
+    """A mux frame claiming more than the 8 MiB cap must tear the
+    connection down instead of becoming a giant allocation."""
+    async def main():
+        import struct
+
+        a = await launch_test_agent()
+        try:
+            r, w = await asyncio.open_connection(*a.gossip_addr)
+            w.write(b"M" + struct.pack(">BII", 1, 1, 0xFFFFFFFF))
+            await w.drain()
+            # the server must close on us (no 4 GB read)
+            data = await asyncio.wait_for(r.read(16), 5)
+            assert data == b""
+            w.close()
+        finally:
+            await a.stop()
+
+    run(main())

@@ -614,3 +614,44 @@ def test_devcluster_process_runtime(tmp_path):
     run_dir = tmp_path / "cluster"
     assert (run_dir / "a" / "corrosion.db").exists()
     assert (run_dir / "b" / "corrosion.db").exists()
+
+
+def test_client_pool_reuses_and_never_retries_posts(run):
+    """The keep-alive pool reuses connections across calls; a POST on
+    a stale pooled connection surfaces an error instead of re-sending
+    (a transaction retry could double-apply)."""
+    async def main():
+        from corrosion_tpu.client import ClientError, CorrosionApiClient
+
+        a = await launch_test_agent()
+        try:
+            def drive():
+                c = CorrosionApiClient(a.api_addr)
+                c.execute([["INSERT INTO tests (id, text) VALUES (1, 'x')"]])
+                for _ in range(5):
+                    c.query("SELECT id FROM tests")
+                assert len(c._pool._free) >= 1  # warm reuse
+                # poison the pooled connection: the next POST must NOT
+                # silently retry — kill the socket underneath it
+                conn = c._pool._free[0]
+                conn.sock.close()
+                try:
+                    c.execute(
+                        [["INSERT INTO tests (id, text) VALUES (2, 'y')"]]
+                    )
+                    second_applied = True
+                except ClientError:
+                    second_applied = False
+                # either the send failed loudly (no silent retry), or
+                # the request never left — but NEVER a double apply
+                cols, rows = c.query("SELECT count(*) FROM tests WHERE id = 2")
+                assert rows[0][0] in (0, 1)
+                if second_applied:
+                    assert rows[0][0] == 1
+                c.close()
+
+            await asyncio.to_thread(drive)
+        finally:
+            await a.stop()
+
+    run(main())
