@@ -167,3 +167,15 @@ def test_bitmatch_detects_sync_skew(tmp_path):
     sim_trace = det_sim_epidemic(skewed, origin=0)
     d = diff_det_traces(sim_trace, agents_trace)
     assert not d["match"]
+
+
+def test_bitmatch_headline_single_sync_peer(tmp_path):
+    """The benchmarked kernel syncs with ONE peer per round
+    (sync_peers=1); the bit-match holds at that exact shape too, not
+    only at the agent default of 3."""
+    r = run_bitmatch(
+        32, writes=1, seed=4, loss=0.05, ring0_size=8, sync_interval=8,
+        sync_peers=1, base_dir=str(tmp_path),
+    )
+    assert r["bitmatch"], r
+    assert r["per_write"][0]["converged_tick_agents"] is not None
