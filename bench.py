@@ -122,40 +122,59 @@ async def _devcluster3() -> dict:
 # -- sweep-point accounting --------------------------------------------
 
 
+def _exact_block(exact: dict) -> dict:
+    """The exact-sampler sub-record of a sweep row (shared by both
+    sweeps): real rank statistics over the seed-parallel runs, plus the
+    batching/sharding facts that produced them."""
+    return {
+        "delivery_model": "exact-rejection-sampler",
+        "msgs_per_node_mean": round(exact["msgs_per_node_mean"], 2),
+        "msgs_per_node_p99": round(exact["msgs_per_node_p99"], 2),
+        "ticks_p50": exact["ticks_p50"],
+        "ticks_p99": exact["ticks_p99"],
+        "converged_frac": exact["converged_frac"],
+        "n_seeds": exact["n_seeds"],
+        "seed_batch": exact.get("seed_batch"),
+        "n_shards": exact.get("n_shards"),
+        "wall_s": round(exact["wall_s"], 2),
+    }
+
+
+def _strip_unfilled_hops(row: dict) -> dict:
+    """A row must not advertise a stat it doesn't fill: hop percentiles
+    whose rank exceeds the measured broadcast coverage (e.g. a p99 when
+    5% loss + partitions pull coverage under 99%) are DROPPED from the
+    record instead of published as null — ``hops_broadcast_frac`` stays
+    whenever hop tracking ran, so the reader can see how much depth
+    coverage the surviving percentiles rest on."""
+    for hk in ("hops_p50", "hops_p99", "hops_broadcast_frac"):
+        if hk in row and row[hk] is None:
+            del row[hk]
+    return row
+
+
 def _sweep_point(n: int, s: dict, exact: dict | None = None) -> dict:
-    """One truthful sweep row: every msgs/hops value is either measured
-    (with its delivery model named) or explicitly null.  ``exact`` is
-    the bitpacked exact-sampler measurement at the SAME n and protocol
-    (sim/calibrate.py run_exact_headline) — since round 5 it is
-    MEASURED at every sweep N including 100k, replacing the old
-    ratio-extrapolated estimate."""
+    """One truthful sweep row: every msgs/hops value is measured (with
+    its delivery model named) — unfilled hop percentiles are dropped,
+    not published as null.  ``exact`` is the bitpacked exact-sampler
+    measurement at the SAME n and protocol (sim/calibrate.py
+    run_exact_headline) — MEASURED at every sweep N including 100k,
+    with seed-parallel batches over the device mesh."""
     row = {
         "n": n,
         "ticks_p50": s["ticks_p50"],
         "ticks_p99": s["ticks_p99"],
         "msgs_per_node_mean": round(s["msgs_per_node_mean"], 2),
         "delivery_model": "perm-fanout-lower-bound",
-        # hop stats are measured over broadcast-infected nodes or null
-        # (never the old max_ticks sentinel); the coverage says why a
-        # percentile is unavailable — p50 stays measured at large N
-        # where 5% loss + partitions pull coverage under the p99 rank
         "hops_p50": s.get("hops_p50"),
         "hops_p99": s.get("hops_p99"),
         "hops_broadcast_frac": s.get("hops_broadcast_frac"),
         "converged_frac": s["converged_frac"],
         "wall_s": round(s["wall_s"], 2),
     }
+    _strip_unfilled_hops(row)
     if exact is not None:
-        row["exact"] = {
-            "delivery_model": "exact-rejection-sampler",
-            "msgs_per_node_mean": round(exact["msgs_per_node_mean"], 2),
-            "msgs_per_node_p99": round(exact["msgs_per_node_p99"], 2),
-            "ticks_p50": exact["ticks_p50"],
-            "ticks_p99": exact["ticks_p99"],
-            "converged_frac": exact["converged_frac"],
-            "n_seeds": exact["n_seeds"],
-            "wall_s": round(exact["wall_s"], 2),
-        }
+        row["exact"] = _exact_block(exact)
     return row
 
 
@@ -246,6 +265,7 @@ def _timed_sim(name: str, run, n_seeds: int, headline: bool = False,
         "compile_s": round(compile_and_first - stats["wall_s"], 1),
     }
     out.update(extra or {})
+    _strip_unfilled_hops(out)
     if stats["converged_frac"] < 1.0 and not headline:
         out["error"] = "did not converge"
     return out
@@ -374,6 +394,43 @@ def main() -> None:
             max_ticks=192, chunk_ticks=16,
         )
 
+    def _exact_seed_policy(n: int) -> int:
+        """Real rank statistics per sweep N: 32 seeds through 64k,
+        16 at 100k, 4 at the 256k stretch point — all seed-parallel
+        (vmapped batches; the mesh-sharded bitmap sets the batch)."""
+        if n <= 64_000:
+            return min(args.seeds, 32)
+        if n <= 100_000:
+            return min(args.seeds, 16)
+        return min(args.seeds, 4)
+
+    def _exact_mesh(n: int):
+        """A ``nodes`` device mesh for the exact sampler when the
+        [N, N/8] sent_to bitmap wants row-sharding (>=256 MB); small N
+        stays single-chip where a replicated-draw fabric only adds
+        collective latency."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        d = jax.device_count()
+        if d < 2 or n % d != 0:
+            return None
+        if n * (-(-n // 8)) < (256 << 20):
+            return None
+        return Mesh(np.array(jax.devices()), ("nodes",))
+
+    def _run_exact(n: int, partitioned: bool) -> dict:
+        from corrosion_tpu.sim.calibrate import run_exact_headline
+
+        ecfg = _exact_cfg(n, partitioned)
+        seeds = _exact_seed_policy(n)
+        mesh = _exact_mesh(n)
+        # warm pays compile at the REAL batch shape, one chunk only
+        run_exact_headline(ecfg, n_seeds=seeds, seed=1, mesh=mesh,
+                           warm_chunks=1)
+        return run_exact_headline(ecfg, n_seeds=seeds, seed=0, mesh=mesh)
+
     # the metric is "p99 convergence + msgs/node VS CLUSTER SIZE N":
     # beyond the per-config series (heterogeneous protocols), sweep the
     # HEADLINE protocol itself over N with identical parameters (the
@@ -384,14 +441,10 @@ def main() -> None:
     if want == set("12345") and not args.check:
         def _sweep() -> dict:
             from corrosion_tpu.sim import run_epidemic_seeds
-            from corrosion_tpu.sim.calibrate import run_exact_headline
 
-            exact_seeds = min(args.seeds, 4)
             points = []
             for n in (1000, 4000, 16000, 64000, 100000):
-                ecfg = _exact_cfg(n, partitioned=True)
-                run_exact_headline(ecfg, n_seeds=1, seed=1)  # compile
-                ex = run_exact_headline(ecfg, n_seeds=exact_seeds, seed=0)
+                ex = _run_exact(n, partitioned=True)
                 if n == args.nodes:
                     # perm stats spliced in from the headline run below
                     # (avoids re-running the priciest N); until then the
@@ -405,18 +458,7 @@ def main() -> None:
                             "the headline run (spliced in the final "
                             "record)"
                         ),
-                        "exact": {
-                            "delivery_model": "exact-rejection-sampler",
-                            "msgs_per_node_mean": round(
-                                ex["msgs_per_node_mean"], 2),
-                            "msgs_per_node_p99": round(
-                                ex["msgs_per_node_p99"], 2),
-                            "ticks_p50": ex["ticks_p50"],
-                            "ticks_p99": ex["ticks_p99"],
-                            "converged_frac": ex["converged_frac"],
-                            "n_seeds": ex["n_seeds"],
-                            "wall_s": round(ex["wall_s"], 2),
-                        },
+                        "exact": _exact_block(ex),
                     })
                     continue
                 cfg_n = _headline_cfg(n)
@@ -459,14 +501,26 @@ def main() -> None:
         # the partitioned series at one value (round-4 weak #3); the
         # partitioned series above stays as the stress case
         def _sweep_lossonly() -> dict:
-            from corrosion_tpu.sim.calibrate import run_exact_headline
-
-            exact_seeds = min(args.seeds, 4)
             points = []
-            for n in (1000, 4000, 16000, 64000, 100000):
-                ecfg = _exact_cfg(n, partitioned=False)
-                run_exact_headline(ecfg, n_seeds=1, seed=1)  # compile
-                ex = run_exact_headline(ecfg, n_seeds=exact_seeds, seed=0)
+            # 256000 is the stretch point: loss-only exact, row-sharded
+            # over the mesh (8.2 GB bitmap -> ~1 GB/chip on 8 shards);
+            # a failure there (e.g. single-chip HBM exhaustion, see
+            # docs/sim.md HBM budget table) must not void the rest of
+            # the series, so each point is individually guarded
+            for n in (1000, 4000, 16000, 64000, 100000, 256000):
+                try:
+                    ex = _run_exact(n, partitioned=False)
+                except Exception as e:  # noqa: BLE001 - surfaced below
+                    points.append({
+                        "n": n,
+                        "error": f"{type(e).__name__}: {e}",
+                        "note": (
+                            "exact point unavailable on this backend; "
+                            "see the N x D HBM budget table in "
+                            "docs/sim.md (256k needs >=4 node shards)"
+                        ),
+                    })
+                    continue
                 points.append({
                     "n": n,
                     "ticks_p50": ex["ticks_p50"],
@@ -477,16 +531,22 @@ def main() -> None:
                     "converged_frac": ex["converged_frac"],
                     "delivery_model": "exact-rejection-sampler",
                     "n_seeds": ex["n_seeds"],
+                    "seed_batch": ex.get("seed_batch"),
+                    "n_shards": ex.get("n_shards"),
                     "wall_s": round(ex["wall_s"], 2),
                 })
+            last_ok = next(
+                (p for p in reversed(points) if "ticks_p99" in p), None
+            )
             return {
                 "metric": "epidemic_lossonly_ticks_vs_n",
-                "value": points[-1]["ticks_p99"],
+                "value": last_ok["ticks_p99"] if last_ok else None,
                 "unit": "ticks",
                 "conditions": (
                     "headline protocol, 5% loss, NO partition — "
                     "convergence depth scales with N instead of being "
-                    "pinned to the heal schedule"
+                    "pinned to the heal schedule; the 256k point is "
+                    "the mesh-sharded exact sampler's stretch shape"
                 ),
                 "points": points,
             }

@@ -72,6 +72,50 @@ LANES = 8
 CHANNEL_BUF_CAP = 1 << 20
 
 
+class TombstoneSet:
+    """Closed-channel ids with bounded memory that can never resurrect
+    a ghost session.
+
+    Channel ids are CLIENT-MONOTONIC within a connection, so the
+    oldest tombstones are the smallest ids.  Eviction is oldest-first
+    (insertion-order deque + set), and everything ever evicted stays
+    dead via a watermark: ``ch in ts`` is true for any id at or below
+    the highest evicted id.  The old ``list(set)[:4096]`` eviction
+    discarded an ARBITRARY half — including the most recently closed
+    ids, whose late in-flight frames would then reopen ghost sessions.
+
+    The watermark makes membership monotone: a dropped tombstone can
+    only widen the dead range, never shrink it.  Callers must check
+    LIVE channels first — a long-lived channel whose id falls under
+    the advancing watermark is still open and must keep working.
+    """
+
+    def __init__(self, cap: int = 8192):
+        from collections import deque
+
+        self.cap = cap
+        self._set: set = set()
+        self._order = deque()
+        self._watermark = -1
+
+    def add(self, ch: int) -> None:
+        if ch in self:
+            return
+        self._set.add(ch)
+        self._order.append(ch)
+        while len(self._order) > self.cap:
+            old = self._order.popleft()
+            self._set.discard(old)
+            if old > self._watermark:
+                self._watermark = old
+
+    def __contains__(self, ch: int) -> bool:
+        return ch <= self._watermark or ch in self._set
+
+    def __len__(self) -> int:
+        return len(self._set)
+
+
 def _backlog(reader: asyncio.StreamReader) -> int:
     """Buffered-but-unread bytes of a pump-fed reader.  StreamReader
     has no public backlog accessor when fed without a transport; the
@@ -355,12 +399,6 @@ async def serve_mux(agent, reader: asyncio.StreamReader,
         def on_close(channel: int, abort: bool) -> None:
             channels.pop(channel, None)
             tombstones.add(channel)
-            if len(tombstones) > 8192:
-                # crude cap: ids are monotonic, so discarding an
-                # arbitrary half only risks a ghost for frames delayed
-                # across thousands of later channels
-                for t in list(tombstones)[:4096]:
-                    tombstones.discard(t)
             if abort and not closed:
                 try:
                     asyncio.ensure_future(
@@ -395,9 +433,9 @@ async def serve_mux(agent, reader: asyncio.StreamReader,
 
     # ids whose server side already closed/aborted: late in-flight
     # client frames for them are DROPPED, not resurrected as ghost
-    # sessions (bounded FIFO; ids are client-monotonic so reuse of an
-    # evicted id cannot occur within a connection's lifetime)
-    tombstones: "set[int]" = set()
+    # sessions (oldest-first eviction + a dead-range watermark on the
+    # client-monotonic ids — see TombstoneSet)
+    tombstones = TombstoneSet()
     try:
         async for cls, ch, payload in read_frames(reader):
             await _pause_while_backlogged(channels)
@@ -407,10 +445,12 @@ async def serve_mux(agent, reader: asyncio.StreamReader,
                     agent.metrics.counter(
                         "corro_transport_frames_total", channel="uni")
             elif cls == CLASS_BI_C2S:
-                if ch in tombstones:
-                    continue
+                # LIVE channels first: an old id still open must keep
+                # working even under the advancing watermark
                 r = channels.get(ch)
                 if r is None:
+                    if ch in tombstones:
+                        continue
                     r = open_server_channel(ch)
                 if not payload:
                     r.feed_eof()

@@ -519,15 +519,33 @@ def _catalog_for(agent: "Agent"):
     return cat
 
 
+# guards lazy creation of an agent's catalog lock: a bare
+# check-then-set would let two first-catalog-query sessions each
+# install their OWN lock and both proceed onto the shared connection
+_catalog_lock_init = threading.Lock()
+
+
+def _agent_catalog_lock(agent: "Agent") -> threading.Lock:
+    """The agent's catalog lock.  ``serve_pg`` installs it at server
+    startup (single task, no race); this lazy path only serves direct
+    callers (tests, tooling) and is made safe by the module-level
+    init guard."""
+    lock = getattr(agent, "_pg_catalog_lock", None)
+    if lock is None:
+        with _catalog_lock_init:
+            lock = getattr(agent, "_pg_catalog_lock", None)
+            if lock is None:
+                lock = agent._pg_catalog_lock = threading.Lock()
+    return lock
+
+
 def _catalog_query(agent: "Agent", tsql: str, params) -> Tuple[list, list]:
     """Run one SELECT against the rendered catalog under the agent's
     catalog lock: sessions execute in worker threads, and one shared
     sqlite connection must not see concurrent cursors (sqlite3's
     serialized mode is a build option, not a guarantee)."""
-    lock = getattr(agent, "_pg_catalog_lock", None)
-    if lock is None:
-        lock = agent._pg_catalog_lock = threading.Lock()
-    with lock:
+    agent.metrics.counter("corro_pg_statements_total", kind="catalog")
+    with _agent_catalog_lock(agent):
         cur = _catalog_for(agent).execute(tsql, params)
         cols = [d[0] for d in cur.description or []]
         return cur.fetchall(), cols
@@ -715,6 +733,11 @@ class _Session:
                 tsql, params, lambda n: _tag_for(tsql, n, 0),
                 _returning_columns(tsql, self.agent) is not None,
             )
+        # the token-pass fallback READ path counts into the same
+        # statement-mix metric as the AST pipeline (kind=read), so the
+        # mix stays consistent whichever pipeline served the statement
+        self.agent.metrics.counter(
+            "corro_pg_statements_total", kind="read")
         # classify with leading parens stripped so a parenthesized
         # compound ("(SELECT ...) UNION ...") gets the same visibility
         # as its bare form; _is_write above already claimed CTE-led DML
@@ -987,6 +1010,9 @@ async def serve_pg(agent: "Agent", host: str = "127.0.0.1", port: int = 0):
     shutdown can abort them: ``Server.wait_closed()`` waits for every
     handler to return, and an idle client would otherwise hold
     ``Agent.stop()`` open indefinitely."""
+    # the catalog lock exists BEFORE any session thread can race to
+    # create it (sessions run catalog queries in worker threads)
+    _agent_catalog_lock(agent)
     conns: set = set()
 
     async def handler(r, w):

@@ -155,6 +155,32 @@ class AgentConfig:
     tls_client_key_file: Optional[str] = None
 
 
+async def _cancel_tasks(tasks, rounds: int = 5, timeout: float = 2.0):
+    """Cancel ``tasks`` and wait until every one actually exits.
+
+    A single cancel + gather is not enough on Python < 3.11:
+    ``asyncio.wait_for`` can swallow a cancellation that races the
+    inner future's completion (bpo-37658) — e.g. a probe ack landing
+    in the same loop cycle as ``stop()``'s cancel — leaving a periodic
+    loop task alive and the gather pending FOREVER.  Re-cancel each
+    round until the set drains (bounded, so a truly stuck task can't
+    hold shutdown hostage either)."""
+    pending = list(tasks)
+    for t in pending:
+        t.cancel()
+    for _ in range(rounds):
+        if not pending:
+            break
+        done, pend = await asyncio.wait(pending, timeout=timeout)
+        for t in done:
+            if not t.cancelled():
+                t.exception()  # retrieve, never raise at shutdown
+        pending = list(pend)
+        for t in pending:
+            t.cancel()
+    return pending
+
+
 class Agent:
     """A full node: storage + bookkeeping + gossip + sync (+ HTTP API)."""
 
@@ -348,9 +374,7 @@ class Agent:
         # graceful=False simulates a crash (tests of the suspicion path)
         if graceful and self._udp is not None:
             self._swim_leave()
-        for t in self._tasks:
-            t.cancel()
-        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await _cancel_tasks(self._tasks)
         self._tasks = []
         # drain in-flight apply batches before tearing down connections /
         # storage — a worker must never touch a closed resource
@@ -363,10 +387,7 @@ class Agent:
             self._apply_pool.shutdown(wait=True)
         if self.transport is not None:
             await self.transport.aclose()
-        for t in list(self._conn_tasks):
-            t.cancel()
-        if self._conn_tasks:
-            await asyncio.gather(*self._conn_tasks, return_exceptions=True)
+        await _cancel_tasks(list(self._conn_tasks))
         if self._udp:
             self._udp.close()
         if self._tcp:

@@ -24,12 +24,16 @@ class ClientError(Exception):
 
 
 class _ConnPool:
-    """Keep-alive HTTP/1.1 connection pool for the request/response
-    calls (the stand-in for the reference's pooled hyper client,
-    ``corro-client/src/lib.rs:51-98``): repeated queries/transactions
-    reuse a warm TCP connection instead of a fresh handshake per call.
-    Streams (subscriptions) hold their connection open and bypass the
-    pool."""
+    """Keep-alive HTTP/1.1 connection pool (the stand-in for the
+    reference's pooled hyper client, ``corro-client/src/lib.rs:51-98``).
+    Pool reuse is for idempotent (GET/HEAD) request/response calls
+    ONLY — table_stats/members and other metadata GETs reuse a warm
+    TCP connection instead of a fresh handshake per call.  Everything
+    else bypasses it: non-idempotent calls (transactions, migrations)
+    must not risk an idle-closed keep-alive — they are never
+    replayed — so they go over ``fresh()`` connections, and the
+    streaming endpoints (queries, subscriptions, updates) hold their
+    connection open via ``_request_stream``."""
 
     def __init__(self, host: str, port: int, timeout: float,
                  size: int = 4):
@@ -45,11 +49,14 @@ class _ConnPool:
         with self._lock:
             if self._free:
                 return self._free.pop(), True
-        return (
-            http.client.HTTPConnection(
-                self.host, self.port, timeout=self.timeout
-            ),
-            False,
+        return self.fresh(), False
+
+    def fresh(self) -> http.client.HTTPConnection:
+        """A brand-new connection, never from the pool: the transport
+        for non-idempotent requests, where an idle-closed keep-alive
+        would fail a request that must not be replayed."""
+        return http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout
         )
 
     def release(self, conn: http.client.HTTPConnection,
@@ -149,24 +156,33 @@ class CorrosionApiClient:
             return self._request_stream(path, body, method)
         data = json.dumps(body).encode() if body is not None else None
         meth = method or ("POST" if body is not None else "GET")
-        # one retry for IDEMPOTENT requests only: a pooled keep-alive
-        # connection the server closed between calls fails at request
-        # time.  A POST (e.g. /v1/transactions) is NEVER re-sent — the
-        # request may have been applied before the connection died and
-        # a retry would double-apply (the same rule _with_failover
-        # documents); POSTs take a fresh connection instead
+        # the pool serves IDEMPOTENT requests only: a pooled keep-alive
+        # connection the server closed while idle fails at request
+        # time, and a GET/HEAD simply retries once on a fresh socket.
+        # A POST (e.g. /v1/transactions) is NEVER re-sent — the request
+        # may have been applied before the connection died and a retry
+        # would double-apply (the same rule _with_failover documents) —
+        # so non-idempotent methods BYPASS the pool entirely: a fresh
+        # connection both ways (no stale-socket first attempt, no
+        # release back for reuse)
         idempotent = meth in ("GET", "HEAD")
         for attempt in (0, 1):
-            conn, was_pooled = self._pool.acquire()
+            if idempotent and attempt == 0:
+                conn, was_pooled = self._pool.acquire()
+            else:
+                # non-idempotent methods always; idempotent RETRIES
+                # too — re-acquiring could pop a second stale pooled
+                # keep-alive and fail a healthy server twice
+                conn, was_pooled = self._pool.fresh(), False
             try:
                 conn.request(meth, path, body=data,
                              headers=self._headers())
                 resp = conn.getresponse()
                 payload = resp.read()
-                reusable = not resp.will_close
+                reusable = idempotent and not resp.will_close
             except (http.client.HTTPException, OSError) as e:
                 self._pool.release(conn, reusable=False)
-                if was_pooled and attempt == 0 and idempotent:
+                if was_pooled and attempt == 0:
                     continue  # stale keep-alive: one fresh retry
                 raise ClientError(
                     0, f"cannot reach {self.base}: {e}"

@@ -42,6 +42,15 @@ from jax.sharding import PartitionSpec as P
 from corrosion_tpu.models.broadcast import BroadcastParams
 from corrosion_tpu.ops.merge import merge_keys
 
+def gather_nodes(x_l, axis: int = 0):
+    """Reassemble a node-sharded leaf: tiled ``all_gather`` over the
+    mesh's ``nodes`` axis, concatenating the shard blocks back along
+    ``axis`` in device order (the inverse of the P(..., "nodes", ...)
+    row split).  Shared by the broadcast fabrics here and the sharded
+    exact rejection sampler (sim/calibrate.py)."""
+    return jax.lax.all_gather(x_l, "nodes", axis=axis, tiled=True)
+
+
 def _shard_map(f, mesh, in_specs, out_specs):
     """shard_map across jax versions: the promoted jax.shard_map (>=0.8,
     check_vma kwarg) or the experimental one (check_rep kwarg).  Checks
@@ -83,10 +92,8 @@ def sharded_broadcast_step(mesh, params: BroadcastParams):
         key_t, key_l = jax.random.split(key)
 
         # (2) the fabric: move sender rows + activity across ICI
-        rows_all = jax.lax.all_gather(
-            rows_l, "nodes"
-        ).reshape(n, rows_l.shape[-1])
-        active_all = jax.lax.all_gather(tx_l > 0, "nodes").reshape(n)
+        rows_all = gather_nodes(rows_l)
+        active_all = gather_nodes(tx_l > 0)
 
         if params.loss > 0.0:
             drop = jax.random.uniform(key_l, (n, k)) < params.loss
@@ -287,10 +294,8 @@ def sharded_seq_sync_step(mesh, params):
 
     def local_step(bits_l, msgs_l, key):
         # (1) fabric: one all_gather moves every shard's bitmaps
-        bits_all = jax.lax.all_gather(
-            bits_l, "nodes"
-        ).reshape(n, bits_l.shape[-1])
-        msgs_all = jax.lax.all_gather(msgs_l, "nodes").reshape(n)
+        bits_all = gather_nodes(bits_l)
+        msgs_all = gather_nodes(msgs_l)
         # (2) replicated algebra on the gathered state — same RNG as
         # the unsharded kernel, so every shard agrees on every session
         new_bits, new_msgs = seq_sync_step(bits_all, msgs_all, key, params)

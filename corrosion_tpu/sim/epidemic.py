@@ -276,6 +276,18 @@ def seed_convergence(allflags):
     return converged, first_idx, first
 
 
+def stats_at_convergence(allflags, *series):
+    """Shared per-seed stat extraction (epidemic + exact-sampler
+    runners): each [S, T] per-tick series is read at that seed's OWN
+    convergence tick, never at global loop stop.
+
+    Returns (converged mask [S], 1-based first tick [S] (inf if
+    never), and one [S] value array per input series)."""
+    converged, first_idx, first = seed_convergence(allflags)
+    rows = np.arange(allflags.shape[0])
+    return converged, first, [s[rows, first_idx] for s in series]
+
+
 def run_epidemic(cfg: EpidemicConfig, seed: int = 0):
     """Single-universe run.  Returns a stats dict (host values)."""
     stats = run_epidemic_seeds(cfg, n_seeds=1, seed=seed)
@@ -381,19 +393,22 @@ def _epidemic_stats(cfg, n_seeds, flags, means, p99s, h50s, h99s, hcovs,
     coverage so the reader can see why.
     """
     allflags = np.concatenate(flags, axis=1)  # [S, T]
-    allmeans = np.concatenate(means, axis=1)
-    allp99s = np.concatenate(p99s, axis=1)
-    allh50s = np.concatenate(h50s, axis=1)
-    allh99s = np.concatenate(h99s, axis=1)
-    allhcovs = np.concatenate(hcovs, axis=1)
-    converged, first_idx, first = seed_convergence(allflags)
-    rows = np.arange(n_seeds)
-    hcov = float(allhcovs[rows, first_idx].mean()) if cfg.track_hops else None
+    converged, first, (m_at, p_at, h50_at, h99_at, hcov_at) = (
+        stats_at_convergence(
+            allflags,
+            np.concatenate(means, axis=1),
+            np.concatenate(p99s, axis=1),
+            np.concatenate(h50s, axis=1),
+            np.concatenate(h99s, axis=1),
+            np.concatenate(hcovs, axis=1),
+        )
+    )
+    hcov = float(hcov_at.mean()) if cfg.track_hops else None
 
-    def hop_stat(vals, needed_cov):
+    def hop_stat(vals_at, needed_cov):
         if not cfg.track_hops or hcov is None or hcov < needed_cov:
             return None
-        v = float(np.nanmean(vals[rows, first_idx]))
+        v = float(np.nanmean(vals_at))
         return None if np.isnan(v) else v
 
     return {
@@ -402,10 +417,10 @@ def _epidemic_stats(cfg, n_seeds, flags, means, p99s, h50s, h99s, hcovs,
         "converged_frac": float(converged.mean()),
         "ticks_p50": float(np.percentile(first, 50)),
         "ticks_p99": float(np.percentile(first, 99)),
-        "msgs_per_node_mean": float(allmeans[rows, first_idx].mean()),
-        "msgs_per_node_p99": float(allp99s[rows, first_idx].mean()),
-        "hops_p50": hop_stat(allh50s, 0.50),
-        "hops_p99": hop_stat(allh99s, 0.99),
+        "msgs_per_node_mean": float(m_at.mean()),
+        "msgs_per_node_p99": float(p_at.mean()),
+        "hops_p50": hop_stat(h50_at, 0.50),
+        "hops_p99": hop_stat(h99_at, 0.99),
         "hops_broadcast_frac": hcov,
         "wall_s": wall,
         "ticks_run": ticks_done,

@@ -98,6 +98,43 @@ def test_sync_heals_partition_after_heal_tick():
     assert r["ticks_p50"] > 12
 
 
+def test_seed_batched_runner_matches_sequential():
+    """Seed-parallel batches (vmapped tick; the rejection while_loop
+    batches to loop-while-any with frozen finished seeds) must produce
+    the SAME per-seed rank statistics as one-seed-at-a-time runs —
+    lifting the seed cap cannot move the published numbers."""
+    cfg = HeadlineExactConfig(
+        n_nodes=1000, fanout=4, ring0_size=64, max_transmissions=8,
+        loss=0.05, sync_interval=4, max_ticks=64, chunk_ticks=8,
+    )
+    seq = run_exact_headline(cfg, n_seeds=5, seed=0, seed_batch=1)
+    bat = run_exact_headline(cfg, n_seeds=5, seed=0, seed_batch=5)
+    # 5 seeds in batches of 2+2+1: the pipelined-batches path
+    mix = run_exact_headline(cfg, n_seeds=5, seed=0, seed_batch=2)
+    for k in ("converged_frac", "ticks_p50", "ticks_p99",
+              "msgs_per_node_mean", "msgs_per_node_p99"):
+        assert seq[k] == bat[k] == mix[k], k
+    assert bat["seed_batch"] == 5 and mix["seed_batch"] == 2
+
+
+def test_seed_batch_policy_tracks_bitmap_budget():
+    """The HBM policy: batch size shrinks with the per-shard bitmap
+    and grows with shard count, clamped to [1, n_seeds, 32]."""
+    from corrosion_tpu.sim.calibrate import exact_seed_batch
+
+    small = HeadlineExactConfig(n_nodes=1000)
+    big = HeadlineExactConfig(n_nodes=256_000)
+    assert exact_seed_batch(small, 32) == 32
+    # 256k single-chip: 8.2 GB bitmap -> one seed at a time
+    assert exact_seed_batch(big, 16, n_shards=1) == 1
+    # sharded 8-ways the same budget fits several seeds
+    assert exact_seed_batch(big, 16, n_shards=8) > \
+        exact_seed_batch(big, 16, n_shards=1)
+    # explicit budget override is respected
+    assert exact_seed_batch(small, 32, hbm_budget_bytes=1) == 1
+    assert exact_seed_batch(small, 4) == 4
+
+
 def test_rejection_guard_rejects_tiny_n():
     """The config refuses N where the excluded set could approach N
     (rejection sampling would stall; the scores kernel owns that

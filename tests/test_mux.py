@@ -31,6 +31,39 @@ def test_lane_hash_is_stable_and_spreads():
     assert lane_of(("10.0.0.1", 1), lanes=3) in (0, 1, 2)
 
 
+def test_tombstones_evict_oldest_first_and_never_resurrect():
+    """Closed-channel bookkeeping: eviction is oldest-first, the most
+    RECENTLY closed ids always stay tombstoned (the old arbitrary
+    list(set)[:N] eviction could discard them and resurrect ghost
+    sessions from late in-flight frames), and ids evicted from the set
+    remain dead forever via the monotonic-id watermark."""
+    from corrosion_tpu.agent.mux import TombstoneSet
+
+    ts = TombstoneSet(cap=100)
+    for ch in range(1000):
+        ts.add(ch)
+    # bounded memory
+    assert len(ts) <= 100
+    # the most recently closed ids are ALWAYS still tombstoned
+    for ch in range(900, 1000):
+        assert ch in ts, f"recently closed {ch} was resurrected"
+    # evicted old ids stay dead via the watermark (never a ghost)
+    for ch in (0, 1, 499, 899):
+        assert ch in ts, f"evicted {ch} was resurrected"
+    # a fresh id that never closed is not tombstoned
+    assert 1000 not in ts
+    # duplicate closes don't grow the structure
+    before = len(ts)
+    ts.add(999)
+    ts.add(0)  # below the watermark: already dead, not re-added
+    assert len(ts) == before
+    # out-of-order closes around the watermark stay monotone-dead
+    ts2 = TombstoneSet(cap=4)
+    for ch in (5, 3, 9, 7, 11, 13):
+        ts2.add(ch)
+    assert all(ch in ts2 for ch in (3, 5, 7, 9, 11, 13))
+
+
 def test_one_connection_carries_uni_and_sync(run):
     """Broadcast traffic AND a parallel sync round to the same peer
     ride ONE TCP connection: exactly one connect recorded, one cached

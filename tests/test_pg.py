@@ -1065,6 +1065,96 @@ def test_pg_orm_shaped_flows(run):
     run(main())
 
 
+def test_pg_statement_mix_metric_consistent_across_pipelines(run):
+    """corro_pg_statements_total{kind=...} counts every pipeline: AST
+    reads, token-pass FALLBACK reads (out-of-grammar statements must
+    not vanish from the mix), catalog queries (kind=catalog from
+    either pipeline), writes and utility statements."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def get(kind):
+                return a.metrics.get_counter(
+                    "corro_pg_statements_total", kind=kind) or 0.0
+
+            def drive():
+                c = PgClient(*a.pg_addr)
+                before = {k: get(k) for k in
+                          ("read", "write", "catalog", "utility")}
+                # AST-pipeline read
+                _, rows, _, errs = c.query("SELECT 1")
+                assert not errs
+                # token-pass FALLBACK read (PRAGMA is outside the
+                # grammar but a legitimate sqlite read)
+                _, rows, _, errs = c.query("PRAGMA user_version")
+                assert not errs
+                # catalog query (AST routing into _catalog_query)
+                _, rows, _, errs = c.query(
+                    "SELECT count(*) FROM pg_catalog.pg_class")
+                assert not errs
+                # write + utility
+                _, _, tags, errs = c.query(
+                    "INSERT INTO tests (id, text) VALUES (9, 'mix')")
+                assert not errs and tags == ["INSERT 0 1"]
+                _, _, tags, errs = c.query("SET application_name = 'x'")
+                assert not errs and tags == ["SET"]
+                c.close()
+                assert get("read") >= before["read"] + 2, (
+                    "fallback read not counted")
+                assert get("catalog") >= before["catalog"] + 1
+                assert get("write") >= before["write"] + 1
+                assert get("utility") >= before["utility"] + 1
+
+            await asyncio.to_thread(drive)
+            # the statement-mix counter rode the fallback pipeline, not
+            # a silent regression of the parser: the PRAGMA really fell
+            # back
+            assert a.metrics.get_counter(
+                "corro_pg_parse_fallbacks_total") >= 1
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_catalog_lock_created_at_server_startup(run):
+    """The catalog lock must exist before any session thread runs (the
+    old lazy check-then-set let two first-catalog-query sessions both
+    install their own lock and race the shared connection)."""
+    import threading
+
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            lock = getattr(a, "_pg_catalog_lock", None)
+            assert lock is not None, "serve_pg did not install the lock"
+            # concurrent first-catalog-queries from two sessions: both
+            # must serialize on the ONE startup lock and succeed
+            errs = []
+
+            def probe():
+                try:
+                    c = PgClient(*a.pg_addr)
+                    _, rows, _, es = c.query(
+                        "SELECT count(*) FROM pg_catalog.pg_class")
+                    assert not es and rows
+                    c.close()
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ts = [threading.Thread(target=probe) for _ in range(4)]
+            await asyncio.to_thread(
+                lambda: ([t.start() for t in ts],
+                         [t.join() for t in ts]))
+            assert not errs, errs
+            assert getattr(a, "_pg_catalog_lock") is lock, (
+                "a session replaced the startup lock")
+        finally:
+            await a.stop()
+
+    run(main())
+
+
 def test_pg_driver_setup_statements(run):
     """Driver/ORM session-setup shapes: SET TRANSACTION / SESSION
     CHARACTERISTICS / NAMES are accepted; SHOW TIME ZONE answers; a

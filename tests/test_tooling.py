@@ -616,38 +616,104 @@ def test_devcluster_process_runtime(tmp_path):
     assert (run_dir / "b" / "corrosion.db").exists()
 
 
+def test_client_post_survives_server_side_idle_close():
+    """Regression: a POST must bypass the keep-alive pool (fresh
+    connection), so a pooled connection the SERVER closed while idle
+    cannot fail the transaction with ClientError(0) and trigger
+    spurious failover.  The stub server keeps connections alive, lets
+    the test close them server-side, and counts every POST body so a
+    silent double-apply would also be caught."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    sockets = []
+    posts = []
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"  # keep-alive by default
+
+        def setup(self):
+            super().setup()
+            sockets.append(self.connection)
+
+        def _reply(self, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            self._reply({"ok": True})
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            posts.append(self.rfile.read(n))
+            self._reply({"results": [{"rows_affected": 1}]})
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        c = CorrosionApiClient(srv.server_address)
+        # a GET warms the keep-alive pool; its server side now sits
+        # idle in the handler thread
+        c.table_stats()
+        assert len(c._pool._free) == 1
+        # server-side idle close: every open connection is torn down
+        # underneath the pooled client socket
+        for s in list(sockets):
+            try:
+                s.close()
+            except OSError:
+                pass
+        # the POST must succeed on a fresh connection — applied
+        # exactly once, no ClientError(0), no failover bait
+        out = c.execute([["INSERT INTO tests (id) VALUES (1)"]])
+        assert out["results"][0]["rows_affected"] == 1
+        assert len(posts) == 1
+        # and a pooled GET after the close still works (one silent
+        # fresh retry is the documented idempotent-only behavior)
+        assert c.table_stats() == {"ok": True}
+        c.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        t.join(timeout=5)
+
+
 def test_client_pool_reuses_and_never_retries_posts(run):
-    """The keep-alive pool reuses connections across calls; a POST on
-    a stale pooled connection surfaces an error instead of re-sending
-    (a transaction retry could double-apply)."""
+    """The keep-alive pool reuses connections across idempotent calls
+    ONLY; a poisoned pooled connection cannot touch a POST at all
+    (non-idempotent methods ride fresh connections and are never
+    re-sent), so the transaction applies exactly once."""
     async def main():
-        from corrosion_tpu.client import ClientError, CorrosionApiClient
+        from corrosion_tpu.client import CorrosionApiClient
 
         a = await launch_test_agent()
         try:
             def drive():
                 c = CorrosionApiClient(a.api_addr)
                 c.execute([["INSERT INTO tests (id, text) VALUES (1, 'x')"]])
+                # POSTs never enter the pool...
+                assert len(c._pool._free) == 0
+                # ...GETs do
                 for _ in range(5):
-                    c.query("SELECT id FROM tests")
+                    c.table_stats()
                 assert len(c._pool._free) >= 1  # warm reuse
-                # poison the pooled connection: the next POST must NOT
-                # silently retry — kill the socket underneath it
+                # poison the pooled connection: the next POST must not
+                # even see it — kill the socket underneath it
                 conn = c._pool._free[0]
                 conn.sock.close()
-                try:
-                    c.execute(
-                        [["INSERT INTO tests (id, text) VALUES (2, 'y')"]]
-                    )
-                    second_applied = True
-                except ClientError:
-                    second_applied = False
-                # either the send failed loudly (no silent retry), or
-                # the request never left — but NEVER a double apply
+                c.execute(
+                    [["INSERT INTO tests (id, text) VALUES (2, 'y')"]]
+                )
                 cols, rows = c.query("SELECT count(*) FROM tests WHERE id = 2")
-                assert rows[0][0] in (0, 1)
-                if second_applied:
-                    assert rows[0][0] == 1
+                assert rows[0][0] == 1  # applied exactly once
                 c.close()
 
             await asyncio.to_thread(drive)
