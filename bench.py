@@ -317,6 +317,13 @@ def main() -> None:
     ap.add_argument("--calibrate-msgs", action="store_true",
                     help="regenerate CALIB_MSGS.json (exact sampler at "
                          "1k-16k vs perm fanout; ~3-5 min) and exit")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the N=32 chaos soak (live cluster under "
+                         "the headline fault family vs the sim's "
+                         "degraded-mode prediction), write "
+                         "CHAOS_N32.json, and exit")
+    ap.add_argument("--chaos-nodes", type=int, default=32,
+                    help="cluster size for --chaos")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
@@ -331,6 +338,17 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "CALIB_MSGS.json"
         )
         _emit(run_msgs_calibration(out_path=out_path))
+        return
+    if args.chaos:
+        from corrosion_tpu.sim.chaos import run_chaos
+
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            f"CHAOS_N{args.chaos_nodes}.json",
+        )
+        _emit(asyncio.run(
+            run_chaos(n=args.chaos_nodes, out_path=out_path)
+        ))
         return
     from corrosion_tpu.sim import EpidemicConfig
 

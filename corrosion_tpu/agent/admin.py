@@ -74,19 +74,47 @@ def _handle(agent: "Agent", msg: dict) -> dict:
         return {"ok": {"reconciled": fixed}}
 
     if cmd == "cluster_members":
+        # per-member transport view (ConnStats + breaker state): the
+        # debuggability surface for chaos runs — injected drops,
+        # redials, and breaker opens are visible per peer address
+        tstats = agent.transport.stats if agent.transport else {}
+        breakers = (
+            agent.transport.breaker_states() if agent.transport else {}
+        )
+        out = []
+        for m in agent.members.all():
+            addr = tuple(m.addr)
+            st = tstats.get(addr)
+            out.append({
+                "actor": m.actor_id.hex(),
+                "addr": list(m.addr),
+                "state": m.state.value,
+                "incarnation": m.incarnation,
+                "rtt_ms": m.rtt_ms,
+                "ring0": m.is_ring0,
+                "quarantined": m.quarantined,
+                "breaker": breakers.get(addr, "closed"),
+                "transport": st.as_dict() if st is not None else None,
+            })
+        return {"ok": out}
+
+    if cmd == "transport_stats":
+        if agent.transport is None:
+            return {"ok": {}}
+        breakers = agent.transport.breaker_states()
         return {
-            "ok": [
-                {
-                    "actor": m.actor_id.hex(),
-                    "addr": list(m.addr),
-                    "state": m.state.value,
-                    "incarnation": m.incarnation,
-                    "rtt_ms": m.rtt_ms,
-                    "ring0": m.is_ring0,
-                }
-                for m in agent.members.all()
-            ]
+            "ok": {
+                f"{a[0]}:{a[1]}": dict(
+                    s.as_dict(), breaker=breakers.get(a, "closed")
+                )
+                for a, s in agent.transport.stats.items()
+            }
         }
+
+    if cmd == "faults":
+        if agent.faults is None:
+            return {"ok": None}
+        return {"ok": agent.faults.as_dict()}
 
     if cmd == "cluster_rejoin":
         return {"ok": {"announced": agent.rejoin()}}

@@ -36,6 +36,10 @@ class Member:
     rtts: deque = field(default_factory=lambda: deque(maxlen=RTT_SAMPLES))
     last_sync_ts: float = 0.0
     last_seen: float = field(default_factory=time.monotonic)
+    # circuit-breaker quarantine (transport-level evidence): a peer
+    # whose sends persistently fail is deprioritized in fanout sampling
+    # the way high-RTT peers are, and restored on a half-open success
+    quarantined: bool = False
 
     @property
     def rtt_ms(self) -> Optional[float]:
@@ -45,6 +49,8 @@ class Member:
 
     @property
     def is_ring0(self) -> bool:
+        if self.quarantined:
+            return False
         rtt = self.rtt_ms
         return rtt is not None and rtt < RING0_MAX_RTT_MS
 
@@ -82,6 +88,13 @@ class Members:
                 return True
             if (incarnation, rank[state]) <= (m.incarnation, rank[m.state]):
                 return False
+            if tuple(addr) != tuple(m.addr):
+                # the peer moved (e.g. restarted on a fresh ephemeral
+                # port): transport-level quarantine was evidence about
+                # the OLD address, and the old breaker can never
+                # half-open-succeed to clear it — start the new address
+                # with a clean slate
+                m.quarantined = False
             m.state = state
             m.incarnation = incarnation
             m.addr = tuple(addr)
@@ -122,6 +135,25 @@ class Members:
             if m:
                 m.last_sync_ts = ts
 
+    def set_quarantined(self, actor_id: bytes, flag: bool) -> None:
+        """Transport breaker verdict: ``True`` when the peer's breaker
+        opened (deprioritize it), ``False`` on half-open success
+        (restore it to full sampling eligibility)."""
+        with self._lock:
+            m = self._members.get(actor_id)
+            if m:
+                m.quarantined = flag
+
+    def quarantine_by_addr(self, addr, flag: bool) -> bool:
+        """Same, keyed by gossip address (what the transport knows)."""
+        addr = tuple(addr)
+        with self._lock:
+            for m in self._members.values():
+                if tuple(m.addr) == addr:
+                    m.quarantined = flag
+                    return True
+        return False
+
     def alive(self) -> List[Member]:
         with self._lock:
             return [
@@ -150,12 +182,27 @@ class Members:
         rng = rng or random
         exclude = exclude or set()
         alive = [m for m in self.alive() if m.actor_id not in exclude]
+        # breaker-quarantined peers are deprioritized like high-RTT
+        # peers: never in ring0 (is_ring0 is False while quarantined),
+        # and sampled only when the healthy pool can't fill k — they
+        # stay reachable (half-open trials need traffic) but a flush
+        # round prefers peers that are actually answering
+        healthy = [m for m in alive if not m.quarantined]
+        shunned = [m for m in alive if m.quarantined]
+
+        def pick(pool, fallback, n):
+            out = rng.sample(pool, min(len(pool), n))
+            short = n - len(out)
+            if short > 0 and fallback:
+                out += rng.sample(fallback, min(len(fallback), short))
+            return out
+
         if not ring0_first:
             if len(alive) <= k:
                 return alive
-            return rng.sample(alive, k)
-        ring0 = [m for m in alive if m.is_ring0]
-        rest = [m for m in alive if not m.is_ring0]
+            return pick(healthy, shunned, k)
+        ring0 = [m for m in healthy if m.is_ring0]
+        rest = [m for m in healthy if not m.is_ring0]
         picked = list(ring0)
-        picked += rng.sample(rest, min(len(rest), k))
+        picked += pick(rest, shunned, k)
         return picked

@@ -138,6 +138,16 @@ class AgentConfig:
     transport_mux: bool = True
     # LRU cap on cached outbound uni connections (fd budget)
     uni_cache_size: int = 512
+    # degraded-mode hardening knobs: bounded redials of dead cached
+    # connections (utils.backoff decorrelated jitter), and the per-peer
+    # circuit breaker that quarantines persistently-failing addresses
+    # so one dead node cannot stall a broadcast flush round
+    connect_timeout: float = 2.0
+    redial_retries: int = 2
+    redial_base: float = 0.05
+    redial_cap: float = 0.5
+    breaker_threshold: int = 5
+    breaker_cooldown: float = 3.0
     # SWIM datagram format: "foca" = binary foca messages, the wire the
     # reference relays verbatim (broadcast/mod.rs:185-324, via
     # bridge/foca.py); "json" = the legacy debuggable envelope.
@@ -262,6 +272,11 @@ class Agent:
         self.gossip_addr: Tuple[str, int] = (config.gossip_host, config.gossip_port)
         self.api_addr: Tuple[str, int] = (config.api_host, config.api_port)
         self.on_change = None  # hook(ChangeV1) for subscriptions layer
+        # fault injection (corrosion_tpu.faults): the controller and the
+        # per-agent hook, installed by devcluster/chaos harnesses before
+        # start(); None in production
+        self.faults = None  # FaultController (introspection/admin)
+        self.fault_filter = None  # hook(channel, addr) -> FaultAction
         self.subs = None  # SubsManager, attached by setup when enabled
         self._admin = None
         self._pg = None
@@ -311,7 +326,21 @@ class Agent:
             max_cached=self.config.uni_cache_size,
             ssl_context=tls_client_ctx,
             mux=self.config.transport_mux,
+            connect_timeout=self.config.connect_timeout,
+            redial_retries=self.config.redial_retries,
+            redial_base=self.config.redial_base,
+            redial_cap=self.config.redial_cap,
+            breaker_threshold=self.config.breaker_threshold,
+            breaker_cooldown=self.config.breaker_cooldown,
+            on_breaker=self._on_breaker,
+            # seeded off the actor id: det-mode replays draw the same
+            # redial backoff schedule (utils/backoff.retry)
+            rng=random.Random(
+                int.from_bytes(self.actor_id[4:8], "big") ^ 0x5EED
+            ),
         )
+        if self.fault_filter is not None:
+            self.transport.fault_filter = self.fault_filter
         # one gossip port for both datagrams (SWIM) and streams, like the
         # reference's single QUIC/UDP endpoint; with an ephemeral port the
         # TCP side of the pair may be taken by someone else — rebind
@@ -390,6 +419,7 @@ class Agent:
         await _cancel_tasks(list(self._conn_tasks))
         if self._udp:
             self._udp.close()
+            self._udp = None  # liveness marker: stopped agents don't send
         if self._tcp:
             self._tcp.close()
             try:
@@ -533,11 +563,19 @@ class Agent:
                 ("corro_transport_peers", float(len(stats)), {})
             )
             for field in ("connects", "bytes_sent", "frames_sent",
-                          "failures"):
+                          "failures", "faults_dropped", "redials",
+                          "breaker_opens"):
                 extra.append((
                     f"corro_transport_{field}",
                     float(sum(getattr(s, field) for s in stats)), {},
                 ))
+            extra.append((
+                "corro_transport_breakers_open",
+                float(sum(
+                    1 for b in self.transport.breakers.values()
+                    if b.is_open
+                )), {},
+            ))
             rtts = [s.rtt_min_ms for s in stats if s.rtt_min_ms is not None]
             if rtts:
                 extra.append(
@@ -639,6 +677,27 @@ class Agent:
                 self.note_member_state(actor, MemberState(state))
 
     def _send_udp(self, addr: Tuple[str, int], msg: dict) -> None:
+        if self._udp:
+            if self.fault_filter is not None:
+                act = self.fault_filter("udp", tuple(addr))
+                if act is not None and act.drop:
+                    # SWIM datagrams are unreliable by design: an
+                    # injected drop is indistinguishable from the
+                    # network eating the packet
+                    self.metrics.counter(
+                        "corro_transport_faults_injected_total",
+                        kind="udp",
+                    )
+                    return
+                if act is not None and act.delay and self._loop:
+                    data_msg = dict(msg)
+                    self._loop.call_later(
+                        act.delay, self._send_udp_now, addr, data_msg
+                    )
+                    return
+            self._send_udp_now(addr, msg)
+
+    def _send_udp_now(self, addr: Tuple[str, int], msg: dict) -> None:
         if self._udp:
             if self.config.cluster_id:
                 # SWIM is cluster-scoped like the foca identity's
@@ -1167,6 +1226,16 @@ class Agent:
             if tuple(m.addr) == tuple(addr):
                 self.members.record_rtt(m.actor_id, rtt_s * 1000.0)
                 break
+
+    def _on_breaker(self, addr, opened: bool) -> None:
+        """Transport circuit-breaker transition → member quarantine:
+        an opened breaker deprioritizes the peer in fanout sampling
+        (like a high-RTT peer); a half-open success restores it."""
+        self.members.quarantine_by_addr(addr, opened)
+        self.metrics.counter(
+            "corro_members_quarantine_transitions_total",
+            state="open" if opened else "restored",
+        )
 
     async def _broadcast_loop(self) -> None:
         """Buffered, rate-limited dissemination over uni-streams.
@@ -1773,12 +1842,19 @@ class Agent:
         return await self.parallel_sync(chosen, ours)
 
     async def parallel_sync(
-        self, members: Sequence[Member], ours: Optional[SyncStateV1] = None
+        self, members: Sequence[Member], ours: Optional[SyncStateV1] = None,
+        _retry: bool = True,
     ) -> int:
         """Sync with several peers at once, deduping needs across them
         (peer.rs:1039-1466): handshake everyone, then allocate each need
         to exactly one server — two peers serving disjoint halves of a
-        node's gaps is the healthy case, not a coincidence."""
+        node's gaps is the healthy case, not a coincidence.
+
+        Degraded-mode hardening: a peer failing MID-STREAM is a
+        retryable partial round, not an aborted one — everything it
+        served before dying is already ingested, and the remaining needs
+        are recomputed from bookkeeping and retried once against peers
+        not used this round (``_retry=False`` bounds the recursion)."""
         if ours is None:
             ours = self.generate_sync()
         # the whole client round is one trace; each handshake's
@@ -1822,12 +1898,44 @@ class Agent:
                 for s in sessions:
                     s["writer"].close()
                 raise
-            counts = await asyncio.gather(
+            results = await asyncio.gather(
                 *(self._sync_session(s) for s in sessions),
                 return_exceptions=True,
             )
-            total = sum(c for c in counts if isinstance(c, int))
+            total = 0
+            partial = 0
+            for r in results:
+                if isinstance(r, tuple):
+                    count, complete = r
+                    total += count
+                    if not complete:
+                        partial += 1
+                else:
+                    partial += 1
             sp.set(sessions=len(sessions), changes=total)
+            if partial:
+                self.metrics.counter(
+                    "corro_sync_partial_sessions_total", partial)
+            if partial and _retry:
+                # retryable partial round: needs the dead peer(s) never
+                # served are still in bookkeeping — recompute and push
+                # them to peers untouched this round (bounded: one pass)
+                used = {tuple(m.addr) for m in members}
+                spare = [
+                    m for m in self.members.alive()
+                    if m.state is MemberState.ALIVE
+                    and tuple(m.addr) not in used
+                    and not m.quarantined
+                ]
+                if spare:
+                    self.metrics.counter(
+                        "corro_sync_partial_retries_total")
+                    retry_peers = self._rng.sample(
+                        spare, min(partial, len(spare))
+                    )
+                    total += await self.parallel_sync(
+                        retry_peers, None, _retry=False
+                    )
             return total
 
     def _allocate_needs(
@@ -2017,9 +2125,15 @@ class Agent:
         else:
             self.enqueue_change(cv, ChangeSource.SYNC)
 
-    async def _sync_session(self, s: dict) -> int:
+    async def _sync_session(self, s: dict) -> Tuple[int, bool]:
         """Send this session's allocated requests, then ingest served
-        changesets until the server closes its side."""
+        changesets until the server closes its side.
+
+        Returns ``(changes_ingested, complete)``: a mid-stream peer
+        failure keeps everything already ingested (bookkeeping is
+        idempotent and incremental) and reports ``complete=False`` so
+        the round can retry the remainder elsewhere — a partial round,
+        not an aborted one."""
         m, reader, writer = s["member"], s["reader"], s["writer"]
         frames = s["frames"]
         count = 0
@@ -2059,10 +2173,10 @@ class Agent:
             self.members.update_sync_ts(m.actor_id, time.time())
             self.metrics.counter("corro_sync_client_rounds_total")
             # per-change accounting happens at enqueue_change
-            return count
+            return count, True
         except (asyncio.TimeoutError, OSError, ConnectionError,
                 speedy.SpeedyError):
-            return count
+            return count, False
         finally:
             writer.close()
 

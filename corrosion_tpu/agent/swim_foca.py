@@ -127,6 +127,29 @@ def send(agent: "Agent", addr: Tuple[str, int], dst: foca.FocaActor,
          updates: Optional[List[foca.FocaMember]] = None) -> None:
     if agent._udp is None:
         return
+    if agent.fault_filter is not None:
+        # same injection seam as Agent._send_udp: SWIM datagrams are
+        # unreliable by design, so an injected drop is indistinguishable
+        # from the network eating the packet
+        act = agent.fault_filter("udp", tuple(addr))
+        if act is not None and act.drop:
+            agent.metrics.counter(
+                "corro_transport_faults_injected_total", kind="udp"
+            )
+            return
+        if act is not None and act.delay and agent._loop is not None:
+            agent._loop.call_later(
+                act.delay, _send_now, agent, addr, dst, message, updates
+            )
+            return
+    _send_now(agent, addr, dst, message, updates)
+
+
+def _send_now(agent: "Agent", addr: Tuple[str, int], dst: foca.FocaActor,
+              message: foca.FocaMessage,
+              updates: Optional[List[foca.FocaMember]] = None) -> None:
+    if agent._udp is None:
+        return
     d = foca.FocaDatagram(
         src=self_actor(agent),
         src_incarnation=agent.incarnation,

@@ -33,6 +33,7 @@ async def launch_test_agent(
     bootstrap: Optional[List[str]] = None,
     schema: str = TEST_SCHEMA,
     tmpdir: Optional[str] = None,
+    fault_filter=None,
     **overrides,
 ) -> Agent:
     d = tmpdir or tempfile.mkdtemp(prefix="corro-test-")
@@ -54,6 +55,12 @@ async def launch_test_agent(
         **kwargs,
     )
     agent = Agent(cfg)
+    # the fault-injection hook must be live BEFORE start(): the boot
+    # window (bootstrap announces, first probes) is part of the fault
+    # model — a node restarting INTO an active partition or lossy link
+    # must not get a fault-free head start
+    if fault_filter is not None:
+        agent.fault_filter = fault_filter
     await agent.start()
     return agent
 

@@ -18,8 +18,9 @@ is dropped and reopened once (transport.rs:213-233 retry semantics).
 from __future__ import annotations
 
 import asyncio
+import random
 import time
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 Addr = Tuple[str, int]
 
@@ -61,6 +62,7 @@ class ConnStats:
     address, surfaced through metrics and ``cluster members``."""
 
     __slots__ = ("connects", "bytes_sent", "frames_sent", "failures",
+                 "faults_dropped", "redials", "breaker_opens",
                  "rtt_last_ms", "rtt_min_ms", "last_used")
 
     def __init__(self):
@@ -68,6 +70,12 @@ class ConnStats:
         self.bytes_sent = 0
         self.frames_sent = 0
         self.failures = 0
+        # degraded-mode accounting: injected in-flight drops (fault
+        # injection), reconnect attempts after a dead cached conn, and
+        # circuit-breaker open transitions — the chaos-run debug surface
+        self.faults_dropped = 0
+        self.redials = 0
+        self.breaker_opens = 0
         self.rtt_last_ms: Optional[float] = None
         self.rtt_min_ms: Optional[float] = None
         self.last_used = 0.0
@@ -78,9 +86,78 @@ class ConnStats:
             "bytes_sent": self.bytes_sent,
             "frames_sent": self.frames_sent,
             "failures": self.failures,
+            "faults_dropped": self.faults_dropped,
+            "redials": self.redials,
+            "breaker_opens": self.breaker_opens,
             "rtt_last_ms": self.rtt_last_ms,
             "rtt_min_ms": self.rtt_min_ms,
         }
+
+
+class CircuitBreaker:
+    """Per-peer failure quarantine: after ``threshold`` consecutive
+    failures the breaker OPENS and sends fail fast (no connect attempt,
+    no timeout) until ``cooldown`` elapses; then ONE half-open trial is
+    allowed — success closes the breaker, failure re-opens it for
+    another cooldown.  This is what keeps a broadcast flush round
+    bounded when a peer is dead: every destination past the first
+    timeout burns zero wall-clock on the corpse."""
+
+    __slots__ = ("threshold", "cooldown", "failures", "opened_at",
+                 "half_open_inflight")
+
+    def __init__(self, threshold: int = 5, cooldown: float = 3.0):
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.failures = 0
+        self.opened_at: Optional[float] = None
+        self.half_open_inflight = False
+
+    @property
+    def is_open(self) -> bool:
+        return self.opened_at is not None
+
+    def allow(self, now: Optional[float] = None) -> bool:
+        if self.opened_at is None:
+            return True
+        now = time.monotonic() if now is None else now
+        if now - self.opened_at < self.cooldown:
+            return False
+        # cooldown passed: admit one half-open trial at a time
+        if self.half_open_inflight:
+            return False
+        self.half_open_inflight = True
+        return True
+
+    def record_success(self) -> bool:
+        """Returns True when this success CLOSED an open breaker
+        (the half-open restore path)."""
+        self.failures = 0
+        self.half_open_inflight = False
+        if self.opened_at is not None:
+            self.opened_at = None
+            return True
+        return False
+
+    def record_failure(self, now: Optional[float] = None) -> bool:
+        """Returns True when this failure OPENED the breaker."""
+        self.half_open_inflight = False
+        if self.opened_at is not None:
+            # half-open trial failed: restart the cooldown
+            self.opened_at = time.monotonic() if now is None else now
+            return False
+        self.failures += 1
+        if self.failures >= self.threshold:
+            self.opened_at = time.monotonic() if now is None else now
+            return True
+        return False
+
+    def state(self) -> str:
+        if self.opened_at is None:
+            return "closed"
+        if time.monotonic() - self.opened_at >= self.cooldown:
+            return "half-open"
+        return "open"
 
 
 class UniConnection:
@@ -111,13 +188,37 @@ class Transport:
 
     def __init__(self, metrics=None, connect_timeout: float = 2.0,
                  on_rtt=None, max_cached: int = 512, ssl_context=None,
-                 mux: bool = True):
+                 mux: bool = True,
+                 redial_retries: int = 2,
+                 redial_base: float = 0.05,
+                 redial_cap: float = 0.5,
+                 breaker_threshold: int = 5,
+                 breaker_cooldown: float = 3.0,
+                 on_breaker: Optional[Callable[[Addr, bool], None]] = None,
+                 rng: Optional[random.Random] = None):
         self._uni: Dict[Addr, UniConnection] = {}
         self.metrics = metrics
         self.connect_timeout = connect_timeout
         self.on_rtt = on_rtt  # callback(addr, rtt_seconds)
         self.ssl_context = ssl_context  # TLS for uni/bi streams (or None)
         self.stats: Dict[Addr, ConnStats] = {}
+        # fault-injection hook: callable(channel, addr) -> FaultAction
+        # (corrosion_tpu.faults) consulted on every send_uni/open_bi;
+        # None = no faults (production default)
+        self.fault_filter = None
+        # bounded redial policy for dead cached connections: retries
+        # ride utils.backoff (decorrelated jitter) off a seedable rng so
+        # det-mode runs replay the same sleep schedule
+        self.redial_retries = redial_retries
+        self.redial_base = redial_base
+        self.redial_cap = redial_cap
+        self._rng = rng or random.Random()
+        # per-peer circuit breakers: a persistently-failing address is
+        # quarantined so one dead node cannot stall a flush round
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown = breaker_cooldown
+        self.breakers: Dict[Addr, CircuitBreaker] = {}
+        self.on_breaker = on_breaker  # callback(addr, opened: bool)
         # LRU cap on cached uni connections (the reference's QUIC conns
         # close on idle timeout; an unbounded TCP cache leaks fds in
         # large in-process clusters)
@@ -148,6 +249,54 @@ class Transport:
         ms = rtt_s * 1000.0
         s.rtt_last_ms = ms
         s.rtt_min_ms = ms if s.rtt_min_ms is None else min(s.rtt_min_ms, ms)
+
+    # -- degraded-mode plumbing -----------------------------------------
+
+    def _breaker(self, addr: Addr) -> CircuitBreaker:
+        b = self.breakers.get(addr)
+        if b is None:
+            # bound the map like the stats cache: evict healthy
+            # (closed, no strikes) entries first — open breakers carry
+            # live quarantine state and must survive the sweep
+            if len(self.breakers) > 4 * self.max_cached:
+                for a in [a for a, br in self.breakers.items()
+                          if not br.is_open and br.failures == 0]:
+                    del self.breakers[a]
+            b = self.breakers[addr] = CircuitBreaker(
+                self.breaker_threshold, self.breaker_cooldown
+            )
+        return b
+
+    def _breaker_success(self, addr: Addr) -> None:
+        b = self.breakers.get(addr)
+        if b is not None and b.record_success():
+            if self.metrics is not None:
+                self.metrics.counter("corro_transport_breaker_closes_total")
+            if self.on_breaker is not None:
+                self.on_breaker(addr, False)
+
+    def _breaker_failure(self, addr: Addr) -> None:
+        if self._breaker(addr).record_failure():
+            self._stat(addr).breaker_opens += 1
+            if self.metrics is not None:
+                self.metrics.counter("corro_transport_breaker_opens_total")
+            if self.on_breaker is not None:
+                self.on_breaker(addr, True)
+
+    def _fault(self, channel: str, addr: Addr):
+        """Consult the fault-injection hook; returns the action or None.
+        Injected drops are the SENDER-INVISIBLE kind (in-flight loss,
+        matching the sim's ``loss``): callers treat them as successful
+        sends that the receiver never sees."""
+        if self.fault_filter is None:
+            return None
+        act = self.fault_filter(channel, addr)
+        if act is None or (not act.drop and not act.delay):
+            return None
+        return act
+
+    def breaker_states(self) -> Dict[Addr, str]:
+        return {a: b.state() for a, b in self.breakers.items()}
 
     async def _open(self, addr: Addr, header: bytes) -> UniConnection:
         t0 = time.monotonic()
@@ -235,26 +384,67 @@ class Transport:
     async def send_uni(self, addr: Addr, frames: bytes,
                        header: bytes) -> bool:
         """Write pre-framed bytes on the cached uni channel to addr;
-        reopen once if the cached connection is dead."""
+        a dead cached connection is dropped and redialed with bounded
+        backoff.  An open circuit breaker fails fast (no connect, no
+        timeout); injected faults drop in flight (sender sees success)."""
+        act = self._fault("uni", addr)
+        if act is not None:
+            if act.delay:
+                await asyncio.sleep(act.delay)
+            if act.drop:
+                self._stat(addr).faults_dropped += 1
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        "corro_transport_faults_injected_total", kind="uni"
+                    )
+                return True  # in-flight loss: the sender believes it sent
+        if not self._breaker(addr).allow():
+            # a fast-fail skip is not a new failure — `failures` counts
+            # real exhausted send attempts (open_bi accounts the same
+            # way); the skip volume has its own counter
+            if self.metrics is not None:
+                self.metrics.counter(
+                    "corro_transport_breaker_skips_total")
+            return False
         if self.mux:
-            for attempt in (0, 1):
+            from corrosion_tpu.utils.backoff import Backoff, retry
+
+            attempts = 0
+
+            async def _attempt():
+                nonlocal attempts
+                attempts += 1
                 try:
                     m = await self._get_mux(addr)
                     await m.send_uni(frames)
-                    st = self._stat(addr)
-                    st.bytes_sent += len(frames)
-                    st.frames_sent += 1
-                    return True
                 except (OSError, ConnectionError, asyncio.TimeoutError):
+                    # the cached mux is dead: drop it so the retry (and
+                    # any concurrent sender) redials a fresh connection
                     self._drop_mux(addr)
-                    if attempt == 1:
-                        self._stat(addr).failures += 1
-                        if self.metrics is not None:
-                            self.metrics.counter(
-                                "corro_transport_uni_failures_total"
-                            )
-                        return False
-            return False
+                    if attempts > 1:
+                        self._stat(addr).redials += 1
+                    raise
+
+            try:
+                await retry(
+                    _attempt,
+                    Backoff(self.redial_base, self.redial_cap,
+                            max_retries=self.redial_retries,
+                            rng=self._rng),
+                )
+            except (OSError, ConnectionError, asyncio.TimeoutError):
+                self._stat(addr).failures += 1
+                self._breaker_failure(addr)
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        "corro_transport_uni_failures_total"
+                    )
+                return False
+            st = self._stat(addr)
+            st.bytes_sent += len(frames)
+            st.frames_sent += 1
+            self._breaker_success(addr)
+            return True
         for attempt in (0, 1):
             conn = self._uni.get(addr)
             try:
@@ -287,12 +477,16 @@ class Transport:
                     self.metrics.counter(
                         "corro_transport_uni_bytes_total", len(frames)
                     )
+                self._breaker_success(addr)
                 return True
             except (OSError, ConnectionError, asyncio.TimeoutError):
                 if addr in self._uni:
                     self._uni.pop(addr).close()
+                if attempt == 0:
+                    self._stat(addr).redials += 1
                 if attempt == 1:
                     self._stat(addr).failures += 1
+                    self._breaker_failure(addr)
                     if self.metrics is not None:
                         self.metrics.counter(
                             "corro_transport_uni_failures_total"
@@ -302,31 +496,90 @@ class Transport:
 
     async def open_bi(self, addr: Addr):
         """(reader, writer) for a sync session.  Multiplexed: a fresh
-        bi CHANNEL on the peer's shared mux connection (retried once on
-        a dead cache entry); legacy: a fresh connection per session
-        like the reference's open_bi."""
+        bi CHANNEL on the peer's shared mux connection (dead cache
+        entries dropped and redialed with bounded backoff); legacy: a
+        fresh connection per session like the reference's open_bi.
+        An open breaker or an injected partition/drop raises OSError —
+        the retryable shape the sync client already handles."""
+        act = self._fault("bi", addr)
+        if act is not None:
+            if act.delay:
+                await asyncio.sleep(act.delay)
+            if act.drop:
+                self._stat(addr).faults_dropped += 1
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        "corro_transport_faults_injected_total", kind="bi"
+                    )
+                raise OSError("fault injected: bi stream dropped")
+        if not self._breaker(addr).allow():
+            if self.metrics is not None:
+                self.metrics.counter("corro_transport_breaker_skips_total")
+            raise OSError("circuit breaker open")
         if self.mux:
-            for attempt in (0, 1):
+            from corrosion_tpu.utils.backoff import Backoff, retry
+
+            attempts = 0
+
+            async def _attempt():
+                nonlocal attempts
+                attempts += 1
                 try:
                     m = await self._get_mux(addr)
                     return m.open_channel()
                 except (OSError, ConnectionError, asyncio.TimeoutError):
                     self._drop_mux(addr)
-                    if attempt == 1:
-                        self._stat(addr).failures += 1
-                        raise
+                    if attempts > 1:
+                        self._stat(addr).redials += 1
+                    raise
+
+            try:
+                chan = await retry(
+                    _attempt,
+                    Backoff(self.redial_base, self.redial_cap,
+                            max_retries=self.redial_retries,
+                            rng=self._rng),
+                )
+            except (OSError, ConnectionError, asyncio.TimeoutError):
+                self._stat(addr).failures += 1
+                self._breaker_failure(addr)
+                raise
+            # re-check the PARTITION after the connect awaits: a
+            # partition arming while open_connection was suspended
+            # (TOCTOU) must not hand back a live channel — the whole
+            # session it gates would then legally stream across the
+            # "partition".  The probe consumes no seeded loss draw.
+            act = self._fault("partition_check", addr)
+            if act is not None and act.drop:
+                self._drop_mux(addr)
+                self._stat(addr).faults_dropped += 1
+                # the breaker must see an outcome: allow() may have
+                # admitted this call as THE half-open trial, and bailing
+                # without recording one would leave half_open_inflight
+                # latched and the breaker wedged open forever.  A
+                # partitioned connect IS a failure to reach the peer.
+                self._breaker_failure(addr)
+                raise OSError("fault injected: bi stream dropped")
+            self._breaker_success(addr)
+            return chan
         t0 = time.monotonic()
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(
-                addr[0], addr[1], ssl=self.ssl_context
-            ),
-            timeout=self.connect_timeout,
-        )
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(
+                    addr[0], addr[1], ssl=self.ssl_context
+                ),
+                timeout=self.connect_timeout,
+            )
+        except (OSError, ConnectionError, asyncio.TimeoutError):
+            self._stat(addr).failures += 1
+            self._breaker_failure(addr)
+            raise
         rtt = time.monotonic() - t0
         self._stat(addr).connects += 1
         self._record_rtt_stat(addr, rtt)
         if self.on_rtt is not None:
             self.on_rtt(addr, rtt)
+        self._breaker_success(addr)
         writer.write(b"B")  # STREAM_BI prelude (runtime dispatch)
         return reader, writer
 

@@ -55,26 +55,82 @@ async def run_inprocess(
     topo: Topology,
     schema: Optional[str] = None,
     base_dir: Optional[str] = None,
+    faults: Optional["object"] = None,
     **agent_overrides,
 ) -> Dict[str, "object"]:
-    """Boot all agents; returns {name: Agent}.  Caller stops them."""
+    """Boot all agents; returns {name: Agent}.  Caller stops them.
+
+    ``faults`` takes a :class:`corrosion_tpu.faults.FaultController`:
+    every node registers with it (topology order — the deterministic
+    index order the partition blocks key off), gets its injection hook
+    installed on the transport/SWIM send paths, and the plan's
+    crash/restart schedule becomes executable via
+    :func:`run_crash_schedule` (restarts relaunch from the SAME node
+    directory, so the reborn agent resumes its identity and catches up
+    through anti-entropy)."""
     from corrosion_tpu.agent.testing import launch_test_agent
 
     base = base_dir or tempfile.mkdtemp(prefix="corro-devcluster-")
     agents: Dict[str, object] = {}
-    for name in topo.nodes:
+
+    async def spawn(name: str) -> "object":
         boots = []
         for up in topo.bootstraps_for(name):
             a = agents.get(up)
-            if a is not None:
+            if a is not None and getattr(a, "_udp", None) is not None:
                 boots.append(f"{a.gossip_addr[0]}:{a.gossip_addr[1]}")
         d = os.path.join(base, name)
         os.makedirs(d, exist_ok=True)
         kwargs = dict(bootstrap=boots, tmpdir=d)
         if schema is not None:
             kwargs["schema"] = schema
-        agents[name] = await launch_test_agent(**kwargs, **agent_overrides)
+        if faults is not None:
+            # installed pre-start (launch_test_agent) so even the boot
+            # window — bootstrap announces on a RESPAWN into an active
+            # partition — is subject to the plan
+            kwargs["fault_filter"] = faults.hook_for(name)
+        agent = await launch_test_agent(**kwargs, **agent_overrides)
+        if faults is not None:
+            faults.register(name, tuple(agent.gossip_addr))
+            agent.faults = faults
+        return agent
+
+    for name in topo.nodes:
+        agents[name] = await spawn(name)
+        if faults is not None:
+            faults.respawn[name] = spawn
+    if faults is not None:
+        faults.agents = agents
+        faults.start()
     return agents
+
+
+async def run_crash_schedule(faults: "object") -> None:
+    """Execute the controller's crash/restart schedule against the
+    cluster booted by :func:`run_inprocess` (pass the same controller).
+
+    Crashes are non-graceful stops (peers see genuine connect failures
+    and run the suspicion pipeline); restarts relaunch from the same
+    node directory — resume, not re-seed — updating the controller's
+    ``agents`` dict in place.  Event times are seconds relative to the
+    controller's start()."""
+    events = []
+    for ev in faults.plan.crashes:
+        events.append((ev.at, "crash", ev.node))
+        if ev.restart_at is not None:
+            events.append((ev.restart_at, "restart", ev.node))
+    events.sort()
+    for at, kind, node in events:
+        delay = at - faults.elapsed()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        if kind == "crash":
+            agent = faults.agents.get(node)
+            if agent is not None:
+                await agent.stop(graceful=False)
+        else:
+            faults.agents[node] = await faults.respawn[node](node)
+        faults.crash_log.append((faults.elapsed(), kind, node))
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1,0 +1,308 @@
+"""Deterministic fault injection for the live agent cluster.
+
+The epidemic kernel can already experience the headline fault family —
+``loss``, ``partition_blocks``/``heal_tick``, churn (``sim/epidemic.py``,
+``sim/churn.py``) — but until now the real agents could not, so the
+sim's degraded-mode predictions were unvalidated against the system they
+model.  This module closes that loop the way the BFT-simulation and
+CRDT-emulation literature does: faults on the *real* implementation must
+be injectable, deterministic, and replayable.
+
+Design:
+
+* a :class:`FaultPlan` is a frozen, seeded description of the fault
+  regime: per-link drop probability, added latency, a block partition
+  with a heal time, and a crash/restart schedule;
+* every per-message decision is a PURE function of
+  ``(seed, src, dst, channel, n)`` where ``n`` is the link-local message
+  counter — no shared RNG stream, so decisions do not depend on global
+  scheduling order.  Replaying the same per-link message sequence yields
+  byte-identical decisions (asserted in ``tests/test_faults.py``);
+* a :class:`FaultController` binds the plan to a running cluster: nodes
+  register by NAME (stable across runs; ports are ephemeral), and each
+  agent gets a hook closure that the transport consults on
+  ``send_uni``/``open_bi`` and the runtime consults on SWIM datagrams.
+
+Fault semantics mirror the simulator:
+
+* ``drop`` and an active partition are IN-FLIGHT losses: the sender
+  believes the send succeeded (uni/udp), the receiver never sees it —
+  exactly the sim's ``loss`` model, so anti-entropy is what heals it;
+* bi-streams (sync) cannot half-deliver a session, so a partitioned or
+  dropped ``open_bi`` surfaces as a connect error — the retryable shape
+  the sync client already handles;
+* crashes are real: the agent task is stopped (``graceful=False``) and
+  later relaunched from the same directory, so peers experience genuine
+  connect failures (breaker + backoff territory, not emulation).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+Addr = Tuple[str, int]
+
+_DECISION = struct.Struct("<B d")  # (dropped, delay_s) — the replay log unit
+
+
+@dataclass(frozen=True)
+class FaultAction:
+    """One per-message fault decision."""
+
+    drop: bool = False
+    delay: float = 0.0
+    reason: str = ""  # "loss" | "partition" | ""
+
+    def encode(self) -> bytes:
+        return _DECISION.pack(1 if self.drop else 0, self.delay)
+
+
+_NO_FAULT = FaultAction()
+
+
+@dataclass(frozen=True)
+class CrashEvent:
+    """Crash ``node`` at ``at`` seconds after start; restart it at
+    ``restart_at`` (None = stays down)."""
+
+    node: str
+    at: float
+    restart_at: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """Seeded, replayable fault regime — the live-cluster analogue of
+    ``EpidemicConfig``'s ``loss``/``partition_blocks``/``heal_tick``
+    plus a churn (crash/restart) schedule."""
+
+    seed: int = 0
+    # per-link, per-message drop probability (sim: EpidemicConfig.loss)
+    drop: float = 0.0
+    # added one-way latency: base + uniform[0, jitter) per message
+    delay: float = 0.0
+    delay_jitter: float = 0.0
+    # nodes split into `partition_blocks` blocks whose cross-traffic is
+    # dropped until `heal_after` seconds (sim: partition_blocks +
+    # heal_tick); None = partition never heals by itself (tests drive
+    # FaultController.heal() manually for determinism)
+    partition_blocks: int = 1
+    heal_after: Optional[float] = None
+    crashes: Tuple[CrashEvent, ...] = ()
+
+    def link_decision(self, src: str, dst: str, channel: str,
+                      n: int) -> FaultAction:
+        """The pure decision function: same (seed, src, dst, channel, n)
+        ⇒ same action, byte for byte, forever."""
+        if self.drop <= 0.0 and self.delay <= 0.0 and self.delay_jitter <= 0.0:
+            return _NO_FAULT
+        h = hashlib.blake2b(
+            f"{self.seed}:{src}:{dst}:{channel}:{n}".encode(),
+            digest_size=16,
+        ).digest()
+        drop_draw = int.from_bytes(h[:8], "big") / 2.0**64
+        delay_draw = int.from_bytes(h[8:], "big") / 2.0**64
+        drop = drop_draw < self.drop
+        delay = 0.0
+        if not drop and (self.delay or self.delay_jitter):
+            delay = self.delay + self.delay_jitter * delay_draw
+        if drop:
+            return FaultAction(drop=True, delay=0.0, reason="loss")
+        if delay:
+            return FaultAction(drop=False, delay=delay)
+        return _NO_FAULT
+
+    def block_of(self, idx: int, n_nodes: int) -> int:
+        """Partition block of node index ``idx`` — identical to the
+        sim's ``_partition_ids`` (idx * blocks // n)."""
+        if self.partition_blocks <= 1 or n_nodes <= 0:
+            return 0
+        return idx * self.partition_blocks // n_nodes
+
+
+class FaultController:
+    """Binds a :class:`FaultPlan` to a live cluster.
+
+    Nodes register by name (in a deterministic order — devcluster boots
+    in topology order); each agent consults :meth:`filter` through a
+    per-node hook.  All decisions are appended to :attr:`decision_log`
+    so a replay can be asserted byte-identical.
+    """
+
+    def __init__(self, plan: FaultPlan,
+                 now: Optional[Callable[[], float]] = None):
+        import time
+
+        self.plan = plan
+        self._now = now or time.monotonic
+        self._t0: Optional[float] = None
+        self._addr_to_node: Dict[Addr, str] = {}
+        self._node_idx: Dict[str, int] = {}
+        self._counters: Dict[Tuple[str, str, str], int] = {}
+        # the partition is armed by split(), not at boot: cluster
+        # formation (membership dissemination) happens whole, then the
+        # harness splits at measurement start — the live analogue of
+        # the sim starting partitioned at tick 0
+        self._split_at: Optional[float] = None
+        self._healed = False
+        self.decision_log = bytearray()
+        self.injected: Dict[str, int] = {"drop": 0, "partition": 0,
+                                         "delay": 0}
+        # crash orchestration bookkeeping (devcluster.run_inprocess)
+        self.agents: Optional[Dict[str, object]] = None
+        self.respawn: Dict[str, Callable] = {}
+        self.crash_log: List[Tuple[float, str, str]] = []
+
+    # -- registration ---------------------------------------------------
+
+    def register(self, name: str, addr: Addr) -> None:
+        self._node_idx.setdefault(name, len(self._node_idx))
+        self._addr_to_node[tuple(addr)] = name
+
+    def start(self) -> None:
+        if self._t0 is None:
+            self._t0 = self._now()
+
+    def restart_clock(self) -> None:
+        """Re-zero the schedule clock (measurement start, after cluster
+        formation): crash/restart event times are relative to this."""
+        self._t0 = self._now()
+
+    def elapsed(self) -> float:
+        if self._t0 is None:
+            return 0.0
+        return self._now() - self._t0
+
+    # -- partition state ------------------------------------------------
+
+    def split(self) -> None:
+        """Arm the partition (no-op for partition_blocks<=1).  The
+        plan's ``heal_after`` runs from this moment; tests may instead
+        heal manually via :meth:`heal` for full determinism.
+
+        Established cross-block connections are SEVERED, not just new
+        dials blocked: a real partition stops delivering on live TCP
+        connections too, and an anti-entropy session that handshook
+        just before the split would otherwise keep legally serving
+        across it (its State is read after the split).  The teardown
+        surfaces in-flight sessions as resets — the retryable-partial
+        shape the sync client is hardened for."""
+        if self.plan.partition_blocks <= 1:
+            return
+        self._split_at = self._now()
+        self._healed = False
+        self._sever_cross_block()
+
+    def _sever_cross_block(self) -> None:
+        if not self.agents:
+            return
+        n = len(self._node_idx)
+        for name, agent in self.agents.items():
+            si = self._node_idx.get(name)
+            transport = getattr(agent, "transport", None)
+            if si is None or transport is None:
+                continue
+            sb = self.plan.block_of(si, n)
+            for addr, peer in list(self._addr_to_node.items()):
+                di = self._node_idx.get(peer)
+                if di is not None and self.plan.block_of(di, n) != sb:
+                    try:
+                        transport.drop(tuple(addr))
+                    except Exception:
+                        pass
+
+    def heal(self) -> None:
+        """Manually end the partition (the deterministic-test path)."""
+        self._healed = True
+
+    def partition_active(self) -> bool:
+        if self._healed or self._split_at is None:
+            return False
+        if self.plan.heal_after is not None \
+                and self._now() - self._split_at >= self.plan.heal_after:
+            self._healed = True
+            return False
+        return True
+
+    def _partitioned(self, src: str, dst: str) -> bool:
+        if not self.partition_active():
+            return False
+        n = len(self._node_idx)
+        si = self._node_idx.get(src)
+        di = self._node_idx.get(dst)
+        if si is None or di is None:
+            return False
+        return (self.plan.block_of(si, n)
+                != self.plan.block_of(di, n))
+
+    # -- the decision path ----------------------------------------------
+
+    def filter(self, src: str, dst: str, channel: str) -> FaultAction:
+        """Decide the fate of the next message on (src → dst, channel).
+
+        Partition drops come first and do NOT consume a link counter
+        tick — the heal time is wall-clock, so burning seeded draws on
+        partition drops would make post-heal decisions timing-dependent.
+        """
+        if self._partitioned(src, dst):
+            act = FaultAction(drop=True, reason="partition")
+            self.injected["partition"] += 1
+            self.decision_log += act.encode()
+            return act
+        if channel == "partition_check":
+            # a pure partition probe (transport's post-connect TOCTOU
+            # recheck): never consumes a seeded link draw
+            return _NO_FAULT
+        key = (src, dst, channel)
+        n = self._counters.get(key, 0)
+        self._counters[key] = n + 1
+        act = self.plan.link_decision(src, dst, channel, n)
+        if act.drop:
+            self.injected["drop"] += 1
+        elif act.delay:
+            self.injected["delay"] += 1
+        self.decision_log += act.encode()
+        return act
+
+    def hook_for(self, name: str) -> Callable[[str, Addr], FaultAction]:
+        """The per-agent injection hook: ``hook(channel, dst_addr)``.
+
+        Unregistered destinations (admin sockets, external clients) are
+        never faulted.
+        """
+
+        def hook(channel: str, addr: Addr) -> FaultAction:
+            dst = self._addr_to_node.get(tuple(addr))
+            if dst is None:
+                return _NO_FAULT
+            return self.filter(name, dst, channel)
+
+        return hook
+
+    # -- introspection (admin `faults` command) -------------------------
+
+    def as_dict(self) -> dict:
+        p = self.plan
+        return {
+            "seed": p.seed,
+            "drop": p.drop,
+            "delay": p.delay,
+            "delay_jitter": p.delay_jitter,
+            "partition_blocks": p.partition_blocks,
+            "heal_after": p.heal_after,
+            "partition_active": self.partition_active(),
+            "crashes": [
+                {"node": c.node, "at": c.at, "restart_at": c.restart_at}
+                for c in p.crashes
+            ],
+            "nodes": len(self._node_idx),
+            "injected": dict(self.injected),
+            "decisions": len(self.decision_log) // _DECISION.size,
+            "crash_log": [
+                {"t": round(t, 3), "event": ev, "node": node}
+                for t, ev, node in self.crash_log
+            ],
+        }

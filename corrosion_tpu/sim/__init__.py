@@ -16,6 +16,7 @@ from corrosion_tpu.sim.epidemic import (
     run_epidemic_seeds,
 )
 from corrosion_tpu.sim.churn import ChurnConfig, run_churn
+from corrosion_tpu.sim.chaos import run_chaos
 from corrosion_tpu.sim.antientropy import (
     AntiEntropyConfig,
     run_anti_entropy_seeds,
@@ -32,4 +33,5 @@ __all__ = [
     "run_epidemic_seeds",
     "ChurnConfig",
     "run_churn",
+    "run_chaos",
 ]

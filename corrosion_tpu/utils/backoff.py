@@ -8,8 +8,9 @@ optionally with a retry limit.
 
 from __future__ import annotations
 
+import asyncio
 import random
-from typing import Iterator, Optional
+from typing import Callable, Iterator, Optional, Tuple, Type
 
 
 class Backoff:
@@ -32,3 +33,36 @@ class Backoff:
 
     def reset(self) -> "Backoff":
         return Backoff(self.base, self.cap, self.max_retries, self.rng)
+
+
+async def retry(
+    fn: Callable,
+    backoff: Backoff,
+    exceptions: Tuple[Type[BaseException], ...] = (
+        OSError, ConnectionError, asyncio.TimeoutError,
+    ),
+    sleep: Callable = asyncio.sleep,
+):
+    """Call ``await fn()`` until it succeeds, sleeping the backoff's
+    next delay after each retryable failure; re-raises the last failure
+    once the delays are exhausted (``max_retries`` bounds the RETRIES:
+    the first attempt is free, so ``max_retries=2`` means ≤3 attempts).
+
+    Deterministic path: give ``backoff`` a seeded ``random.Random`` —
+    delays are drawn only from that rng, in attempt order, so a replay
+    with the same seed and the same failure sequence sleeps the same
+    schedule.  ``sleep`` is injectable so tests (and the det scheduler)
+    can collect the delays instead of waiting them out.
+    """
+    delays = iter(backoff)
+    while True:
+        try:
+            return await fn()
+        except exceptions:
+            # NOTE: StopIteration must not escape a coroutine (PEP 479
+            # turns it into RuntimeError) — exhausted delays re-raise
+            # the ORIGINAL failure instead
+            delay = next(delays, None)
+            if delay is None:
+                raise
+            await sleep(delay)

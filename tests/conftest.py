@@ -27,3 +27,10 @@ assert jax.device_count() == 8, (
     f"expected 8 virtual CPU devices, got {jax.device_count()} "
     "(XLA_FLAGS set too late?)"
 )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long soaks (e.g. the N=32 chaos run) excluded from tier-1",
+    )

@@ -181,6 +181,21 @@ def test_partition_heals_via_sync(run, tmp_path):
 
             for i, a in enumerate(agents):
                 partition(a, i < n // 2)
+            # a partition severs ESTABLISHED connections too, not just
+            # new dials: drop cached cross-group muxes (live sessions
+            # die with a reset) and drain the one-tick window in which
+            # an open_bi that entered the ORIGINAL method before the
+            # patch could still hand back a live cross-group session —
+            # its handshake must complete (empty: nothing written yet)
+            # before the writes land, or it may legally serve them
+            # across the "partition" (the faults.FaultController
+            # split() semantics, corrosion_tpu/faults.py)
+            for a in agents:
+                side = group[tuple(a.gossip_addr)]
+                for b in agents:
+                    if a is not b and group[tuple(b.gossip_addr)] != side:
+                        a.transport.drop(tuple(b.gossip_addr))
+            await asyncio.sleep(0.1)
 
             # writes on BOTH sides while split
             agents[0].execute_transaction(

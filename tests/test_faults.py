@@ -1,0 +1,563 @@
+"""Deterministic fault injection + degraded-mode hardening.
+
+Covers the fault subsystem end to end: FaultPlan decision determinism
+(same seed ⇒ byte-identical decisions), the backoff retry helper's
+jitter bounds, circuit-breaker state machine + member quarantine and
+restore, and live-cluster convergence THROUGH the sim's fault family —
+10% link loss, a partition-heal cycle, and a crash/restart — on a
+9-node in-process cluster (tier-1-fast sizes; the N≈32 soak is
+``@pytest.mark.slow`` and feeds ``CHAOS_N32.json`` via
+``bench.py --chaos``).
+"""
+
+import asyncio
+import random
+import time
+
+import pytest
+
+from corrosion_tpu.faults import (
+    CrashEvent,
+    FaultAction,
+    FaultController,
+    FaultPlan,
+)
+from corrosion_tpu.utils.backoff import Backoff, retry
+
+
+@pytest.fixture
+def run():
+    def _run(coro):
+        return asyncio.run(coro)
+
+    return _run
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan / FaultController determinism
+# ---------------------------------------------------------------------------
+
+
+def test_faultplan_decisions_are_byte_identical_across_replays():
+    plan = FaultPlan(seed=42, drop=0.3, delay=0.01, delay_jitter=0.02)
+
+    def trace(p):
+        out = bytearray()
+        for src in ("n0", "n1", "n2"):
+            for dst in ("n0", "n1", "n2"):
+                for ch in ("uni", "bi", "udp"):
+                    for n in range(64):
+                        out += p.link_decision(src, dst, ch, n).encode()
+        return bytes(out)
+
+    a, b = trace(plan), trace(plan)
+    assert a == b  # pure function: replay is byte-identical
+    # decisions actually vary (some drops, some passes)
+    acts = [
+        plan.link_decision("n0", "n1", "uni", n) for n in range(200)
+    ]
+    drops = sum(1 for x in acts if x.drop)
+    assert 20 < drops < 120  # ~30% of 200, loose bounds
+    # a different seed yields a different decision stream
+    other = FaultPlan(seed=43, drop=0.3, delay=0.01, delay_jitter=0.02)
+    assert trace(other) != a
+
+
+def test_faultcontroller_replay_log_is_byte_identical():
+    plan = FaultPlan(seed=7, drop=0.25, partition_blocks=2)
+
+    def drive(ctrl):
+        for name, addr in (("a", ("127.0.0.1", 1)), ("b", ("127.0.0.1", 2)),
+                           ("c", ("127.0.0.1", 3)), ("d", ("127.0.0.1", 4))):
+            ctrl.register(name, addr)
+        ctrl.start()
+        ctrl.split()
+        hooks = {n: ctrl.hook_for(n) for n in "abcd"}
+        for i in range(50):
+            hooks["a"]("uni", ("127.0.0.1", 2))
+            hooks["a"]("uni", ("127.0.0.1", 3))  # cross-block: partition
+            hooks["c"]("udp", ("127.0.0.1", 4))
+            hooks["b"]("bi", ("127.0.0.1", 1))
+        ctrl.heal()
+        for i in range(50):
+            hooks["a"]("uni", ("127.0.0.1", 3))  # healed: seeded draws
+        return bytes(ctrl.decision_log)
+
+    log1 = drive(FaultController(plan))
+    log2 = drive(FaultController(plan))
+    assert log1 == log2
+    assert len(log1) > 0
+
+
+def test_partition_blocks_match_sim_partition_ids():
+    # same index→block map as sim/epidemic._partition_ids
+    plan = FaultPlan(partition_blocks=3)
+    blocks = [plan.block_of(i, 9) for i in range(9)]
+    assert blocks == [0, 0, 0, 1, 1, 1, 2, 2, 2]
+
+
+def test_partitioned_drop_does_not_consume_link_counter():
+    """Partition drops must not burn seeded draws: post-heal decisions
+    depend only on the number of NON-partition messages sent."""
+    plan = FaultPlan(seed=1, drop=0.5, partition_blocks=2)
+    addrs = {"a": ("127.0.0.1", 1), "b": ("127.0.0.1", 2)}
+
+    def post_heal_trace(n_partition_drops):
+        ctrl = FaultController(plan)
+        for n, ad in addrs.items():
+            ctrl.register(n, ad)
+        ctrl.start()
+        ctrl.split()
+        hook = ctrl.hook_for("a")
+        for _ in range(n_partition_drops):
+            assert hook("uni", addrs["b"]).reason == "partition"
+        ctrl.heal()
+        return b"".join(
+            hook("uni", addrs["b"]).encode() for _ in range(20)
+        )
+
+    assert post_heal_trace(3) == post_heal_trace(11)
+
+
+def test_unregistered_destination_is_never_faulted():
+    ctrl = FaultController(FaultPlan(seed=0, drop=1.0))
+    ctrl.register("a", ("127.0.0.1", 1))
+    hook = ctrl.hook_for("a")
+    act = hook("uni", ("10.0.0.9", 999))  # not a cluster node
+    assert not act.drop and not act.delay
+
+
+# ---------------------------------------------------------------------------
+# backoff retry helper
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_jitter_bounds_and_determinism():
+    rng = random.Random(123)
+    delays = list(Backoff(base=0.1, cap=2.0, max_retries=50, rng=rng))
+    assert len(delays) == 50
+    prev = 0.1
+    for d in delays:
+        # decorrelated jitter: each delay in [base, min(cap, prev*3)]
+        assert 0.1 <= d <= 2.0
+        assert d <= max(0.1, prev * 3) + 1e-9
+        prev = d
+    # seeded rng ⇒ replayable schedule
+    delays2 = list(
+        Backoff(base=0.1, cap=2.0, max_retries=50,
+                rng=random.Random(123))
+    )
+    assert delays == delays2
+
+
+def test_retry_helper_bounded_and_replayable(run):
+    async def main():
+        calls = {"n": 0}
+        slept = []
+
+        async def fake_sleep(d):
+            slept.append(d)
+
+        async def always_fails():
+            calls["n"] += 1
+            raise OSError("nope")
+
+        with pytest.raises(OSError):
+            await retry(
+                always_fails,
+                Backoff(base=0.05, cap=0.5, max_retries=3,
+                        rng=random.Random(9)),
+                sleep=fake_sleep,
+            )
+        # max_retries bounds the RETRIES: 1 first attempt + 3 retries
+        assert calls["n"] == 4
+        assert len(slept) == 3
+        assert all(0.05 <= d <= 0.5 for d in slept)
+
+        # deterministic-RNG path: same seed, same failure sequence ⇒
+        # same sleep schedule
+        slept2 = []
+
+        async def fake_sleep2(d):
+            slept2.append(d)
+
+        with pytest.raises(OSError):
+            await retry(
+                always_fails,
+                Backoff(base=0.05, cap=0.5, max_retries=3,
+                        rng=random.Random(9)),
+                sleep=fake_sleep2,
+            )
+        assert slept == slept2
+
+        # success stops retrying immediately
+        state = {"n": 0}
+
+        async def second_try():
+            state["n"] += 1
+            if state["n"] < 2:
+                raise ConnectionError("flap")
+            return "ok"
+
+        assert await retry(
+            second_try,
+            Backoff(base=0.01, cap=0.05, max_retries=3,
+                    rng=random.Random(1)),
+            sleep=fake_sleep,
+        ) == "ok"
+        assert state["n"] == 2
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker + member quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_circuit_breaker_state_machine():
+    from corrosion_tpu.agent.transport import CircuitBreaker
+
+    b = CircuitBreaker(threshold=3, cooldown=0.05)
+    assert b.allow() and b.state() == "closed"
+    assert not b.record_failure()
+    assert not b.record_failure()
+    assert b.record_failure()  # third consecutive failure OPENS
+    assert b.is_open and not b.allow()
+    time.sleep(0.06)
+    assert b.state() == "half-open"
+    assert b.allow()  # one half-open trial
+    assert not b.allow()  # ...only one at a time
+    assert b.record_success()  # trial succeeded: breaker closes
+    assert b.state() == "closed" and b.allow()
+    # reopen, then a FAILED half-open trial restarts the cooldown
+    for _ in range(3):
+        b.record_failure()
+    assert b.is_open
+    time.sleep(0.06)
+    assert b.allow()
+    assert not b.record_failure()  # re-arms, does not double-count open
+    assert not b.allow()
+
+
+def test_members_quarantine_deprioritizes_and_restores():
+    from corrosion_tpu.agent.members import Members
+
+    ms = Members(b"self" * 4)
+    actors = []
+    for i in range(6):
+        actor = bytes([i]) * 16
+        actors.append(actor)
+        ms.upsert(actor, ("127.0.0.1", 1000 + i))
+        for _ in range(5):
+            ms.record_rtt(actor, 1.0)  # everyone ring0-fast
+    assert len(ms.ring0()) == 6
+    bad = actors[0]
+    ms.set_quarantined(bad, True)
+    # quarantined: out of ring0, and never sampled while healthy peers
+    # can fill k
+    assert all(m.actor_id != bad for m in ms.ring0())
+    rng = random.Random(0)
+    for _ in range(50):
+        picked = ms.sample(3, rng, ring0_first=False)
+        assert all(m.actor_id != bad for m in picked)
+    # ...but still used when the healthy pool cannot fill k (half-open
+    # trials need traffic)
+    picked = ms.sample(6, rng, ring0_first=False)
+    assert len(picked) == 6
+    # restore on half-open success: full eligibility returns
+    ms.set_quarantined(bad, False)
+    assert any(m.actor_id == bad for m in ms.ring0())
+    seen_bad = any(
+        any(m.actor_id == bad for m in ms.sample(3, rng, ring0_first=False))
+        for _ in range(100)
+    )
+    assert seen_bad
+
+    # the addr-keyed path the transport callback uses
+    assert ms.quarantine_by_addr(("127.0.0.1", 1001), True)
+    assert ms.get(actors[1]).quarantined
+    assert ms.quarantine_by_addr(("127.0.0.1", 1001), False)
+    assert not ms.get(actors[1]).quarantined
+    assert not ms.quarantine_by_addr(("10.0.0.1", 1), True)
+
+
+# ---------------------------------------------------------------------------
+# live 9-node cluster through the fault family
+# ---------------------------------------------------------------------------
+
+
+async def _boot_cluster(n, tmp_path, ctrl, **overrides):
+    from corrosion_tpu.devcluster import Topology, run_inprocess
+
+    topo = Topology.parse("\n".join(f"n0 -> n{i}" for i in range(1, n)))
+    kwargs = dict(
+        ring0_enabled=False,
+        subs_enabled=False,
+        api_port=None,
+        uni_cache_size=16,
+        breaker_cooldown=0.3,
+        redial_base=0.02,
+        redial_cap=0.2,
+    )
+    kwargs.update(overrides)
+    agents = await run_inprocess(
+        topo, base_dir=str(tmp_path), faults=ctrl, **kwargs
+    )
+    from corrosion_tpu.agent.testing import seed_full_membership
+
+    # membership is pre-seeded: these tests pin the DATA-plane degraded
+    # paths (loss / partition / crash catch-up / breaker bounds), not
+    # SWIM formation — which is covered by test_agent_gossip and the
+    # soak, and would add a wall-clock flake surface here
+    seed_full_membership(list(agents.values()))
+    return agents
+
+
+def _table(a):
+    return a.storage.read_query("SELECT id, text FROM tests ORDER BY id")[1]
+
+
+async def _stop_all(agents):
+    for a in list(agents.values()):
+        try:
+            await a.stop()
+        except Exception:
+            pass
+
+
+def test_9node_converges_through_10pct_loss(run, tmp_path):
+    async def main():
+        from corrosion_tpu.agent.testing import wait_for
+
+        n = 9
+        ctrl = FaultController(FaultPlan(seed=3, drop=0.10))
+        agents = await _boot_cluster(n, tmp_path, ctrl)
+        try:
+            for i in range(6):
+                agents[f"n{i % n}"].execute_transaction([
+                    ("INSERT INTO tests (id, text) VALUES (?, ?)",
+                     (i, f"lossy{i}"))
+                ])
+            await wait_for(
+                lambda: all(
+                    len(_table(a)) == 6 for a in agents.values()
+                ) and len({
+                    tuple(_table(a)) for a in agents.values()
+                }) == 1,
+                timeout=60,
+            )
+            # faults actually fired (10% of the gossip volume)
+            assert ctrl.injected["drop"] > 0
+        finally:
+            await _stop_all(agents)
+
+    run(main())
+
+
+def test_9node_partition_heal_cycle_converges(run, tmp_path):
+    async def main():
+        from corrosion_tpu.agent.testing import wait_for
+
+        n = 9
+        ctrl = FaultController(FaultPlan(seed=5, partition_blocks=3))
+        agents = await _boot_cluster(
+            n, tmp_path, ctrl, suspect_timeout=30.0
+        )
+        try:
+            ctrl.split()
+            # one write per block while split
+            for i, writer in enumerate(("n0", "n3", "n6")):
+                agents[writer].execute_transaction([
+                    ("INSERT INTO tests (id, text) VALUES (?, ?)",
+                     (i, f"block{i}"))
+                ])
+            # in-block convergence: every node gets ITS block's write
+            own = {
+                f"n{j}": [(j // 3, f"block{j // 3}")] for j in range(n)
+            }
+            try:
+                await wait_for(
+                    lambda: all(
+                        own[name][0] in _table(a)
+                        for name, a in agents.items()
+                    ),
+                    timeout=45,
+                )
+            except TimeoutError:
+                state = {
+                    name: _table(a) for name, a in agents.items()
+                }
+                raise AssertionError(
+                    f"in-block delivery stalled: tables={state} "
+                    f"injected={ctrl.injected}"
+                )
+            # isolation: while the partition holds, no node has any
+            # OTHER block's write
+            for name, a in agents.items():
+                assert _table(a) == own[name], (
+                    f"partition leaked: {name} has {_table(a)}, "
+                    f"expected {own[name]}"
+                )
+            assert ctrl.injected["partition"] > 0
+            ctrl.heal()
+            want = [(0, "block0"), (1, "block1"), (2, "block2")]
+            await wait_for(
+                lambda: all(_table(a) == want for a in agents.values()),
+                timeout=60,
+            )
+        finally:
+            await _stop_all(agents)
+
+    run(main())
+
+
+def test_9node_crash_restart_catches_up_via_anti_entropy(run, tmp_path):
+    async def main():
+        from corrosion_tpu.agent.testing import wait_for
+        from corrosion_tpu.devcluster import run_crash_schedule
+
+        n = 9
+        ctrl = FaultController(FaultPlan(
+            seed=11,
+            crashes=(CrashEvent("n8", at=0.0, restart_at=0.8),),
+        ))
+        agents = await _boot_cluster(n, tmp_path, ctrl)
+        try:
+            victim_actor = agents["n8"].actor_id
+            ctrl.restart_clock()
+            crash_task = asyncio.ensure_future(run_crash_schedule(ctrl))
+            # wait until the victim is actually down before writing so
+            # the writes genuinely miss it
+            await wait_for(
+                lambda: any(e == "crash" for _, e, _n in ctrl.crash_log),
+                timeout=10,
+            )
+            for i in range(8):
+                agents[f"n{i % (n - 1)}"].execute_transaction([
+                    ("INSERT INTO tests (id, text) VALUES (?, ?)",
+                     (i, f"missed{i}"))
+                ])
+            await asyncio.wait_for(crash_task, timeout=20)
+            reborn = ctrl.agents["n8"]
+            assert reborn.actor_id == victim_actor  # resume, not re-seed
+            await wait_for(
+                lambda: len(_table(reborn)) == 8
+                and _table(reborn) == _table(ctrl.agents["n0"]),
+                timeout=60,
+            )
+        finally:
+            await _stop_all(ctrl.agents)
+
+    run(main())
+
+
+def test_flush_round_does_not_stall_on_dead_peer(run, tmp_path):
+    """With one peer crashed, a broadcast flush round stays bounded:
+    breaker + bounded redial keep the live peers converging fast, and
+    the dead peer's breaker opens (quarantining it from fanout)."""
+    async def main():
+        from corrosion_tpu.agent.testing import wait_for
+
+        n = 5
+        ctrl = FaultController(FaultPlan(seed=2))
+        agents = await _boot_cluster(
+            n, tmp_path, ctrl,
+            breaker_threshold=2,
+            # long suspicion: SWIM must NOT down-mark the corpse for
+            # us — the transport layer alone has to stay bounded
+            suspect_timeout=60.0,
+            connect_timeout=0.3,
+        )
+        try:
+            dead_addr = tuple(agents["n4"].gossip_addr)
+            await agents["n4"].stop(graceful=False)
+            live = [agents[f"n{i}"] for i in range(4)]
+            t0 = time.perf_counter()
+            for i in range(10):
+                live[i % 4].execute_transaction([
+                    ("INSERT INTO tests (id, text) VALUES (?, ?)",
+                     (i, f"bounded{i}"))
+                ])
+            await wait_for(
+                lambda: all(len(_table(a)) == 10 for a in live)
+                and len({tuple(_table(a)) for a in live}) == 1,
+                timeout=15,
+            )
+            elapsed = time.perf_counter() - t0
+            # well under the suite budget: the corpse cost at most a
+            # few connect timeouts before its breaker opened
+            assert elapsed < 15.0
+            opened = sum(
+                a.transport.stats[dead_addr].breaker_opens
+                for a in live
+                if dead_addr in a.transport.stats
+            )
+            assert opened >= 1
+            # ConnStats surfaced the degraded-mode accounting
+            assert any(
+                a.transport.stats[dead_addr].failures > 0
+                for a in live
+                if dead_addr in a.transport.stats
+            )
+        finally:
+            await _stop_all(agents)
+
+    run(main())
+
+
+def test_admin_cluster_members_exposes_transport_and_faults(run, tmp_path):
+    async def main():
+        from corrosion_tpu.agent.admin import _handle
+        from corrosion_tpu.agent.testing import wait_for
+
+        n = 3
+        ctrl = FaultController(FaultPlan(seed=1, drop=0.05))
+        agents = await _boot_cluster(n, tmp_path, ctrl)
+        try:
+            agents["n0"].execute_transaction([
+                ("INSERT INTO tests (id, text) VALUES (1, 'x')",)
+            ])
+            await wait_for(
+                lambda: all(len(_table(a)) == 1 for a in agents.values()),
+                timeout=20,
+            )
+            a = agents["n0"]
+            members = _handle(a, {"cmd": "cluster_members"})["ok"]
+            assert len(members) == n - 1
+            for m in members:
+                assert "breaker" in m and "quarantined" in m
+                if m["transport"] is not None:
+                    for k in ("faults_dropped", "redials",
+                              "breaker_opens"):
+                        assert k in m["transport"]
+            faults = _handle(a, {"cmd": "faults"})["ok"]
+            assert faults["drop"] == 0.05
+            assert faults["nodes"] == n
+            assert faults["decisions"] > 0
+            ts = _handle(a, {"cmd": "transport_stats"})["ok"]
+            assert isinstance(ts, dict)
+        finally:
+            await _stop_all(agents)
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# the N≈32 soak (bench.py --chaos writes CHAOS_N32.json from this path)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_soak_n32(run, tmp_path):
+    async def main():
+        from corrosion_tpu.sim.chaos import run_chaos
+
+        out = tmp_path / "CHAOS_N32.json"
+        result = await run_chaos(
+            n=32, out_path=str(out), base_dir=str(tmp_path / "cluster")
+        )
+        assert result["diff"]["both_converged"]
+        assert out.exists()
+
+    run(main())
