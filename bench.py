@@ -61,6 +61,134 @@ def _emit(line: dict) -> None:
     print(json.dumps(_sanitize(line)), flush=True)
 
 
+# -- apply-path microbenchmark (bench.py --apply) ----------------------
+
+
+def _apply_bench_changes(n: int, site: bytes, col_version: int):
+    """``n`` cell changes over ``n // 4`` rows x 4 cells — the shape of
+    a sync-driven backfill (many rows, few cells each)."""
+    from corrosion_tpu.agent.pack import pack_values
+    from corrosion_tpu.types.base import CrsqlDbVersion, CrsqlSeq
+    from corrosion_tpu.types.change import Change
+
+    changes = []
+    seq = 0
+    for r in range(max(1, n // 4)):
+        pk = pack_values([r])
+        for cid in ("a", "b", "c", "d"):
+            changes.append(Change(
+                table="bench", pk=pk, cid=cid,
+                val=f"v{col_version}-{r}-{cid}",
+                col_version=col_version,
+                db_version=CrsqlDbVersion(col_version),
+                seq=CrsqlSeq(seq), site_id=site, cl=1,
+            ))
+            seq += 1
+            if len(changes) >= n:
+                return changes
+    return changes
+
+
+def run_apply_bench(sizes=(1000, 10000), out_path="APPLY_BENCH.json"):
+    """Per-change vs batched CRDT apply throughput (changes/s), cold
+    (fresh rows) and warm (existing rows, superseding col_versions).
+    Each measurement gets its own database; the two paths are also
+    cross-checked to impact the same number of rows."""
+    import tempfile
+
+    from corrosion_tpu.agent.storage import CrConn
+
+    site = b"\x42" * 16
+    points = []
+
+    def _mk_db(d, name):
+        conn = CrConn(os.path.join(d, f"{name}.db"))
+        conn.conn.execute(
+            "CREATE TABLE IF NOT EXISTS bench ("
+            " id INTEGER PRIMARY KEY NOT NULL, a, b, c, d)"
+        )
+        conn.as_crr("bench")
+        return conn
+
+    def _measure(db, changes, batched):
+        t0 = time.perf_counter()
+        if batched:
+            impacted = db.apply_changes_batched(changes)
+        else:
+            with db.apply_tx():
+                impacted = db.apply_changes_sequential_in_tx(changes)
+        return time.perf_counter() - t0, impacted
+
+    with tempfile.TemporaryDirectory(prefix="corro-apply-bench-") as d:
+        for n in sizes:
+            cold = _apply_bench_changes(n, site, col_version=1)
+            warm = _apply_bench_changes(n, site, col_version=2)
+            for mode in ("cold", "warm"):
+                row = {"n_changes": n, "mode": mode}
+                impacts = {}
+                for batched in (False, True):
+                    key = "batched" if batched else "per_change"
+                    db = _mk_db(d, f"{n}-{mode}-{key}")
+                    try:
+                        if mode == "warm":
+                            # pre-populate rows, then time the
+                            # superseding second pass
+                            db.apply_changes_batched(cold)
+                        wall, impacted = _measure(
+                            db, warm if mode == "warm" else cold, batched
+                        )
+                    finally:
+                        db.close()
+                    impacts[key] = impacted
+                    row[key] = {
+                        "wall_s": round(wall, 4),
+                        "changes_per_s": round(n / max(wall, 1e-9), 1),
+                        "rows_impacted": impacted,
+                    }
+                if impacts["per_change"] != impacts["batched"]:
+                    row["error"] = (
+                        "impact mismatch: per_change="
+                        f"{impacts['per_change']} "
+                        f"batched={impacts['batched']}"
+                    )
+                row["speedup"] = round(
+                    row["batched"]["changes_per_s"]
+                    / max(row["per_change"]["changes_per_s"], 1e-9), 2
+                )
+                points.append(row)
+    headline = next(
+        (p for p in points
+         if p["n_changes"] == max(sizes) and p["mode"] == "cold"),
+        points[-1],
+    )
+    bad = [p for p in points if "error" in p]
+    out = {
+        "metric": "apply_batched_speedup",
+        # a speedup over DIVERGENT semantics must not read as a clean
+        # headline: any impact mismatch voids the value
+        "value": None if bad else headline["speedup"],
+        "unit": "x",
+        "conditions": (
+            "changes/s applying one remote actor's cell changes "
+            "(n/4 rows x 4 cells) through apply_changes_sequential_in_tx "
+            "vs apply_changes_batched, one transaction each; cold = "
+            "fresh rows, warm = superseding col_versions over "
+            "existing rows"
+        ),
+        "points": points,
+    }
+    if bad:
+        out["error"] = (
+            f"{len(bad)} point(s) with per-change/batched "
+            "rows-impacted mismatch"
+        )
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(_sanitize(out), f, indent=2)
+            f.write("\n")
+    return out
+
+
 # -- config #1: real 3-node devcluster ---------------------------------
 
 
@@ -324,12 +452,23 @@ def main() -> None:
                          "CHAOS_N32.json, and exit")
     ap.add_argument("--chaos-nodes", type=int, default=32,
                     help="cluster size for --chaos")
+    ap.add_argument("--apply", action="store_true",
+                    help="run the per-change vs batched CRDT apply "
+                         "microbenchmark (1k/10k changes, cold+warm), "
+                         "write APPLY_BENCH.json, and exit")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
     if args.check:
         args.nodes, args.seeds, args.config = 4096, 8, "5"
 
+    if args.apply:
+        # pure-sqlite benchmark: no JAX/compile-cache setup needed
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "APPLY_BENCH.json"
+        )
+        _emit(run_apply_bench(out_path=out_path))
+        return
     _enable_compile_cache()
     if args.calibrate_msgs:
         from corrosion_tpu.sim.calibrate import run_msgs_calibration

@@ -281,6 +281,25 @@ CREATE TABLE IF NOT EXISTS __corro_sync_state (
         )
         self._persist_gaps(actor_id)
 
+    def persist_versions(
+        self, actor_id: bytes,
+        rows: List[Tuple[int, int, int, Optional[int]]],
+    ) -> None:
+        """Batch write-through for several applied versions of one actor
+        (the merged apply-transaction path): one executemany + ONE gap
+        diff instead of a per-version write-through.  ``rows`` is
+        ``(version, db_version, last_seq, ts)`` tuples; call inside the
+        storage tx, after the in-memory ``apply_version`` calls (the gap
+        diff reads the final needed set)."""
+        self.conn.executemany(
+            "INSERT OR REPLACE INTO __corro_bookkeeping "
+            "(actor_id, start_version, end_version, db_version, last_seq, ts)"
+            " VALUES (?, ?, NULL, ?, ?, ?)",
+            [(actor_id, v, dbv, last_seq, ts)
+             for v, dbv, last_seq, ts in rows],
+        )
+        self._persist_gaps(actor_id)
+
     def persist_cleared(self, actor_id: bytes, start: int, end: int,
                         ts: Optional[int] = None) -> None:
         """store_empty_changeset: merge with overlapping/adjacent cleared
@@ -329,13 +348,18 @@ CREATE TABLE IF NOT EXISTS __corro_sync_state (
         self._persist_gaps(actor_id)
 
     def clear_partial(self, actor_id: bytes, version: int) -> None:
-        self.conn.execute(
+        self.clear_partials(actor_id, [version])
+
+    def clear_partials(self, actor_id: bytes, versions: List[int]) -> None:
+        """Batch variant of :meth:`clear_partial` (merged apply path)."""
+        rows = [(actor_id, v) for v in versions]
+        self.conn.executemany(
             "DELETE FROM __corro_seq_bookkeeping WHERE actor_id=? AND version=?",
-            (actor_id, version),
+            rows,
         )
-        self.conn.execute(
+        self.conn.executemany(
             "DELETE FROM __corro_buffered_changes WHERE actor_id=? AND version=?",
-            (actor_id, version),
+            rows,
         )
 
     def _persist_gaps(self, actor_id: bytes) -> None:
@@ -429,6 +453,18 @@ CREATE TABLE IF NOT EXISTS __corro_sync_state (
             (actor_id, version, seq, blob),
         )
 
+    def buffer_changes(
+        self, actor_id: bytes, version: int,
+        rows: List[Tuple[int, bytes]],
+    ) -> None:
+        """Batch variant of :meth:`buffer_change`: one executemany for a
+        whole partial chunk's ``(seq, blob)`` rows."""
+        self.conn.executemany(
+            "INSERT OR REPLACE INTO __corro_buffered_changes "
+            "(actor_id, version, seq, change) VALUES (?, ?, ?, ?)",
+            [(actor_id, version, seq, blob) for seq, blob in rows],
+        )
+
     def buffered_changes(self, actor_id: bytes, version: int) -> List[Tuple[int, bytes]]:
         return [
             (seq, bytes(blob))
@@ -438,6 +474,27 @@ CREATE TABLE IF NOT EXISTS __corro_sync_state (
                 (actor_id, version),
             )
         ]
+
+    # -- transactional snapshot (merged-apply failure recovery) ----------
+
+    def snapshot_actor(self, actor_id: bytes) -> tuple:
+        """Copy one actor's in-memory version state.  Paired with
+        :meth:`restore_actor` around a multi-changeset transaction: if
+        the tx rolls back after ``apply_version`` calls, memory must be
+        rolled back too, or the lost versions read as already-applied
+        and are never re-fetched until restart."""
+        bv = self.for_actor(actor_id)
+        needed = RangeSet()
+        for s, e in bv.needed.spans():
+            needed.insert(s, e)
+        return (needed, dict(bv.partials), dict(bv.versions), bv.max_version)
+
+    def restore_actor(self, actor_id: bytes, snapshot: tuple) -> None:
+        bv = self.for_actor(actor_id)
+        bv.needed, bv.partials, bv.versions, bv.max_version = snapshot
+        # the gap write-through cache may now disagree with the rolled-
+        # back DB rows: drop it so the next diff re-reads the table
+        self._persisted_gaps.pop(actor_id, None)
 
     # -- access ----------------------------------------------------------
 

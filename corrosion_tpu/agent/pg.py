@@ -539,16 +539,29 @@ def _agent_catalog_lock(agent: "Agent") -> threading.Lock:
     return lock
 
 
-def _catalog_query(agent: "Agent", tsql: str, params) -> Tuple[list, list]:
+def _catalog_query(agent: "Agent", tsql: str, params,
+                   on_conn=None) -> Tuple[list, list]:
     """Run one SELECT against the rendered catalog under the agent's
     catalog lock: sessions execute in worker threads, and one shared
     sqlite connection must not see concurrent cursors (sqlite3's
-    serialized mode is a build option, not a guarantee)."""
+    serialized mode is a build option, not a guarantee).
+
+    ``on_conn`` (called with the catalog connection while the query
+    runs, then with None) makes catalog reads interruptible by a
+    concurrent CancelRequest — the lock scope guarantees the tracked
+    connection is running OUR statement, never another session's."""
     agent.metrics.counter("corro_pg_statements_total", kind="catalog")
     with _agent_catalog_lock(agent):
-        cur = _catalog_for(agent).execute(tsql, params)
-        cols = [d[0] for d in cur.description or []]
-        return cur.fetchall(), cols
+        conn = _catalog_for(agent)
+        if on_conn is not None:
+            on_conn(conn)
+        try:
+            cur = conn.execute(tsql, params)
+            cols = [d[0] for d in cur.description or []]
+            return cur.fetchall(), cols
+        finally:
+            if on_conn is not None:
+                on_conn(None)
 
 
 _GUC_DEFAULTS = {
@@ -636,7 +649,11 @@ class _Session:
                 self.txn_failed = False
                 return [], [], 0, "ROLLBACK"
             if writes:
-                self.agent.execute_transaction(writes)
+                # tracked: a CancelRequest landing mid-COMMIT interrupts
+                # the buffered transaction's replay (57014)
+                self.agent.execute_transaction(
+                    writes, on_conn=self._track_conn
+                )
             return [], [], 0, "COMMIT"
         if word == "ROLLBACK" and "TO" in up_words[1:3]:
             # ROLLBACK [WORK] TO [SAVEPOINT] name: truncate the write
@@ -818,7 +835,8 @@ class _Session:
                 ),
             )
             rows, cols = _catalog_query(
-                self.agent, tsql, self._remap(params, order)
+                self.agent, tsql, self._remap(params, order),
+                on_conn=self._track_conn,
             )
             return cols, rows, len(rows), f"SELECT {len(rows)}"
 
@@ -867,7 +885,12 @@ class _Session:
             self.txn_writes.append(stmt)
             # rowcount unknown until commit; report optimistically
             return [], [], 1, tag(1)
-        out = self.agent.execute_transaction([stmt])
+        # tracked while the storage lock is held: a concurrent
+        # CancelRequest interrupts the in-flight WRITE too (57014),
+        # not just pooled reads
+        out = self.agent.execute_transaction(
+            [stmt], on_conn=self._track_conn
+        )
         res = out["results"][0]
         rc = res.get("rows_affected", 0)
         if "rows" in res:
@@ -998,7 +1021,9 @@ class _Session:
             tsql = _SCHEMA_PREFIX_RE.sub("", t)
             if order:
                 params = self._remap(params, order)
-            rows, cols = _catalog_query(self.agent, tsql, params)
+            rows, cols = _catalog_query(
+                self.agent, tsql, params, on_conn=self._track_conn
+            )
             return cols, rows, len(rows), f"SELECT {len(rows)}"
         return None
 

@@ -1034,9 +1034,15 @@ class Agent:
                 booked.apply_version(version, dbv, last_seq, ts)
                 self._queue_or_defer_broadcast(version, dbv, last_seq, ts)
 
-    def execute_transaction(self, statements: Sequence) -> dict:
+    def execute_transaction(self, statements: Sequence,
+                            on_conn=None) -> dict:
         """Run write statements in one tx; version + bookkeeping + queue
-        the broadcast (``make_broadcastable_changes`` parity)."""
+        the broadcast (``make_broadcastable_changes`` parity).
+
+        ``on_conn`` (called with the RW connection once the storage lock
+        is held, then with None before release) lets a caller interrupt
+        the in-flight write — the PG front-end's CancelRequest path,
+        mirroring ``CrConn.read_query``'s contract."""
         results = []
         booked = self.bookie.for_actor(self.actor_id)
         # hold the storage lock across COMMIT *and* the in-memory bookie
@@ -1047,67 +1053,88 @@ class Agent:
         # reference (api/public/mod.rs:59)
         with self.metrics.timed("corro_write_tx_seconds"), \
                 self.storage._lock.prio(PRIO_HIGH, "write", kind="write"):
-            with self.storage.write_tx() as conn:
-                for stmt in statements:
-                    sql, params = unpack_stmt(stmt)
-                    cur = conn.execute(sql, params)
-                    head = sql.lstrip().split(None, 1)
-                    is_dml = bool(head) and head[0].upper() in (
-                        "INSERT", "UPDATE", "DELETE", "REPLACE", "WITH",
-                    )
-                    if cur.rowcount < 0 and cur.description is None \
-                            and is_dml:
-                        # sqlite3 reports -1 for INSERT..SELECT and
-                        # friends; changes() has the statement's true
-                        # direct count (triggers excluded).  DML-gated:
-                        # for DDL, changes() still holds the PREVIOUS
-                        # statement's count
-                        cur = conn.execute("SELECT changes()")
-                        n = cur.fetchone()[0]
-                        results.append({"rows_affected": n})
-                        continue
-                    if cur.description is not None:
-                        # RETURNING clause (ORM-style writes): surface
-                        # the produced rows alongside the write result,
-                        # JSON-safe (a BLOB column must not 500 the
-                        # HTTP response after the write committed).
-                        # fetchall() FIRST — sqlite3 only counts
-                        # affected rows as RETURNING rows are stepped,
-                        # so rowcount is 0 until the fetch completes
-                        from corrosion_tpu.agent.pack import jsonable_row
-
-                        fetched = cur.fetchall()
-                        res = {
-                            "rows_affected": cur.rowcount,
-                            "columns": [d[0] for d in cur.description],
-                            "rows": [jsonable_row(r) for r in fetched],
-                        }
-                    else:
-                        res = {"rows_affected": cur.rowcount}
-                    results.append(res)
-                n_changes = self.storage._state("seq")
-                if n_changes > 0:
-                    version = booked.last() + 1
-                    db_version = self.storage._state("pending_db_version")
-                    ts = self.clock.new_timestamp()
-                    # persist inside the tx (atomic with the data); the
-                    # in-memory bookie commits only after COMMIT succeeds,
-                    # so a failed commit can't leave memory advertising a
-                    # version the DB never stored
-                    self.bookie.persist_version(
-                        self.actor_id, version, db_version,
-                        n_changes - 1, int(ts),
-                    )
-                else:
-                    version = None
-            if version is not None:
-                booked.apply_version(version, db_version, n_changes - 1, ts)
-        if version is not None:
+            # tracked only while the lock is held, so a cancel cannot
+            # interrupt another session's statement on the shared conn
+            if on_conn is not None:
+                on_conn(self.storage.conn)
+            try:
+                committed = self._execute_transaction_locked(
+                    statements, results, booked
+                )
+            finally:
+                if on_conn is not None:
+                    on_conn(None)
+        if committed is not None:
+            version, db_version, n_changes, ts = committed
             self._queue_or_defer_broadcast(
                 version, db_version, n_changes - 1, ts
             )
             self._compact_best_effort()
-        return {"results": results, "version": version}
+            return {"results": results, "version": version}
+        return {"results": results, "version": None}
+
+    def _execute_transaction_locked(self, statements, results,
+                                    booked) -> Optional[tuple]:
+        """Body of :meth:`execute_transaction` under the storage lock;
+        returns ``(version, db_version, n_changes, ts)`` for a committed
+        versioned write, None for a changeless one."""
+        with self.storage.write_tx() as conn:
+            for stmt in statements:
+                sql, params = unpack_stmt(stmt)
+                cur = conn.execute(sql, params)
+                head = sql.lstrip().split(None, 1)
+                is_dml = bool(head) and head[0].upper() in (
+                    "INSERT", "UPDATE", "DELETE", "REPLACE", "WITH",
+                )
+                if cur.rowcount < 0 and cur.description is None \
+                        and is_dml:
+                    # sqlite3 reports -1 for INSERT..SELECT and
+                    # friends; changes() has the statement's true
+                    # direct count (triggers excluded).  DML-gated:
+                    # for DDL, changes() still holds the PREVIOUS
+                    # statement's count
+                    cur = conn.execute("SELECT changes()")
+                    n = cur.fetchone()[0]
+                    results.append({"rows_affected": n})
+                    continue
+                if cur.description is not None:
+                    # RETURNING clause (ORM-style writes): surface
+                    # the produced rows alongside the write result,
+                    # JSON-safe (a BLOB column must not 500 the
+                    # HTTP response after the write committed).
+                    # fetchall() FIRST — sqlite3 only counts
+                    # affected rows as RETURNING rows are stepped,
+                    # so rowcount is 0 until the fetch completes
+                    from corrosion_tpu.agent.pack import jsonable_row
+
+                    fetched = cur.fetchall()
+                    res = {
+                        "rows_affected": cur.rowcount,
+                        "columns": [d[0] for d in cur.description],
+                        "rows": [jsonable_row(r) for r in fetched],
+                    }
+                else:
+                    res = {"rows_affected": cur.rowcount}
+                results.append(res)
+            n_changes = self.storage._state("seq")
+            if n_changes > 0:
+                version = booked.last() + 1
+                db_version = self.storage._state("pending_db_version")
+                ts = self.clock.new_timestamp()
+                # persist inside the tx (atomic with the data); the
+                # in-memory bookie commits only after COMMIT succeeds,
+                # so a failed commit can't leave memory advertising a
+                # version the DB never stored
+                self.bookie.persist_version(
+                    self.actor_id, version, db_version,
+                    n_changes - 1, int(ts),
+                )
+            else:
+                version = None
+        if version is None:
+            return None
+        booked.apply_version(version, db_version, n_changes - 1, ts)
+        return (version, db_version, n_changes, ts)
 
     def _find_and_clear_overwritten(self) -> List[Tuple[int, int]]:
         """Local compaction: versions whose change rows were all
@@ -1410,9 +1437,9 @@ class Agent:
     # apply workers off the event loop)
     # ------------------------------------------------------------------
 
-    def enqueue_change(self, cv: ChangeV1, source: ChangeSource) -> None:
-        """Queue an incoming changeset; oldest entries drop on overflow
-        (handlers.rs:904-923 drop-oldest policy)."""
+    def _enqueue_ingest(self, item, source) -> None:
+        """Shared bounded enqueue: drop-oldest on overflow
+        (handlers.rs:904-923 policy) + channel accounting + wakeup."""
         if len(self._ingest) >= self.config.processing_queue_len:
             self._ingest.popleft()
             self.metrics.counter("corro_changes_dropped_total")
@@ -1420,12 +1447,16 @@ class Agent:
                 "corro_channel_drops_total", channel="changes")
         self.metrics.counter(
             "corro_channel_sends_total", channel="changes")
-        self._ingest.append((cv, source))
+        self._ingest.append((item, source))
+        if self._ingest_event is not None:
+            self._ingest_event.set()
+
+    def enqueue_change(self, cv: ChangeV1, source: ChangeSource) -> None:
+        """Queue an incoming changeset; oldest entries drop on overflow."""
+        self._enqueue_ingest(cv, source)
         if source is ChangeSource.SYNC:
             n = len(cv.changeset.changes) if cv.changeset.is_full else 0
             self.metrics.counter("corro_sync_changes_received_total", n)
-        if self._ingest_event is not None:
-            self._ingest_event.set()
 
     async def _change_loop(self) -> None:
         """Batch + dispatch loop: up to ``max_concurrent_applies`` batches
@@ -1473,12 +1504,20 @@ class Agent:
                     except asyncio.TimeoutError:
                         break
                     continue
-                cv, source = self._ingest.popleft()
-                batch.append((cv, source))
-                cost += max(
-                    1,
-                    len(cv.changeset.changes) if cv.changeset.is_full else 1,
-                )
+                item, source = self._ingest.popleft()
+                batch.append((item, source))
+                if source is None:
+                    # raw uni payload, decoded in the worker: true change
+                    # count is unknown pre-decode, so estimate from the
+                    # payload size (speedy changes run ~100+ bytes) so
+                    # apply_queue_len keeps bounding real batch work
+                    cost += max(1, len(item) >> 7)
+                else:
+                    cost += max(
+                        1,
+                        len(item.changeset.changes)
+                        if item.changeset.is_full else 1,
+                    )
             if not batch:
                 continue
             while len(inflight) >= cfg.max_concurrent_applies:
@@ -1499,7 +1538,11 @@ class Agent:
     def _finish_apply(self, fut) -> None:
         try:
             results = fut.result()
-        except (asyncio.CancelledError, Exception):
+        except asyncio.CancelledError:
+            # shutdown-time cancellation is not an apply failure: let it
+            # propagate instead of polluting the error metric
+            raise
+        except Exception:
             self.metrics.counter("corro_changes_apply_errors_total")
             return
         for cv, source, news in results:
@@ -1513,7 +1556,12 @@ class Agent:
                 )
 
     def _apply_batch(self, batch: List[tuple]) -> List[tuple]:
-        """Apply a batch on a worker thread; returns (cv, source, news)."""
+        """Apply a batch on a worker thread; returns (cv, source, news).
+
+        Raw uni-stream payloads (enqueued undecoded so the event loop
+        never blocks on deserialization) are speedy-decoded here, and
+        consecutive complete changesets from the same actor are merged
+        into ONE apply transaction (one fsync instead of N)."""
         with self._apply_gauge_lock:
             self._apply_active += 1
             self._apply_max_overlap = max(
@@ -1523,13 +1571,52 @@ class Agent:
         self.metrics.histogram("corro_apply_batch_size", len(batch))
         out = []
         try:
-            for cv, source in batch:
-                try:
-                    news = self.handle_change(cv, source, rebroadcast=False)
-                except Exception:
-                    self.metrics.counter("corro_changes_apply_errors_total")
-                    news = False
-                out.append((cv, source, news))
+            with self.metrics.timed("corro_apply_seconds"):
+                items: List[tuple] = []
+                for item, source in batch:
+                    if source is None:  # raw uni payload, decode off-loop
+                        try:
+                            cv = self.decode_uni_frame(item)
+                        except Exception:
+                            # decode_uni_frame catches SpeedyError, but
+                            # a hostile frame can raise others (e.g.
+                            # invalid UTF-8): one bad payload must not
+                            # abort the whole batch's valid changesets
+                            self.metrics.counter(
+                                "corro_wire_decode_errors_total")
+                            cv = None
+                        if cv is not None:
+                            items.append((cv, ChangeSource.BROADCAST))
+                    else:
+                        items.append((item, source))
+                i, n = 0, len(items)
+                while i < n:
+                    cv, source = items[i]
+                    j = i + 1
+                    cs = cv.changeset
+                    if cs.is_full and cs.is_complete():
+                        actor = cv.actor_id.bytes
+                        while j < n:
+                            cv2, _s2 = items[j]
+                            cs2 = cv2.changeset
+                            if (cv2.actor_id.bytes != actor
+                                    or not cs2.is_full
+                                    or not cs2.is_complete()):
+                                break
+                            j += 1
+                    if j - i > 1:
+                        out.extend(self._handle_change_group(items[i:j]))
+                    else:
+                        try:
+                            news = self.handle_change(
+                                cv, source, rebroadcast=False
+                            )
+                        except Exception:
+                            self.metrics.counter(
+                                "corro_changes_apply_errors_total")
+                            news = False
+                        out.append((cv, source, news))
+                    i = j
         finally:
             with self._apply_gauge_lock:
                 self._apply_active -= 1
@@ -1537,6 +1624,121 @@ class Agent:
                     "corro_apply_in_flight", self._apply_active
                 )
         return out
+
+    def _handle_change_group(self, group: List[tuple]) -> List[tuple]:
+        """Process consecutive complete changesets from one actor in one
+        merged apply transaction.  Dedup/clock/metrics/rebroadcast stay
+        per changeset; if the merged transaction fails, each changeset is
+        retried in its own transaction so one poisoned changeset only
+        kills itself."""
+        flags: List[Optional[bool]] = [None] * len(group)
+        live_idx: List[int] = []
+        dropped = [False] * len(group)
+        for k, (cv, source) in enumerate(group):
+            if self._pre_change(cv, source):
+                live_idx.append(k)
+            else:
+                # dedup/self-origin drop: handle_change returns without
+                # any accounting here, so the group path must too
+                flags[k] = False
+                dropped[k] = True
+        if live_idx:
+            live = [group[k][0] for k in live_idx]
+            try:
+                news_flags = self._apply_complete_group(
+                    live[0].actor_id.bytes, live
+                )
+            except Exception:
+                # not an apply error yet: the per-changeset retry below
+                # may fully recover — only ITS failures count, the merge
+                # abort itself gets its own series
+                self.metrics.counter("corro_apply_group_fallbacks_total")
+                news_flags = []
+                for cv in live:
+                    try:
+                        news_flags.append(self._process_changeset(cv))
+                    except Exception:
+                        self.metrics.counter(
+                            "corro_changes_apply_errors_total")
+                        news_flags.append(False)
+            for k, news in zip(live_idx, news_flags):
+                flags[k] = news
+        if any(flags):
+            # one post-group sweep: compaction is idempotent maintenance,
+            # so per-changeset sweeps inside one merged tx are redundant
+            self._compact_best_effort()
+        out = []
+        for k, (cv, source) in enumerate(group):
+            news = bool(flags[k])
+            if not dropped[k]:
+                try:
+                    # per-item guard: a raising on_change subscriber
+                    # must not abort accounting for the rest of a group
+                    # whose transaction already committed
+                    self._post_change(cv, source, news, rebroadcast=False,
+                                      compact=False)
+                except Exception:
+                    self.metrics.counter("corro_changes_apply_errors_total")
+            out.append((cv, source, news))
+        return out
+
+    def _apply_complete_group(self, actor: bytes,
+                              cvs: List[ChangeV1]) -> List[bool]:
+        """Merge several COMPLETE changesets from ``actor`` under one
+        storage lock + one apply transaction.  The already-have gate is
+        evaluated up front (before any mutation), and the in-memory
+        bookie state is snapshotted and RESTORED if the transaction
+        fails — otherwise the rolled-back versions would read as
+        'contained' and the per-changeset retry in
+        ``_handle_change_group`` would silently skip them.  Bookkeeping
+        rows flush via the bookie's executemany batch variants."""
+        with self.storage._lock:
+            booked = self.bookie.for_actor(actor)
+            flags: List[bool] = []
+            to_apply: List[ChangeV1] = []
+            batch_versions: set = set()
+            for cv in cvs:
+                v = int(cv.changeset.version)
+                if v in batch_versions or (
+                    booked.contains_version(v) and v not in booked.partials
+                ):
+                    flags.append(False)
+                    continue
+                batch_versions.add(v)
+                to_apply.append(cv)
+                flags.append(True)
+            if not to_apply:
+                return flags
+            snapshot = self.bookie.snapshot_actor(actor)
+            try:
+                with self.storage.apply_tx():
+                    for cv in to_apply:
+                        self.storage.apply_changes_in_tx(
+                            cv.changeset.changes
+                        )
+                    rows: List[tuple] = []
+                    for cv in to_apply:
+                        cs = cv.changeset
+                        v = int(cs.version)
+                        # in-memory BEFORE persist: the gap diff reads
+                        # the post-apply needed set (persist_version
+                        # contract)
+                        booked.apply_version(
+                            v, cs.max_db_version(), int(cs.last_seq),
+                            cs.ts,
+                        )
+                        rows.append((
+                            v, cs.max_db_version(), int(cs.last_seq),
+                            int(cs.ts) if cs.ts is not None else None,
+                        ))
+                    self.bookie.persist_versions(actor, rows)
+                    self.bookie.clear_partials(actor, [r[0] for r in rows])
+            except BaseException:
+                # the DB rolled back: memory must match, or every one
+                # of these versions would be skipped as already-applied
+                self.bookie.restore_actor(actor, snapshot)
+                raise
+            return flags
 
     # ------------------------------------------------------------------
     # change ingestion (handle_changes parity)
@@ -1565,6 +1767,14 @@ class Agent:
         ``rebroadcast=False`` when called from the change loop's worker
         thread — the loop requeues news itself on the event loop.
         """
+        if not self._pre_change(cv, source):
+            return False
+        news = self._process_changeset(cv)
+        self._post_change(cv, source, news, rebroadcast)
+        return news
+
+    def _pre_change(self, cv: ChangeV1, source: ChangeSource) -> bool:
+        """Dedup + clock ingestion ahead of applying; False = drop."""
         if cv.actor_id.bytes == self.actor_id:
             return False
         key = self._seen_key(cv)
@@ -1582,8 +1792,14 @@ class Agent:
                 self.clock.update_with_timestamp(cv.changeset.ts)
             except Exception:
                 pass
-        news = self._process_changeset(cv)
-        if news and cv.changeset.is_full:
+        return True
+
+    def _post_change(self, cv: ChangeV1, source: ChangeSource, news: bool,
+                     rebroadcast: bool, compact: bool = True) -> None:
+        """Accounting + rebroadcast + subscription fan-out after an
+        apply (``compact=False`` when the caller sweeps once per merged
+        transaction group instead of per changeset)."""
+        if compact and news and cv.changeset.is_full:
             # a remote apply can overwrite our own rows' change entries
             self._compact_best_effort()
         self.metrics.counter(
@@ -1602,7 +1818,6 @@ class Agent:
             )
         if news and self.on_change is not None:
             self.on_change(cv)
-        return news
 
     def _process_changeset(self, cv: ChangeV1) -> bool:
         # hold the storage lock across the have-it-already checks AND the
@@ -1660,13 +1875,15 @@ class Agent:
                 self.bookie.clear_partial(actor, v)
             return True
 
-        # partial: buffer + maybe promote
+        # partial: buffer + maybe promote.  Buffered blobs are the
+        # speedy binary codec behind a one-byte format prefix (legacy
+        # JSON blobs from older databases still decode on read)
         with self.storage.apply_tx():
-            for ch in cs.changes:
-                self.bookie.buffer_change(
-                    actor, v, int(ch.seq),
-                    wire.encode_datagram(wire.change_to_dict(ch)),
-                )
+            self.bookie.buffer_changes(
+                actor, v,
+                [(int(ch.seq), wire.encode_buffered_change(ch))
+                 for ch in cs.changes],
+            )
             partial = booked.insert_partial(
                 v, (int(cs.seqs[0]), int(cs.seqs[1])), int(cs.last_seq), cs.ts
             )
@@ -1676,7 +1893,7 @@ class Agent:
             )
             if partial.is_complete():
                 buffered = [
-                    wire.change_from_dict(wire.decode_datagram(blob))
+                    wire.decode_buffered_change(blob)
                     for _, blob in self.bookie.buffered_changes(actor, v)
                 ]
                 self.storage.apply_changes_in_tx(buffered)
@@ -2213,13 +2430,30 @@ class Agent:
         finally:
             self._conn_tasks.discard(task)
 
+    # UniPayload::V1 / Broadcast / Change variant tags: three zero u32s.
+    # decode_uni_payload accepts nothing else, so frames failing this
+    # cheap prelude check can be rejected before consuming a bounded
+    # ingest-queue slot (a junk burst must not evict real changesets).
+    _UNI_PRELUDE = b"\x00" * 12
+
+    def enqueue_uni_payload(self, payload: bytes) -> None:
+        """Queue one RAW uni-stream payload for off-loop decoding: the
+        event loop only deframes (+ a 12-byte tag sanity check); speedy
+        decode happens in the apply worker pool (``_apply_batch``), so a
+        burst of inbound gossip never blocks the loop on
+        deserialization.  Same bounded drop-oldest policy as
+        ``enqueue_change``."""
+        off = 1 if self.config.debug_hops else 0
+        if payload[off : off + 12] != self._UNI_PRELUDE:
+            self.metrics.counter("corro_wire_decode_errors_total")
+            return
+        self._enqueue_ingest(payload, None)
+
     def _ingest_uni_payloads(self, payloads) -> None:
         """Deframed uni payloads → ingest queue (shared by the
         dedicated uni stream server and the mux demux)."""
         for payload in payloads:
-            cv = self.decode_uni_frame(payload)
-            if cv is not None:
-                self.enqueue_change(cv, ChangeSource.BROADCAST)
+            self.enqueue_uni_payload(payload)
 
     async def _serve_uni(self, reader, writer) -> None:
         """Long-lived inbound broadcast stream: speedy UniPayload frames
@@ -2468,7 +2702,7 @@ class Agent:
                     for clipped in partial.seqs.intersection_spans(s, e)
                 ]
             buffered = {
-                seq: wire.change_from_dict(wire.decode_datagram(blob))
+                seq: wire.decode_buffered_change(blob)
                 for seq, blob in self.bookie.buffered_changes(actor, v)
             }
             for s, e in have:

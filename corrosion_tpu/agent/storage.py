@@ -68,6 +68,13 @@ class TableInfo:
     pk_cols: Tuple[str, ...]
     data_cols: Tuple[str, ...]  # non-pk columns
     all_cols: Tuple[str, ...] = ()  # DECLARATION order (RETURNING *)
+    # every data column is nullable or has a DEFAULT: a fresh row can be
+    # created listing only (pk + written cells) with the exact same
+    # outcome as _ensure_row + per-cell UPDATEs.  When False the batched
+    # apply keeps the conservative two-step shape, bug-for-bug with the
+    # per-change path (whose pk-only INSERT OR IGNORE silently fails on
+    # NOT-NULL-without-default columns).
+    fused_insert_ok: bool = True
 
 
 def register_udfs(conn: sqlite3.Connection) -> None:
@@ -278,6 +285,9 @@ class CrConn:
         return TableInfo(
             name=table, pk_cols=pk, data_cols=data,
             all_cols=tuple(r[1] for r in info),
+            fused_insert_ok=all(
+                not r[3] or r[4] is not None for r in info if not r[5]
+            ),
         )
 
     @property
@@ -711,7 +721,11 @@ END;
             try:
                 yield self.conn
             except BaseException:
-                self.conn.execute("ROLLBACK")
+                # an interrupt (CancelRequest) or constraint abort may
+                # have rolled the tx back already; a second ROLLBACK
+                # would mask the real error with "cannot rollback"
+                if self.conn.in_transaction:
+                    self.conn.execute("ROLLBACK")
                 raise
             wrote = self._state("seq") > 0
             if wrote:
@@ -873,19 +887,40 @@ END;
                 try:
                     self._set_state("apply_mode", 0)
                 finally:
-                    self.conn.execute("ROLLBACK")
+                    # see write_tx: the tx may have auto-rolled-back
+                    if self.conn.in_transaction:
+                        self.conn.execute("ROLLBACK")
                 raise
             self._set_state("apply_mode", 0)
             self.conn.execute("COMMIT")
 
     def apply_changes_in_tx(self, changes: Iterable[Change]) -> int:
-        """Merge changes inside an open ``apply_tx``; returns rows impacted."""
+        """Merge changes inside an open ``apply_tx``; returns rows impacted.
+
+        Dispatches to the batched pipeline beyond a couple of changes —
+        semantics are pinned identical to the per-change path by the
+        randomized parity suite (tests/test_apply_batched.py)."""
+        changes = list(changes)
+        if len(changes) <= 2:
+            return sum(self._apply_one(ch) for ch in changes)
+        return self._apply_changes_batched(changes)
+
+    def apply_changes_sequential_in_tx(self, changes: Iterable[Change]) -> int:
+        """The per-change reference path (one row-CL lookup + cell write +
+        clock upsert per change).  Kept as the parity oracle for the
+        batched pipeline and the ``bench.py --apply`` baseline."""
         return sum(self._apply_one(ch) for ch in changes)
 
     def apply_changes(self, changes: Iterable[Change]) -> int:
         """Merge remote changes in their own transaction."""
         with self.apply_tx():
             return self.apply_changes_in_tx(changes)
+
+    def apply_changes_batched(self, changes: Iterable[Change]) -> int:
+        """Merge remote changes in their own transaction, always through
+        the batched pipeline (no small-batch dispatch)."""
+        with self.apply_tx():
+            return self._apply_changes_batched(list(changes))
 
     def _apply_one(self, ch: Change) -> int:
         info = self._tables.get(ch.table)
@@ -963,6 +998,337 @@ END;
             (ch.pk, ch.cid, ch.col_version, int(ch.db_version), int(ch.seq), ordinal),
         )
         return 1
+
+    # -- batched application --------------------------------------------
+    #
+    # The ingest hot path: same merge as _apply_one, restructured around
+    # batches — group per table, intern sites in first-appearance order,
+    # prefetch row-CL / clock / current cell values with one IN (...)
+    # query per table per kind, merge in memory (superseded cells
+    # coalesce to the causally-winning write), then flush the net state
+    # with executemany on per-(table, cid) cached SQL strings.  Final DB
+    # state (data, clock, cl, impact records, site ordinals) is
+    # identical to applying the same stream through _apply_one — pinned
+    # by tests/test_apply_batched.py.
+    #
+    # Contract: change values are AFFINITY-STABLE for their columns —
+    # the invariant every collect_changes-produced stream holds, since
+    # an origin ships the value it already stored (post-affinity).  A
+    # hostile stream writing e.g. an INTEGER into a TEXT column can make
+    # this path diverge from _apply_one only on exact-value LWW ties,
+    # where _apply_one compares against sqlite's affinity-converted
+    # read-back while the in-batch winner here is the raw wire value.
+
+    _PREFETCH_CHUNK = 500  # bound parameters per IN (...) query
+
+    def _apply_sql(self, key: Tuple) -> str:
+        """Cached SQL text for the batched flush statements; identical
+        strings also let sqlite3's per-connection statement cache reuse
+        prepared statements across batches."""
+        cache = getattr(self, "_apply_sql_cache", None)
+        if cache is None:
+            cache = self._apply_sql_cache = {}
+        sql = cache.get(key)
+        if sql is None:
+            kind, t = key[0], key[1]
+            info = self._tables[t]
+            pk_where = " AND ".join(f'"{p}" IS ?' for p in info.pk_cols)
+            if kind == "cell_upd":
+                sets = ", ".join(f'"{_ident(c)}" = ?' for c in key[2])
+                sql = f'UPDATE "{t}" SET {sets} WHERE {pk_where}'
+            elif kind == "row_del":
+                sql = f'DELETE FROM "{t}" WHERE {pk_where}'
+            elif kind == "row_ins":
+                cols = ", ".join(f'"{p}"' for p in info.pk_cols)
+                ph = ", ".join("?" for _ in info.pk_cols)
+                sql = f'INSERT OR IGNORE INTO "{t}" ({cols}) VALUES ({ph})'
+            elif kind == "row_ins_fused":
+                names = list(info.pk_cols) + [_ident(c) for c in key[2]]
+                cols = ", ".join(f'"{c}"' for c in names)
+                ph = ", ".join("?" for _ in names)
+                sql = f'INSERT OR IGNORE INTO "{t}" ({cols}) VALUES ({ph})'
+            elif kind == "clock_ins":
+                # plain INSERT: the caller proved no conflicting row can
+                # exist (generation replaced, or absent in the prefetch);
+                # a violated invariant fails loud instead of diverging
+                sql = (
+                    f'INSERT INTO "{t}__corro_clock" '
+                    "(pk, cid, col_version, db_version, seq, site_ordinal) "
+                    "VALUES (?, ?, ?, ?, ?, ?)"
+                )
+            elif kind == "clock_del":
+                sql = f'DELETE FROM "{t}__corro_clock" WHERE pk=?'
+            elif kind == "clock_ups":
+                sql = (
+                    f'INSERT INTO "{t}__corro_clock" '
+                    "(pk, cid, col_version, db_version, seq, site_ordinal) "
+                    "VALUES (?, ?, ?, ?, ?, ?) "
+                    "ON CONFLICT(pk, cid) DO UPDATE SET "
+                    "col_version=excluded.col_version, "
+                    "db_version=excluded.db_version,"
+                    "seq=excluded.seq, site_ordinal=excluded.site_ordinal"
+                )
+            elif kind == "cl_ins":
+                # plain INSERT: no cl entry existed for this pk at batch
+                # start (prefetch-proved), so no conflict is possible
+                sql = (
+                    f'INSERT INTO "{t}__corro_cl" '
+                    "(pk, cl, db_version, seq, site_ordinal, sentinel) "
+                    "VALUES (?, ?, ?, ?, ?, ?)"
+                )
+            elif kind == "cl_ups":
+                sql = (
+                    f'INSERT INTO "{t}__corro_cl" '
+                    "(pk, cl, db_version, seq, site_ordinal, sentinel) "
+                    "VALUES (?, ?, ?, ?, ?, ?) "
+                    "ON CONFLICT(pk) DO UPDATE SET cl=excluded.cl, "
+                    "db_version=excluded.db_version, seq=excluded.seq, "
+                    "site_ordinal=excluded.site_ordinal, "
+                    "sentinel=MAX(sentinel, excluded.sentinel)"
+                )
+            else:  # pragma: no cover - programming error
+                raise KeyError(kind)
+            cache[key] = sql
+        return sql
+
+    def _apply_changes_batched(self, changes: List[Change]) -> int:
+        by_table: Dict[str, List[Change]] = {}
+        ordinals: Dict[bytes, int] = {}
+        for ch in changes:
+            if ch.table not in self._tables:
+                continue
+            by_table.setdefault(ch.table, []).append(ch)
+            # intern in first-appearance order: ordinal assignment must
+            # match the per-change path byte for byte
+            if ch.site_id not in ordinals:
+                ordinals[ch.site_id] = self.site_ordinal(ch.site_id)
+        impacted = 0
+        for t, t_changes in by_table.items():
+            impacted += self._apply_table_batched(
+                self._tables[t], t_changes, ordinals
+            )
+        return impacted
+
+    def _prefetch_rows(self, sql_head: str, keys: List[bytes]) -> list:
+        """Run ``sql_head`` (ending in ``IN (``) over ``keys`` in bound-
+        parameter-sized chunks; returns all rows."""
+        out: list = []
+        for i in range(0, len(keys), self._PREFETCH_CHUNK):
+            chunk = keys[i : i + self._PREFETCH_CHUNK]
+            qs = ",".join("?" * len(chunk))
+            out.extend(
+                self.conn.execute(sql_head + qs + ")", chunk).fetchall()
+            )
+        return out
+
+    def _apply_table_batched(
+        self, info: TableInfo, t_changes: List[Change],
+        ordinals: Dict[bytes, int],
+    ) -> int:
+        t = info.name
+        pks: List[bytes] = []
+        seen_pk = set()
+        for ch in t_changes:
+            if ch.pk not in seen_pk:
+                seen_pk.add(ch.pk)
+                pks.append(ch.pk)
+
+        # one IN (...) prefetch per kind: row causal lengths, cell clock
+        # versions, and current cell values (the LWW tie-break operand)
+        cl_by_pk: Dict[bytes, int] = {}
+        for pk, cl in self._prefetch_rows(
+            f'SELECT pk, cl FROM "{t}__corro_cl" WHERE pk IN (', pks
+        ):
+            cl_by_pk[bytes(pk)] = cl
+        clock_by_cell: Dict[Tuple[bytes, str], int] = {}
+        for pk, cid, colv in self._prefetch_rows(
+            f'SELECT pk, cid, col_version FROM "{t}__corro_clock" '
+            "WHERE pk IN (", pks
+        ):
+            clock_by_cell[(bytes(pk), cid)] = colv
+        vals_by_pk: Dict[bytes, dict] = {}
+        if info.data_cols:
+            pk_expr = "corro_pack(" + ", ".join(
+                f'"{p}"' for p in info.pk_cols
+            ) + ")"
+            sel = ", ".join(f'"{c}"' for c in info.data_cols)
+            for row in self._prefetch_rows(
+                f'SELECT {pk_expr}, {sel} FROM "{t}" WHERE {pk_expr} IN (',
+                pks,
+            ):
+                vals_by_pk[bytes(row[0])] = dict(
+                    zip(info.data_cols, row[1:])
+                )
+
+        # in-memory merge: replay the per-change decision sequence
+        # against dict state; superseded same-(pk, cid) writes coalesce
+        # to the causal winner before any SQL runs.  State per pk:
+        # [cl, cl_row, gen_changed, alive, ensure, cells, db_view_ok]
+        CL, CLROW, GEN, ALIVE, ENSURE, CELLS, DBOK = range(7)
+        states: Dict[bytes, list] = {}
+        impacted = 0
+        sentinel_cid = SENTINEL_CID
+        cl_get = cl_by_pk.get
+        clock_get = clock_by_cell.get
+        for ch in t_changes:
+            pk = ch.pk
+            st = states.get(pk)
+            if st is None:
+                st = states[pk] = [
+                    cl_get(pk), None, False, None, False, {}, True,
+                ]
+            cl = ch.cl
+
+            if ch.cid == sentinel_cid:
+                if st[CL] is not None and cl <= st[CL]:
+                    continue
+                # sentinel flag only ever upgrades; 1 is its maximum
+                st[CLROW] = (pk, cl, int(ch.db_version), int(ch.seq),
+                             ordinals[ch.site_id], 1)
+                st[CL] = cl
+                st[GEN], st[ALIVE], st[DBOK] = True, cl % 2 == 1, False
+                st[CELLS] = {}
+                impacted += 1
+                continue
+
+            have_cl = st[CL]
+            if have_cl is not None and cl < have_cl:
+                continue
+            if have_cl is None or cl > have_cl:
+                prev = st[CLROW]
+                st[CLROW] = (pk, cl, int(ch.db_version), int(ch.seq),
+                             ordinals[ch.site_id],
+                             prev[5] if prev else 0)
+                st[CL] = cl
+                st[GEN], st[ALIVE], st[DBOK] = True, cl % 2 == 1, False
+                st[CELLS] = {}
+                if cl % 2 == 0:
+                    impacted += 1
+                    continue
+            elif cl % 2 == 0:
+                continue
+            else:
+                st[ENSURE] = True
+
+            # LWW: in-batch winner first, else the (still valid) DB view
+            cells = st[CELLS]
+            cur = cells.get(ch.cid)
+            if cur is not None:
+                local_ver, cur_val = cur[1], cur[0]
+            elif st[DBOK]:
+                local_ver = clock_get((pk, ch.cid))
+                cur_val = None
+                if local_ver is not None:
+                    row_vals = vals_by_pk.get(pk)
+                    if row_vals is not None:
+                        cur_val = row_vals.get(ch.cid)
+            else:
+                local_ver = None
+            if local_ver is not None:
+                if ch.col_version < local_ver:
+                    continue
+                if ch.col_version == local_ver and \
+                        value_cmp(ch.val, cur_val) <= 0:
+                    continue
+            cells[ch.cid] = (
+                ch.val, ch.col_version, int(ch.db_version), int(ch.seq),
+                ordinals[ch.site_id],
+            )
+            impacted += 1
+
+        # flush the net state, each statement kind one executemany on a
+        # cached SQL string: cl upserts; row + clock deletes for changed
+        # generations; then rows/cells — fresh rows take a FUSED insert
+        # carrying their cell values when the schema allows (otherwise
+        # the conservative pk-only insert + grouped per-row UPDATE,
+        # bug-for-bug with the per-change path); clock rows split into
+        # pure inserts (no existing row possible) vs upserts
+        cl_ins = [
+            st[CLROW] for pk, st in states.items()
+            if st[CLROW] and pk not in cl_by_pk
+        ]
+        cl_ups = [
+            st[CLROW] for pk, st in states.items()
+            if st[CLROW] and pk in cl_by_pk
+        ]
+        if cl_ins:
+            self.conn.executemany(self._apply_sql(("cl_ins", t)), cl_ins)
+        if cl_ups:
+            self.conn.executemany(self._apply_sql(("cl_ups", t)), cl_ups)
+        # generation deletes: skipped for rows that provably have
+        # nothing to delete (fresh pks), which is the whole of a cold
+        # backfill — the per-change path issues those no-op DELETEs
+        clock_pks = {pk for pk, _cid in clock_by_cell}
+        know_rows = bool(info.data_cols)  # pk-only tables: no row view
+        gen_pks = [pk for pk, st in states.items() if st[GEN]]
+        row_dels = [
+            unpack_values(pk) for pk in gen_pks
+            if not know_rows or pk in vals_by_pk
+        ]
+        if row_dels:
+            self.conn.executemany(self._apply_sql(("row_del", t)), row_dels)
+        clock_dels = [(pk,) for pk in gen_pks if pk in clock_pks]
+        if clock_dels:
+            self.conn.executemany(
+                self._apply_sql(("clock_del", t)), clock_dels
+            )
+        fused_ok = info.fused_insert_ok
+        ins_plain: List[Sequence] = []
+        ins_by_cids: Dict[tuple, List[list]] = {}
+        upd_by_cids: Dict[tuple, List[list]] = {}
+        clock_ins: List[tuple] = []
+        clock_ups: List[tuple] = []
+        for pk, st in states.items():
+            cells = st[CELLS]
+            gen = st[GEN]
+            if cells:
+                fresh_clock = not st[DBOK]  # generation replaced: clock
+                # rows for this pk were just deleted, inserts can't
+                # conflict; otherwise conflict iff the cell existed
+                for cid, cell in cells.items():
+                    row = (pk, cid, cell[1], cell[2], cell[3], cell[4])
+                    if fresh_clock or (pk, cid) not in clock_by_cell:
+                        clock_ins.append(row)
+                    else:
+                        clock_ups.append(row)
+            needs_row = (gen and st[ALIVE]) or (not gen and st[ENSURE])
+            if not needs_row:
+                continue
+            row_absent = gen or pk not in vals_by_pk
+            if cells and fused_ok and row_absent:
+                cids = tuple(cells)
+                ins_by_cids.setdefault(cids, []).append(
+                    list(unpack_values(pk)) + [cells[c][0] for c in cids]
+                )
+                continue
+            if info.data_cols and not gen and pk in vals_by_pk:
+                pass  # row already exists: the OR IGNORE would no-op
+            else:
+                ins_plain.append(unpack_values(pk))
+            if cells:
+                cids = tuple(cells)
+                upd_by_cids.setdefault(cids, []).append(
+                    [cells[c][0] for c in cids] + list(unpack_values(pk))
+                )
+        if ins_plain:
+            self.conn.executemany(self._apply_sql(("row_ins", t)), ins_plain)
+        for cids, rows in ins_by_cids.items():
+            self.conn.executemany(
+                self._apply_sql(("row_ins_fused", t, cids)), rows
+            )
+        for cids, rows in upd_by_cids.items():
+            self.conn.executemany(
+                self._apply_sql(("cell_upd", t, cids)), rows
+            )
+        if clock_ins:
+            self.conn.executemany(
+                self._apply_sql(("clock_ins", t)), clock_ins
+            )
+        if clock_ups:
+            self.conn.executemany(
+                self._apply_sql(("clock_ups", t)), clock_ups
+            )
+        return impacted
 
     # -- row helpers ----------------------------------------------------
 

@@ -134,6 +134,38 @@ def change_v1_from_dict(d: dict) -> ChangeV1:
 
 
 # ---------------------------------------------------------------------------
+# partial-changeset buffer blobs (__corro_buffered_changes.change)
+# ---------------------------------------------------------------------------
+
+# Versioned binary format: one prefix byte, then the speedy Change
+# layout (bridge/speedy.py encode_change).  Old databases hold JSON
+# blobs from the legacy encoding (change_to_dict + encode_datagram);
+# those start with '{' (0x7b, which can never be a known format prefix)
+# and still decode on read — no migration pass required.
+BUFFERED_BLOB_SPEEDY = 0x01
+
+
+def encode_buffered_change(ch: Change) -> bytes:
+    from corrosion_tpu.bridge import speedy
+
+    return bytes((BUFFERED_BLOB_SPEEDY,)) + speedy.encode_change(ch)
+
+
+def decode_buffered_change(blob: bytes) -> Change:
+    blob = bytes(blob)
+    if blob[:1] == b"{":
+        # legacy JSON blob written before the binary format
+        return change_from_dict(decode_datagram(blob))
+    if blob[:1] == bytes((BUFFERED_BLOB_SPEEDY,)):
+        from corrosion_tpu.bridge import speedy
+
+        return speedy.decode_change(blob[1:])
+    raise ValueError(
+        f"unknown buffered-change blob format {blob[:1]!r}"
+    )
+
+
+# ---------------------------------------------------------------------------
 # framing
 # ---------------------------------------------------------------------------
 

@@ -284,6 +284,22 @@ def _r_change(r: Reader) -> Change:
     )
 
 
+def encode_change(c: Change) -> bytes:
+    """One Change in the speedy layout (the partial-buffer blob body)."""
+    w = Writer()
+    _w_change(w, c)
+    return w.getvalue()
+
+
+def decode_change(data: bytes) -> Change:
+    """Inverse of :func:`encode_change`; raises SpeedyError on junk or
+    trailing bytes."""
+    r = Reader(data)
+    c = _r_change(r)
+    r.expect_end()
+    return c
+
+
 # ---------------------------------------------------------------------------
 # Changeset / ChangeV1 / UniPayload / BiPayload
 # ---------------------------------------------------------------------------

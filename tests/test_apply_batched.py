@@ -1,0 +1,581 @@
+"""Batched change-application pipeline tests.
+
+The contract under test: ``CrConn.apply_changes_batched`` must leave
+the database in EXACTLY the state the per-change reference path
+(``_apply_one`` via ``apply_changes_sequential_in_tx``) leaves it in —
+data tables, clock tables, causal-length tables, compaction impact
+records, site interning order, ``collect_changes`` output and the
+rows-impacted count — across shuffled, duplicated and superseded
+change streams.  Plus the runtime half of the pipeline: merged apply
+transactions, off-loop uni decode, the JSON→speedy partial-buffer
+migration, and shutdown-cancellation accounting.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from corrosion_tpu.agent import wire
+from corrosion_tpu.agent.pack import pack_values
+from corrosion_tpu.agent.storage import CrConn
+from corrosion_tpu.bridge import speedy
+from corrosion_tpu.types import ActorId, Changeset, ChangeSource, ChangeV1
+from corrosion_tpu.types.base import CrsqlDbVersion, CrsqlSeq, Version
+from corrosion_tpu.types.change import Change, SENTINEL_CID
+from corrosion_tpu.types.hlc import Timestamp
+
+# `items` columns are UNTYPED (BLOB affinity): stored values roundtrip
+# verbatim, so the randomized generator may throw any value type at
+# them.  `typed` exercises declared affinities with affinity-stable
+# values (the shape real change streams have: an origin collects values
+# it already stored).  `pkonly` exercises the sentinel-only shape.
+SCHEMA = """
+CREATE TABLE IF NOT EXISTS items (
+  id INTEGER PRIMARY KEY NOT NULL, a, b, c);
+CREATE TABLE IF NOT EXISTS typed (
+  id INTEGER PRIMARY KEY NOT NULL,
+  name TEXT NOT NULL DEFAULT '',
+  n INTEGER);
+CREATE TABLE IF NOT EXISTS pkonly (k INTEGER PRIMARY KEY NOT NULL);
+"""
+
+SITES = [bytes([i]) * 16 for i in range(1, 4)]
+
+
+def _mk(tmp_path, name):
+    conn = CrConn(str(tmp_path / f"{name}.db"), site_id=b"\x77" * 16)
+    conn.conn.executescript(SCHEMA)
+    for t in ("items", "typed", "pkonly"):
+        conn.as_crr(t)
+    return conn
+
+
+def _rand_val(rng, table, cid=None):
+    # Values are AFFINITY-STABLE for their columns, the invariant every
+    # collect_changes-produced stream holds (an origin ships the value
+    # it already stored, post-affinity): strings into TEXT, ints into
+    # INTEGER, anything into the untyped (BLOB-affinity) columns.  A
+    # stream violating this can diverge from the per-change path only
+    # in redundant-rewrite accounting on exact value ties — see the
+    # batched-apply contract note in agent/storage.py.
+    if table == "typed":
+        if cid == "name":
+            return rng.choice(["alpha", "beta", "", "zzz", "-3"])
+        return rng.choice([1, 7, -3, 0, None, 123456])
+    return rng.choice([
+        None, 0, 1, -5, 2.5, -0.25, "x", "yy", "", b"", b"\x00\x01",
+        b"\xff", 123456789, "unicode-é",
+    ])
+
+
+def _rand_change(rng):
+    table = rng.choice(["items", "items", "typed", "pkonly"])
+    pk = pack_values([rng.randrange(6)])
+    site = rng.choice(SITES)
+    dbv = rng.randrange(1, 50)
+    seq = rng.randrange(0, 200)
+    cl = rng.randrange(1, 5)
+    if table == "pkonly" or rng.random() < 0.2:
+        return Change(
+            table=table, pk=pk, cid=SENTINEL_CID, val=None,
+            col_version=cl, db_version=CrsqlDbVersion(dbv),
+            seq=CrsqlSeq(seq), site_id=site, cl=cl,
+        )
+    cid = rng.choice(["a", "b", "c"] if table == "items" else ["name", "n"])
+    return Change(
+        table=table, pk=pk, cid=cid, val=_rand_val(rng, table, cid),
+        col_version=rng.randrange(1, 4), db_version=CrsqlDbVersion(dbv),
+        seq=CrsqlSeq(seq), site_id=site, cl=cl,
+    )
+
+
+def _stream(rng, n):
+    """A hostile stream: random changes, duplicated entries, superseded
+    same-cell writes, then shuffled."""
+    out = [_rand_change(rng) for _ in range(n)]
+    # duplicates (re-delivery) and superseded rewrites of earlier cells
+    for _ in range(n // 4):
+        out.append(rng.choice(out))
+    for _ in range(n // 4):
+        base = rng.choice(out)
+        if base.cid != SENTINEL_CID:
+            out.append(Change(
+                table=base.table, pk=base.pk, cid=base.cid,
+                val=_rand_val(rng, base.table, base.cid),
+                col_version=rng.randrange(1, 5),
+                db_version=base.db_version, seq=base.seq,
+                site_id=base.site_id, cl=base.cl,
+            ))
+    rng.shuffle(out)
+    return out
+
+
+def _dump(c):
+    """Every piece of observable CRDT state, order-normalized."""
+    out = {}
+    for t in ("items", "typed", "pkonly"):
+        out[f"{t}.data"] = sorted(
+            c.conn.execute(f'SELECT * FROM "{t}"').fetchall(),
+            key=repr,
+        )
+        out[f"{t}.clock"] = sorted(c.conn.execute(
+            f'SELECT pk, cid, col_version, db_version, seq, site_ordinal '
+            f'FROM "{t}__corro_clock"').fetchall())
+        out[f"{t}.cl"] = sorted(c.conn.execute(
+            f'SELECT pk, cl, db_version, seq, site_ordinal, sentinel '
+            f'FROM "{t}__corro_cl"').fetchall())
+    out["sites"] = c.conn.execute(
+        "SELECT ordinal, site_id FROM __corro_sites ORDER BY ordinal"
+    ).fetchall()
+    out["impacted"] = sorted(c.conn.execute(
+        "SELECT site_ordinal, db_version FROM __corro_versions_impacted"
+    ).fetchall())
+    return out
+
+
+def _assert_state_equal(seq_db, bat_db):
+    ds, db_ = _dump(seq_db), _dump(bat_db)
+    for key in ds:
+        assert ds[key] == db_[key], f"divergence in {key}"
+    # collect_changes must agree for every interned origin site
+    for site in SITES + [seq_db.site_id]:
+        s = seq_db.collect_changes((1, 64), None if site == seq_db.site_id else site)
+        b = bat_db.collect_changes((1, 64), None if site == bat_db.site_id else site)
+        assert s == b, f"collect_changes diverged for site {site[:1].hex()}"
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_batched_apply_parity_randomized(tmp_path, seed):
+    rng = random.Random(seed)
+    a = _mk(tmp_path, f"seq{seed}")
+    b = _mk(tmp_path, f"bat{seed}")
+    # identical local writes first, so remote applies can overwrite
+    # local change rows and exercise the compaction impact triggers
+    for c in (a, b):
+        c.execute(
+            "INSERT INTO items (id, a, b) VALUES (1, 'local', 0)")
+        c.execute("INSERT INTO typed (id, name, n) VALUES (2, 'loc', 7)")
+        c.execute("INSERT INTO pkonly (k) VALUES (3)")
+    for _round in range(3):
+        batch = _stream(rng, 40)
+        with a.apply_tx():
+            n_seq = a.apply_changes_sequential_in_tx(list(batch))
+        n_bat = b.apply_changes_batched(list(batch))
+        assert n_seq == n_bat, "rows-impacted diverged"
+        _assert_state_equal(a, b)
+    a.close()
+    b.close()
+
+
+def test_batched_apply_parity_interleaves_with_local_writes(tmp_path):
+    """Remote batches between local writes: version counters, triggers
+    and backfill bookkeeping stay identical."""
+    rng = random.Random(99)
+    a = _mk(tmp_path, "seq-mix")
+    b = _mk(tmp_path, "bat-mix")
+    for i in range(3):
+        for c in (a, b):
+            c.execute(
+                "INSERT OR REPLACE INTO items (id, a) VALUES (?, ?)",
+                (i, f"w{i}"),
+            )
+        batch = _stream(rng, 25)
+        with a.apply_tx():
+            a.apply_changes_sequential_in_tx(list(batch))
+        b.apply_changes_batched(list(batch))
+        assert a.db_version() == b.db_version()
+        _assert_state_equal(a, b)
+    a.close()
+    b.close()
+
+
+def test_batched_apply_empty_and_tiny(tmp_path):
+    a = _mk(tmp_path, "tiny")
+    assert a.apply_changes_batched([]) == 0
+    ch = Change(
+        table="items", pk=pack_values([9]), cid="a", val="v",
+        col_version=1, db_version=CrsqlDbVersion(1), seq=CrsqlSeq(0),
+        site_id=SITES[0], cl=1,
+    )
+    assert a.apply_changes_batched([ch]) == 1
+    # idempotent re-apply through the dispatching entry point
+    assert a.apply_changes([ch, ch, ch]) == 0
+    assert a.conn.execute(
+        "SELECT a FROM items WHERE id=9").fetchone() == ("v",)
+    a.close()
+
+
+def test_batched_apply_unknown_table_is_skipped(tmp_path):
+    a = _mk(tmp_path, "unk")
+    ch = Change(
+        table="nope", pk=pack_values([1]), cid="x", val=1,
+        col_version=1, db_version=CrsqlDbVersion(1), seq=CrsqlSeq(0),
+        site_id=SITES[0], cl=1,
+    )
+    assert a.apply_changes_batched([ch] * 5) == 0
+    a.close()
+
+
+# ---------------------------------------------------------------------------
+# partial-buffer blob format: speedy with versioned prefix, JSON legacy
+# ---------------------------------------------------------------------------
+
+
+def _sample_change(val="hello"):
+    return Change(
+        table="items", pk=pack_values([4]), cid="a", val=val,
+        col_version=3, db_version=CrsqlDbVersion(9), seq=CrsqlSeq(2),
+        site_id=SITES[1], cl=1,
+    )
+
+
+@pytest.mark.parametrize(
+    "val", [None, 5, -7, 2.25, "txt", b"\x00\xfe", ""]
+)
+def test_buffered_blob_roundtrip_speedy(val):
+    ch = _sample_change(val)
+    blob = wire.encode_buffered_change(ch)
+    assert blob[0] == wire.BUFFERED_BLOB_SPEEDY
+    assert blob[1:] == speedy.encode_change(ch)
+    assert wire.decode_buffered_change(blob) == ch
+
+
+def test_buffered_blob_decodes_legacy_json():
+    ch = _sample_change()
+    legacy = wire.encode_datagram(wire.change_to_dict(ch))
+    assert legacy[:1] == b"{"
+    assert wire.decode_buffered_change(legacy) == ch
+
+
+def test_buffered_blob_unknown_prefix_raises():
+    with pytest.raises(ValueError):
+        wire.decode_buffered_change(b"\x7fjunk")
+
+
+def test_partial_promotion_reads_mixed_blob_formats(tmp_path):
+    """A database carrying legacy JSON buffered rows (written before the
+    binary format) promotes a completed version correctly when the
+    missing chunk arrives through the new pipeline."""
+    from corrosion_tpu.agent.runtime import Agent, AgentConfig
+
+    async def main():
+        cfg = AgentConfig(
+            db_path=str(tmp_path / "agent.db"),
+            schema_sql=SCHEMA,
+            api_port=None,
+        )
+        agent = Agent(cfg)
+        try:
+            actor = SITES[2]
+            ts = Timestamp(1)
+            ch0 = Change(
+                table="items", pk=pack_values([11]), cid="a", val="old",
+                col_version=1, db_version=CrsqlDbVersion(1),
+                seq=CrsqlSeq(0), site_id=actor, cl=1,
+            )
+            ch1 = Change(
+                table="items", pk=pack_values([11]), cid="b", val="new",
+                col_version=1, db_version=CrsqlDbVersion(1),
+                seq=CrsqlSeq(1), site_id=actor, cl=1,
+            )
+            # chunk 1 (seq 0) buffered through the live path...
+            cv0 = ChangeV1(
+                actor_id=ActorId(actor),
+                changeset=Changeset.full(
+                    Version(1), [ch0], (CrsqlSeq(0), CrsqlSeq(0)),
+                    last_seq=CrsqlSeq(1), ts=ts,
+                ),
+            )
+            assert agent.handle_change(cv0, ChangeSource.SYNC)
+            # ...then rewritten in place as a LEGACY JSON blob, as an
+            # old database would hold it
+            legacy = wire.encode_datagram(wire.change_to_dict(ch0))
+            with agent.storage._lock:
+                agent.storage.conn.execute(
+                    "UPDATE __corro_buffered_changes SET change=? "
+                    "WHERE actor_id=? AND version=1 AND seq=0",
+                    (legacy, actor),
+                )
+            cv1 = ChangeV1(
+                actor_id=ActorId(actor),
+                changeset=Changeset.full(
+                    Version(1), [ch1], (CrsqlSeq(1), CrsqlSeq(1)),
+                    last_seq=CrsqlSeq(1), ts=ts,
+                ),
+            )
+            assert agent.handle_change(cv1, ChangeSource.SYNC)
+            row = agent.storage.conn.execute(
+                "SELECT a, b FROM items WHERE id=11").fetchone()
+            assert row == ("old", "new")
+            booked = agent.bookie.for_actor(actor)
+            assert booked.contains_version(1)
+            assert 1 not in booked.partials
+        finally:
+            agent.storage.close()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# runtime: merged apply transactions + off-loop decode + cancellation
+# ---------------------------------------------------------------------------
+
+
+def _complete_cv(actor, version, pk, val, ts=7):
+    ch = Change(
+        table="items", pk=pack_values([pk]), cid="a", val=val,
+        col_version=1, db_version=CrsqlDbVersion(version),
+        seq=CrsqlSeq(0), site_id=actor, cl=1,
+    )
+    return ChangeV1(
+        actor_id=ActorId(actor),
+        changeset=Changeset.full(
+            Version(version), [ch], (CrsqlSeq(0), CrsqlSeq(0)),
+            last_seq=CrsqlSeq(0), ts=Timestamp(ts),
+        ),
+    )
+
+
+def test_apply_batch_merges_consecutive_changesets(tmp_path):
+    """Consecutive complete changesets from one actor apply in ONE
+    merged transaction with correct per-changeset news flags, and the
+    bookkeeping matches the per-changeset path."""
+    from corrosion_tpu.agent.runtime import Agent, AgentConfig
+
+    async def main():
+        agent = Agent(AgentConfig(
+            db_path=str(tmp_path / "merge.db"), schema_sql=SCHEMA,
+            api_port=None,
+        ))
+        try:
+            actor = SITES[0]
+            cvs = [_complete_cv(actor, v, pk=v, val=f"v{v}")
+                   for v in (1, 2, 3)]
+            dup = cvs[1]
+            batch = [(cv, ChangeSource.SYNC) for cv in cvs]
+            batch.append((dup, ChangeSource.SYNC))
+            commits_before = agent.storage.conn.execute(
+                "PRAGMA data_version").fetchone()[0]
+            out = agent._apply_batch(batch)
+            assert [news for _cv, _s, news in out] == [
+                True, True, True, False,
+            ]
+            booked = agent.bookie.for_actor(actor)
+            assert booked.last() == 3
+            for v in (1, 2, 3):
+                assert booked.contains_version(v)
+            rows = agent.storage.conn.execute(
+                "SELECT id, a FROM items ORDER BY id").fetchall()
+            assert rows == [(1, "v1"), (2, "v2"), (3, "v3")]
+            # bookkeeping rows persisted (restart = resume)
+            persisted = agent.storage.conn.execute(
+                "SELECT start_version, db_version, last_seq FROM "
+                "__corro_bookkeeping WHERE actor_id=? "
+                "ORDER BY start_version", (actor,),
+            ).fetchall()
+            assert persisted == [(1, 1, 0), (2, 2, 0), (3, 3, 0)]
+            del commits_before
+        finally:
+            agent.storage.close()
+
+    asyncio.run(main())
+
+
+def test_apply_batch_decodes_raw_uni_payloads_off_loop(tmp_path):
+    """Raw (undecoded) uni payloads enqueued by the stream server are
+    decoded inside the apply worker, deduped and applied."""
+    from corrosion_tpu.agent.runtime import Agent, AgentConfig
+
+    async def main():
+        agent = Agent(AgentConfig(
+            db_path=str(tmp_path / "raw.db"), schema_sql=SCHEMA,
+            api_port=None,
+        ))
+        try:
+            actor = SITES[1]
+            cv = _complete_cv(actor, 1, pk=21, val="raw")
+            frame = agent.encode_broadcast_frame(cv)
+            payloads = speedy.FrameReader().feed(frame)
+            assert len(payloads) == 1
+            agent._ingest_uni_payloads(payloads)
+            assert len(agent._ingest) == 1
+            item, source = agent._ingest[0]
+            assert source is None and isinstance(item, (bytes, bytearray))
+            batch = list(agent._ingest)
+            agent._ingest.clear()
+            out = agent._apply_batch(batch)
+            assert len(out) == 1 and out[0][2] is True
+            assert agent.storage.conn.execute(
+                "SELECT a FROM items WHERE id=21").fetchone() == ("raw",)
+            # garbage payloads are dropped without poisoning the batch
+            out = agent._apply_batch([(b"\xde\xad\xbe\xef", None)])
+            assert out == []
+            # and rejected at ENQUEUE by the prelude check, so a junk
+            # burst cannot evict real changesets from the bounded queue
+            agent._ingest_uni_payloads([b"\xde\xad\xbe\xef" * 8])
+            assert len(agent._ingest) == 0
+            assert agent.metrics.get_counter(
+                "corro_wire_decode_errors_total") >= 1
+            # a payload passing the prelude check but raising a
+            # NON-SpeedyError deep in decode (invalid UTF-8 in a string
+            # field) is skipped without aborting the batch's valid work
+            w = speedy.Writer()
+            w.tag(0).tag(0).tag(0)          # UniPayload/Broadcast/Change
+            w.raw(SITES[1])                 # actor
+            w.tag(1)                        # Changeset::Full
+            w.u64(5)                        # version
+            w.u32(1)                        # one change
+            w.lp_bytes(b"\xff\xfe")         # table name: invalid UTF-8
+            hostile = w.getvalue()
+            good = _complete_cv(SITES[2], 1, pk=22, val="ok")
+            out = agent._apply_batch([
+                (hostile, None), (good, ChangeSource.SYNC),
+            ])
+            assert len(out) == 1 and out[0][2] is True
+            assert agent.storage.conn.execute(
+                "SELECT a FROM items WHERE id=22").fetchone() == ("ok",)
+        finally:
+            agent.storage.close()
+
+    asyncio.run(main())
+
+
+def test_merged_group_failure_falls_back_per_changeset(tmp_path):
+    """If the merged transaction fails AFTER the in-memory bookkeeping
+    moved (e.g. the bookkeeping flush), memory is restored from the
+    snapshot so the per-changeset fallback re-applies every changeset
+    instead of skipping them as already-contained."""
+    from corrosion_tpu.agent.runtime import Agent, AgentConfig
+
+    async def main():
+        agent = Agent(AgentConfig(
+            db_path=str(tmp_path / "fallback.db"), schema_sql=SCHEMA,
+            api_port=None,
+        ))
+        try:
+            actor = SITES[0]
+            cvs = [_complete_cv(actor, v, pk=40 + v, val=f"f{v}")
+                   for v in (1, 2)]
+            orig = agent.bookie.persist_versions
+            calls = {"n": 0}
+
+            def boom(*a, **kw):
+                calls["n"] += 1
+                raise RuntimeError("flush failed")
+
+            agent.bookie.persist_versions = boom
+            try:
+                out = agent._apply_batch(
+                    [(cv, ChangeSource.SYNC) for cv in cvs]
+                )
+            finally:
+                agent.bookie.persist_versions = orig
+            assert calls["n"] == 1
+            # the merge abort has its own series; the recovered retry
+            # must NOT read as an apply error
+            assert agent.metrics.get_counter(
+                "corro_apply_group_fallbacks_total") == 1
+            assert agent.metrics.get_counter(
+                "corro_changes_apply_errors_total") == 0
+            # fallback re-applied both in their own transactions
+            assert [news for _cv, _s, news in out] == [True, True]
+            rows = agent.storage.conn.execute(
+                "SELECT id, a FROM items WHERE id >= 41 ORDER BY id"
+            ).fetchall()
+            assert rows == [(41, "f1"), (42, "f2")]
+            booked = agent.bookie.for_actor(actor)
+            assert booked.contains_version(1)
+            assert booked.contains_version(2)
+            # and the bookkeeping rows exist (written by the fallback)
+            persisted = agent.storage.conn.execute(
+                "SELECT start_version FROM __corro_bookkeeping "
+                "WHERE actor_id=? ORDER BY start_version", (actor,),
+            ).fetchall()
+            assert persisted == [(1,), (2,)]
+        finally:
+            agent.storage.close()
+
+    asyncio.run(main())
+
+
+def test_finish_apply_reraises_cancellation(tmp_path):
+    """A shutdown-time CancelledError must propagate, not count into
+    corro_changes_apply_errors_total."""
+    from corrosion_tpu.agent.runtime import Agent, AgentConfig
+
+    async def main():
+        agent = Agent(AgentConfig(
+            db_path=str(tmp_path / "cancel.db"), schema_sql=SCHEMA,
+            api_port=None,
+        ))
+        try:
+            fut = asyncio.get_running_loop().create_future()
+            fut.cancel()
+            await asyncio.sleep(0)
+            before = agent.metrics.get_counter(
+                "corro_changes_apply_errors_total")
+            with pytest.raises(asyncio.CancelledError):
+                agent._finish_apply(fut)
+            assert agent.metrics.get_counter(
+                "corro_changes_apply_errors_total") == before
+            # a real failure still counts
+            bad = asyncio.get_running_loop().create_future()
+            bad.set_exception(RuntimeError("boom"))
+            agent._finish_apply(bad)
+            assert agent.metrics.get_counter(
+                "corro_changes_apply_errors_total") == before + 1
+        finally:
+            agent.storage.close()
+
+    asyncio.run(main())
+
+
+def test_apply_batch_records_apply_seconds(tmp_path):
+    from corrosion_tpu.agent.runtime import Agent, AgentConfig
+
+    async def main():
+        agent = Agent(AgentConfig(
+            db_path=str(tmp_path / "hist.db"), schema_sql=SCHEMA,
+            api_port=None,
+        ))
+        try:
+            cv = _complete_cv(SITES[0], 1, pk=31, val="t")
+            agent._apply_batch([(cv, ChangeSource.SYNC)])
+            rendered = agent.metrics.render()
+            assert "corro_apply_seconds" in rendered
+        finally:
+            agent.storage.close()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# bench smoke: the batched path is exercised (not timed) in tier-1;
+# the timed 10k acceptance run is marked slow
+# ---------------------------------------------------------------------------
+
+
+def test_apply_bench_smoke_500():
+    from bench import run_apply_bench
+
+    out = run_apply_bench(sizes=(500,), out_path=None)
+    assert out["points"], "no benchmark points produced"
+    for p in out["points"]:
+        assert "error" not in p, p
+        assert p["per_change"]["rows_impacted"] == \
+            p["batched"]["rows_impacted"]
+
+
+@pytest.mark.slow
+def test_apply_bench_10k_speedup():
+    from bench import run_apply_bench
+
+    out = run_apply_bench(sizes=(1000, 10000), out_path=None)
+    for p in out["points"]:
+        assert "error" not in p, p
+    headline = next(
+        p for p in out["points"]
+        if p["n_changes"] == 10000 and p["mode"] == "cold"
+    )
+    assert headline["speedup"] >= 3.0, headline

@@ -1017,6 +1017,112 @@ def test_pg_cancel_request(run):
     run(main())
 
 
+def test_pg_cancel_request_interrupts_write(run):
+    """CancelRequest landing on an in-flight WRITE interrupts it (57014)
+    instead of silently no-opping: the write connection is tracked while
+    the storage lock is held (round-5 ADVICE item).  The aborted write
+    must not have committed, and the session keeps serving.
+
+    Sync is slowed way down: the sync loop's generate_sync takes the
+    storage lock with a synchronous acquire ON the event loop, so with
+    the test-speed cadence it would freeze the loop behind our
+    minutes-long write and the cancel connection would never be
+    served."""
+    async def main():
+        a = await launch_test_agent(
+            pg_port=0, sync_interval_min=120, sync_interval_max=121,
+        )
+        try:
+            def drive():
+                import threading
+                import time
+
+                c = PgClient(*a.pg_addr)
+
+                def fire_cancel():
+                    time.sleep(0.4)
+                    PgClient.cancel_request(*a.pg_addr, c.backend_key)
+
+                t = threading.Thread(target=fire_cancel)
+                t.start()
+                # a deliberately slow WRITE: the aggregate forces the
+                # whole recursive spin BEFORE any row is produced, so
+                # the statement burns time in pure SQL (GIL released —
+                # per-row CRR trigger UDF callbacks would starve the
+                # event loop serving the cancel connection)
+                _, _, _, errs = c.query(
+                    "INSERT INTO tests (id, text)"
+                    " SELECT n + 1000000, 'spin' FROM ("
+                    "WITH RECURSIVE spin(n) AS ("
+                    " SELECT 1 UNION ALL SELECT n + 1 FROM spin"
+                    " WHERE n < 300000000) SELECT max(n) AS n FROM spin)"
+                )
+                t.join()
+                assert errs, "write was not cancelled"
+                assert c.last_error_codes == ["57014"], c.last_error_codes
+                # the interrupted transaction rolled back: nothing stuck
+                _, rows, _, errs = c.query(
+                    "SELECT count(*) FROM tests WHERE text = 'spin'"
+                )
+                assert not errs and rows == [["0"]]
+                # session still writable afterwards
+                _, _, _, errs = c.query(
+                    "INSERT INTO tests (id, text) VALUES (7001, 'after')"
+                )
+                assert not errs
+                c.close()
+
+            await asyncio.to_thread(drive)
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+def test_pg_cancel_request_interrupts_catalog_query(run):
+    """CancelRequest landing on a catalog query interrupts it (57014):
+    the shared catalog connection is tracked under the catalog lock
+    (round-5 ADVICE item)."""
+    async def main():
+        a = await launch_test_agent(pg_port=0)
+        try:
+            def drive():
+                import threading
+                import time
+
+                c = PgClient(*a.pg_addr)
+
+                def fire_cancel():
+                    time.sleep(0.4)
+                    PgClient.cancel_request(*a.pg_addr, c.backend_key)
+
+                t = threading.Thread(target=fire_cancel)
+                t.start()
+                # a deliberately slow catalog read: the pg_class ref
+                # routes the whole statement to the rendered catalog
+                _, _, _, errs = c.query(
+                    "WITH RECURSIVE spin(n) AS ("
+                    " SELECT 1 UNION ALL SELECT n + 1 FROM spin"
+                    " WHERE n < 300000000)"
+                    " SELECT count(*) FROM spin, pg_class"
+                )
+                t.join()
+                assert errs, "catalog query was not cancelled"
+                assert c.last_error_codes == ["57014"], c.last_error_codes
+                # catalog still serves afterwards
+                _, rows, _, errs = c.query(
+                    "SELECT count(*) FROM pg_catalog.pg_namespace"
+                )
+                assert not errs and int(rows[0][0]) >= 1
+                c.close()
+
+            await asyncio.to_thread(drive)
+        finally:
+            await a.stop()
+
+    run(main())
+
+
 def test_pg_orm_shaped_flows(run):
     """The verdict's named ORM shapes, end-to-end on the wire without
     regex probes: prepared INSERT..RETURNING with casts, upsert with
