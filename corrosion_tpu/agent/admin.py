@@ -71,6 +71,11 @@ def _handle(agent: "Agent", msg: dict) -> dict:
                         fixed += 1
                 agent.bookie._persist_gaps(actor)
             agent.storage.conn.commit()
+            if fixed:
+                # direct RangeSet surgery bypasses the BookedVersions
+                # mutation hooks: invalidate the cached generate_sync
+                # snapshot or handshakes keep advertising the old gaps
+                agent.bookie._bump_gen()
         return {"ok": {"reconciled": fixed}}
 
     if cmd == "cluster_members":

@@ -46,7 +46,7 @@ class PartialVersion:
 class BookedVersions:
     """One remote (or local) actor's version ledger."""
 
-    def __init__(self, actor_id: bytes):
+    def __init__(self, actor_id: bytes, on_mutate=None):
         self.actor_id = actor_id
         self.needed = RangeSet()  # versions we know exist but don't have
         self.partials: Dict[int, PartialVersion] = {}
@@ -55,6 +55,14 @@ class BookedVersions:
         self.versions: Dict[int, Tuple[int, int]] = {}
         self.max_version: int = 0
         self.last_cleared_ts: Optional[Timestamp] = None
+        # dirty-flag hook (Bookie.gen): every mutation that can change a
+        # generate_sync snapshot reports upward so the runtime can cache
+        # the snapshot between bookkeeping changes
+        self._on_mutate = on_mutate
+
+    def _touch(self) -> None:
+        if self._on_mutate is not None:
+            self._on_mutate()
 
     # -- queries ---------------------------------------------------------
 
@@ -101,6 +109,7 @@ class BookedVersions:
         self.needed.remove(version, version)
         self.partials.pop(version, None)
         self.versions[version] = (db_version, last_seq)
+        self._touch()
 
     def mark_cleared(self, start: int, end: int) -> None:
         """Versions [start, end] are empty (overwritten or compacted).
@@ -120,11 +129,13 @@ class BookedVersions:
         for v in [v for v in self.versions if start <= v <= end]:
             del self.versions[v]
         self.cleared.insert(start, end)
+        self._touch()
 
     def update_cleared_ts(self, ts: Timestamp) -> None:
         """Advance the cleared watermark (``agent.rs:1541-1545``)."""
         if self.last_cleared_ts is None or int(ts) > int(self.last_cleared_ts):
             self.last_cleared_ts = ts
+            self._touch()
 
     def insert_partial(
         self,
@@ -146,6 +157,7 @@ class BookedVersions:
         if ts is not None:
             partial.ts = ts
         partial.seqs.insert(seqs[0], seqs[1])
+        self._touch()
         return partial
 
     # -- sync handshake feed ---------------------------------------------
@@ -209,7 +221,17 @@ CREATE TABLE IF NOT EXISTS __corro_sync_state (
             conn.executescript(self.TABLES)
         self._actors: Dict[bytes, BookedVersions] = {}
         self._persisted_gaps: Dict[bytes, set] = {}
+        # bookkeeping generation: bumped by every in-memory mutation
+        # (any BookedVersions change, new actors, restores).  The
+        # runtime caches its generate_sync snapshot against this, so
+        # inbound sync handshakes stop re-walking every actor's
+        # RangeSets when nothing changed.  Mutations happen under the
+        # storage lock; readers compare under the same lock.
+        self.gen = 0
         self._load()
+
+    def _bump_gen(self) -> None:
+        self.gen += 1
 
     # -- persistence -----------------------------------------------------
 
@@ -415,6 +437,37 @@ CREATE TABLE IF NOT EXISTS __corro_sync_state (
             ).fetchone()
         return row[0] if row else None
 
+    _TS_CHUNK = 500  # bound parameters per IN (...) query
+
+    def version_ts_many(
+        self, actor_id: bytes, versions: List[int], conn=None,
+    ) -> Dict[int, int]:
+        """Batch variant of :meth:`version_ts`: one chunked ``IN (...)``
+        query for a whole serve-range's versions instead of a point
+        query each.  ``conn`` (e.g. a read-only pool connection) skips
+        the storage lock — bookkeeping rows are committed data."""
+        out: Dict[int, int] = {}
+
+        def _run(c) -> None:
+            for i in range(0, len(versions), self._TS_CHUNK):
+                chunk = versions[i : i + self._TS_CHUNK]
+                qs = ",".join("?" * len(chunk))
+                for v, ts in c.execute(
+                    "SELECT start_version, ts FROM __corro_bookkeeping "
+                    "WHERE actor_id=? AND end_version IS NULL "
+                    f"AND start_version IN ({qs})",
+                    [actor_id, *chunk],
+                ):
+                    if ts is not None:
+                        out[v] = ts
+
+        if conn is not None:
+            _run(conn)
+        else:
+            with self._lock:
+                _run(self.conn)
+        return out
+
     def cleared_since(
         self, actor_id: bytes, since_ts: Optional[int] = None
     ) -> List[Tuple[int, List[Tuple[int, int]]]]:
@@ -465,10 +518,14 @@ CREATE TABLE IF NOT EXISTS __corro_sync_state (
             [(actor_id, version, seq, blob) for seq, blob in rows],
         )
 
-    def buffered_changes(self, actor_id: bytes, version: int) -> List[Tuple[int, bytes]]:
+    def buffered_changes(self, actor_id: bytes, version: int,
+                         conn=None) -> List[Tuple[int, bytes]]:
+        """Buffered seq chunks of a partial version.  ``conn`` lets the
+        off-loop sync server read through a pooled RO connection."""
+        c = conn if conn is not None else self.conn
         return [
             (seq, bytes(blob))
-            for seq, blob in self.conn.execute(
+            for seq, blob in c.execute(
                 "SELECT seq, change FROM __corro_buffered_changes "
                 "WHERE actor_id=? AND version=? ORDER BY seq",
                 (actor_id, version),
@@ -495,13 +552,17 @@ CREATE TABLE IF NOT EXISTS __corro_sync_state (
         # the gap write-through cache may now disagree with the rolled-
         # back DB rows: drop it so the next diff re-reads the table
         self._persisted_gaps.pop(actor_id, None)
+        self._bump_gen()
 
     # -- access ----------------------------------------------------------
 
     def for_actor(self, actor_id: bytes) -> BookedVersions:
         bv = self._actors.get(actor_id)
         if bv is None:
-            bv = self._actors[actor_id] = BookedVersions(actor_id)
+            bv = self._actors[actor_id] = BookedVersions(
+                actor_id, on_mutate=self._bump_gen
+            )
+            self._bump_gen()
         return bv
 
     def actors(self) -> Dict[bytes, BookedVersions]:

@@ -98,6 +98,12 @@ class AgentConfig:
     sync_interval_max: float = 2.0
     sync_peers: int = 3
     max_sync_sessions: int = 3
+    # batched serve pipeline (docs/sync.md): full-range needs resolve
+    # versions -> db_versions in one bookkeeping pass, collect whole
+    # spans off the event loop on RO-pool connections, and coalesce
+    # changeset frames into buffered writes with one drain per budget.
+    # False = the per-version parity oracle (bench baseline / tests)
+    sync_batched_serve: bool = True
     seen_cache_size: int = 65536
     # ingest pipeline (handlers.rs:742-956 / config.rs:10-45 defaults)
     processing_queue_len: int = 20_000  # bounded, drop-oldest
@@ -261,6 +267,14 @@ class Agent:
         self._udp: Optional[asyncio.DatagramTransport] = None
         self._tcp: Optional[asyncio.AbstractServer] = None
         self._sync_sem: Optional[asyncio.Semaphore] = None
+        # generate_sync snapshot cache keyed on the bookie generation
+        # (dirty flag): (gen, SyncStateV1) — see generate_sync()
+        self._sync_gen_cache: Optional[tuple] = None
+        # serve-side collection workers (lazy: tests drive _serve_need
+        # without start()); distinct from the apply pool so a long
+        # backfill serve can't starve change application
+        self._serve_pool = None
+        self._sync_server_sessions = 0  # in-flight inbound sessions
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         if config.schema_sql:
             apply_schema(self.storage, config.schema_sql)
@@ -417,6 +431,11 @@ class Agent:
         if self.transport is not None:
             await self.transport.aclose()
         await _cancel_tasks(list(self._conn_tasks))
+        # after the connection handlers: a live sync session must not
+        # race a shut-down collection pool
+        if self._serve_pool is not None:
+            self._serve_pool.shutdown(wait=True)
+            self._serve_pool = None
         if self._udp:
             self._udp.close()
             self._udp = None  # liveness marker: stopped agents don't send
@@ -548,6 +567,10 @@ class Agent:
         extra.append((
             "corro_bcast_queue_depth",
             float(self._bcast_queue.qsize()), {},
+        ))
+        extra.append((
+            "corro_sync_server_sessions",
+            float(self._sync_server_sessions), {},
         ))
         if self.subs is not None:
             with self.subs._lock:
@@ -1915,9 +1938,24 @@ class Agent:
 
     def generate_sync(self) -> SyncStateV1:
         # snapshot under the storage/bookie lock: RangeSet mutations are
-        # multi-step, so an unlocked reader could zip mismatched span lists
+        # multi-step, so an unlocked reader could zip mismatched span
+        # lists.  The snapshot is cached against the bookie generation
+        # (dirty flag bumped by every bookkeeping mutation), so a burst
+        # of inbound handshakes re-walks every actor's RangeSets only
+        # when something actually changed.  The returned state is a
+        # SHARED immutable snapshot — callers must not mutate it.
         with self.storage._lock:
-            return self._generate_sync_locked()
+            gen = self.bookie.gen
+            cached = self._sync_gen_cache
+            if cached is not None and cached[0] == gen:
+                self.metrics.counter(
+                    "corro_sync_state_cache_total", hit="true")
+                return cached[1]
+            state = self._generate_sync_locked()
+            self._sync_gen_cache = (gen, state)
+            self.metrics.counter(
+                "corro_sync_state_cache_total", hit="false")
+            return state
 
     def _generate_sync_locked(self) -> SyncStateV1:
         state = SyncStateV1(actor_id=ActorId(self.actor_id))
@@ -1942,23 +1980,35 @@ class Agent:
     def _clear_buffered_meta(self, chunk: int = 1000) -> int:
         """Delete buffered-change/seq bookkeeping rows for versions that
         are now cleared, in bounded chunks (clear_buffered_meta_loop
-        parity, util.rs:425-480).  Returns rows deleted."""
+        parity, util.rs:425-480).  Returns rows deleted.
+
+        The storage lock is released and re-acquired between chunks at
+        the LOW tier: the spans are snapshotted up front, so a 10k-row
+        sweep becomes many short maintenance holds instead of one long
+        one that starves applies and client writes."""
         deleted = 0
         with self.storage._lock:
-            for actor, bv in self.bookie.actors().items():
-                for s, e in bv.cleared.spans():
-                    for table in ("__corro_seq_bookkeeping",
-                                  "__corro_buffered_changes"):
-                        while True:
-                            cur = self.storage.conn.execute(
-                                f"DELETE FROM {table} WHERE rowid IN ("
-                                f"SELECT rowid FROM {table} WHERE actor_id=? "
-                                "AND version BETWEEN ? AND ? LIMIT ?)",
-                                (actor, s, e, chunk),
-                            )
-                            deleted += cur.rowcount
-                            if cur.rowcount < chunk:
-                                break
+            work = [
+                (actor, s, e)
+                for actor, bv in self.bookie.actors().items()
+                for s, e in bv.cleared.spans()
+            ]
+        for actor, s, e in work:
+            for table in ("__corro_seq_bookkeeping",
+                          "__corro_buffered_changes"):
+                while True:
+                    with self.storage._lock.prio(
+                        PRIO_LOW, "buffered-meta"
+                    ):
+                        cur = self.storage.conn.execute(
+                            f"DELETE FROM {table} WHERE rowid IN ("
+                            f"SELECT rowid FROM {table} WHERE actor_id=? "
+                            "AND version BETWEEN ? AND ? LIMIT ?)",
+                            (actor, s, e, chunk),
+                        )
+                    deleted += cur.rowcount
+                    if cur.rowcount < chunk:
+                        break
         if deleted:
             self.metrics.counter(
                 "corro_buffered_meta_cleared_total", deleted
@@ -2029,12 +2079,29 @@ class Agent:
             except Exception:
                 self.metrics.counter("corro_sync_round_errors_total")
 
+    def _breaker_open(self, m: Member) -> bool:
+        """Is the transport circuit breaker for this member's address
+        open right now?  (Quarantine normally mirrors this, but the
+        breaker can open between the transition callback and the next
+        membership update — check both.)"""
+        if self.transport is None:
+            return False
+        b = self.transport.breakers.get(tuple(m.addr))
+        return b is not None and b.is_open
+
     def _choose_sync_peers(self, ours: SyncStateV1) -> List[Member]:
         """Peer choice heuristic (handlers.rs:963-1074): sample 2x the
         desired count uniformly, then keep the best by (most needed
-        from them, longest since last sync, lowest RTT)."""
+        from them, longest since last sync, lowest RTT).
+
+        Quarantined / breaker-open members are excluded outright — a
+        dead-but-undetected peer chosen here would absorb a whole sync
+        round (the partial-retry path already filters them; this keeps
+        the first pass from wasting its round the same way)."""
         peers = [
-            m for m in self.members.alive() if m.state is MemberState.ALIVE
+            m for m in self.members.alive()
+            if m.state is MemberState.ALIVE and not m.quarantined
+            and not self._breaker_open(m)
         ]
         if not peers:
             return []
@@ -2484,6 +2551,13 @@ class Agent:
     SYNC_NEED_JOBS = 6  # concurrent need jobs per session (peer.rs:843)
     SYNC_MAX_PARTIAL_SPANS = 1024  # clamp hostile partial seqs lists
     SYNC_MAX_SESSION_NEEDS = 10_000  # total needs one session may request
+    # batched serve pipeline (docs/sync.md): versions resolved/collected
+    # per storage-lock window, and the byte budget one coalesced write
+    # accumulates before draining when the session carries no adaptive
+    # chunk budget (live sessions drain per sess["chunk"] so slow-reader
+    # adaptation keeps seeing real backpressure)
+    SYNC_RESOLVE_CHUNK = 256
+    SYNC_DRAIN_BUDGET = 64 * 1024
 
     async def _serve_sync(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
@@ -2492,12 +2566,17 @@ class Agent:
         State + Clock, then serve Request needs until the client
         half-closes; closing our side ends the session."""
         if self._sync_sem.locked():
+            # rejections were silent: count them by reason so capacity
+            # pressure is visible next to the accept/serve series
+            self.metrics.counter(
+                "corro_sync_rejections_sent_total", reason="capacity")
             await self._send_sync_msg(
                 writer, ("rejection", speedy.REJECTION_MAX_CONCURRENCY)
             )
             writer.close()
             return
         async with self._sync_sem:
+            self._sync_server_sessions += 1
             jobs: set = set()
             job_sem = asyncio.Semaphore(self.SYNC_NEED_JOBS)
             sess = {"chunk": self.SYNC_CHUNK_MAX}
@@ -2528,6 +2607,10 @@ class Agent:
                 srv_span.__enter__()
                 self.metrics.counter("corro_trace_spans_total")
                 if int(cluster) != self.config.cluster_id:
+                    self.metrics.counter(
+                        "corro_sync_rejections_sent_total",
+                        reason="cluster",
+                    )
                     await self._send_sync_msg(
                         writer,
                         ("rejection", speedy.REJECTION_DIFFERENT_CLUSTER),
@@ -2611,6 +2694,7 @@ class Agent:
                     srv_span.span.set(error=repr(e))
                 return
             finally:
+                self._sync_server_sessions -= 1
                 if srv_span is not None:
                     srv_span.span.set(needs=total_needs)
                     srv_span.__exit__(None, None, None)
@@ -2631,10 +2715,16 @@ class Agent:
             s, e = need.versions
             # clamp hostile/stale ranges to what we can possibly serve
             s, e = max(1, int(s)), min(int(e), bv.last())
-            # newest first (peer.rs serve order): under a chunk budget or
-            # a slow-peer abort the requester keeps the freshest data.
-            # A version served as a cleared span jumps the cursor BELOW
-            # the whole span — no per-version spin over large ranges
+            if self.config.sync_batched_serve:
+                await self._serve_full_range_batched(
+                    writer, actor, bv, s, e, sess
+                )
+                return
+            # per-version parity oracle: newest first (peer.rs serve
+            # order) — under a chunk budget or a slow-peer abort the
+            # requester keeps the freshest data.  A version served as a
+            # cleared span jumps the cursor BELOW the whole span — no
+            # per-version spin over large ranges
             v, i = e, 0
             while v >= s:
                 span = await self._serve_version(
@@ -2752,14 +2842,220 @@ class Agent:
             cs = Changeset.full(Version(v), chunk, seqs, last_seq, ts)
             await self._send_sync_change(writer, actor, cs, sess)
 
+    # -- batched serve pipeline (docs/sync.md) -------------------------
+    #
+    # The serve mirror of the batched apply pipeline: a full-range need
+    # is resolved version->db_version in ONE in-memory bookkeeping pass
+    # per SYNC_RESOLVE_CHUNK versions (a short storage-lock hold), the
+    # whole span is collected with one sentinel + one cell query per
+    # table on a read-only pool connection OFF the event loop, split by
+    # db_version in memory, encoded to frames in the worker, and sent
+    # as coalesced buffered writes with one drain per SYNC_DRAIN_BUDGET
+    # bytes.  collect(chunk N+1) overlaps encode/send(chunk N).  Served
+    # bytes are pinned identical to the per-version oracle
+    # (_serve_version) by tests/test_serve_batched.py.
+
+    def _serve_executor(self):
+        pool = self._serve_pool
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = self._serve_pool = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="corro-serve",
+            )
+        return pool
+
+    async def _serve_full_range_batched(
+        self, writer, actor: bytes, bv, s: int, e: int,
+        sess: Optional[dict] = None,
+    ) -> None:
+        """Serve a full version range [s, e] newest-first through the
+        batched pipeline; bytes identical to the per-version oracle."""
+        if e < s:
+            return
+        loop = asyncio.get_running_loop()
+        pool = self._serve_executor()
+        fut = loop.run_in_executor(
+            pool, self._collect_serve_chunk, actor, bv, s, e,
+            sess["chunk"] if sess else MAX_CHANGES_BYTE_SIZE,
+        )
+        try:
+            while fut is not None:
+                frames, cursor = await fut
+                if cursor >= s:
+                    # prefetch: collect the next chunk while this sends
+                    fut = loop.run_in_executor(
+                        pool, self._collect_serve_chunk, actor, bv, s,
+                        cursor,
+                        sess["chunk"] if sess else MAX_CHANGES_BYTE_SIZE,
+                    )
+                else:
+                    fut = None
+                await self._send_sync_frames(writer, frames, sess)
+        except BaseException:
+            if fut is not None:
+                # a send abort (e.g. _SlowPeer) abandons the in-flight
+                # prefetch: consume its outcome so a collection error
+                # can't surface as an unretrieved-exception log
+                fut.add_done_callback(
+                    lambda f: f.cancelled() or f.exception()
+                )
+            raise
+
+    def _collect_serve_chunk(
+        self, actor: bytes, bv, lo: int, hi: int, max_buf: int,
+    ) -> Tuple[List[bytes], int]:
+        """Worker-thread body: resolve + collect + encode one chunk of a
+        full-range need, newest first from ``hi`` down to (at most)
+        ``lo``.  Returns (encoded frames in serve order, next cursor —
+        the version the per-version oracle would continue at)."""
+        chunk_lo = max(lo, hi - self.SYNC_RESOLVE_CHUNK + 1)
+        # phase A — bookkeeping resolution under the storage lock: pure
+        # in-memory walk mirroring the oracle's per-version decisions
+        plan: List[tuple] = []
+        with self.storage._lock:
+            last_cleared_ts = bv.last_cleared_ts
+            v = hi
+            while v >= chunk_lo:
+                if bv.cleared.contains(v):
+                    span_lo, span_hi = v, v
+                    for cs_s, cs_e in bv.cleared.overlapping(v, v):
+                        span_lo, span_hi = cs_s, cs_e
+                    plan.append(("cleared", span_lo, span_hi))
+                    v = span_lo - 1
+                    continue
+                entry = bv.versions.get(v)
+                if entry is not None:
+                    plan.append(("version", v, entry[0], entry[1]))
+                else:
+                    partial = bv.partials.get(v)
+                    if partial is not None:
+                        plan.append((
+                            "partial", v, partial.seqs.spans(),
+                            partial.last_seq, partial.ts,
+                        ))
+                v -= 1
+            next_cursor = v
+        # phase B — DB reads on a read-only pool connection, NO storage
+        # lock: one range collection + one batched ts lookup + buffered
+        # reads, all inside one read transaction (one WAL snapshot)
+        version_items = [it for it in plan if it[0] == "version"]
+        site = None if actor == self.actor_id else actor
+        by_dbv: Dict[int, List] = {}
+        ts_by_v: Dict[int, int] = {}
+        buffered_by_v: Dict[int, dict] = {}
+        with self.storage.reader() as conn:
+            conn.execute("BEGIN")
+            try:
+                if version_items:
+                    dbvs = [it[2] for it in version_items]
+                    for ch in self.storage.collect_changes_ro(
+                        conn, (min(dbvs), max(dbvs)), site
+                    ):
+                        by_dbv.setdefault(int(ch.db_version), []).append(ch)
+                    ts_by_v = self.bookie.version_ts_many(
+                        actor, [it[1] for it in version_items], conn=conn
+                    )
+                for it in plan:
+                    if it[0] == "partial":
+                        buffered_by_v[it[1]] = {
+                            seq: wire.decode_buffered_change(blob)
+                            for seq, blob in self.bookie.buffered_changes(
+                                actor, it[1], conn=conn
+                            )
+                        }
+            finally:
+                if conn.in_transaction:
+                    conn.execute("COMMIT")
+        # phase C — encode frames in serve order (still in the worker,
+        # so the event loop never pays for speedy encoding)
+        frames: List[bytes] = []
+        for it in plan:
+            if it[0] == "cleared":
+                cs = Changeset.empty(
+                    (Version(it[1]), Version(it[2])), last_cleared_ts
+                )
+                frames.append(self.encode_sync_change_frame(actor, cs))
+            elif it[0] == "version":
+                v, dbv, last_seq = it[1], it[2], it[3]
+                row_ts = ts_by_v.get(v)
+                ts = Timestamp(row_ts) if row_ts is not None else Timestamp(0)
+                changes = by_dbv.get(dbv)
+                if not changes:
+                    # read-time cleared detection (oracle parity): the
+                    # version's rows were all overwritten since
+                    cs = Changeset.empty((Version(v), Version(v)), ts)
+                    frames.append(self.encode_sync_change_frame(actor, cs))
+                    continue
+                chunker = ChunkedChanges(
+                    changes, 0, last_seq, max_buf_size=max_buf
+                )
+                for chunk, seqs in chunker:
+                    cs = Changeset.full(
+                        Version(v), chunk, seqs, last_seq, ts
+                    )
+                    frames.append(self.encode_sync_change_frame(actor, cs))
+            else:
+                v, have, last_seq, pts = it[1], it[2], it[3], it[4]
+                buffered = buffered_by_v.get(v, {})
+                for hs, he in have:
+                    chunk = [
+                        buffered[q]
+                        for q in range(hs, he + 1)
+                        if q in buffered
+                    ]
+                    cs = Changeset.full(
+                        Version(v), chunk, (hs, he), last_seq,
+                        pts or Timestamp(0),
+                    )
+                    frames.append(self.encode_sync_change_frame(actor, cs))
+        return frames, next_cursor
+
+    def encode_sync_change_frame(self, actor: bytes, cs: Changeset) -> bytes:
+        """One served changeset → its exact on-wire frame bytes (speedy
+        SyncMessage + u32-BE framing).  Shared by the per-version oracle
+        and the batched pipeline so both emit identical bytes."""
+        cv = ChangeV1(actor_id=ActorId(actor), changeset=cs)
+        return speedy.frame(speedy.encode_sync_message(cv))
+
+    async def _send_sync_frames(self, writer, frames: List[bytes],
+                                sess: Optional[dict] = None) -> None:
+        """Coalesced framing: buffer whole encoded changeset frames into
+        one write with a single drain per chunk budget, instead of a
+        write+drain round per changeset.  The budget is the session's
+        ADAPTIVE chunk size (re-read after every drain): blocks stay
+        small enough that a slow reader still backpressures individual
+        drains past the adapt threshold — a block far above the
+        transport's high-water mark would hide the stall from the
+        timing-based halving/abort logic entirely."""
+        buf: List[bytes] = []
+        size = 0
+        for f in frames:
+            buf.append(f)
+            size += len(f)
+            self.metrics.counter("corro_sync_served_total")
+            if size >= (sess["chunk"] if sess else self.SYNC_DRAIN_BUDGET):
+                await self._drain_sync_block(writer, b"".join(buf), sess)
+                buf, size = [], 0
+        if buf:
+            await self._drain_sync_block(writer, b"".join(buf), sess)
+
     async def _send_sync_change(self, writer, actor: bytes, cs: Changeset,
                                 sess: Optional[dict] = None) -> None:
-        """Send one changeset frame, timing the flush: a slow reader
-        first halves the session's chunk budget (8 KiB floor 1 KiB),
-        then aborts the session outright (peer.rs:344-348,796-811)."""
-        cv = ChangeV1(actor_id=ActorId(actor), changeset=cs)
-        writer.write(speedy.frame(speedy.encode_sync_message(cv)))
+        """Send one changeset frame (the per-version oracle's framing:
+        one write + one timed drain per changeset)."""
         self.metrics.counter("corro_sync_served_total")
+        await self._drain_sync_block(
+            writer, self.encode_sync_change_frame(actor, cs), sess
+        )
+
+    async def _drain_sync_block(self, writer, blob: bytes,
+                                sess: Optional[dict] = None) -> None:
+        """Write one buffered block and drain, timing the flush: a slow
+        reader first halves the session's chunk budget (8 KiB floor
+        1 KiB), then aborts the session outright
+        (peer.rs:344-348,796-811)."""
+        writer.write(blob)
         t0 = time.monotonic()
         try:
             await asyncio.wait_for(

@@ -785,68 +785,110 @@ END;
         with self._lock:
             ordinal = 1 if site_id is None else self.site_ordinal(site_id)
             origin = self.site_id if site_id is None else site_id
-            lo, hi = db_version_range
-            out: List[Change] = []
-            for t, info in self._tables.items():
-                # row-level '-1' sentinel changes: exactly the cl entries
-                # flagged sentinel (deletes, resurrects, pk moves, pk-only
-                # inserts) — plain inserts of cell-bearing tables ride
-                # their cell rows alone, matching cr-sqlite's change
-                # streams (pinned in tests/test_crsqlite_golden.py).
-                for pk, cl, dbv, seq in self.conn.execute(
-                    f'SELECT pk, cl, db_version, seq FROM "{t}__corro_cl" '
-                    "WHERE site_ordinal=? AND db_version BETWEEN ? AND ? "
-                    "AND sentinel = 1",
-                    (ordinal, lo, hi),
-                ):
-                    out.append(
-                        Change(
-                            table=t,
-                            pk=bytes(pk),
-                            cid=SENTINEL_CID,
-                            val=None,
-                            col_version=cl,
-                            db_version=CrsqlDbVersion(dbv),
-                            seq=CrsqlSeq(seq),
-                            site_id=origin,
-                            cl=cl,
-                        )
+            return self._collect_changes_on(
+                self.conn, ordinal, origin, db_version_range
+            )
+
+    def site_ordinal_ro(self, conn, site_id: bytes) -> Optional[int]:
+        """Read-only ordinal lookup on an explicit connection (no
+        interning, no storage lock); None if the site was never seen."""
+        row = conn.execute(
+            "SELECT ordinal FROM __corro_sites WHERE site_id = ?",
+            (site_id,),
+        ).fetchone()
+        return row[0] if row else None
+
+    def collect_changes_ro(
+        self,
+        conn,
+        db_version_range: Tuple[int, int],
+        site_id: Optional[bytes] = None,
+    ) -> List[Change]:
+        """:meth:`collect_changes` on an explicit (read-only pool)
+        connection, WITHOUT taking the storage lock — the sync serve
+        path's off-loop range collection.  The site must already be
+        interned (it is for any site we hold versions of); an unknown
+        site collects nothing."""
+        if site_id is None:
+            ordinal: Optional[int] = 1
+            origin = self.site_id
+        else:
+            ordinal = self.site_ordinal_ro(conn, site_id)
+            origin = site_id
+        if ordinal is None:
+            return []
+        return self._collect_changes_on(
+            conn, ordinal, origin, db_version_range
+        )
+
+    def _collect_changes_on(
+        self, conn, ordinal: int, origin: bytes,
+        db_version_range: Tuple[int, int],
+    ) -> List[Change]:
+        """Shared body: one sentinel + one cell query per table over the
+        whole inclusive db_version range, sorted (db_version, seq)."""
+        lo, hi = db_version_range
+        out: List[Change] = []
+        for t, info in self._tables.items():
+            # row-level '-1' sentinel changes: exactly the cl entries
+            # flagged sentinel (deletes, resurrects, pk moves, pk-only
+            # inserts) — plain inserts of cell-bearing tables ride
+            # their cell rows alone, matching cr-sqlite's change
+            # streams (pinned in tests/test_crsqlite_golden.py).
+            for pk, cl, dbv, seq in conn.execute(
+                f'SELECT pk, cl, db_version, seq FROM "{t}__corro_cl" '
+                "WHERE site_ordinal=? AND db_version BETWEEN ? AND ? "
+                "AND sentinel = 1",
+                (ordinal, lo, hi),
+            ):
+                out.append(
+                    Change(
+                        table=t,
+                        pk=bytes(pk),
+                        cid=SENTINEL_CID,
+                        val=None,
+                        col_version=cl,
+                        db_version=CrsqlDbVersion(dbv),
+                        seq=CrsqlSeq(seq),
+                        site_id=origin,
+                        cl=cl,
                     )
-                if not info.data_cols:
-                    continue  # no cells to collect
-                # cell-level rows with current values, one JOIN per table:
-                # cl from the causal-length table, the live value picked out
-                # of the data row by a generated CASE over the column name
-                val_case = (
-                    "CASE k.cid "
-                    + " ".join(f"WHEN '{c}' THEN d.\"{c}\"" for c in info.data_cols)
-                    + " END"
                 )
-                d_pk = "corro_pack(" + ", ".join(f'd."{p}"' for p in info.pk_cols) + ")"
-                for pk, cid, colv, dbv, seq, cl, val in self.conn.execute(
-                    f"SELECT k.pk, k.cid, k.col_version, k.db_version, k.seq,"
-                    f" COALESCE(c.cl, 1), {val_case} "
-                    f'FROM "{t}__corro_clock" k '
-                    f'LEFT JOIN "{t}__corro_cl" c ON c.pk = k.pk '
-                    f'LEFT JOIN "{t}" d ON {d_pk} = k.pk '
-                    "WHERE k.site_ordinal=? AND k.db_version BETWEEN ? AND ?",
-                    (ordinal, lo, hi),
-                ):
-                    out.append(
-                        Change(
-                            table=t,
-                            pk=bytes(pk),
-                            cid=cid,
-                            val=val,
-                            col_version=colv,
-                            db_version=CrsqlDbVersion(dbv),
-                            seq=CrsqlSeq(seq),
-                            site_id=origin,
-                            cl=cl,
-                        )
+            if not info.data_cols:
+                continue  # no cells to collect
+            # cell-level rows with current values, one JOIN per table:
+            # cl from the causal-length table, the live value picked out
+            # of the data row by a generated CASE over the column name
+            val_case = (
+                "CASE k.cid "
+                + " ".join(f"WHEN '{c}' THEN d.\"{c}\"" for c in info.data_cols)
+                + " END"
+            )
+            d_pk = "corro_pack(" + ", ".join(f'd."{p}"' for p in info.pk_cols) + ")"
+            for pk, cid, colv, dbv, seq, cl, val in conn.execute(
+                f"SELECT k.pk, k.cid, k.col_version, k.db_version, k.seq,"
+                f" COALESCE(c.cl, 1), {val_case} "
+                f'FROM "{t}__corro_clock" k '
+                f'LEFT JOIN "{t}__corro_cl" c ON c.pk = k.pk '
+                f'LEFT JOIN "{t}" d ON {d_pk} = k.pk '
+                "WHERE k.site_ordinal=? AND k.db_version BETWEEN ? AND ?",
+                (ordinal, lo, hi),
+            ):
+                out.append(
+                    Change(
+                        table=t,
+                        pk=bytes(pk),
+                        cid=cid,
+                        val=val,
+                        col_version=colv,
+                        db_version=CrsqlDbVersion(dbv),
+                        seq=CrsqlSeq(seq),
+                        site_id=origin,
+                        cl=cl,
                     )
-            out.sort(key=lambda ch: (int(ch.db_version), int(ch.seq)))
-            return out
+                )
+        out.sort(key=lambda ch: (int(ch.db_version), int(ch.seq)))
+        return out
 
     def changes_for_version(self, db_version: int, site_id: Optional[bytes] = None):
         return self.collect_changes((db_version, db_version), site_id)

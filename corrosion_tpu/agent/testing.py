@@ -79,6 +79,45 @@ def seed_full_membership(agents) -> None:
             a.members.upsert(b.actor_id, tuple(b.gossip_addr))
 
 
+class CaptureWriter:
+    """StreamWriter stand-in that collects written bytes — serve-path
+    harnesses point ``_serve_need``/``_serve_sync`` at one of these and
+    decode ``buf`` with ``speedy.FrameReader``."""
+
+    def __init__(self):
+        self.buf = bytearray()
+
+    def write(self, b: bytes) -> None:
+        self.buf += b
+
+    async def drain(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def make_offline_agent(
+    tmpdir: Optional[str] = None,
+    schema: str = TEST_SCHEMA,
+    **overrides,
+) -> Agent:
+    """Build a full Agent WITHOUT starting its network loops: storage,
+    bookkeeping, and the sync serve path all work (handle_change /
+    _serve_need are loop-independent), so serve-side parity and bench
+    harnesses can drive thousands of versions without paying gossip
+    timers or socket setup.  Callers must ``agent.storage.close()`` (or
+    use it inside asyncio.run and close after)."""
+    d = tmpdir or tempfile.mkdtemp(prefix="corro-offline-")
+    cfg = AgentConfig(
+        db_path=f"{d}/corrosion.db",
+        schema_sql=schema,
+        api_port=None,
+        **overrides,
+    )
+    return Agent(cfg)
+
+
 async def wait_for(predicate, timeout: float = 10.0, interval: float = 0.05):
     """Poll until predicate() is truthy or raise TimeoutError."""
     loop = asyncio.get_running_loop()

@@ -1,0 +1,35 @@
+"""Serve-path benchmark harness checks.
+
+Tier-1 runs the full ``bench.py --sync`` machinery at 500 versions (a
+smoke: parity must hold, the live two-node backfill must converge);
+the 10k-version headline gates (>=3x serve throughput, no event-loop
+stall over 50 ms while serving) run in the @slow tier.
+"""
+
+import pytest
+
+from bench import run_sync_bench
+
+
+def test_sync_bench_smoke_500():
+    out = run_sync_bench(n_versions=500, out_path=None, live=True)
+    assert "error" not in out, out.get("error")
+    # a served-bytes mismatch voids the headline — the smoke pins it
+    assert out["parity_ok"] is True
+    assert out["value"] is not None and out["value"] > 0
+    pts = out["points"]
+    assert pts["per_version"]["cold"]["served_bytes"] == \
+        pts["batched"]["cold"]["served_bytes"] > 0
+    assert out["live_backfill"]["converged"] is True
+
+
+@pytest.mark.slow
+def test_sync_bench_headline_10k():
+    out = run_sync_bench(n_versions=10_000, out_path=None, live=True)
+    assert "error" not in out, out.get("error")
+    assert out["parity_ok"] is True
+    # acceptance gates: >=3x cold serve throughput, and the batched
+    # serve never stalls the event loop beyond 50 ms
+    assert out["value"] >= 3.0, out
+    assert out["points"]["batched"]["cold"]["max_stall_ms"] <= 50.0, out
+    assert out["live_backfill"]["converged"] is True
