@@ -308,11 +308,17 @@ CREATE TABLE IF NOT EXISTS __corro_sync_state (
         rows: List[Tuple[int, int, int, Optional[int]]],
     ) -> None:
         """Batch write-through for several applied versions of one actor
-        (the merged apply-transaction path): one executemany + ONE gap
-        diff instead of a per-version write-through.  ``rows`` is
-        ``(version, db_version, last_seq, ts)`` tuples; call inside the
-        storage tx, after the in-memory ``apply_version`` calls (the gap
-        diff reads the final needed set)."""
+        (the merged apply-transaction AND group-commit write paths): one
+        executemany + ONE gap diff instead of a per-version
+        write-through.  ``rows`` is ``(version, db_version, last_seq,
+        ts)`` tuples; call inside the storage tx.  The gap diff reads
+        the current in-memory needed set — for the LOCAL actor's
+        group-commit writes that set is untouched by sequential version
+        assignment, so calling before the in-memory ``apply_version``
+        (which a commit-after-persist ordering requires) is sound; for
+        merged remote applies call after them, as before."""
+        if not rows:
+            return
         self.conn.executemany(
             "INSERT OR REPLACE INTO __corro_bookkeeping "
             "(actor_id, start_version, end_version, db_version, last_seq, ts)"

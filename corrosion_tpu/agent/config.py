@@ -148,6 +148,8 @@ def load_config(path: Optional[str] = None, **overrides) -> AgentConfig:
         "sync_peers",
         "max_sync_sessions",
         "seen_cache_size",
+        "write_group_commit",
+        "write_group_max",
     ):
         if key in perf:
             kwargs[key] = perf[key]

@@ -4,7 +4,9 @@ Parity: ``crates/corro-agent/src/api/public/`` + routes assembled at
 ``agent/util.rs:181-293``:
 
 * ``POST /v1/transactions`` — execute write statements in one version
-  (broadcast on commit);
+  (broadcast on commit); concurrent requests coalesce through the
+  group-commit write combiner (docs/writes.md) — each handler thread's
+  batch keeps its own version and failure isolation;
 * ``POST /v1/queries`` — streaming NDJSON query results
   (columns / row / eoq events, like ``TypedQueryEvent``);
 * ``POST /v1/migrations`` — merge schema SQL;

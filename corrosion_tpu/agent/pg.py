@@ -650,7 +650,11 @@ class _Session:
                 return [], [], 0, "ROLLBACK"
             if writes:
                 # tracked: a CancelRequest landing mid-COMMIT interrupts
-                # the buffered transaction's replay (57014)
+                # the buffered transaction's replay (57014).  Under
+                # group commit the replay runs as one SAVEPOINT batch of
+                # a combined group (docs/writes.md); an interrupt aborts
+                # the group, the per-batch fallback replays the OTHER
+                # sessions' batches, and this session still sees 57014
                 self.agent.execute_transaction(
                     writes, on_conn=self._track_conn
                 )

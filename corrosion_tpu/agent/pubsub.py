@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import os
 import queue
 import re
@@ -49,6 +50,8 @@ import threading
 import time
 import uuid
 from typing import Dict, List, Optional, Set, Tuple
+
+logger = logging.getLogger("corrosion_tpu.agent.pubsub")
 
 from corrosion_tpu.agent.pack import jsonable_row, pack_values, unpack_values
 from corrosion_tpu.types.changeset import ChangeV1
@@ -1391,7 +1394,16 @@ class SubsManager:
             try:
                 h.refresh()
             except sqlite3.Error:
-                pass
+                # the candidate set stays pending-free, so the refresh
+                # is simply LOST until the next change touches the sub's
+                # tables — count it (a systemic cause, e.g. busy storms,
+                # must be visible next to the delta-fallback counter)
+                self.agent.metrics.counter(
+                    "corro_subs_refresh_failures_total"
+                )
+                logger.debug(
+                    "full refresh failed for sub %s", h.id, exc_info=True
+                )
 
     def idle(self) -> bool:
         """True when no candidate work is queued OR in flight — the

@@ -104,6 +104,13 @@ class AgentConfig:
     # changeset frames into buffered writes with one drain per budget.
     # False = the per-version parity oracle (bench baseline / tests)
     sync_batched_serve: bool = True
+    # group-commit write combining (docs/writes.md): concurrent
+    # execute_transaction callers coalesce into one storage-lock hold /
+    # one outer transaction (per-client SAVEPOINTs), with ONE change
+    # collection per group on a read-only pool connection off the event
+    # loop.  False = the per-transaction parity oracle.
+    write_group_commit: bool = True
+    write_group_max: int = 64  # client batches per combined group
     seen_cache_size: int = 65536
     # ingest pipeline (handlers.rs:742-956 / config.rs:10-45 defaults)
     processing_queue_len: int = 20_000  # bounded, drop-oldest
@@ -274,6 +281,20 @@ class Agent:
         # without start()); distinct from the apply pool so a long
         # backfill serve can't starve change application
         self._serve_pool = None
+        # group-commit write combiner (agent/writes.py): callers of
+        # execute_transaction coalesce into shared commits; the leader
+        # is always a caller thread, so this works without the loop
+        from corrosion_tpu.agent.writes import WriteCombiner
+
+        self._write_combiner = WriteCombiner(
+            self, max_group=config.write_group_max
+        )
+        # single-thread local-broadcast collection worker (lazy): keeps
+        # collect_changes + chunk encoding for local commits OFF the
+        # event loop while preserving version order of on_change fanout
+        self._wbcast_pool = None
+        self._wbcast_lock = threading.Lock()
+        self._wbcast_closed = False  # stop(): no lazy pool rebirth
         self._sync_server_sessions = 0  # in-flight inbound sessions
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         if config.schema_sql:
@@ -314,8 +335,13 @@ class Agent:
             self._pre_start_broadcasts = []
             pending_cvs = self._pre_start_cvs
             self._pre_start_cvs = []
-        for version, db_version, last_seq, ts in pending:
-            self._queue_local_broadcast(version, db_version, last_seq, ts)
+        if pending:
+            # deferred pre-start commits: collection runs on the
+            # write-bcast worker, never on the event loop starting up
+            # (start() precedes stop(), so the pool can't be closed)
+            self._wbcast_executor().submit(
+                self._broadcast_local_commits, pending
+            )
         for cv in pending_cvs:
             self.metrics.counter(
                 "corro_channel_sends_total", channel="bcast")
@@ -436,6 +462,18 @@ class Agent:
         if self._serve_pool is not None:
             self._serve_pool.shutdown(wait=True)
             self._serve_pool = None
+        # drain queued local-broadcast collections before storage goes
+        # away (their RO reads must not race close).  The closed flag
+        # flips under the lock BEFORE shutdown so a write completing
+        # concurrently with stop() can't lazily rebirth a pool that
+        # would read closing storage and leak its thread — late
+        # dispatches drop instead (the versions are durable;
+        # anti-entropy serves them after restart)
+        with self._wbcast_lock:
+            self._wbcast_closed = True
+            pool, self._wbcast_pool = self._wbcast_pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
         if self._udp:
             self._udp.close()
             self._udp = None  # liveness marker: stopped agents don't send
@@ -571,6 +609,10 @@ class Agent:
         extra.append((
             "corro_sync_server_sessions",
             float(self._sync_server_sessions), {},
+        ))
+        extra.append((
+            "corro_write_queue_depth",
+            float(self._write_combiner.depth()), {},
         ))
         if self.subs is not None:
             with self.subs._lock:
@@ -1059,13 +1101,38 @@ class Agent:
 
     def execute_transaction(self, statements: Sequence,
                             on_conn=None) -> dict:
-        """Run write statements in one tx; version + bookkeeping + queue
-        the broadcast (``make_broadcastable_changes`` parity).
+        """Run write statements in one client transaction; version +
+        bookkeeping + queue the broadcast (``make_broadcastable_changes``
+        parity).
+
+        With ``AgentConfig.write_group_commit`` (default on) the call
+        routes through the write combiner (``agent/writes.py``,
+        docs/writes.md): concurrent callers share one storage-lock hold
+        and one outer commit, each batch isolated under its own
+        SAVEPOINT — same results, versions, broadcasts, and subscription
+        events as the per-transaction path, which stays below as the
+        parity oracle.  Batches opening with transaction-control SQL
+        (BEGIN/COMMIT/PRAGMA/…) always take the oracle path.
 
         ``on_conn`` (called with the RW connection once the storage lock
         is held, then with None before release) lets a caller interrupt
         the in-flight write — the PG front-end's CancelRequest path,
         mirroring ``CrConn.read_query``'s contract."""
+        if self.config.write_group_commit:
+            from corrosion_tpu.agent.writes import has_tx_control
+
+            if not has_tx_control(statements):
+                return self._write_combiner.submit(statements, on_conn)
+            self.metrics.counter(
+                "corro_write_group_fallbacks_total", reason="stmt"
+            )
+        return self._execute_transaction_single(statements, on_conn)
+
+    def _execute_transaction_single(self, statements: Sequence,
+                                    on_conn=None) -> dict:
+        """The per-transaction write path: one storage-lock hold, one
+        BEGIN..COMMIT, one broadcast collection — the parity oracle the
+        write combiner is pinned against (tests/test_write_combiner.py)."""
         results = []
         booked = self.bookie.for_actor(self.actor_id)
         # hold the storage lock across COMMIT *and* the in-memory bookie
@@ -1096,49 +1163,56 @@ class Agent:
             return {"results": results, "version": version}
         return {"results": results, "version": None}
 
+    def _execute_statements(self, conn, statements, results) -> None:
+        """Run one client batch's statements on ``conn``, appending a
+        result dict per statement.  Shared verbatim by the per-
+        transaction oracle and the group-commit combiner so the two can
+        never diverge on result shapes."""
+        for stmt in statements:
+            sql, params = unpack_stmt(stmt)
+            cur = conn.execute(sql, params)
+            head = sql.lstrip().split(None, 1)
+            is_dml = bool(head) and head[0].upper() in (
+                "INSERT", "UPDATE", "DELETE", "REPLACE", "WITH",
+            )
+            if cur.rowcount < 0 and cur.description is None \
+                    and is_dml:
+                # sqlite3 reports -1 for INSERT..SELECT and
+                # friends; changes() has the statement's true
+                # direct count (triggers excluded).  DML-gated:
+                # for DDL, changes() still holds the PREVIOUS
+                # statement's count
+                cur = conn.execute("SELECT changes()")
+                n = cur.fetchone()[0]
+                results.append({"rows_affected": n})
+                continue
+            if cur.description is not None:
+                # RETURNING clause (ORM-style writes): surface
+                # the produced rows alongside the write result,
+                # JSON-safe (a BLOB column must not 500 the
+                # HTTP response after the write committed).
+                # fetchall() FIRST — sqlite3 only counts
+                # affected rows as RETURNING rows are stepped,
+                # so rowcount is 0 until the fetch completes
+                from corrosion_tpu.agent.pack import jsonable_row
+
+                fetched = cur.fetchall()
+                res = {
+                    "rows_affected": cur.rowcount,
+                    "columns": [d[0] for d in cur.description],
+                    "rows": [jsonable_row(r) for r in fetched],
+                }
+            else:
+                res = {"rows_affected": cur.rowcount}
+            results.append(res)
+
     def _execute_transaction_locked(self, statements, results,
                                     booked) -> Optional[tuple]:
-        """Body of :meth:`execute_transaction` under the storage lock;
-        returns ``(version, db_version, n_changes, ts)`` for a committed
-        versioned write, None for a changeless one."""
+        """Body of :meth:`_execute_transaction_single` under the storage
+        lock; returns ``(version, db_version, n_changes, ts)`` for a
+        committed versioned write, None for a changeless one."""
         with self.storage.write_tx() as conn:
-            for stmt in statements:
-                sql, params = unpack_stmt(stmt)
-                cur = conn.execute(sql, params)
-                head = sql.lstrip().split(None, 1)
-                is_dml = bool(head) and head[0].upper() in (
-                    "INSERT", "UPDATE", "DELETE", "REPLACE", "WITH",
-                )
-                if cur.rowcount < 0 and cur.description is None \
-                        and is_dml:
-                    # sqlite3 reports -1 for INSERT..SELECT and
-                    # friends; changes() has the statement's true
-                    # direct count (triggers excluded).  DML-gated:
-                    # for DDL, changes() still holds the PREVIOUS
-                    # statement's count
-                    cur = conn.execute("SELECT changes()")
-                    n = cur.fetchone()[0]
-                    results.append({"rows_affected": n})
-                    continue
-                if cur.description is not None:
-                    # RETURNING clause (ORM-style writes): surface
-                    # the produced rows alongside the write result,
-                    # JSON-safe (a BLOB column must not 500 the
-                    # HTTP response after the write committed).
-                    # fetchall() FIRST — sqlite3 only counts
-                    # affected rows as RETURNING rows are stepped,
-                    # so rowcount is 0 until the fetch completes
-                    from corrosion_tpu.agent.pack import jsonable_row
-
-                    fetched = cur.fetchall()
-                    res = {
-                        "rows_affected": cur.rowcount,
-                        "columns": [d[0] for d in cur.description],
-                        "rows": [jsonable_row(r) for r in fetched],
-                    }
-                else:
-                    res = {"rows_affected": cur.rowcount}
-                results.append(res)
+            self._execute_statements(conn, statements, results)
             n_changes = self.storage._state("seq")
             if n_changes > 0:
                 version = booked.last() + 1
@@ -1158,6 +1232,244 @@ class Agent:
             return None
         booked.apply_version(version, db_version, n_changes - 1, ts)
         return (version, db_version, n_changes, ts)
+
+    # -- group-commit write combining (docs/writes.md) ------------------
+    #
+    # Concurrent execute_transaction callers coalesce (agent/writes.py):
+    # one storage-lock hold + one outer BEGIN..COMMIT per group, each
+    # client batch under its own SAVEPOINT, versions/db_versions/seq
+    # spans assigned gaplessly in submission order, bookkeeping flushed
+    # via Bookie.persist_versions, then ONE change collection for the
+    # group's whole db_version span on a read-only pool connection off
+    # the event loop — with on_change fired per changeset and one
+    # compaction sweep per group.  The per-transaction path above is
+    # the parity oracle (tests/test_write_combiner.py).
+
+    def _execute_write_group(self, reqs) -> None:
+        """Drain one combined group: resolve every request's result or
+        error and set its ``done`` event.  Never raises — a dead leader
+        would strand every parked caller."""
+        from corrosion_tpu.agent.writes import GroupAborted
+
+        booked = self.bookie.for_actor(self.actor_id)
+        self.metrics.counter("corro_write_groups_total")
+        self.metrics.histogram("corro_write_group_size", len(reqs))
+        aborted: Optional[GroupAborted] = None
+        entries = None
+        try:
+            with self.metrics.timed("corro_write_group_seconds"), \
+                    self.storage._lock.prio(PRIO_HIGH, "write-group",
+                                            kind="write"):
+                entries = self._run_write_group_locked(reqs, booked)
+        except GroupAborted as ga:
+            aborted = ga
+        except BaseException as e:  # lock/commit-level failure
+            aborted = GroupAborted(None, e)
+        if aborted is not None:
+            # replay every batch that didn't fail in its own savepoint
+            # and didn't commit durably (a hostile mid-group COMMIT
+            # finishes its prefix in _recover_committed_group — those
+            # requests carry a result and must NOT be replayed, that
+            # would double-apply) through the per-transaction oracle
+            # (the mirror of _handle_change_group's merged-tx
+            # fallback); the batch that surfaced the abort keeps its
+            # original error
+            self.metrics.counter(
+                "corro_write_group_fallbacks_total", reason="abort"
+            )
+            if aborted.recovered:
+                try:
+                    self._dispatch_local_broadcast(
+                        list(aborted.recovered)
+                    )
+                except Exception:
+                    self.metrics.counter(
+                        "corro_local_broadcast_errors_total")
+            for i, req in enumerate(reqs):
+                if i == aborted.index:
+                    req.error = aborted.error
+                elif req.error is None and req.result is None:
+                    try:
+                        req.result = self._execute_transaction_single(
+                            req.statements, req.on_conn
+                        )
+                    except BaseException as e:
+                        req.error = e
+                req.done.set()
+            return
+        # committed: ONE coalesced broadcast collection for the span
+        # (off the event loop), then unblock the callers — their write
+        # is durable — and sweep compaction once for the whole group
+        if entries:
+            try:
+                self._dispatch_local_broadcast(entries)
+            except Exception:
+                self.metrics.counter("corro_local_broadcast_errors_total")
+        for req in reqs:
+            req.done.set()
+        if entries:
+            self._compact_best_effort()
+
+    def _run_write_group_locked(self, reqs, booked) -> List[tuple]:
+        """Group body under the storage lock: one outer transaction,
+        per-batch savepoints.  Returns the committed ``(version,
+        db_version, last_seq, ts)`` entries in submission order; sets
+        ``result``/``error`` on every request (without firing ``done``).
+
+        Raises ``GroupAborted`` when the OUTER transaction is lost
+        (interrupt, disk error, a statement that terminated it):
+        usually a rollback — nothing committed, no request state
+        trusted — but a statement that COMMITTED the outer transaction
+        mid-group is detected via the committed db_version cursor and
+        its durable prefix finished in place
+        (:meth:`_recover_committed_group`)."""
+        import sqlite3
+
+        from corrosion_tpu.agent.writes import GroupAborted
+
+        conn = self.storage.conn
+        conn.execute("BEGIN IMMEDIATE")
+        # committed db_version cursor at group start: if the outer tx
+        # terminates and this has ADVANCED durably, a statement
+        # committed mid-group rather than rolling back
+        dbv0 = self.storage._state("db_version")
+        entries: List[tuple] = []  # (version, db_version, last_seq, ts)
+        req_state: List[Optional[tuple]] = []  # (results, version|None)
+        rows: List[tuple] = []  # bookkeeping executemany rows
+        version = booked.last()
+        try:
+            for i, req in enumerate(reqs):
+                # per-batch version state, exactly like write_tx: the
+                # CRR triggers stamp this batch's rows with its OWN
+                # (pending db_version, seq 0..n-1) span
+                pending = self.storage.begin_write_batch()
+                conn.execute("SAVEPOINT corro_wg")
+                if req.on_conn is not None:
+                    req.on_conn(conn)
+                results: List[dict] = []
+                try:
+                    self._execute_statements(conn, req.statements, results)
+                    if not conn.in_transaction:
+                        # a statement ended the outer tx underneath us
+                        # (screened tx-control should prevent this, but
+                        # a hostile/odd statement must fail loud, not
+                        # half-commit a group)
+                        raise sqlite3.OperationalError(
+                            "statement terminated the group transaction"
+                        )
+                except BaseException as e:
+                    if not conn.in_transaction:
+                        raise GroupAborted(i, e)
+                    # savepoint-scoped failure: only this caller fails
+                    conn.execute("ROLLBACK TO corro_wg")
+                    conn.execute("RELEASE corro_wg")
+                    req.error = e
+                    req_state.append(None)
+                    continue
+                finally:
+                    if req.on_conn is not None:
+                        req.on_conn(None)
+                conn.execute("RELEASE corro_wg")
+                n_changes = self.storage._state("seq")
+                if n_changes > 0:
+                    self.storage._set_state("db_version", pending)
+                    version += 1
+                    ts = self.clock.new_timestamp()
+                    rows.append((version, pending, n_changes - 1, int(ts)))
+                    entries.append((version, pending, n_changes - 1, ts))
+                    req_state.append((results, version))
+                else:
+                    # changeless batch: no version/db_version consumed
+                    req_state.append((results, None))
+            if rows:
+                # one executemany write-through for the whole group
+                # (persist INSIDE the tx, atomic with the data —
+                # persist_version contract)
+                self.bookie.persist_versions(self.actor_id, rows)
+            conn.execute("COMMIT")
+        except GroupAborted as ga:
+            if conn.in_transaction:
+                conn.execute("ROLLBACK")
+            else:
+                self._recover_committed_group(
+                    ga, dbv0, entries, rows, reqs, req_state, booked
+                )
+            raise
+        except BaseException as e:
+            ga = GroupAborted(None, e)
+            if conn.in_transaction:
+                conn.execute("ROLLBACK")
+            else:
+                self._recover_committed_group(
+                    ga, dbv0, entries, rows, reqs, req_state, booked
+                )
+            raise ga
+        # in-memory bookie only AFTER the commit succeeded (the oracle's
+        # ordering): a failed commit must never leave memory advertising
+        # versions the DB never stored.  Still under the storage lock,
+        # so generate_sync's locked snapshot can't see a half-applied
+        # group
+        for v, dbv, last_seq, ts in entries:
+            booked.apply_version(v, dbv, last_seq, ts)
+        for req, st in zip(reqs, req_state):
+            if st is None:
+                continue
+            results, v = st
+            req.result = {"results": results, "version": v}
+        return entries
+
+    def _recover_committed_group(self, ga, dbv0, entries, rows, reqs,
+                                 req_state, booked) -> None:
+        """The group's outer transaction is GONE (still under the
+        storage lock).  Usually that is a rollback and nothing
+        committed — detected here by the committed db_version cursor
+        still reading ``dbv0`` — and the abort fallback may safely
+        replay every batch.  But a statement that slipped past
+        tx-control screening and COMMITTED mid-group leaves every batch
+        processed so far durable WITHOUT bookkeeping; replaying those
+        would double-apply.  Finish the committed prefix in place
+        instead: persist its bookkeeping rows in a recovery
+        transaction, apply the in-memory versions, attach the callers'
+        results (so the fallback skips them), and hand the entries to
+        the abort path via ``ga.recovered`` for broadcast.  Best
+        effort by design — the one invariant that must hold even when
+        recovery itself fails is that durable batches are never
+        replayed, so results attach regardless."""
+        try:
+            committed = self.storage._state("db_version")
+        except Exception:
+            return  # storage unreadable: nothing more we can do
+        if committed == dbv0:
+            return  # clean rollback: replay is safe
+        self.metrics.counter("corro_write_group_hostile_commits_total")
+        logger.warning(
+            "a group write statement committed mid-group "
+            "(db_version %d -> %d); recovering %d durable batches",
+            dbv0, committed, len(req_state),
+        )
+        conn = self.storage.conn
+        persisted = True
+        if rows:
+            try:
+                conn.execute("BEGIN IMMEDIATE")
+                self.bookie.persist_versions(self.actor_id, rows)
+                conn.execute("COMMIT")
+            except BaseException:
+                persisted = False
+                try:
+                    if conn.in_transaction:
+                        conn.execute("ROLLBACK")
+                except Exception:
+                    pass
+        if persisted:
+            for v, dbv, last_seq, ts in entries:
+                booked.apply_version(v, dbv, last_seq, ts)
+            ga.recovered = list(entries)
+        for req, st in zip(reqs, req_state):
+            if st is None:
+                continue  # savepoint-failed batch keeps its error
+            results, v = st
+            req.result = {"results": results, "version": v}
 
     def _find_and_clear_overwritten(self) -> List[Tuple[int, int]]:
         """Local compaction: versions whose change rows were all
@@ -1241,35 +1553,109 @@ class Agent:
     def _queue_or_defer_broadcast(
         self, version: int, db_version: int, last_seq: int, ts: Timestamp
     ) -> None:
-        """Queue a local broadcast, or buffer it until start() when the
-        event loop isn't up yet (writes before start() must still gossip)."""
+        """Queue one committed local version's broadcast, or buffer it
+        until start() when the event loop isn't up yet (writes before
+        start() must still gossip)."""
+        self._dispatch_local_broadcast([(version, db_version, last_seq, ts)])
+
+    def _wbcast_executor(self):
+        """The single-thread local-broadcast collection worker (lazy),
+        or None once stop() closed it (no pool rebirth after teardown).
+        ONE thread on purpose: collection + on_change + enqueue stay in
+        version order, exactly like the old loop-serialized path."""
+        with self._wbcast_lock:
+            if self._wbcast_closed:
+                return None
+            pool = self._wbcast_pool
+            if pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                pool = self._wbcast_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="corro-wbcast",
+                )
+            return pool
+
+    def _dispatch_local_broadcast(self, entries: List[tuple]) -> None:
+        """Route committed-version entries ``(version, db_version,
+        last_seq, ts)`` to collection + broadcast enqueue.
+
+        Collection (SQL) and chunk encoding NEVER run on the event loop
+        (the pre-round-6 path scheduled them there with
+        ``call_soon_threadsafe``, stalling SWIM acks under write
+        bursts): on a live agent they run on the ordered write-bcast
+        worker; the deterministic scheduler (``_SyncLoop`` stand-in
+        loop) runs them inline so its synchronous queue contract holds;
+        with no loop at all they defer to start()."""
         with self._bcast_gate:
             if self._loop is None:
-                self._pre_start_broadcasts.append(
-                    (version, db_version, last_seq, ts)
-                )
+                self._pre_start_broadcasts.extend(entries)
                 return
-            loop = self._loop
-        loop.call_soon_threadsafe(
-            self._queue_local_broadcast, version, db_version, last_seq, ts
-        )
+            live_loop = isinstance(self._loop, asyncio.AbstractEventLoop)
+        if live_loop:
+            pool = self._wbcast_executor()
+            if pool is not None:  # None: stop() already tore it down
+                pool.submit(self._broadcast_local_commits, entries)
+        else:
+            self._broadcast_local_commits(entries)
 
-    def _queue_local_broadcast(
-        self, version: int, db_version: int, last_seq: int, ts: Timestamp
-    ) -> None:
-        changes = self.storage.collect_changes((db_version, db_version))
-        for chunk, seqs in ChunkedChanges(changes, 0, last_seq):
-            cs = Changeset.full(
-                Version(version), chunk, seqs, last_seq=last_seq, ts=ts
+    def _broadcast_local_commits(self, entries: List[tuple]) -> None:
+        """Worker body: one coalesced collection for the entries' whole
+        db_version span, then per-changeset on_change + broadcast
+        enqueue in version order.  A failure here must not surface as an
+        unretrieved executor exception — the versions are already
+        durable and anti-entropy serves them regardless."""
+        try:
+            cvs = self._local_commit_changesets(entries)
+        except Exception:
+            self.metrics.counter("corro_local_broadcast_errors_total")
+            logger.debug("local broadcast collection failed", exc_info=True)
+            return
+        for cv in cvs:
+            # per-changeset isolation, like the old per-version
+            # dispatch: a raising on_change subscriber drops THAT
+            # version's broadcast, not the rest of the group's
+            try:
+                if self.on_change is not None:
+                    self.on_change(cv)
+                self._queue_or_defer_cv(cv)
+            except Exception:
+                self.metrics.counter("corro_local_broadcast_errors_total")
+                logger.debug(
+                    "local broadcast dispatch failed", exc_info=True
+                )
+
+    def _local_commit_changesets(
+        self, entries: List[tuple]
+    ) -> List[ChangeV1]:
+        """Committed local versions -> their broadcast changesets, via
+        ONE range collection on a read-only pool connection (no storage
+        lock — the rows are committed data) split by db_version in
+        memory.  A combined group's db_versions are consecutive (the
+        group held the storage lock across all its batches), so the
+        span contains exactly the entries' changes; chunking per
+        version is identical to the per-commit path."""
+        if not entries:
+            return []
+        dbvs = [e[1] for e in entries]
+        with self.storage.reader() as conn:
+            changes = self.storage.collect_changes_ro(
+                conn, (min(dbvs), max(dbvs))
             )
-            cv = ChangeV1(actor_id=ActorId(self.actor_id), changeset=cs)
-            if self.on_change is not None:
-                self.on_change(cv)
-            self.metrics.counter(
-                "corro_channel_sends_total", channel="bcast")
-            self._bcast_queue.put_nowait(
-                (cv, self.config.max_transmissions, 0)
-            )
+        by_dbv: Dict[int, List] = {}
+        for ch in changes:
+            by_dbv.setdefault(int(ch.db_version), []).append(ch)
+        cvs: List[ChangeV1] = []
+        for version, db_version, last_seq, ts in entries:
+            for chunk, seqs in ChunkedChanges(
+                by_dbv.get(db_version, []), 0, last_seq
+            ):
+                cs = Changeset.full(
+                    Version(version), chunk, seqs, last_seq=last_seq, ts=ts
+                )
+                cvs.append(
+                    ChangeV1(actor_id=ActorId(self.actor_id), changeset=cs)
+                )
+        return cvs
 
     def _record_rtt(self, addr, rtt_s: float) -> None:
         for m in self.members.alive():
@@ -2017,50 +2403,64 @@ class Agent:
 
     async def _maintenance_loop(self) -> None:
         """WAL checkpoint + incremental vacuum + compaction leftovers +
-        buffered-meta clearing (handlers.rs:394-534, util.rs:425-480)."""
+        buffered-meta clearing (handlers.rs:394-534, util.rs:425-480).
+        The SQL body runs on the apply pool: a WAL checkpoint of a busy
+        database takes 100ms+, and running it on the event loop stalled
+        SWIM acks every maintenance tick."""
         while True:
             await asyncio.sleep(self.config.maintenance_interval)
             try:
-                # crash-leftover impacted versions from before a restart
-                self._find_and_clear_overwritten()
-                self._clear_buffered_meta()
-            except Exception:
-                pass
-            try:
-                from corrosion_tpu.agent.locks import PRIO_LOW
-
-                # maintenance yields the connection to applies and API
-                # writes (LOW tier) and gets interrupted rather than
-                # stalling them behind a long truncate/vacuum
-                with self.storage._lock.prio(PRIO_LOW, "maintenance"), \
-                        self.storage.interruptible(30.0):
-                    (wal_pages, _) = self.storage.conn.execute(
-                        "PRAGMA wal_checkpoint(PASSIVE)"
-                    ).fetchone()[1:]
-                    if wal_pages is not None and wal_pages > self.config.wal_truncate_pages:
-                        self.storage.conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
-                        self.metrics.counter("corro_db_wal_truncations")
-                    (freelist,) = self.storage.conn.execute(
-                        "PRAGMA freelist_count"
-                    ).fetchone()
-                    if freelist > self.config.vacuum_free_pages:
-                        self.storage.conn.execute(
-                            f"PRAGMA incremental_vacuum({freelist // 2})"
-                        )
-                        self.metrics.counter("corro_db_vacuums")
-                    # db/queue gauges moved to scrape time
-                    # (metric_gauges): one owner per series name, and
-                    # a scrape reads current values instead of stale
-                    # maintenance-tick snapshots
-                    if wal_pages is not None:
-                        self.metrics.gauge(
-                            "corro_db_wal_pages", wal_pages
-                        )
-                self.metrics.gauge(
-                    "corro_members_ring0", len(self.members.ring0())
+                await self._loop.run_in_executor(
+                    self._apply_pool, self._maintenance_pass
                 )
             except Exception:
                 pass
+            self.metrics.gauge(
+                "corro_members_ring0", len(self.members.ring0())
+            )
+
+    def _maintenance_pass(self) -> None:
+        """One blocking maintenance sweep (worker thread)."""
+        try:
+            # crash-leftover impacted versions from before a restart
+            self._find_and_clear_overwritten()
+            self._clear_buffered_meta()
+        except Exception:
+            pass
+        try:
+            from corrosion_tpu.agent.locks import PRIO_LOW
+
+            # maintenance yields the connection to applies and API
+            # writes (LOW tier) and gets interrupted rather than
+            # stalling them behind a long truncate/vacuum
+            with self.storage._lock.prio(PRIO_LOW, "maintenance"), \
+                    self.storage.interruptible(30.0):
+                (wal_pages, _) = self.storage.conn.execute(
+                    "PRAGMA wal_checkpoint(PASSIVE)"
+                ).fetchone()[1:]
+                if wal_pages is not None and \
+                        wal_pages > self.config.wal_truncate_pages:
+                    self.storage.conn.execute(
+                        "PRAGMA wal_checkpoint(TRUNCATE)")
+                    self.metrics.counter("corro_db_wal_truncations")
+                (freelist,) = self.storage.conn.execute(
+                    "PRAGMA freelist_count"
+                ).fetchone()
+                if freelist > self.config.vacuum_free_pages:
+                    self.storage.conn.execute(
+                        f"PRAGMA incremental_vacuum({freelist // 2})"
+                    )
+                    self.metrics.counter("corro_db_vacuums")
+                # db/queue gauges moved to scrape time
+                # (metric_gauges): one owner per series name, and
+                # a scrape reads current values instead of stale
+                # maintenance-tick snapshots
+                if wal_pages is not None:
+                    self.metrics.gauge(
+                        "corro_db_wal_pages", wal_pages
+                    )
+        except Exception:
+            pass
 
     async def _sync_loop(self) -> None:
         from corrosion_tpu.utils.backoff import Backoff

@@ -701,6 +701,20 @@ END;
             "UPDATE __corro_state SET value=? WHERE key=?", (value, key)
         )
 
+    def begin_write_batch(self) -> int:
+        """Arm the trigger state for one local write batch inside an
+        already-open transaction: allocate the next pending db_version
+        and reset the seq counter.  Returns the pending db_version.
+        Shared by :meth:`write_tx` (one batch per transaction) and the
+        group-commit combiner (one batch per SAVEPOINT inside a shared
+        outer transaction — ``runtime._run_write_group_locked``); the
+        caller commits the allocation by setting ``db_version`` to the
+        returned value iff the batch produced changes."""
+        pending = self._state("db_version") + 1
+        self._set_state("pending_db_version", pending)
+        self._set_state("seq", 0)
+        return pending
+
     @contextmanager
     def write_tx(self):
         """One local transaction == at most one allocated db_version.
@@ -715,9 +729,7 @@ END;
 
         with self._lock.prio(PRIO_HIGH, "write", kind="write"):
             self.conn.execute("BEGIN IMMEDIATE")
-            pending = self._state("db_version") + 1
-            self._set_state("pending_db_version", pending)
-            self._set_state("seq", 0)
+            pending = self.begin_write_batch()
             try:
                 yield self.conn
             except BaseException:

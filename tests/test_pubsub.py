@@ -1017,3 +1017,48 @@ def test_from_items_parser_envelope():
         "SELECT 1",
     ):
         assert from_items(sql) is None, sql
+
+
+def test_refresh_failure_counted_not_swallowed(run):
+    """A full-refresh failure in the drain round is counted in
+    corro_subs_refresh_failures_total (it used to vanish into a bare
+    `except sqlite3.Error: pass`), and the worker survives it."""
+    async def main():
+        a = await launch_test_agent()
+        try:
+            a.execute_transaction(
+                [["INSERT INTO tests (id, text) VALUES (1, 'one')"]]
+            )
+            handle = a.subs.subscribe("SELECT id, text FROM tests")
+            await wait_for(a.subs.idle, timeout=10)
+            import sqlite3 as _sqlite3
+
+            orig = handle.refresh
+            fails = {"n": 0}
+
+            def boom():
+                fails["n"] += 1
+                raise _sqlite3.OperationalError("injected refresh failure")
+
+            handle.refresh = boom
+            try:
+                a.subs._drain_round({handle.id}, {})
+            finally:
+                handle.refresh = orig
+            assert fails["n"] == 1
+            assert a.metrics.get_counter(
+                "corro_subs_refresh_failures_total") == 1
+            # the matcher still works after the failed round
+            a.execute_transaction(
+                [["INSERT INTO tests (id, text) VALUES (2, 'two')"]]
+            )
+            await wait_for(
+                lambda: any(
+                    c[0] == 2 for _, c in list(handle.rows.values())
+                ),
+                timeout=10,
+            )
+        finally:
+            await a.stop()
+
+    run(main())

@@ -1,0 +1,395 @@
+"""Group-commit write combining: parity, atomicity, ordering, fanout.
+
+The combiner (``agent/writes.py`` + ``runtime._execute_write_group``,
+docs/writes.md) must be observationally equivalent to the
+per-transaction oracle: converged data, clock/cl state, bookkeeping,
+version assignment, and one broadcast changeset per client transaction.
+The randomized suite replays each concurrent run's committed batches in
+version order through the oracle and compares full state dumps.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import threading
+
+import pytest
+
+from corrosion_tpu.agent.testing import (
+    launch_test_agent,
+    make_offline_agent,
+    wait_for,
+)
+from corrosion_tpu.agent.writes import WriteRequest, has_tx_control
+
+
+def _close(agent) -> None:
+    if agent._wbcast_pool is not None:
+        agent._wbcast_pool.shutdown(wait=True)
+        agent._wbcast_pool = None
+    agent.storage.close()
+
+
+def _state_dump(agent) -> dict:
+    """Deterministic converged-state snapshot: table data, clock/cl
+    stamps, bookkeeping rows (ts excluded — HLC wall time differs
+    between runs), and the in-memory version ledger."""
+    conn = agent.storage.conn
+    dump: dict = {}
+    for t in agent.storage.tables:
+        dump[t] = sorted(conn.execute(f'SELECT * FROM "{t}"').fetchall())
+        dump[t + "_clock"] = sorted(
+            (bytes(row[0]), *row[1:])
+            for row in conn.execute(
+                f'SELECT pk, cid, col_version, db_version, seq,'
+                f' site_ordinal FROM "{t}__corro_clock"'
+            ).fetchall()
+        )
+        dump[t + "_cl"] = sorted(
+            (bytes(row[0]), *row[1:])
+            for row in conn.execute(
+                f'SELECT pk, cl, db_version, seq, site_ordinal, sentinel'
+                f' FROM "{t}__corro_cl"'
+            ).fetchall()
+        )
+    dump["bookkeeping"] = sorted(
+        conn.execute(
+            "SELECT start_version, end_version, db_version, last_seq "
+            "FROM __corro_bookkeeping WHERE actor_id=?",
+            (agent.actor_id,),
+        ).fetchall()
+    )
+    bv = agent.bookie.for_actor(agent.actor_id)
+    dump["versions"] = sorted(
+        (v, dbv, ls) for v, (dbv, ls) in bv.versions.items()
+    )
+    dump["max_version"] = bv.last()
+    return dump
+
+
+def _random_batch(rng: random.Random, tag: str):
+    """One client transaction: 1-3 statements over a small id space so
+    concurrent runs genuinely contend; never statement-level failing."""
+    stmts = []
+    for _ in range(rng.randint(1, 3)):
+        kind = rng.random()
+        rid = rng.randint(0, 20)
+        if kind < 0.6:
+            stmts.append((
+                "INSERT INTO tests (id, text) VALUES (?, ?) "
+                "ON CONFLICT(id) DO UPDATE SET text=excluded.text",
+                (rid, f"{tag}-{rng.randint(0, 999)}"),
+            ))
+        elif kind < 0.8:
+            stmts.append((
+                "UPDATE tests SET text=? WHERE id=?",
+                (f"{tag}-u{rng.randint(0, 999)}", rid),
+            ))
+        elif kind < 0.95:
+            stmts.append(("DELETE FROM tests WHERE id=?", (rid,)))
+        else:
+            # changeless: matches no row, consumes no version
+            stmts.append(("UPDATE tests SET text='x' WHERE id=-1", ()))
+    return stmts
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_concurrent_writer_parity_vs_sequential_oracle(seed):
+    """N threads x M transactions through the combiner, then the SAME
+    batches replayed in version (commit) order through the per-tx
+    oracle: every byte of converged state must match, and version
+    assignment must be gapless and submission-ordered."""
+    n_threads, n_tx = 4, 6
+    combined = make_offline_agent(write_group_commit=True)
+    oracle = make_offline_agent(write_group_commit=False)
+    try:
+        committed = {}  # version -> statements
+        errors = []
+        bar = threading.Barrier(n_threads)
+
+        def worker(t: int) -> None:
+            rng = random.Random((seed << 8) | t)
+            bar.wait()
+            for i in range(n_tx):
+                stmts = _random_batch(rng, f"s{seed}t{t}i{i}")
+                try:
+                    res = combined.execute_transaction(stmts)
+                except Exception as e:  # no batch here may fail
+                    errors.append(e)
+                    return
+                if res["version"] is not None:
+                    committed[res["version"]] = stmts
+
+        threads = [
+            threading.Thread(target=worker, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        # gapless, submission-ordered assignment
+        versions = sorted(committed)
+        assert versions == list(range(1, len(versions) + 1))
+        bv = combined.bookie.for_actor(combined.actor_id)
+        assert bv.last() == len(versions)
+        assert bv.contains_range(1, bv.last())
+        # sequential replay in commit order on the oracle
+        for v in versions:
+            res = oracle.execute_transaction(committed[v])
+            assert res["version"] == v
+        assert _state_dump(combined) == _state_dump(oracle)
+    finally:
+        _close(combined)
+        _close(oracle)
+
+
+def test_savepoint_atomicity_with_injected_failures():
+    """A failing batch inside a group rolls back to ITS savepoint and
+    fails only its caller; the surrounding batches commit with gapless,
+    submission-ordered versions."""
+    a = make_offline_agent()
+    try:
+        reqs = [
+            WriteRequest([(
+                "INSERT INTO tests (id, text) VALUES (?, ?)", (1, "a"))]),
+            # NOT NULL violation: text has no NULL-accepting default path
+            WriteRequest([
+                ("INSERT INTO tests (id, text) VALUES (?, ?)", (2, "b")),
+                ("INSERT INTO tests (id, text) VALUES (?, NULL)", (3,)),
+            ]),
+            WriteRequest([(
+                "INSERT INTO tests (id, text) VALUES (?, ?)", (4, "c"))]),
+            # changeless: no version consumed
+            WriteRequest([("UPDATE tests SET text='x' WHERE id=-1", ())]),
+            WriteRequest([(
+                "INSERT INTO tests (id, text) VALUES (?, ?)", (5, "d"))]),
+        ]
+        a._execute_write_group(reqs)
+        assert reqs[0].result["version"] == 1
+        assert reqs[1].result is None
+        assert type(reqs[1].error).__name__ == "IntegrityError"
+        assert reqs[2].result["version"] == 2
+        assert reqs[3].result["version"] is None
+        assert reqs[4].result["version"] == 3
+        # the failed batch's FIRST statement rolled back with it
+        _, rows = a.storage.read_query("SELECT id FROM tests ORDER BY id")
+        assert [r[0] for r in rows] == [1, 4, 5]
+        bv = a.bookie.for_actor(a.actor_id)
+        assert bv.last() == 3 and bv.contains_range(1, 3)
+        # persisted bookkeeping matches memory (restart = resume)
+        _, rows = a.storage.read_query(
+            "SELECT COUNT(*) FROM __corro_bookkeeping "
+            "WHERE actor_id=? AND end_version IS NULL",
+            (a.actor_id,),
+        )
+        assert rows[0][0] == 3
+    finally:
+        _close(a)
+
+
+def test_group_abort_falls_back_to_per_tx():
+    """A statement that kills the OUTER transaction (here a bare
+    ROLLBACK, which screening normally keeps out of groups) aborts the
+    group; every other batch replays through the per-tx oracle path and
+    still commits — the aborting caller alone gets the error."""
+    a = make_offline_agent()
+    try:
+        reqs = [
+            WriteRequest([(
+                "INSERT INTO tests (id, text) VALUES (?, ?)", (1, "a"))]),
+            WriteRequest([("ROLLBACK", ())]),
+            WriteRequest([(
+                "INSERT INTO tests (id, text) VALUES (?, ?)", (2, "b"))]),
+        ]
+        a._execute_write_group(reqs)
+        assert reqs[0].result["version"] == 1 and reqs[0].error is None
+        assert reqs[1].error is not None and reqs[1].result is None
+        assert reqs[2].result["version"] == 2 and reqs[2].error is None
+        _, rows = a.storage.read_query("SELECT id FROM tests ORDER BY id")
+        assert [r[0] for r in rows] == [1, 2]
+        assert a.metrics.get_counter(
+            "corro_write_group_fallbacks_total", reason="abort") == 1
+        bv = a.bookie.for_actor(a.actor_id)
+        assert bv.contains_range(1, bv.last()) and bv.last() == 2
+    finally:
+        _close(a)
+
+
+def test_tx_control_statements_take_oracle_path():
+    """Transaction-control/file-level SQL is screened out of groups —
+    it runs the per-tx oracle (counted) with unchanged results — and a
+    comment prefix cannot smuggle it past the screen."""
+    assert has_tx_control(["COMMIT"])
+    assert has_tx_control([("pragma user_version", ())])
+    assert has_tx_control(["/* x */ COMMIT"])
+    assert has_tx_control(["-- c\nROLLBACK"])
+    assert has_tx_control(["  /* a */ -- b\n  /* c */ BEGIN"])
+    assert not has_tx_control([("INSERT INTO t VALUES (1)", ())])
+    assert not has_tx_control(["/* COMMIT */ INSERT INTO t VALUES (1)"])
+    a = make_offline_agent()
+    try:
+        a.execute_transaction(["PRAGMA user_version"])
+        assert a.metrics.get_counter(
+            "corro_write_group_fallbacks_total", reason="stmt") == 1
+        # and a normal write afterwards still combines fine
+        res = a.execute_transaction([
+            ("INSERT INTO tests (id, text) VALUES (1, 'x')", ())
+        ])
+        assert res["version"] == 1
+    finally:
+        _close(a)
+
+
+def test_on_conn_hook_contract_in_groups():
+    """The cancellation hook sees the RW connection while ITS batch
+    executes under the lock, then None — same contract as the oracle."""
+    a = make_offline_agent()
+    try:
+        calls = []
+        req = WriteRequest(
+            [("INSERT INTO tests (id, text) VALUES (1, 'x')", ())],
+            on_conn=lambda c: calls.append(c),
+        )
+        a._execute_write_group([req])
+        assert req.error is None
+        assert calls[0] is a.storage.conn and calls[1] is None
+    finally:
+        _close(a)
+
+
+def test_hostile_mid_group_commit_never_double_applies():
+    """Belt-and-braces for a statement that slips past tx-control
+    screening and COMMITS the outer transaction mid-group (driven
+    directly through _execute_write_group to bypass the screen): the
+    already-durable prefix is finished in place — version assigned,
+    bookkeeping persisted, caller told success — NOT replayed (which
+    would double-apply), while later batches fall back per-tx."""
+    a = make_offline_agent()
+    try:
+        reqs = [
+            WriteRequest([(
+                "INSERT INTO tests (id, text) VALUES (?, ?)", (1, "a"))]),
+            WriteRequest(["/* smuggled */ COMMIT"]),
+            WriteRequest([(
+                "INSERT INTO tests (id, text) VALUES (?, ?)", (2, "b"))]),
+        ]
+        a._execute_write_group(reqs)
+        # batch 0 committed durably via the hostile COMMIT and was
+        # recovered, not replayed: exactly one row, version 1, success
+        assert reqs[0].error is None and reqs[0].result["version"] == 1
+        assert reqs[1].error is not None and reqs[1].result is None
+        assert reqs[2].error is None and reqs[2].result["version"] == 2
+        _, rows = a.storage.read_query(
+            "SELECT id, COUNT(*) FROM tests GROUP BY id ORDER BY id")
+        assert [tuple(r) for r in rows] == [(1, 1), (2, 1)]
+        assert a.metrics.get_counter(
+            "corro_write_group_hostile_commits_total") == 1
+        # recovered version is advertised: memory and durable
+        # bookkeeping agree, gapless
+        bv = a.bookie.for_actor(a.actor_id)
+        assert bv.last() == 2 and bv.contains_range(1, 2)
+        _, rows = a.storage.read_query(
+            "SELECT COUNT(*) FROM __corro_bookkeeping "
+            "WHERE actor_id=? AND end_version IS NULL", (a.actor_id,))
+        assert rows[0][0] == 2
+    finally:
+        _close(a)
+
+
+def test_leader_death_resolves_inflight_group():
+    """If a BaseException escapes the group executor (belt-and-braces:
+    interpreter shutdown, KeyboardInterrupt), the already-popped group's
+    members must still resolve — a stranded caller would block its
+    handler thread forever — and the combiner must elect a fresh leader
+    for the next submit."""
+    a = make_offline_agent()
+    try:
+        orig = a._execute_write_group
+
+        def boom(reqs):
+            raise KeyboardInterrupt("injected leader death")
+
+        a._execute_write_group = boom
+        with pytest.raises(KeyboardInterrupt):
+            a.execute_transaction([
+                ("INSERT INTO tests (id, text) VALUES (1, 'x')", ())
+            ])
+        a._execute_write_group = orig
+        # no stuck leadership claim: the next write combines normally
+        res = a.execute_transaction([
+            ("INSERT INTO tests (id, text) VALUES (2, 'y')", ())
+        ])
+        assert res["version"] == 1
+    finally:
+        _close(a)
+
+
+def test_no_wbcast_pool_rebirth_after_stop():
+    """A write completing concurrently with stop() must not lazily
+    recreate the broadcast worker pool after teardown — that leaked a
+    thread reading closed storage.  Post-stop dispatches drop."""
+    async def main():
+        a = await launch_test_agent()
+        a.execute_transaction([
+            ("INSERT INTO tests (id, text) VALUES (1, 'x')", ())
+        ])
+        await a.stop()
+        assert a._wbcast_pool is None
+        assert a._wbcast_executor() is None
+        # the late-dispatch path a racing writer would take: no-op
+        a._dispatch_local_broadcast([(2, 2, 0, 0)])
+        assert a._wbcast_pool is None
+
+    asyncio.run(main())
+
+
+def test_group_emits_one_broadcast_changeset_per_transaction():
+    """Subscription/broadcast parity: a combined group still fans out
+    one complete changeset per client transaction, in version order,
+    through ``on_change`` — deterministically via a direct group, then
+    under real concurrent writers."""
+    async def main():
+        a = await launch_test_agent(subs_enabled=False)
+        got = []
+        a.on_change = got.append
+        try:
+            # deterministic group of 3
+            reqs = [
+                WriteRequest([(
+                    "INSERT INTO tests (id, text) VALUES (?, ?)",
+                    (i, f"v{i}"))])
+                for i in range(3)
+            ]
+            await asyncio.get_running_loop().run_in_executor(
+                None, a._execute_write_group, reqs
+            )
+            await wait_for(lambda: len(got) >= 3, timeout=10)
+            assert [int(cv.changeset.version) for cv in got] == [1, 2, 3]
+            assert all(
+                cv.changeset.is_full and cv.changeset.is_complete()
+                for cv in got
+            )
+            # concurrent writers: one changeset per committed version
+            loop = asyncio.get_running_loop()
+
+            def writer(w: int):
+                for i in range(4):
+                    a.execute_transaction([(
+                        "INSERT INTO tests (id, text) VALUES (?, ?)",
+                        (100 + w * 10 + i, "y"),
+                    )])
+
+            await asyncio.gather(*[
+                loop.run_in_executor(None, writer, w) for w in range(4)
+            ])
+            await wait_for(lambda: len(got) >= 3 + 16, timeout=10)
+            assert sorted(
+                int(cv.changeset.version) for cv in got
+            ) == list(range(1, 20))
+        finally:
+            await a.stop()
+
+    asyncio.run(main())
