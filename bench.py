@@ -61,6 +61,14 @@ def _emit(line: dict) -> None:
     print(json.dumps(_sanitize(line)), flush=True)
 
 
+def _committed_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 # -- apply-path microbenchmark (bench.py --apply) ----------------------
 
 
@@ -87,6 +95,125 @@ def _apply_bench_changes(n: int, site: bytes, col_version: int):
             if len(changes) >= n:
                 return changes
     return changes
+
+
+_APPLY_AB_SCHEMA = """
+CREATE TABLE IF NOT EXISTS bench (
+  id INTEGER NOT NULL PRIMARY KEY,
+  a TEXT, b TEXT, c TEXT, d TEXT
+);
+"""
+
+
+def _apply_ingest_once(d: str, n_changes: int, tag: str,
+                       cfg_overrides=None) -> float:
+    """Agent-level ingest throughput (changes/s): ``n_changes`` cell
+    changes as complete single-version changesets from one remote
+    actor, fed through ``Agent._apply_batch`` in bounded batches — the
+    layer the provenance plane instruments.  The storage-level apply
+    the headline measures sits BELOW the plane and never executes it."""
+    from corrosion_tpu.agent.pack import pack_values
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.types import ActorId, ChangeSource, ChangeV1, Changeset
+    from corrosion_tpu.types.base import CrsqlDbVersion, CrsqlSeq, Version
+    from corrosion_tpu.types.change import Change
+
+    site = b"\x51" * 16
+    adir = os.path.join(d, f"ingest{tag}")
+    os.makedirs(adir, exist_ok=True)
+    agent = make_offline_agent(
+        tmpdir=adir, schema=_APPLY_AB_SCHEMA, **(cfg_overrides or {})
+    )
+    try:
+        cvs = []
+        total = 0
+        v = 0
+        while total < n_changes:
+            v += 1
+            pk = pack_values([v])
+            changes = []
+            for seq, cid in enumerate(("a", "b", "c", "d")):
+                changes.append(Change(
+                    table="bench", pk=pk, cid=cid, val=f"v-{v}-{cid}",
+                    col_version=1, db_version=CrsqlDbVersion(v),
+                    seq=CrsqlSeq(seq), site_id=site, cl=1,
+                ))
+                total += 1
+                if total >= n_changes:
+                    break
+            last = CrsqlSeq(len(changes) - 1)
+            cvs.append(ChangeV1(
+                actor_id=ActorId(site),
+                changeset=Changeset.full(
+                    Version(v), changes, (CrsqlSeq(0), last), last,
+                    agent.clock.new_timestamp(),
+                ),
+            ))
+        t0 = time.perf_counter()
+        for i in range(0, len(cvs), 64):
+            agent._apply_batch(
+                [(cv, ChangeSource.SYNC) for cv in cvs[i:i + 64]]
+            )
+        wall = time.perf_counter() - t0
+        return n_changes / max(wall, 1e-9)
+    finally:
+        agent.storage.close()
+
+
+def _apply_overhead_ab(n_changes: int, reps: int = 5,
+                       committed=None, measured=None,
+                       max_regression: float = 0.05) -> dict:
+    """Paired in-run A/B of the observability plane's ingest cost,
+    mirroring ``_write_overhead_ab``: plane off vs on in temporally-
+    adjacent pairs (arm order alternating per pair), gated on the
+    MEDIAN per-pair ratio.  The host's throughput is bimodal (a
+    virtualized box drifts between full-core and shared-core modes),
+    which defeats both best-of-N (one lucky spike in one arm skews the
+    ratio of bests) and any cross-run comparison — but the two runs of
+    an adjacent pair almost always land in the SAME mode, so per-pair
+    ratios are stable and their median rejects the rare pair that
+    straddles a mode switch."""
+    import statistics
+    import tempfile
+
+    pairs = []
+    with tempfile.TemporaryDirectory(prefix="corro-apply-ab-") as d:
+        for rep in range(reps):
+            arms = (("off", _PLANE_OFF), ("on", None))
+            if rep % 2:
+                arms = arms[::-1]
+            cps = {}
+            for arm, over in arms:
+                cps[arm] = _apply_ingest_once(
+                    d, n_changes, f"-{arm}{rep}", cfg_overrides=over
+                )
+            pairs.append({
+                "off_changes_per_s": round(cps["off"], 1),
+                "on_changes_per_s": round(cps["on"], 1),
+                "ratio": round(cps["on"] / max(cps["off"], 1e-9), 4),
+            })
+    ratio = statistics.median(p["ratio"] for p in pairs)
+    gate = {
+        "method": (
+            f"paired in-run A/B, {reps} adjacent off/on pairs of "
+            "agent-level ingest (_apply_batch, SYNC source) at the "
+            "headline change count (arm order alternating), median "
+            "per-pair ratio; plane = provenance (the only knob live "
+            "at this layer: the offline agent never start()s a stall "
+            "probe, and SYNC ingest does not encode traced uni "
+            "frames — those costs are covered by the write-path A/B)"
+        ),
+        "n_changes": n_changes,
+        "pairs": pairs,
+        "ratio": round(ratio, 4),
+        "max_regression": max_regression,
+        "pass": bool(ratio >= 1.0 - max_regression),
+    }
+    if committed is not None and measured is not None:
+        # cross-run context only (host drift dwarfs the plane's cost)
+        gate["committed"] = committed
+        gate["committed_ratio"] = round(measured / committed, 4)
+    return gate
 
 
 def run_apply_bench(sizes=(1000, 10000), out_path="APPLY_BENCH.json"):
@@ -161,6 +288,7 @@ def run_apply_bench(sizes=(1000, 10000), out_path="APPLY_BENCH.json"):
          if p["n_changes"] == max(sizes) and p["mode"] == "cold"),
         points[-1],
     )
+    committed = _committed_json(out_path) if out_path else None
     bad = [p for p in points if "error" in p]
     out = {
         "metric": "apply_batched_speedup",
@@ -182,6 +310,37 @@ def run_apply_bench(sizes=(1000, 10000), out_path="APPLY_BENCH.json"):
             f"{len(bad)} point(s) with per-change/batched "
             "rows-impacted mismatch"
         )
+    # observability overhead gate: paired in-run A/B at the ingest
+    # layer (where the plane actually runs — the storage-level numbers
+    # above never execute it); committed headline recorded as
+    # cross-run context only
+    committed_hl = None
+    if committed:
+        committed_hl = next(
+            (p["batched"]["changes_per_s"]
+             for p in committed.get("points", ())
+             if p.get("n_changes") == headline["n_changes"]
+             and p.get("mode") == "cold" and "batched" in p),
+            None,
+        )
+    if headline["n_changes"] >= 5000:
+        out["overhead_gate"] = _apply_overhead_ab(
+            headline["n_changes"],
+            committed=committed_hl,
+            measured=headline["batched"]["changes_per_s"],
+        )
+        if out["overhead_gate"]["pass"] is False:
+            out.setdefault(
+                "error",
+                "observability overhead gate failed: plane-on ingest "
+                "throughput regressed > 5% vs plane-off in paired A/B",
+            )
+    else:
+        out["overhead_gate"] = {
+            "pass": None,
+            "skipped": "smoke scale (n_changes < 5000): plane cost "
+                       "below noise floor; gated at the 10k headline",
+        }
     if out_path:
         with open(out_path, "w") as f:
             json.dump(_sanitize(out), f, indent=2)
@@ -422,7 +581,9 @@ def run_sync_bench(n_versions: int = 10_000,
 # -- write-path microbenchmark (bench.py --write) ----------------------
 
 
-def _write_bench_once(d: str, n_tx: int, writers: int, combined: bool):
+def _write_bench_once(d: str, n_tx: int, writers: int, combined: bool,
+                      cfg_overrides: dict | None = None,
+                      tag: str = ""):
     """One mode point: a live (started) agent with no peers, ``writers``
     threads splitting ``n_tx`` single-upsert transactions over disjoint
     rows, the shared event loop under a 5 ms stall probe.  Returns the
@@ -435,11 +596,12 @@ def _write_bench_once(d: str, n_tx: int, writers: int, combined: bool):
 
     key = "combined" if combined else "per_tx"
     cfg = AgentConfig(
-        db_path=os.path.join(d, f"write-{n_tx}-{writers}-{key}.db"),
+        db_path=os.path.join(d, f"write-{n_tx}-{writers}-{key}{tag}.db"),
         schema_sql=TEST_SCHEMA,
         api_port=None,
         subs_enabled=False,
         write_group_commit=combined,
+        **(cfg_overrides or {}),
     )
     per = max(1, n_tx // writers)
 
@@ -545,6 +707,69 @@ def _write_stall_idle_baseline(seconds: float) -> float:
     return _asyncio.run(run())
 
 
+# the convergence observability plane's knobs, all off — the A/B
+# baseline arm (defaults leave them all on)
+_PLANE_OFF = {
+    "provenance": False,
+    "bcast_trace_propagation": False,
+    "stall_probe_interval": 0.0,
+}
+
+
+def _write_overhead_ab(n_tx: int, writers: int,
+                       committed=None, measured=None, reps: int = 3,
+                       max_regression: float = 0.05) -> dict:
+    """Paired A/B of the observability plane's write-path cost at one
+    shape: ``reps`` temporally-adjacent (plane-off, plane-on) pairs of
+    combined-mode runs, arm order alternating per pair so warm-up and
+    disk-state effects cancel.  The gate is the MEDIAN of the per-pair
+    on/off ratios — host noise on a shared box swings single runs
+    >10%, but it drifts slowly, so a within-pair ratio is stable where
+    a cross-pair (or cross-run) comparison is not."""
+    import statistics
+    import tempfile
+
+    pairs = []
+    with tempfile.TemporaryDirectory(prefix="corro-write-ab-") as d:
+        for rep in range(reps):
+            arms = (("off", _PLANE_OFF), ("on", None))
+            if rep % 2:
+                arms = arms[::-1]
+            tx = {}
+            for arm, over in arms:
+                r, _snap = _write_bench_once(
+                    d, n_tx, writers, combined=True,
+                    cfg_overrides=over, tag=f"-ab-{arm}{rep}",
+                )
+                tx[arm] = r["tx_per_s"]
+            pairs.append({
+                "off_tx_per_s": tx["off"],
+                "on_tx_per_s": tx["on"],
+                "ratio": round(tx["on"] / max(tx["off"], 1e-9), 4),
+            })
+    ratio = statistics.median(p["ratio"] for p in pairs)
+    gate = {
+        "method": (
+            f"paired in-run A/B, {reps} adjacent off/on pairs at the "
+            "headline shape (arm order alternating), median per-pair "
+            "ratio; plane = provenance + broadcast trace propagation "
+            "+ stall probe"
+        ),
+        "n_tx": n_tx,
+        "writers": writers,
+        "pairs": pairs,
+        "ratio": round(ratio, 4),
+        "max_regression": max_regression,
+        "pass": bool(ratio >= 1.0 - max_regression),
+    }
+    if committed is not None and measured is not None:
+        # cross-run context only (host drift between sessions dwarfs
+        # the plane's cost — see method note)
+        gate["committed"] = committed
+        gate["committed_ratio"] = round(measured / committed, 4)
+    return gate
+
+
 def run_write_bench(sizes=(1000, 10000), writers=(1, 8, 32),
                     out_path="WRITE_BENCH.json") -> dict:
     """Local write-path throughput: concurrent client transactions
@@ -635,6 +860,7 @@ def run_write_bench(sizes=(1000, 10000), writers=(1, 8, 32),
          if p["n_tx"] == max(sizes) and p["writers"] == max(writers)),
         points[-1],
     )
+    committed = _committed_json(out_path) if out_path else None
     bad = [p for p in points if "error" in p]
     out = {
         "metric": "write_group_commit_speedup",
@@ -663,6 +889,52 @@ def run_write_bench(sizes=(1000, 10000), writers=(1, 8, 32),
             f"{len(bad)} point(s) with per-tx/combined converged-state "
             "mismatch"
         )
+    # observability overhead gate: PAIRED in-run A/B at the headline
+    # shape — the plane (provenance + broadcast trace propagation +
+    # stall probe) toggled off/on in temporally-adjacent pairs (arm
+    # order alternating per pair), gating on the MEDIAN per-pair ratio
+    # so low-frequency host drift cancels.  A cross-run comparison
+    # against a JSON committed hours earlier measures that drift, not
+    # the instrumentation (identical configs swing >25% on a shared
+    # box), so the committed headline ratio is recorded as context
+    # only.
+    committed_hl = None
+    if committed:
+        committed_hl = next(
+            (p["combined"]["tx_per_s"]
+             for p in committed.get("points", ())
+             if p.get("n_tx") == headline["n_tx"]
+             and p.get("writers") == headline["writers"]
+             and "combined" in p),
+            None,
+        )
+    if headline["n_tx"] >= 5000:
+        old_swi2 = sys.getswitchinterval()
+        sys.setswitchinterval(0.002)
+        try:
+            out["overhead_gate"] = _write_overhead_ab(
+                headline["n_tx"], headline["writers"],
+                committed=committed_hl,
+                measured=headline["combined"]["tx_per_s"],
+            )
+        finally:
+            sys.setswitchinterval(old_swi2)
+        if out["overhead_gate"]["pass"] is False:
+            out.setdefault(
+                "error",
+                "observability overhead gate failed: plane-on combined "
+                "throughput regressed > 5% vs plane-off in paired A/B",
+            )
+    else:
+        # sub-second arms at smoke shapes sit below the host's
+        # run-to-run noise floor — the median pair ratio gates nothing
+        # there, so the A/B runs only at the 10k headline (@slow tier
+        # and artifact generation)
+        out["overhead_gate"] = {
+            "pass": None,
+            "skipped": "smoke scale (n_tx < 5000): plane cost below "
+                       "noise floor; gated at the 10k headline",
+        }
     if out_path:
         with open(out_path, "w") as f:
             json.dump(_sanitize(out), f, indent=2)
@@ -933,6 +1205,16 @@ def main() -> None:
                          "CHAOS_N32.json, and exit")
     ap.add_argument("--chaos-nodes", type=int, default=32,
                     help="cluster size for --chaos")
+    ap.add_argument("--obs", action="store_true",
+                    help="run the observability soak (live cluster "
+                         "measuring its OWN convergence via telemetry, "
+                         "gated ±15%% against harness ground truth, "
+                         "next to the kernel prediction), write "
+                         "OBS_N32.json, and exit")
+    ap.add_argument("--obs-nodes", type=int, default=32,
+                    help="cluster size for --obs")
+    ap.add_argument("--obs-writes", type=int, default=40,
+                    help="workload size for --obs")
     ap.add_argument("--apply", action="store_true",
                     help="run the per-change vs batched CRDT apply "
                          "microbenchmark (1k/10k changes, cold+warm), "
@@ -992,6 +1274,17 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "CALIB_MSGS.json"
         )
         _emit(run_msgs_calibration(out_path=out_path))
+        return
+    if args.obs:
+        from corrosion_tpu.sim.obs import run_obs
+
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            f"OBS_N{args.obs_nodes}.json",
+        )
+        _emit(asyncio.run(run_obs(
+            n=args.obs_nodes, writes=args.obs_writes, out_path=out_path,
+        )))
         return
     if args.chaos:
         from corrosion_tpu.sim.chaos import run_chaos

@@ -139,6 +139,11 @@ def _handle(agent: "Agent", msg: dict) -> dict:
     if cmd == "trace_spans":
         from corrosion_tpu.agent import tracing
 
+        # --trace <id>: assemble one cross-node trace from this node's
+        # ring without shipping (and grepping) the whole dump
+        trace_id = msg.get("trace")
+        if trace_id is not None:
+            trace_id = str(trace_id).lower()
         return {
             "ok": [
                 {
@@ -150,9 +155,16 @@ def _handle(agent: "Agent", msg: dict) -> dict:
                     "dur_ms": s.dur_ms,
                     "attrs": {k: str(v) for k, v in s.attrs.items()},
                 }
-                for s in tracing.recent_spans(int(msg.get("limit", 100)))
+                for s in tracing.recent_spans(
+                    int(msg.get("limit", 100)), trace_id=trace_id
+                )
             ]
         }
+
+    if cmd == "health":
+        # runtime health: loop stall probe, queue depths, the agent's
+        # own convergence-lag measurement (docs/telemetry.md)
+        return {"ok": agent.health_snapshot()}
 
     if cmd == "actor_version":
         actor = bytes.fromhex(msg.get("actor", agent.actor_id.hex()))

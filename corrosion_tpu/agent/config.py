@@ -9,7 +9,11 @@ Parity: ``crates/corro-types/src/config.rs`` — sections ``[db]``,
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # py<3.11: same API under the old name
+    import tomli as tomllib
 from typing import Any, Dict, List, Optional
 
 from corrosion_tpu.agent.runtime import AgentConfig
@@ -110,10 +114,14 @@ def load_config(path: Optional[str] = None, **overrides) -> AgentConfig:
         # client-cert knob, independent of gossip mTLS
         kwargs["pg_tls_verify_client"] = bool(pg.get("verify_client"))
     # [telemetry.traces] path: append finished spans as OTLP-flavored
-    # JSON lines (the reference exports via OTLP; config.rs telemetry)
+    # JSON lines (the reference exports via OTLP; config.rs telemetry).
+    # max_bytes bounds the file (one rotation to path.1, then drops
+    # counted in corro_trace_spans_dropped_total)
     traces = data.get("telemetry", {}).get("traces")
     if isinstance(traces, dict) and traces.get("path"):
         kwargs["trace_export_path"] = traces["path"]
+        if "max_bytes" in traces:
+            kwargs["trace_export_max_bytes"] = int(traces["max_bytes"])
     # [gossip.tls] (config.rs TlsConfig: cert-file/key-file/ca-file/
     # insecure + [gossip.tls.client] cert-file/key-file/required)
     tls = gossip.get("tls", {})
@@ -150,6 +158,12 @@ def load_config(path: Optional[str] = None, **overrides) -> AgentConfig:
         "seen_cache_size",
         "write_group_commit",
         "write_group_max",
+        # convergence observability plane (docs/telemetry.md)
+        "provenance",
+        "staleness_evict_s",
+        "bcast_trace_propagation",
+        "stall_probe_interval",
+        "stall_probe_slow_ms",
     ):
         if key in perf:
             kwargs[key] = perf[key]

@@ -208,13 +208,13 @@ class DetCluster:
         the previous delivery phase) become due entries this tick."""
         for i, a in enumerate(self.agents):
             while not a._bcast_queue.empty():
-                cv, remaining, hop = a._bcast_queue.get_nowait()
+                cv, remaining, hop, tp = a._bcast_queue.get_nowait()
                 key = a._seen_key(cv)
                 if key in self._entries[i]:
                     continue
                 self._entries[i][key] = _Entry(
                     cv=cv,
-                    frame=a.encode_broadcast_frame(cv, hop),
+                    frame=a.encode_broadcast_frame(cv, hop, tp),
                     remaining=remaining,
                     next_due=self.tick_no,
                 )
@@ -268,9 +268,11 @@ class DetCluster:
         for dest, frame in deliveries:
             a = self.agents[dest]
             for payload in speedy.FrameReader().feed(frame):
-                cv = a.decode_uni_frame(payload)
-                if cv is not None:
-                    a.handle_change(cv, ChangeSource.BROADCAST)
+                decoded = a.decode_uni_frame_meta(payload)
+                if decoded is not None:
+                    cv, tp, hop = decoded
+                    a.handle_change(cv, ChangeSource.BROADCAST,
+                                    meta=(tp, hop))
         # anti-entropy phase on the kernel's cadence
         # (sim/epidemic.py: tick % sync_interval == sync_interval - 1),
         # after deliveries so sync sees this tick's learned state

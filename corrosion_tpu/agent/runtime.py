@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from corrosion_tpu.agent import tracing, wire
+from corrosion_tpu.agent.metrics import percentile_sorted
 from corrosion_tpu.agent.locks import PRIO_HIGH, PRIO_LOW
 from corrosion_tpu.agent.bookkeeping import Bookie
 from corrosion_tpu.agent.members import Member, Members, MemberState
@@ -65,6 +66,12 @@ logger = logging.getLogger("corrosion_tpu.agent")
 STREAM_UNI = b"U"
 STREAM_BI = b"B"
 STREAM_MUX = b"M"  # multiplexed uni+bi channels (agent/mux.py)
+
+# precomputed corro_change_lag_seconds label keys (provenance runs per
+# ingested changeset — kwargs packing + sort per call is measurable)
+_PROV_KEY_BROADCAST = (("path", "broadcast"),)
+_PROV_KEY_REBROADCAST = (("path", "rebroadcast"),)
+_PROV_KEY_SYNC = (("path", "sync"),)
 
 
 class _SlowPeer(Exception):
@@ -126,8 +133,35 @@ class AgentConfig:
     subs_enabled: bool = True
     subs_path: Optional[str] = None
     admin_path: Optional[str] = None
-    # append finished spans as OTLP-flavored JSON lines ([telemetry.traces])
+    # append finished spans as OTLP-flavored JSON lines ([telemetry.traces]);
+    # bounded: one rotation at max_bytes, drops counted after that
     trace_export_path: Optional[str] = None
+    trace_export_max_bytes: int = 64 * 1024 * 1024
+    # -- convergence observability plane (docs/telemetry.md) -----------
+    # change provenance: on a version's FIRST arrival, record
+    # origin-commit -> apply lag (corro_change_lag_seconds{path=
+    # broadcast|rebroadcast|sync}) and per-origin-actor staleness —
+    # the agent measures its own convergence
+    provenance: bool = True
+    # evict an origin actor's staleness entry once nothing has been
+    # applied from it for this long AND it is no longer an alive
+    # member: a departed (or identity-renewed, e.g. `cluster rejoin`)
+    # actor must not leave a permanently rising
+    # corro_change_staleness_seconds{actor_id=} series — and unbounded
+    # label cardinality — on every node that ever applied its writes,
+    # while a live-but-unconverged actor keeps alerting.  0 disables.
+    staleness_evict_s: float = 600.0
+    # carry hop + traceparent on uni broadcasts via the versioned
+    # envelope (bridge/speedy.py encode_traced_uni): the write-group /
+    # collect / remote-apply spans share one trace id, and receivers
+    # can label lag broadcast vs rebroadcast.  Old-format payloads
+    # always decode; turn this OFF for reference-byte-exact wire output
+    bcast_trace_propagation: bool = True
+    # always-on event-loop stall probe (agent/health.py, the bench
+    # stall gates made continuous): corro_loop_stall_ms histogram +
+    # max gauge + slow-callback attribution.  0 disables.
+    stall_probe_interval: float = 0.05
+    stall_probe_slow_ms: float = 50.0
     pg_port: Optional[int] = None  # PostgreSQL wire protocol (None = off)
     pg_host: Optional[str] = None  # PG bind host (None = api_host)
     # PG TLS client-cert verification is its OWN knob (corro-pg
@@ -248,6 +282,23 @@ class Agent:
         # debug_hops: seen-key -> hop depth at first receipt (harness
         # reads this to measure real dissemination depth)
         self._recv_hops: Dict[tuple, int] = {}
+        # change provenance (first-seen dedupe): (actor, version) pairs
+        # whose first-arrival lag was already recorded, FIFO-bounded
+        # like the broadcast dedup cache; plus the freshest origin
+        # wall-clock ts seen per actor (the staleness gauge's base)
+        self._prov_seen: Dict[tuple, None] = {}
+        self._prov_lock = threading.Lock()
+        self._origin_ts_wall: Dict[bytes, float] = {}
+        # LOCAL wall time of the most recent applied write per origin
+        # actor — the eviction clock (idle time), deliberately separate
+        # from the origin HLC ts above: evicting on origin-ts age would
+        # delete a rising staleness series at exactly the moment its
+        # "stopped converging" alert should fire
+        self._origin_seen_wall: Dict[bytes, float] = {}
+        # loop health probe (agent/health.py), created on start()
+        self.health = None
+        self._trace_token = None  # export ownership (set in start())
+        self._trace_dropped_seen = 0  # last synced export-drop total
         self._acks: Dict[int, asyncio.Future] = {}
         self._suspects: Dict[bytes, float] = {}
         self._bcast_queue: asyncio.Queue = asyncio.Queue()
@@ -324,8 +375,12 @@ class Agent:
     async def start(self) -> None:
         if self.config.trace_export_path:
             self._trace_token = tracing.configure_export(
-                self.config.trace_export_path
+                self.config.trace_export_path,
+                max_bytes=self.config.trace_export_max_bytes,
             )
+            # baseline against the process-lifetime drop total: drops
+            # from a PREVIOUS owner's sink are not this agent's to claim
+            self._trace_dropped_seen = tracing.export_dropped_total()
         # publish the loop and drain deferred broadcasts atomically, so a
         # concurrent writer either defers (and is flushed below) or sees
         # the live loop — never a stranded append
@@ -342,11 +397,11 @@ class Agent:
             self._wbcast_executor().submit(
                 self._broadcast_local_commits, pending
             )
-        for cv in pending_cvs:
+        for cv, tp in pending_cvs:
             self.metrics.counter(
                 "corro_channel_sends_total", channel="bcast")
             self._bcast_queue.put_nowait(
-                (cv, self.config.max_transmissions, 0)
+                (cv, self.config.max_transmissions, 0, tp)
             )
         self._sync_sem = asyncio.Semaphore(self.config.max_sync_sessions)
         self._ingest_event = asyncio.Event()
@@ -417,6 +472,15 @@ class Agent:
             asyncio.create_task(self._sync_loop()),
             asyncio.create_task(self._maintenance_loop()),
         ]
+        if self.config.stall_probe_interval > 0:
+            from corrosion_tpu.agent.health import LoopHealthProbe
+
+            self.health = LoopHealthProbe(
+                self.metrics,
+                interval=self.config.stall_probe_interval,
+                slow_ms=self.config.stall_probe_slow_ms,
+            )
+            self._tasks.append(asyncio.create_task(self.health.run()))
         if self.config.api_port is not None:
             from corrosion_tpu.agent.http import start_http_api
 
@@ -539,8 +603,13 @@ class Agent:
         # stall PRIO_HIGH client writes (the reference's metrics loop
         # reads through its read pool too)
         for t in self.storage.tables:
+            # identifier-quote the table name: a schema may legally
+            # declare adversarial names (embedded quotes) and a scrape
+            # must not turn them into SQL — exposition escaping keeps
+            # the label value scrape-safe regardless
+            q = t.replace('"', '""')
             _, rows = self.storage.read_query(
-                f'SELECT COUNT(*) FROM "{t}"'
+                f'SELECT COUNT(*) FROM "{q}"'
             )
             extra.append(
                 ("corro_table_rows", float(rows[0][0]), {"table": t})
@@ -646,7 +715,100 @@ class Agent:
                 extra.append(
                     ("corro_transport_rtt_min_ms", float(min(rtts)), {})
                 )
+        # per-origin-actor staleness (provenance plane): wall-now minus
+        # the freshest origin-commit ts applied from that actor — a
+        # rising series means we stopped converging on its writes
+        now_wall = time.time()
+        for actor, ts_wall in self._staleness_entries(now_wall):
+            extra.append((
+                "corro_change_staleness_seconds",
+                max(0.0, now_wall - ts_wall),
+                {"actor_id": actor.hex()},
+            ))
+        # bounded trace export drops: the sink is process-wide, so ONLY
+        # the agent whose token opened the CURRENTLY active sink syncs
+        # the global total into its counter — if every past owner of an
+        # in-process cluster claimed the delta, summing the family
+        # across nodes would overcount drops n_owners-fold
+        if tracing.export_token_active(self._trace_token):
+            dropped = tracing.export_dropped_total()
+            if dropped > self._trace_dropped_seen:
+                self.metrics.counter(
+                    "corro_trace_spans_dropped_total",
+                    dropped - self._trace_dropped_seen,
+                )
+                self._trace_dropped_seen = dropped
         return extra
+
+    def _staleness_entries(self, now_wall: float):
+        """Snapshot ``(actor, freshest-origin-ts)`` pairs, evicting on
+        the way out the entries of actors that are BOTH idle past
+        ``staleness_evict_s`` (no write applied from them locally — the
+        idle clock, not the origin-ts age, which a partition or a slow
+        remote clock legitimately grows) AND not an alive cluster
+        member — a departed or identity-renewed actor must not leave a
+        permanently rising staleness series (and ever-growing label
+        cardinality) behind, while a live-but-unconverged actor keeps
+        alerting.  A later write from the actor re-creates its entry on
+        first arrival."""
+        evict = self.config.staleness_evict_s
+        with self._prov_lock:
+            if evict > 0:
+                dead = []
+                for a, seen in self._origin_seen_wall.items():
+                    if now_wall - seen <= evict:
+                        continue
+                    m = self.members.get(a)
+                    if m is not None and m.state is not MemberState.DOWN:
+                        continue
+                    dead.append(a)
+                for a in dead:
+                    self._origin_seen_wall.pop(a, None)
+                    self._origin_ts_wall.pop(a, None)
+            return list(self._origin_ts_wall.items())
+
+    def health_snapshot(self) -> dict:
+        """Runtime health for the admin ``health`` command: the loop
+        stall probe's state, queue depths, apply concurrency, per-path
+        convergence lag (windowed quantiles from the agent's own
+        provenance measurement), and per-origin staleness — the
+        always-on form of the gates the benches enforce."""
+        now_wall = time.time()
+        staleness = {
+            actor.hex(): round(max(0.0, now_wall - ts), 3)
+            for actor, ts in self._staleness_entries(now_wall)
+        }
+        lag: Dict[str, dict] = {}
+        for key, samples in self.metrics.histogram_samples(
+            "corro_change_lag_seconds"
+        ).items():
+            if not samples:
+                continue
+            path = dict(key).get("path", "?")
+            s = sorted(samples)
+            count, total = self.metrics.histogram_stats(
+                "corro_change_lag_seconds", path=path
+            )
+            lag[path] = {
+                "count": count,
+                "p50_s": round(percentile_sorted(s, 0.5), 4),
+                "p99_s": round(percentile_sorted(s, 0.99), 4),
+                "max_s": round(s[-1], 4),
+                "mean_s": round(total / max(count, 1), 4),
+            }
+        return {
+            "actor": self.actor_id.hex(),
+            "loop": self.health.snapshot() if self.health else None,
+            "queues": {
+                "changes": len(self._ingest),
+                "bcast": self._bcast_queue.qsize() if self._loop else 0,
+                "write": self._write_combiner.depth(),
+            },
+            "apply_in_flight": self._apply_active,
+            "members_alive": len(self.members.alive()),
+            "convergence_lag": lag,
+            "origin_staleness_s": staleness,
+        }
 
     def _members_table(self) -> None:
         self.storage.conn.execute(
@@ -1256,15 +1418,26 @@ class Agent:
         self.metrics.histogram("corro_write_group_size", len(reqs))
         aborted: Optional[GroupAborted] = None
         entries = None
-        try:
-            with self.metrics.timed("corro_write_group_seconds"), \
-                    self.storage._lock.prio(PRIO_HIGH, "write-group",
-                                            kind="write"):
-                entries = self._run_write_group_locked(reqs, booked)
-        except GroupAborted as ga:
-            aborted = ga
-        except BaseException as e:  # lock/commit-level failure
-            aborted = GroupAborted(None, e)
+        # the group span roots the broadcast trace: its context flows
+        # through the collect worker onto the wire (traced uni
+        # envelope) so every remote's first-arrival apply span shares
+        # this trace id — one write, one cross-cluster trace
+        with tracing.span("write.group", batches=len(reqs)) as wsp:
+            self.metrics.counter("corro_trace_spans_total")
+            group_tp = wsp.traceparent
+            try:
+                with self.metrics.timed("corro_write_group_seconds"), \
+                        self.storage._lock.prio(PRIO_HIGH, "write-group",
+                                                kind="write"):
+                    entries = self._run_write_group_locked(reqs, booked)
+            except GroupAborted as ga:
+                aborted = ga
+            except BaseException as e:  # lock/commit-level failure
+                aborted = GroupAborted(None, e)
+            wsp.set(
+                committed=len(entries or ()),
+                aborted=aborted is not None,
+            )
         if aborted is not None:
             # replay every batch that didn't fail in its own savepoint
             # and didn't commit durably (a hostile mid-group COMMIT
@@ -1280,7 +1453,7 @@ class Agent:
             if aborted.recovered:
                 try:
                     self._dispatch_local_broadcast(
-                        list(aborted.recovered)
+                        list(aborted.recovered), traceparent=group_tp
                     )
                 except Exception:
                     self.metrics.counter(
@@ -1302,7 +1475,9 @@ class Agent:
         # is durable — and sweep compaction once for the whole group
         if entries:
             try:
-                self._dispatch_local_broadcast(entries)
+                self._dispatch_local_broadcast(
+                    entries, traceparent=group_tp
+                )
             except Exception:
                 self.metrics.counter("corro_local_broadcast_errors_total")
         for req in reqs:
@@ -1538,16 +1713,17 @@ class Agent:
         except Exception:
             self.metrics.counter("corro_compaction_sweep_errors_total")
 
-    def _queue_or_defer_cv(self, cv: ChangeV1) -> None:
+    def _queue_or_defer_cv(self, cv: ChangeV1,
+                           traceparent: Optional[str] = None) -> None:
         with self._bcast_gate:
             if self._loop is None:
-                self._pre_start_cvs.append(cv)
+                self._pre_start_cvs.append((cv, traceparent))
                 return
             loop = self._loop
         self.metrics.counter("corro_channel_sends_total", channel="bcast")
         loop.call_soon_threadsafe(
             self._bcast_queue.put_nowait,
-            (cv, self.config.max_transmissions, 0),
+            (cv, self.config.max_transmissions, 0, traceparent),
         )
 
     def _queue_or_defer_broadcast(
@@ -1556,7 +1732,10 @@ class Agent:
         """Queue one committed local version's broadcast, or buffer it
         until start() when the event loop isn't up yet (writes before
         start() must still gossip)."""
-        self._dispatch_local_broadcast([(version, db_version, last_seq, ts)])
+        self._dispatch_local_broadcast(
+            [(version, db_version, last_seq, ts)],
+            traceparent=tracing.current_traceparent(),
+        )
 
     def _wbcast_executor(self):
         """The single-thread local-broadcast collection worker (lazy),
@@ -1575,9 +1754,13 @@ class Agent:
                 )
             return pool
 
-    def _dispatch_local_broadcast(self, entries: List[tuple]) -> None:
+    def _dispatch_local_broadcast(self, entries: List[tuple],
+                                  traceparent: Optional[str] = None) -> None:
         """Route committed-version entries ``(version, db_version,
         last_seq, ts)`` to collection + broadcast enqueue.
+        ``traceparent`` carries the committing write's span context onto
+        the collection worker (contextvars don't cross threads), so the
+        collect span and the remote apply spans share its trace id.
 
         Collection (SQL) and chunk encoding NEVER run on the event loop
         (the pre-round-6 path scheduled them there with
@@ -1594,18 +1777,33 @@ class Agent:
         if live_loop:
             pool = self._wbcast_executor()
             if pool is not None:  # None: stop() already tore it down
-                pool.submit(self._broadcast_local_commits, entries)
+                pool.submit(
+                    self._broadcast_local_commits, entries, traceparent
+                )
         else:
-            self._broadcast_local_commits(entries)
+            self._broadcast_local_commits(entries, traceparent)
 
-    def _broadcast_local_commits(self, entries: List[tuple]) -> None:
+    def _broadcast_local_commits(self, entries: List[tuple],
+                                 traceparent: Optional[str] = None) -> None:
         """Worker body: one coalesced collection for the entries' whole
         db_version span, then per-changeset on_change + broadcast
         enqueue in version order.  A failure here must not surface as an
         unretrieved executor exception — the versions are already
         durable and anti-entropy serves them regardless."""
         try:
-            cvs = self._local_commit_changesets(entries)
+            # the collect span re-parents on the committing write's
+            # trace; its own context rides the queued broadcasts so a
+            # remote's first-arrival apply span completes the chain
+            with tracing.span(
+                "bcast.collect", remote=traceparent, entries=len(entries)
+            ) as sp:
+                self.metrics.counter("corro_trace_spans_total")
+                cvs = self._local_commit_changesets(entries)
+                sp.set(changesets=len(cvs))
+            tp_out = (
+                sp.traceparent
+                if self.config.bcast_trace_propagation else None
+            )
         except Exception:
             self.metrics.counter("corro_local_broadcast_errors_total")
             logger.debug("local broadcast collection failed", exc_info=True)
@@ -1617,7 +1815,7 @@ class Agent:
             try:
                 if self.on_change is not None:
                     self.on_change(cv)
-                self._queue_or_defer_cv(cv)
+                self._queue_or_defer_cv(cv, tp_out)
             except Exception:
                 self.metrics.counter("corro_local_broadcast_errors_total")
                 logger.debug(
@@ -1791,10 +1989,10 @@ class Agent:
             else:
                 timeout = None
             try:
-                cv, remaining, hop = await asyncio.wait_for(
+                cv, remaining, hop, tp = await asyncio.wait_for(
                     self._bcast_queue.get(), timeout=timeout
                 )
-                frame = self.encode_broadcast_frame(cv, hop)
+                frame = self.encode_broadcast_frame(cv, hop, tp)
                 buffer.append((frame, cv, remaining, set()))
                 buf_bytes += len(frame)
             except asyncio.TimeoutError:
@@ -1805,29 +2003,38 @@ class Agent:
             ):
                 await flush()
 
-    def encode_broadcast_frame(self, cv: ChangeV1, hop: int = 0) -> bytes:
+    def encode_broadcast_frame(self, cv: ChangeV1, hop: int = 0,
+                               traceparent: Optional[str] = None) -> bytes:
         """One queued broadcast → the exact on-wire frame bytes
         (speedy UniPayload + u32-BE framing; optional debug-hop prefix).
-        Shared by the live broadcast loop and the deterministic
-        scheduler (``agent/det.py``) so both emit identical bytes."""
+        With ``bcast_trace_propagation`` the payload rides the versioned
+        traced envelope (hop + traceparent ahead of the classic bytes —
+        receivers accept both formats).  Shared by the live broadcast
+        loop and the deterministic scheduler (``agent/det.py``) so both
+        emit identical bytes."""
         payload = speedy.encode_uni_payload(
             UniPayload(
                 broadcast=BroadcastV1(change=cv),
                 cluster_id=ClusterId(self.config.cluster_id),
             )
         )
+        if self.config.bcast_trace_propagation:
+            payload = speedy.encode_traced_uni(payload, traceparent, hop)
         if self.config.debug_hops:
             payload = bytes([min(hop, 255)]) + payload
         return speedy.frame(payload)
 
-    def decode_uni_frame(self, payload: bytes) -> Optional[ChangeV1]:
-        """One deframed uni-stream payload → its ChangeV1 (or None on a
-        decode error / foreign cluster).  Shared by the live uni-stream
-        server and the deterministic scheduler."""
-        hop = 0
+    def decode_uni_frame_meta(
+        self, payload: bytes
+    ) -> Optional[Tuple[ChangeV1, Optional[str], int]]:
+        """One deframed uni-stream payload → ``(ChangeV1, traceparent,
+        hop)``, or None on a decode error / foreign cluster.  Classic
+        (untraced) payloads yield ``(cv, None, 0)``."""
+        dbg_hop = 0
         if self.config.debug_hops and payload:
-            hop, payload = payload[0], payload[1:]
+            dbg_hop, payload = payload[0], payload[1:]
         try:
+            payload, tp, hop = speedy.decode_traced_uni(payload)
             up = speedy.decode_uni_payload(payload)
         except speedy.SpeedyError:
             self.metrics.counter("corro_wire_decode_errors_total")
@@ -1838,8 +2045,15 @@ class Agent:
         if self.config.debug_hops:
             key = self._seen_key(cv)
             with self._seen_lock:
-                self._recv_hops.setdefault(key, hop)
-        return cv
+                self._recv_hops.setdefault(key, dbg_hop)
+        return cv, tp, hop
+
+    def decode_uni_frame(self, payload: bytes) -> Optional[ChangeV1]:
+        """One deframed uni-stream payload → its ChangeV1 (or None on a
+        decode error / foreign cluster).  Shared by the live uni-stream
+        server and the deterministic scheduler."""
+        decoded = self.decode_uni_frame_meta(payload)
+        return decoded[0] if decoded is not None else None
 
     # ------------------------------------------------------------------
     # ingest pipeline (handle_changes parity: bounded queue, batching,
@@ -1954,14 +2168,15 @@ class Agent:
         except Exception:
             self.metrics.counter("corro_changes_apply_errors_total")
             return
-        for cv, source, news in results:
+        for cv, source, news, meta in results:
             if news and source is ChangeSource.BROADCAST:
                 self.metrics.counter("corro_broadcast_rebroadcast_total")
                 self.metrics.counter(
                     "corro_channel_sends_total", channel="bcast")
                 self._bcast_queue.put_nowait(
                     (cv, self.config.max_transmissions,
-                     self._rebroadcast_hop(cv))
+                     self._rebroadcast_hop(cv, meta),
+                     meta[0] if meta is not None else None)
                 )
 
     def _apply_batch(self, batch: List[tuple]) -> List[tuple]:
@@ -1985,28 +2200,31 @@ class Agent:
                 for item, source in batch:
                     if source is None:  # raw uni payload, decode off-loop
                         try:
-                            cv = self.decode_uni_frame(item)
+                            decoded = self.decode_uni_frame_meta(item)
                         except Exception:
-                            # decode_uni_frame catches SpeedyError, but
-                            # a hostile frame can raise others (e.g.
-                            # invalid UTF-8): one bad payload must not
-                            # abort the whole batch's valid changesets
+                            # decode catches SpeedyError, but a hostile
+                            # frame can raise others (e.g. invalid
+                            # UTF-8): one bad payload must not abort
+                            # the whole batch's valid changesets
                             self.metrics.counter(
                                 "corro_wire_decode_errors_total")
-                            cv = None
-                        if cv is not None:
-                            items.append((cv, ChangeSource.BROADCAST))
+                            decoded = None
+                        if decoded is not None:
+                            cv, tp, hop = decoded
+                            items.append(
+                                (cv, ChangeSource.BROADCAST, (tp, hop))
+                            )
                     else:
-                        items.append((item, source))
+                        items.append((item, source, None))
                 i, n = 0, len(items)
                 while i < n:
-                    cv, source = items[i]
+                    cv, source, _meta = items[i]
                     j = i + 1
                     cs = cv.changeset
                     if cs.is_full and cs.is_complete():
                         actor = cv.actor_id.bytes
                         while j < n:
-                            cv2, _s2 = items[j]
+                            cv2, _s2, _m2 = items[j]
                             cs2 = cv2.changeset
                             if (cv2.actor_id.bytes != actor
                                     or not cs2.is_full
@@ -2016,16 +2234,25 @@ class Agent:
                     if j - i > 1:
                         out.extend(self._handle_change_group(items[i:j]))
                     else:
+                        t0 = time.perf_counter()
                         try:
                             news = self.handle_change(
-                                cv, source, rebroadcast=False
+                                cv, source, rebroadcast=False, meta=_meta,
+                                record_prov=False,
                             )
                         except Exception:
                             self.metrics.counter(
                                 "corro_changes_apply_errors_total")
                             news = False
-                        out.append((cv, source, news))
+                        self._record_apply_span(
+                            cv, _meta, news,
+                            (time.perf_counter() - t0) * 1e3,
+                        )
+                        out.append((cv, source, news, _meta))
                     i = j
+                # one provenance flush for the whole batch (the
+                # per-item calls above defer with record_prov=False)
+                self._record_provenance_many(out)
         finally:
             with self._apply_gauge_lock:
                 self._apply_active -= 1
@@ -2043,7 +2270,7 @@ class Agent:
         flags: List[Optional[bool]] = [None] * len(group)
         live_idx: List[int] = []
         dropped = [False] * len(group)
-        for k, (cv, source) in enumerate(group):
+        for k, (cv, source, _meta) in enumerate(group):
             if self._pre_change(cv, source):
                 live_idx.append(k)
             else:
@@ -2051,6 +2278,7 @@ class Agent:
                 # any accounting here, so the group path must too
                 flags[k] = False
                 dropped[k] = True
+        t0 = time.perf_counter()
         if live_idx:
             live = [group[k][0] for k in live_idx]
             try:
@@ -2076,8 +2304,9 @@ class Agent:
             # one post-group sweep: compaction is idempotent maintenance,
             # so per-changeset sweeps inside one merged tx are redundant
             self._compact_best_effort()
+        group_ms = (time.perf_counter() - t0) * 1e3
         out = []
-        for k, (cv, source) in enumerate(group):
+        for k, (cv, source, meta) in enumerate(group):
             news = bool(flags[k])
             if not dropped[k]:
                 try:
@@ -2085,10 +2314,13 @@ class Agent:
                     # must not abort accounting for the rest of a group
                     # whose transaction already committed
                     self._post_change(cv, source, news, rebroadcast=False,
-                                      compact=False)
+                                      compact=False, meta=meta,
+                                      record_prov=False)
                 except Exception:
                     self.metrics.counter("corro_changes_apply_errors_total")
-            out.append((cv, source, news))
+                self._record_apply_span(cv, meta, news, group_ms,
+                                        group=len(group))
+            out.append((cv, source, news, meta))
         return out
 
     def _apply_complete_group(self, actor: bytes,
@@ -2161,25 +2393,35 @@ class Agent:
             return (cv.actor_id.bytes, "empty", cs.versions)
         return (cv.actor_id.bytes, "empty_set", cs.ranges)
 
-    def _rebroadcast_hop(self, cv: ChangeV1) -> int:
-        """Hop count for re-gossiping a received payload (debug_hops
-        instrumentation only; 0 when off)."""
-        if not self.config.debug_hops:
-            return 0
-        with self._seen_lock:
-            return self._recv_hops.get(self._seen_key(cv), 0) + 1
+    def _rebroadcast_hop(self, cv: ChangeV1, meta=None) -> int:
+        """Hop count for re-gossiping a received payload: received hop
+        + 1 from the traced envelope when the payload carried one,
+        falling back to the debug_hops receipt table (0 without
+        either)."""
+        if self.config.debug_hops:
+            with self._seen_lock:
+                return self._recv_hops.get(self._seen_key(cv), 0) + 1
+        if meta is not None:
+            return meta[1] + 1
+        return 0
 
     def handle_change(self, cv: ChangeV1, source: ChangeSource,
-                      rebroadcast: bool = True) -> bool:
+                      rebroadcast: bool = True, meta=None,
+                      record_prov: bool = True) -> bool:
         """Process one incoming changeset; returns True if it was news.
 
         ``rebroadcast=False`` when called from the change loop's worker
         thread — the loop requeues news itself on the event loop.
+        ``meta`` is the traced-envelope ``(traceparent, hop)`` receipt
+        context, when the payload carried one.  ``record_prov=False``
+        when the caller flushes the whole batch's provenance in one
+        pass (``_record_provenance_many``).
         """
         if not self._pre_change(cv, source):
             return False
         news = self._process_changeset(cv)
-        self._post_change(cv, source, news, rebroadcast)
+        self._post_change(cv, source, news, rebroadcast, meta=meta,
+                          record_prov=record_prov)
         return news
 
     def _pre_change(self, cv: ChangeV1, source: ChangeSource) -> bool:
@@ -2204,7 +2446,8 @@ class Agent:
         return True
 
     def _post_change(self, cv: ChangeV1, source: ChangeSource, news: bool,
-                     rebroadcast: bool, compact: bool = True) -> None:
+                     rebroadcast: bool, compact: bool = True,
+                     meta=None, record_prov: bool = True) -> None:
         """Accounting + rebroadcast + subscription fan-out after an
         apply (``compact=False`` when the caller sweeps once per merged
         transaction group instead of per changeset)."""
@@ -2216,6 +2459,8 @@ class Agent:
             source=source.value,
             news=str(news).lower(),
         )
+        if news and record_prov:
+            self._record_provenance(cv, source, meta)
         if (rebroadcast and news and source is ChangeSource.BROADCAST
                 and self._loop):
             self.metrics.counter("corro_broadcast_rebroadcast_total")
@@ -2223,10 +2468,89 @@ class Agent:
                 "corro_channel_sends_total", channel="bcast")
             self._bcast_queue.put_nowait(
                 (cv, self.config.max_transmissions,
-                 self._rebroadcast_hop(cv))
+                 self._rebroadcast_hop(cv, meta),
+                 meta[0] if meta is not None else None)
             )
         if news and self.on_change is not None:
             self.on_change(cv)
+
+    def _record_provenance(self, cv: ChangeV1, source: ChangeSource,
+                           meta) -> None:
+        """Change provenance: on the FIRST arrival of each (actor,
+        version), record origin-commit → apply lag per arrival path
+        (``corro_change_lag_seconds{path=broadcast|rebroadcast|sync}``)
+        and refresh the origin actor's staleness base — the node's own
+        convergence measurement, no external harness required."""
+        self._record_provenance_many(((cv, source, True, meta),))
+
+    def _record_provenance_many(self, results) -> None:
+        """Batched provenance for a whole apply batch (same semantics
+        as :meth:`_record_provenance`, same ``results`` tuples
+        ``_apply_batch`` returns): one dedupe-lock hold, one wall-clock
+        read, and one metrics-lock hold for N changesets — per-item
+        recording costs ~5% of ingest throughput, the bench overhead
+        A/B's whole budget."""
+        if not self.config.provenance:
+            return
+        now = time.time()
+        lags = []
+        with self._prov_lock:
+            seen = self._prov_seen
+            origin_ts = self._origin_ts_wall
+            for cv, source, news, meta in results:
+                if not news:
+                    continue
+                cs = cv.changeset
+                ts = cs.ts
+                if ts is None or not cs.is_full:
+                    continue
+                actor = cv.actor_id.bytes
+                key = (actor, int(cs.version))
+                if key in seen:
+                    continue
+                seen[key] = None
+                if len(seen) > self.config.seen_cache_size:
+                    seen.pop(next(iter(seen)))
+                origin = ts.wall_seconds()
+                if origin > origin_ts.get(actor, 0.0):
+                    origin_ts[actor] = origin
+                # idle clock for eviction: LOCAL receipt time, so an
+                # actively-writing actor is never evicted no matter
+                # how skewed its origin clock is
+                self._origin_seen_wall[actor] = now
+                if source is ChangeSource.SYNC:
+                    lkey = _PROV_KEY_SYNC
+                elif meta is not None and meta[1] > 0:
+                    lkey = _PROV_KEY_REBROADCAST
+                else:
+                    lkey = _PROV_KEY_BROADCAST
+                lags.append((lkey, now - origin if now > origin else 0.0))
+        if lags:
+            self.metrics.histogram_keyed_many(
+                "corro_change_lag_seconds", lags
+            )
+
+    def _record_apply_span(self, cv: ChangeV1, meta, news: bool,
+                           dur_ms: float, group: int = 0) -> None:
+        """Complete the broadcast trace on the receiving node: one
+        ``bcast.apply`` span per version FIRST ARRIVAL that carried a
+        traceparent (non-news duplicates would drown the ring — the
+        fanout delivers every payload several times per node)."""
+        if not news or meta is None or meta[0] is None:
+            return
+        cs = cv.changeset
+        attrs = {
+            "actor": cv.actor_id.bytes.hex(),
+            "hop": meta[1],
+        }
+        if cs.is_full:
+            attrs["version"] = int(cs.version)
+        if group:
+            # merged-transaction apply: the duration is the group's
+            attrs["group"] = group
+        if tracing.record("bcast.apply", remote=meta[0],
+                          duration_ms=dur_ms, **attrs) is not None:
+            self.metrics.counter("corro_trace_spans_total")
 
     def _process_changeset(self, cv: ChangeV1) -> bool:
         # hold the storage lock across the have-it-already checks AND the
@@ -2909,9 +3233,16 @@ class Agent:
         decode happens in the apply worker pool (``_apply_batch``), so a
         burst of inbound gossip never blocks the loop on
         deserialization.  Same bounded drop-oldest policy as
-        ``enqueue_change``."""
+        ``enqueue_change``.  The traced envelope, if present, is walked
+        (fixed-offset arithmetic only — no string or change decode) so
+        the prelude screen applies to both wire formats."""
         off = 1 if self.config.debug_hops else 0
-        if payload[off : off + 12] != self._UNI_PRELUDE:
+        try:
+            start = speedy.traced_uni_payload_start(payload, off)
+        except speedy.SpeedyError:
+            self.metrics.counter("corro_wire_decode_errors_total")
+            return
+        if payload[start : start + 12] != self._UNI_PRELUDE:
             self.metrics.counter("corro_wire_decode_errors_total")
             return
         self._enqueue_ingest(payload, None)

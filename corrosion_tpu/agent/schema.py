@@ -70,16 +70,36 @@ def parse_schema(sql: str) -> Schema:
         scratch.close()
 
 
+# the CRR machinery interpolates table/column names into bookkeeping
+# DDL and cached hot-path SQL as plain quoted identifiers — word
+# identifiers only, enforced HERE so a hostile schema (user input via
+# config or the schema API) is rejected cleanly at apply time instead
+# of surfacing as a SQL syntax error mid-introspection (or worse,
+# splicing into trigger bodies)
+_IDENT_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+
 def _introspect(conn: sqlite3.Connection) -> Schema:
     tables: Dict[str, TableSchema] = {}
     for name, create_sql in conn.execute(
         "SELECT name, sql FROM sqlite_master WHERE type='table' "
         "AND name NOT LIKE 'sqlite_%' AND name NOT LIKE '\\_\\_corro\\_%' ESCAPE '\\'"
     ).fetchall():
+        if not _IDENT_RE.match(name):
+            raise SchemaError(
+                f"table name {name!r} is not a plain identifier "
+                "([A-Za-z_][A-Za-z0-9_]*): quoted/special names cannot "
+                "be CRRs"
+            )
         cols = []
         for cid, cname, ctype, notnull, dflt, pk in conn.execute(
             f'PRAGMA table_info("{name}")'
         ):
+            if not _IDENT_RE.match(cname):
+                raise SchemaError(
+                    f"table {name}: column name {cname!r} is not a "
+                    "plain identifier"
+                )
             cols.append(
                 Column(
                     name=cname,

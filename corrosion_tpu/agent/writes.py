@@ -30,6 +30,7 @@ tests/test_write_combiner.py).
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import List, Optional, Sequence
 
@@ -112,7 +113,8 @@ class WriteRequest:
     """One caller's buffered transaction: statements in, result or
     error out, ``done`` set exactly once by the group leader."""
 
-    __slots__ = ("statements", "on_conn", "done", "result", "error")
+    __slots__ = ("statements", "on_conn", "done", "result", "error",
+                 "enqueued")
 
     def __init__(self, statements: Sequence, on_conn=None):
         self.statements = statements
@@ -120,6 +122,9 @@ class WriteRequest:
         self.done = threading.Event()
         self.result: Optional[dict] = None
         self.error: Optional[BaseException] = None
+        # combiner queueing delay (corro_write_group_wait_seconds):
+        # the front-door half of a change's end-to-end provenance lag
+        self.enqueued = time.perf_counter()
 
     def finish(self) -> dict:
         """Block until the leader resolves this request; raise its
@@ -175,6 +180,14 @@ class WriteCombiner:
                         self._q.popleft()
                         for _ in range(min(len(self._q), self.max_group))
                     ]
+                now = time.perf_counter()
+                # time parked awaiting a leader: the local queuing half
+                # of a change's end-to-end convergence lag — recorded
+                # for the whole group under ONE metrics-lock hold
+                self._agent.metrics.histogram_keyed_many(
+                    "corro_write_group_wait_seconds",
+                    [((), max(0.0, now - r.enqueued)) for r in group],
+                )
                 self._agent._execute_write_group(group)
                 group = []
                 with self._cv:

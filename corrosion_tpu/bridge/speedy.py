@@ -418,6 +418,108 @@ def decode_uni_payload(data: bytes) -> UniPayload:
     return UniPayload(broadcast=BroadcastV1(change=change), cluster_id=cluster_id)
 
 
+# -- traced uni envelope (versioned extension) -------------------------
+#
+# Broadcast-path trace propagation (docs/telemetry.md): a 1-byte
+# version prefix ahead of the classic UniPayload bytes, mirroring the
+# partial-buffer blob versioning — the classic payload's first byte is
+# 0x00 (the u32-LE UniPayload::V1 tag), so 0x01 unambiguously marks the
+# extended format and OLD-FORMAT PAYLOADS DECODE UNCHANGED.  Body:
+#
+#   u8 version (=1) | u8 hop | Option<String> traceparent | UniPayload
+#
+# ``hop`` counts rebroadcast generations (0 = the origin's own
+# transmission), letting receivers label provenance lag broadcast vs
+# rebroadcast; ``traceparent`` re-parents the remote apply span on the
+# origin's write-group trace.  Emission is gated by
+# ``AgentConfig.bcast_trace_propagation`` — turn it off for
+# reference-byte-exact wire output (receivers accept both regardless).
+
+TRACED_UNI_VERSION = 1
+# traceparent is 55 chars; anything longer is junk, reject before it
+# can bloat frames or the span ring
+MAX_TRACEPARENT_LEN = 64
+
+
+def encode_traced_uni(payload: bytes, traceparent: Optional[str] = None,
+                      hop: int = 0) -> bytes:
+    """Wrap classic UniPayload bytes in the traced envelope."""
+    w = Writer()
+    w.u8(TRACED_UNI_VERSION)
+    w.u8(min(max(int(hop), 0), 255))
+    w.opt(traceparent, w.s)
+    w.raw(payload)
+    return w.getvalue()
+
+
+def decode_traced_uni(data: bytes) -> Tuple[bytes, Optional[str], int]:
+    """``(classic_payload, traceparent, hop)`` from either wire format.
+
+    Classic payloads (first byte 0x00) pass through with no trace
+    context; unknown envelope versions raise SpeedyError."""
+    if not data:
+        raise SpeedyError("empty uni payload")
+    if data[0] == 0:
+        return data, None, 0
+    if data[0] != TRACED_UNI_VERSION:
+        raise SpeedyError(f"unknown traced-uni version {data[0]}")
+    r = Reader(data, pos=1)
+    hop = r.u8()
+    # strict Option tag, matching traced_uni_payload_start: the walker
+    # and the decoder must accept the SAME byte set or the live path's
+    # prelude screen and the det scheduler diverge on hostile frames
+    flag = r.u8()
+    if flag == 0:
+        tp = None
+    elif flag == 1:
+        # bound in BYTES (the u32 length prefix), exactly like
+        # traced_uni_payload_start — bounding the decoded char count
+        # instead would let a multi-byte-UTF-8 traceparent pass here
+        # while the walker rejects the same frame, and live ingest
+        # (which screens via the walker) would diverge from the det
+        # scheduler on identical bytes
+        raw = r.lp_bytes()
+        if len(raw) > MAX_TRACEPARENT_LEN:
+            raise SpeedyError("oversized traceparent")
+        try:
+            tp = raw.decode("utf-8")
+        except UnicodeDecodeError as e:
+            # keep the SpeedyError contract: a raw UnicodeDecodeError
+            # would escape callers' `except SpeedyError` handling
+            raise SpeedyError(f"invalid traceparent utf-8: {e}") from None
+    else:
+        raise SpeedyError(f"bad Option tag {flag}")
+    return data[r.pos:], tp, hop
+
+
+def traced_uni_payload_start(data: bytes, off: int = 0) -> int:
+    """Offset of the classic UniPayload bytes inside ``data`` — the
+    cheap event-loop-side check (no string decode, no change decode)
+    that lets the ingest queue's 12-byte tag prelude screen work on
+    both wire formats.  Raises SpeedyError on a malformed envelope."""
+    if off >= len(data):
+        raise SpeedyError("empty uni payload")
+    if data[off] == 0:
+        return off
+    if data[off] != TRACED_UNI_VERSION:
+        raise SpeedyError(f"unknown traced-uni version {data[off]}")
+    pos = off + 2  # version + hop
+    if pos >= len(data):
+        raise SpeedyError("truncated traced-uni envelope")
+    flag = data[pos]
+    pos += 1
+    if flag == 0:
+        return pos
+    if flag != 1:
+        raise SpeedyError(f"bad Option tag {flag}")
+    if pos + 4 > len(data):
+        raise SpeedyError("truncated traceparent length")
+    (n,) = struct.unpack_from("<I", data, pos)
+    if n > MAX_TRACEPARENT_LEN:
+        raise SpeedyError("oversized traceparent")
+    return pos + 4 + n
+
+
 def encode_bi_payload(p: BiPayload, cluster_id: ClusterId = ClusterId(0)) -> bytes:
     """BiPayload::V1 { data: BiPayloadV1::SyncStart { actor_id, trace_ctx },
     cluster_id }."""

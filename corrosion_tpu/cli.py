@@ -225,7 +225,20 @@ def build_parser() -> argparse.ArgumentParser:
     trace = sub.add_parser("trace").add_subparsers(dest="sub", required=True)
     sp = trace.add_parser("spans", help="recent finished spans")
     sp.add_argument("--limit", type=int, default=100)
-    sp.set_defaults(fn=lambda a: cmd_admin(a, "trace_spans", limit=a.limit))
+    sp.add_argument("--trace", default=None, metavar="TRACE_ID",
+                    help="only spans of this trace id (assemble one "
+                         "cross-node trace from each node's ring)")
+    sp.set_defaults(fn=lambda a: cmd_admin(
+        a, "trace_spans", limit=a.limit,
+        **({"trace": a.trace} if a.trace else {}),
+    ))
+
+    sp = sub.add_parser(
+        "health",
+        help="runtime health: loop stall probe, queue depths, the "
+             "node's own convergence-lag measurement",
+    )
+    sp.set_defaults(fn=lambda a: cmd_admin(a, "health"))
 
     actor = sub.add_parser("actor").add_subparsers(dest="sub", required=True)
     sp = actor.add_parser("version")

@@ -105,6 +105,166 @@ async def run_inprocess(
     return agents
 
 
+class ClusterObserver:
+    """Telemetry-derived cluster view: the live cluster measuring its
+    OWN convergence (docs/telemetry.md, convergence observability
+    plane).
+
+    Every per-node read goes THROUGH the Prometheus text exposition
+    (``Metrics.render`` + the strict parser) — the same bytes a real
+    scraper would see, so an exposition regression fails the observer,
+    not just a lint.  Two in-process-only extras ride alongside:
+
+    * exact cross-node convergence percentiles from the raw
+      ``corro_change_lag_seconds`` sample rings (exposition carries
+      only per-node quantiles — a p99 of p99s is not a p99);
+    * cross-node trace assembly from the (process-shared) span ring —
+      the multi-process equivalent is ``corrosion-tpu trace spans
+      --trace <id>`` against each node's admin socket.
+    """
+
+    def __init__(self, agents: Dict[str, "object"]):
+        self.agents = dict(agents)
+        self._base_msgs = 0.0
+
+    # -- scrape --------------------------------------------------------
+
+    def scrape(self) -> Dict[str, dict]:
+        """Parse every node's rendered /metrics text, strictly."""
+        from corrosion_tpu.agent.metrics import parse_prometheus_text
+
+        out = {}
+        for name, a in self.agents.items():
+            text = a.metrics.render(a.metric_gauges())
+            out[name] = parse_prometheus_text(text)
+        return out
+
+    @staticmethod
+    def _family_sum(parsed: dict, family: str) -> float:
+        fam = parsed.get(family)
+        if fam is None:
+            return 0.0
+        return sum(v for _n, _l, v in fam["samples"])
+
+    def msgs_total(self, scrape: Optional[Dict[str, dict]] = None) -> float:
+        """Cluster-wide dissemination message count (the north-star
+        msgs/node numerator), from the scraped exposition."""
+        scrape = scrape or self.scrape()
+        return sum(
+            self._family_sum(p, "corro_broadcast_sent_total")
+            + self._family_sum(p, "corro_sync_served_total")
+            for p in scrape.values()
+        )
+
+    def mark(self) -> None:
+        """Zero the msgs/node baseline at the measurement start."""
+        self._base_msgs = self.msgs_total()
+
+    def msgs_per_node(self, scrape: Optional[Dict[str, dict]] = None) -> float:
+        return (self.msgs_total(scrape) - self._base_msgs) / max(
+            1, len(self.agents)
+        )
+
+    # -- convergence ---------------------------------------------------
+
+    def convergence_lag(self) -> dict:
+        """The cluster's self-measured convergence: every node's raw
+        first-arrival lag samples pooled, exact percentiles computed
+        over the pool, per-path counts from the cumulative stats."""
+        samples = []
+        paths: Dict[str, int] = {}
+        for a in self.agents.values():
+            for key, ring in a.metrics.histogram_samples(
+                "corro_change_lag_seconds"
+            ).items():
+                samples.extend(ring)
+                path = dict(key).get("path", "?")
+                count, _total = a.metrics.histogram_stats(
+                    "corro_change_lag_seconds", path=path
+                )
+                paths[path] = paths.get(path, 0) + count
+        if not samples:
+            return {"count": 0, "paths": paths}
+        from corrosion_tpu.agent.metrics import percentile_sorted
+
+        s = sorted(samples)
+        return {
+            "count": len(s),
+            "paths": paths,
+            "p50_s": percentile_sorted(s, 0.5),
+            "p99_s": percentile_sorted(s, 0.99),
+            "max_s": s[-1],
+            "mean_s": sum(s) / len(s),
+        }
+
+    def staleness(self, scrape: Optional[Dict[str, dict]] = None
+                  ) -> Dict[str, float]:
+        """Worst per-origin staleness across the cluster, from the
+        scraped gauge."""
+        worst: Dict[str, float] = {}
+        for parsed in (scrape or self.scrape()).values():
+            fam = parsed.get("corro_change_staleness_seconds")
+            if fam is None:
+                continue
+            for _n, labels, v in fam["samples"]:
+                actor = labels.get("actor_id", "?")
+                worst[actor] = max(worst.get(actor, 0.0), v)
+        return worst
+
+    def loop_health(self, scrape: Optional[Dict[str, dict]] = None) -> dict:
+        """Max loop stall across nodes + total attributed slow
+        callbacks (the always-on stall probe, agent/health.py)."""
+        worst = 0.0
+        slow = 0.0
+        for parsed in (scrape or self.scrape()).values():
+            fam = parsed.get("corro_loop_stall_max_ms")
+            if fam is not None:
+                worst = max(
+                    (v for _n, _l, v in fam["samples"]), default=worst
+                )
+            slow += self._family_sum(
+                parsed, "corro_loop_slow_callbacks_total"
+            )
+        return {"max_stall_ms": worst, "slow_callbacks": slow}
+
+    # -- traces --------------------------------------------------------
+
+    def assemble_trace(self, trace_id: str):
+        """All spans of one trace, oldest first (in-process: the span
+        ring is process-shared; multi-process: ask each node's admin
+        socket with ``trace spans --trace``)."""
+        from corrosion_tpu.agent import tracing
+
+        spans = tracing.recent_spans(
+            tracing.RECENT_MAX, trace_id=trace_id
+        )
+        return sorted(spans, key=lambda s: s.start)
+
+    def latest_write_trace(self):
+        """Trace id of the most recent write.group span, if any — the
+        root of a broadcast-path trace."""
+        from corrosion_tpu.agent import tracing
+
+        for s in reversed(tracing.recent_spans(tracing.RECENT_MAX)):
+            if s.name == "write.group":
+                return s.trace_id
+        return None
+
+    def snapshot(self) -> dict:
+        """One observer record: the cluster's own convergence numbers
+        next to its health surface."""
+        scrape = self.scrape()
+        return {
+            "n_nodes": len(self.agents),
+            "convergence_lag": self.convergence_lag(),
+            "msgs_per_node": self.msgs_per_node(scrape),
+            "loop_health": self.loop_health(scrape),
+            "staleness_worst_s": (
+                max(self.staleness(scrape).values(), default=0.0)
+            ),
+        }
+
+
 async def run_crash_schedule(faults: "object") -> None:
     """Execute the controller's crash/restart schedule against the
     cluster booted by :func:`run_inprocess` (pass the same controller).

@@ -47,6 +47,12 @@ class Timestamp(int):
     def logical(self) -> int:
         return int(self) & _LOGICAL_MASK
 
+    def wall_seconds(self) -> float:
+        """Physical half as Unix seconds (≈65 µs granularity) — the
+        provenance time base: origin-commit→apply lag is wall-now minus
+        the changeset timestamp's wall seconds."""
+        return self.physical_ns / 1e9
+
     def __repr__(self) -> str:  # pragma: no cover - cosmetic
         return f"Timestamp(phys_ns={self.physical_ns}, logical={self.logical})"
 

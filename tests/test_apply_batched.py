@@ -358,7 +358,7 @@ def test_apply_batch_merges_consecutive_changesets(tmp_path):
             commits_before = agent.storage.conn.execute(
                 "PRAGMA data_version").fetchone()[0]
             out = agent._apply_batch(batch)
-            assert [news for _cv, _s, news in out] == [
+            assert [news for _cv, _s, news, _meta in out] == [
                 True, True, True, False,
             ]
             booked = agent.bookie.for_actor(actor)
@@ -479,7 +479,7 @@ def test_merged_group_failure_falls_back_per_changeset(tmp_path):
             assert agent.metrics.get_counter(
                 "corro_changes_apply_errors_total") == 0
             # fallback re-applied both in their own transactions
-            assert [news for _cv, _s, news in out] == [True, True]
+            assert [news for _cv, _s, news, _meta in out] == [True, True]
             rows = agent.storage.conn.execute(
                 "SELECT id, a FROM items WHERE id >= 41 ORDER BY id"
             ).fetchall()

@@ -246,3 +246,118 @@ def test_decode_rejects_trailing_garbage():
     data = speedy.encode_sync_message(Timestamp(5)) + b"\x00"
     with pytest.raises(speedy.SpeedyError):
         speedy.decode_sync_message(data)
+
+
+# ---------------------------------------------------------------------------
+# traced uni envelope (broadcast-path trace propagation)
+# ---------------------------------------------------------------------------
+
+
+def _classic_uni_bytes():
+    cs = Changeset.full(
+        Version(1), [mk_change()], (CrsqlSeq(0), CrsqlSeq(0)),
+        CrsqlSeq(0), Timestamp(1),
+    )
+    return speedy.encode_uni_payload(
+        UniPayload(
+            broadcast=BroadcastV1(
+                change=ChangeV1(actor_id=A1, changeset=cs)
+            )
+        )
+    )
+
+
+TP = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+
+
+def test_traced_uni_roundtrip():
+    classic = _classic_uni_bytes()
+    wrapped = speedy.encode_traced_uni(classic, TP, hop=3)
+    payload, tp, hop = speedy.decode_traced_uni(wrapped)
+    assert payload == classic and tp == TP and hop == 3
+    # no traceparent variant
+    payload, tp, hop = speedy.decode_traced_uni(
+        speedy.encode_traced_uni(classic, None, hop=0)
+    )
+    assert payload == classic and tp is None and hop == 0
+
+
+def test_traced_uni_golden_bytes():
+    """Pin the envelope layout independently of the codec: u8 version,
+    u8 hop, speedy Option<String> traceparent, then the classic bytes."""
+    classic = _classic_uni_bytes()
+    wrapped = speedy.encode_traced_uni(classic, TP, hop=2)
+    expect = (
+        b"\x01"                       # envelope version
+        + b"\x02"                     # hop
+        + b"\x01"                     # Option tag: Some
+        + struct.pack("<I", len(TP)) + TP.encode()
+        + classic
+    )
+    assert wrapped == expect
+    none_wrapped = speedy.encode_traced_uni(classic, None, hop=0)
+    assert none_wrapped == b"\x01\x00\x00" + classic
+
+
+def test_traced_uni_old_format_decodes_unchanged():
+    """Backward compat (the migration contract): classic UniPayload
+    bytes — first byte 0x00, the u32-LE V1 tag — pass through both the
+    decoder and the offset walker untouched."""
+    classic = _classic_uni_bytes()
+    assert classic[0] == 0
+    payload, tp, hop = speedy.decode_traced_uni(classic)
+    assert payload == classic and tp is None and hop == 0
+    assert speedy.traced_uni_payload_start(classic) == 0
+    # and the decoded change is byte-for-byte the classic decode
+    up = speedy.decode_uni_payload(payload)
+    assert up.broadcast.change.actor_id == A1
+
+
+def test_traced_uni_payload_start_matches_decoder():
+    classic = _classic_uni_bytes()
+    for tp, hop in ((TP, 1), (None, 0)):
+        wrapped = speedy.encode_traced_uni(classic, tp, hop)
+        start = speedy.traced_uni_payload_start(wrapped)
+        assert wrapped[start:] == classic
+
+
+def test_traced_uni_hostile_inputs():
+    classic = _classic_uni_bytes()
+    with pytest.raises(speedy.SpeedyError):
+        speedy.decode_traced_uni(b"")
+    with pytest.raises(speedy.SpeedyError):
+        speedy.decode_traced_uni(b"\x07" + classic)  # unknown version
+    with pytest.raises(speedy.SpeedyError):
+        speedy.traced_uni_payload_start(b"\x07" + classic)
+    with pytest.raises(speedy.SpeedyError):
+        speedy.traced_uni_payload_start(b"\x01\x00")  # truncated option
+    with pytest.raises(speedy.SpeedyError):
+        speedy.traced_uni_payload_start(b"\x01\x00\x02")  # bad Option tag
+    # oversized traceparent: rejected by BOTH the walker and the decoder
+    big = b"\x01\x00\x01" + struct.pack("<I", 4096) + b"x" * 4096 + classic
+    with pytest.raises(speedy.SpeedyError):
+        speedy.traced_uni_payload_start(big)
+    with pytest.raises(speedy.SpeedyError):
+        speedy.decode_traced_uni(big)
+    # the bound is in BYTES on both sides: a traceparent of 33
+    # two-byte UTF-8 chars (66 bytes > MAX, 33 chars < MAX) must be
+    # rejected by BOTH — a char-count bound in the decoder would let
+    # it pass while the walker (live ingest's prelude screen) drops
+    # the frame, so live and det would diverge on identical bytes
+    multi = "é" * 33
+    assert len(multi) <= speedy.MAX_TRACEPARENT_LEN
+    assert len(multi.encode("utf-8")) > speedy.MAX_TRACEPARENT_LEN
+    sneaky = speedy.encode_traced_uni(classic, multi)
+    with pytest.raises(speedy.SpeedyError):
+        speedy.traced_uni_payload_start(sneaky)
+    with pytest.raises(speedy.SpeedyError):
+        speedy.decode_traced_uni(sneaky)
+    # invalid UTF-8 traceparent bytes: the walker passes them (it never
+    # decodes), so the decoder MUST raise SpeedyError — a raw
+    # UnicodeDecodeError would escape callers' `except SpeedyError`
+    # count-and-drop handling and crash the frame's consumer
+    bad_utf8 = (b"\x01\x00\x01" + struct.pack("<I", 2) + b"\xff\xfe"
+                + classic)
+    assert speedy.traced_uni_payload_start(bad_utf8) == 9
+    with pytest.raises(speedy.SpeedyError):
+        speedy.decode_traced_uni(bad_utf8)

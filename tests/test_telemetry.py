@@ -1,0 +1,291 @@
+"""Metrics exposition contract: cumulative histogram aggregates, strict
+Prometheus text parsing under hostile input, and the doc-drift lint
+keeping docs/telemetry.md and the emitted `corro_*` series in lockstep.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+
+import pytest
+
+from corrosion_tpu.agent.metrics import (
+    ExpositionError,
+    Metrics,
+    parse_prometheus_text,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _series(text: str, family: str):
+    return parse_prometheus_text(text)[family]["samples"]
+
+
+# -- cumulative histogram aggregates -----------------------------------
+
+
+def test_histogram_count_monotone_past_trim_boundary():
+    """Regression: `_count`/`_sum` were computed over the trimmed
+    1024-sample quantile ring, silently resetting after 1024
+    observations.  They must be cumulative — a Prometheus summary's
+    `_count` is monotone by contract."""
+    m = Metrics()
+    n = 1500  # past the 1024-sample ring trim
+    for i in range(n):
+        m.histogram("corro_test_seconds", float(i))
+    samples = _series(m.render(), "corro_test_seconds")
+    by_name = {name: v for name, _l, v in samples}
+    assert by_name["corro_test_seconds_count"] == float(n)
+    assert by_name["corro_test_seconds_sum"] == float(sum(range(n)))
+    # the quantile ring stays windowed (the trim is the point of it);
+    # block trimming keeps it between 1024 and 1279 samples
+    assert 1024 <= len(m.histogram_samples("corro_test_seconds")[()]) < 1280
+    # and the cumulative stats surface matches the exposition
+    assert m.histogram_stats("corro_test_seconds") == (n, float(sum(range(n))))
+
+
+def test_histogram_count_monotone_across_renders():
+    m = Metrics()
+    m.histogram("corro_test_seconds", 1.0)
+    first = {n: v for n, _l, v in _series(m.render(), "corro_test_seconds")}
+    m.histogram("corro_test_seconds", 2.0)
+    second = {n: v for n, _l, v in _series(m.render(), "corro_test_seconds")}
+    assert second["corro_test_seconds_count"] > first["corro_test_seconds_count"]
+    assert second["corro_test_seconds_sum"] > first["corro_test_seconds_sum"]
+
+
+# -- strict parsing + hostile exposition -------------------------------
+
+
+def test_hostile_label_values_roundtrip_through_strict_parser():
+    """Adversarial label values — quotes, backslashes, newlines — must
+    render escaped and parse back to the original strings."""
+    hostile = 'we"ird\\ta\nble'
+    m = Metrics()
+    m.counter("corro_test_total", table=hostile)
+    m.gauge("corro_test_gauge", 7.0, who='a"b', other="c\\d")
+    m.histogram("corro_test_seconds", 0.5, kind="x\ny")
+    text = m.render(
+        extra_gauges=[("corro_table_rows", 3.0, {"table": hostile})]
+    )
+    fams = parse_prometheus_text(text)
+    assert fams["corro_test_total"]["samples"][0][1] == {"table": hostile}
+    assert fams["corro_table_rows"]["samples"][0][1] == {"table": hostile}
+    glabels = fams["corro_test_gauge"]["samples"][0][1]
+    assert glabels == {"who": 'a"b', "other": "c\\d"}
+    hsamples = fams["corro_test_seconds"]["samples"]
+    assert all(l["kind"] == "x\ny" for _n, l, _v in hsamples)
+
+
+def test_extra_gauge_merges_into_registered_family():
+    """A scrape-time extra gauge sharing a name with a registered gauge
+    renders under ONE `# TYPE` line (strict parsers reject a repeated
+    TYPE) and the scrape-time value wins."""
+    m = Metrics()
+    m.gauge("corro_members_ring0", 1.0)
+    text = m.render(extra_gauges=[("corro_members_ring0", 4.0, {})])
+    assert text.count("# TYPE corro_members_ring0 gauge") == 1
+    fams = parse_prometheus_text(text)  # raises on a repeated TYPE
+    assert fams["corro_members_ring0"]["samples"] == [
+        ("corro_members_ring0", {}, 4.0)
+    ]
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "# TYPE corro_x gauge\n# TYPE corro_x gauge\ncorro_x 1\n",
+        "corro_orphan 1\n",  # sample without a TYPE declaration
+        "# TYPE corro_x gauge\ncorro_x{l=\"a\nb\"} 1\n",  # raw newline
+        "# TYPE corro_x gauge\ncorro_x{l=\"a\\qb\"} 1\n",  # bad escape
+        "# TYPE corro_x gauge\ncorro_x{l=\"ab} 1\n",  # unterminated
+        "# TYPE corro_x gauge\ncorro_x nope\n",  # junk value
+        "# TYPE corro_x wat\ncorro_x 1\n",  # unknown type
+        "# TYPE 9bad gauge\n",  # bad family name
+    ],
+)
+def test_parser_rejects_malformed_exposition(bad):
+    with pytest.raises(ExpositionError):
+        parse_prometheus_text(bad)
+
+
+def test_adversarial_table_names_rejected_cleanly():
+    """The CRR machinery interpolates table/column names into
+    bookkeeping DDL and cached hot-path SQL — a hostile schema (user
+    input) with a quoted table or column name must be rejected as a
+    clean SchemaError at apply time, not surface as a SQL syntax error
+    mid-introspection (the pre-plane behavior)."""
+    from corrosion_tpu.agent.schema import SchemaError, parse_schema
+
+    for evil in (
+        'CREATE TABLE "ev""il" (id INTEGER NOT NULL PRIMARY KEY);',
+        'CREATE TABLE "sp ace" (id INTEGER NOT NULL PRIMARY KEY);',
+        'CREATE TABLE t (id INTEGER NOT NULL PRIMARY KEY, "c""ol" TEXT'
+        " NOT NULL DEFAULT '');",
+    ):
+        with pytest.raises(SchemaError):
+            parse_schema(evil)
+
+
+def test_agent_scrape_parses_under_strict_parser(tmp_path):
+    """A live offline agent's full /metrics render — registry series
+    plus every scrape-time extra gauge (table rows, queue depths,
+    staleness, transport aggregates) — passes the strict parser."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+
+    a = make_offline_agent(tmpdir=str(tmp_path))
+    try:
+        a.execute_transaction(
+            [("INSERT INTO tests (id, text) VALUES (1, 'x')", ())]
+        )
+        fams = parse_prometheus_text(a.metrics.render(a.metric_gauges()))
+        rows = {
+            labels["table"]: v
+            for _n, labels, v in fams["corro_table_rows"]["samples"]
+        }
+        assert rows["tests"] == 1.0
+    finally:
+        a.storage.close()
+
+
+def test_unknown_swim_kind_clamps_and_parses(tmp_path):
+    """A hostile SWIM datagram kind must not mint an unbounded (or
+    unparseable) label series: unknown kinds clamp to `other`."""
+    import json
+
+    from corrosion_tpu.agent.runtime import _UdpProtocol
+    from corrosion_tpu.agent.testing import make_offline_agent
+
+    a = make_offline_agent(tmpdir=str(tmp_path))
+    try:
+        proto = _UdpProtocol(a)
+        evil = 'evil"kind\nwith\\junk'
+        proto.datagram_received(
+            json.dumps({"c": 0, "k": evil, "pb": []}).encode(),
+            ("127.0.0.1", 1),
+        )
+        fams = parse_prometheus_text(a.metrics.render())
+        kinds = {
+            labels["kind"]
+            for _n, labels, _v in fams[
+                "corro_gossip_datagrams_received_total"
+            ]["samples"]
+        }
+        assert "other" in kinds
+        assert evil not in kinds
+    finally:
+        a.storage.close()
+
+
+# -- doc-drift lint (tier-1) -------------------------------------------
+
+# corro_*-named identifiers that are NOT metric series (SQL UDFs, a
+# contextvar, an attribute name) — keep in sync with their call sites
+NON_METRIC_NAMES = {
+    "corro_pack",  # storage.py SQL UDF
+    "corro_json_contains",  # storage.py SQL UDF
+    "corro_current_span",  # tracing.py contextvar name
+    "corro_conns",  # runtime.py pg server attribute
+}
+
+
+def _emitted_series() -> set:
+    """Every `corro_*` series named in corrosion_tpu/ source: string
+    literals, plus the one dynamic transport-gauge f-string expanded
+    from its literal iteration tuple."""
+    names = set()
+    for p in sorted((REPO / "corrosion_tpu").rglob("*.py")):
+        src = p.read_text()
+        tree = ast.parse(src)
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and re.fullmatch(r"corro_[a-z0-9_]*[a-z0-9]", node.value)
+            ):
+                names.add(node.value)
+            if isinstance(node, ast.JoinedStr):
+                first = node.values[0]
+                if not (
+                    isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)
+                    and first.value.startswith("corro_")
+                ):
+                    continue
+                m = re.search(
+                    r"for field in \(([^)]*)\):?\s*\n[^\n]*\n\s*f\""
+                    + re.escape(first.value),
+                    src,
+                )
+                assert m, (
+                    f"dynamic corro_* f-string in {p} the doc-drift "
+                    "lint cannot expand — iterate a literal tuple "
+                    "directly above it, or make the names literals"
+                )
+                for field in re.findall(r'"([a-z0-9_]+)"', m.group(1)):
+                    names.add(first.value + field)
+    return names - NON_METRIC_NAMES
+
+
+def _documented_series() -> set:
+    """Every `corro_*` series named in docs/telemetry.md backticks.
+    `{a,b}` inside a name is alternation (expanded); `{k=v}` is a label
+    set (stripped).  Fenced code blocks are skipped — their backticks
+    would break inline pairing."""
+    documented = set()
+    fenced = False
+    for line in (REPO / "docs" / "telemetry.md").read_text().splitlines():
+        if line.lstrip().startswith("```"):
+            fenced = not fenced
+            continue
+        if fenced:
+            continue
+        for tok in re.findall(r"`([^`]+)`", line):
+            for m in re.finditer(
+                r"corro_[a-zA-Z0-9_]*(?:\{[^}]*\}[a-zA-Z0-9_]*)*", tok
+            ):
+                name = m.group(0)
+                variants = [""]
+                pos = 0
+                for bm in re.finditer(r"\{([^}]*)\}", name):
+                    head = name[pos : bm.start()]
+                    body = bm.group(1)
+                    pos = bm.end()
+                    if "=" in body:  # label braces: name ends here
+                        variants = [v + head for v in variants]
+                        pos = len(name)
+                        break
+                    variants = [
+                        v + head + alt
+                        for v in variants
+                        for alt in body.split(",")
+                    ]
+                tail = name[pos:]
+                for v in variants:
+                    full = v + tail
+                    if re.fullmatch(r"corro_[a-z0-9_]*[a-z0-9]", full):
+                        documented.add(full)
+    return documented
+
+
+def test_docs_and_emitted_series_in_lockstep():
+    """Doc-drift lint: every `corro_*` series emitted in corrosion_tpu/
+    must be named in docs/telemetry.md, and vice-versa — the build
+    fails when metrics and docs diverge."""
+    emitted = _emitted_series()
+    documented = _documented_series()
+    # sanity: both extractors actually found the registry
+    assert len(emitted) > 50 and len(documented) > 50
+    undocumented = sorted(emitted - documented)
+    assert not undocumented, (
+        "emitted but not in docs/telemetry.md: "
+        f"{undocumented} — add rows (or extend NON_METRIC_NAMES if "
+        "these are not metric series)"
+    )
+    phantom = sorted(documented - emitted)
+    assert not phantom, (
+        f"documented in docs/telemetry.md but emitted nowhere: {phantom}"
+    )

@@ -153,3 +153,104 @@ def test_span_file_export_shares_trace_across_nodes(run, tmp_path):
             assert tracing._sink is None
 
     run(main())
+
+
+def test_recent_spans_trace_filter():
+    """`recent_spans(trace_id=...)` filters BEFORE the limit applies, so
+    one cross-node trace assembles without grepping the full dump."""
+    with tracing.span("filter.root") as root:
+        with tracing.span("filter.child"):
+            pass
+    for _ in range(5):  # unrelated traffic after ours
+        with tracing.span("filter.noise"):
+            pass
+    got = tracing.recent_spans(2, trace_id=root.trace_id)
+    assert [s.name for s in got] == ["filter.child", "filter.root"]
+    assert all(s.trace_id == root.trace_id for s in got)
+    assert tracing.recent_spans(0, trace_id=root.trace_id) == []
+
+
+def test_record_reparents_and_rejects_junk():
+    """`tracing.record` mints post-hoc spans: re-parented on a remote
+    traceparent, on the current span, or as a trace root — and junk
+    traceparents must NOT mint orphan traces."""
+    with tracing.span("record.origin") as origin:
+        tp = origin.traceparent
+    s = tracing.record("record.apply", remote=tp, duration_ms=12.5, hop=1)
+    assert s is not None
+    assert s.trace_id == origin.trace_id
+    assert s.parent_id == origin.span_id
+    assert s.dur_ms == 12.5 and s.attrs["hop"] == 1
+    assert s in tracing.recent_spans(10, trace_id=origin.trace_id)
+    # junk off the wire: no span, no orphan trace
+    assert tracing.record("record.bad", remote="garbage") is None
+    # no remote: parents on the task-current span
+    with tracing.span("record.outer") as outer:
+        inner = tracing.record("record.inner")
+    assert inner.trace_id == outer.trace_id
+    assert inner.parent_id == outer.span_id
+
+
+def test_bounded_export_rotates_once_then_drops(tmp_path):
+    """[telemetry.traces] max_bytes: the spans file rotates ONCE to
+    `path.1`, then further spans drop into the counted total — an
+    append-forever export must not eat the disk."""
+    out = tmp_path / "spans.jsonl"
+    base_dropped = tracing.export_dropped_total()
+    token = tracing.configure_export(str(out), max_bytes=1200)
+    try:
+        for i in range(60):
+            with tracing.span("export.fill", i=i):
+                pass
+        assert (tmp_path / "spans.jsonl.1").exists()
+        # the ACTIVE file stays bounded
+        assert out.stat().st_size <= 1200
+        # the rotated file holds the earlier spans
+        assert (tmp_path / "spans.jsonl.1").stat().st_size <= 1200
+        dropped = tracing.export_dropped_total() - base_dropped
+        assert dropped > 0  # second fill has nowhere to rotate to
+        # on-disk footprint never exceeds two files
+        assert sorted(p.name for p in tmp_path.iterdir()) == [
+            "spans.jsonl", "spans.jsonl.1"
+        ]
+    finally:
+        tracing.disable_export_if(token)
+    assert tracing._sink is None
+
+
+def test_export_token_active_tracks_ownership(tmp_path):
+    """A superseded export owner must stop claiming the process-wide
+    drop total: only the token that opened the CURRENTLY active sink
+    is active (the agent's drop-counter sync guards on this — without
+    it, every past owner in an in-process cluster syncs the same delta
+    and the summed family overcounts n-owners-fold)."""
+    out1 = tmp_path / "a.jsonl"
+    out2 = tmp_path / "b.jsonl"
+    t1 = tracing.configure_export(str(out1))
+    try:
+        assert tracing.export_token_active(t1)
+        assert not tracing.export_token_active(None)
+        t2 = tracing.configure_export(str(out2))
+        try:
+            # reconfiguring supersedes the first owner
+            assert not tracing.export_token_active(t1)
+            assert tracing.export_token_active(t2)
+        finally:
+            tracing.disable_export_if(t2)
+        assert not tracing.export_token_active(t2)
+    finally:
+        tracing.disable_export_if(t1)
+
+
+def test_export_unbounded_when_max_bytes_zero(tmp_path):
+    out = tmp_path / "spans.jsonl"
+    base_dropped = tracing.export_dropped_total()
+    token = tracing.configure_export(str(out), max_bytes=0)
+    try:
+        for i in range(40):
+            with tracing.span("export.unbounded", i=i):
+                pass
+        assert not (tmp_path / "spans.jsonl.1").exists()
+        assert tracing.export_dropped_total() == base_dropped
+    finally:
+        tracing.disable_export_if(token)
