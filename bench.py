@@ -1205,6 +1205,18 @@ def main() -> None:
                          "CHAOS_N32.json, and exit")
     ap.add_argument("--chaos-nodes", type=int, default=32,
                     help="cluster size for --chaos")
+    ap.add_argument("--scenarios", action="store_true",
+                    help="run the adversarial scenario matrix (clock "
+                         "skew / one-way partition / slow IO + loop "
+                         "stall / equivocating peer / compound) on a "
+                         "live cluster next to the kernel prediction, "
+                         "gated on convergence + no-divergence, write "
+                         "SCENARIOS_N32.json, and exit")
+    ap.add_argument("--scenario-nodes", type=int, default=32,
+                    help="cluster size for --scenarios")
+    ap.add_argument("--scenario-families", default=None,
+                    help="comma-separated subset of scenario families "
+                         "(default: all)")
     ap.add_argument("--obs", action="store_true",
                     help="run the observability soak (live cluster "
                          "measuring its OWN convergence via telemetry, "
@@ -1296,6 +1308,23 @@ def main() -> None:
         _emit(asyncio.run(
             run_chaos(n=args.chaos_nodes, out_path=out_path)
         ))
+        return
+    if args.scenarios:
+        from corrosion_tpu.sim.scenarios import run_scenarios
+
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            f"SCENARIOS_N{args.scenario_nodes}.json",
+        )
+        families = (
+            [f.strip() for f in args.scenario_families.split(",")
+             if f.strip()]
+            if args.scenario_families else None
+        )
+        _emit(asyncio.run(run_scenarios(
+            n=args.scenario_nodes, families=families,
+            out_path=out_path,
+        )))
         return
     from corrosion_tpu.sim import EpidemicConfig
 

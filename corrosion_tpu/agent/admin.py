@@ -98,6 +98,10 @@ def _handle(agent: "Agent", msg: dict) -> dict:
                 "rtt_ms": m.rtt_ms,
                 "ring0": m.is_ring0,
                 "quarantined": m.quarantined,
+                # evidence class behind the quarantine: "breaker"
+                # (transport failures) or "equivocation" (hostile
+                # changesets — never cleared by transport success)
+                "quarantine_reason": m.quarantine_reason,
                 "breaker": breakers.get(addr, "closed"),
                 "transport": st.as_dict() if st is not None else None,
             })

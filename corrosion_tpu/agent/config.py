@@ -164,6 +164,8 @@ def load_config(path: Optional[str] = None, **overrides) -> AgentConfig:
         "bcast_trace_propagation",
         "stall_probe_interval",
         "stall_probe_slow_ms",
+        # equivocation defense (docs/faults.md)
+        "equivocation_detection",
     ):
         if key in perf:
             kwargs[key] = perf[key]

@@ -36,10 +36,15 @@ class Member:
     rtts: deque = field(default_factory=lambda: deque(maxlen=RTT_SAMPLES))
     last_sync_ts: float = 0.0
     last_seen: float = field(default_factory=time.monotonic)
-    # circuit-breaker quarantine (transport-level evidence): a peer
-    # whose sends persistently fail is deprioritized in fanout sampling
-    # the way high-RTT peers are, and restored on a half-open success
+    # quarantine: a peer is deprioritized in fanout sampling the way
+    # high-RTT peers are.  `quarantine_reason` records the evidence
+    # class — "breaker" (transport-level: persistent send failures,
+    # restored on half-open success) or "equivocation" (protocol-level:
+    # conflicting changesets for one (actor, version); never restored
+    # by transport success — cleared only by the runtime's bounded
+    # verdict expiry or an identity renewal)
     quarantined: bool = False
+    quarantine_reason: str = ""
 
     @property
     def rtt_ms(self) -> Optional[float]:
@@ -88,13 +93,16 @@ class Members:
                 return True
             if (incarnation, rank[state]) <= (m.incarnation, rank[m.state]):
                 return False
-            if tuple(addr) != tuple(m.addr):
+            if tuple(addr) != tuple(m.addr) \
+                    and m.quarantine_reason != "equivocation":
                 # the peer moved (e.g. restarted on a fresh ephemeral
                 # port): transport-level quarantine was evidence about
                 # the OLD address, and the old breaker can never
                 # half-open-succeed to clear it — start the new address
-                # with a clean slate
+                # with a clean slate.  Equivocation evidence is about
+                # the ACTOR, not the address: it survives a move
                 m.quarantined = False
+                m.quarantine_reason = ""
             m.state = state
             m.incarnation = incarnation
             m.addr = tuple(addr)
@@ -135,22 +143,38 @@ class Members:
             if m:
                 m.last_sync_ts = ts
 
-    def set_quarantined(self, actor_id: bytes, flag: bool) -> None:
-        """Transport breaker verdict: ``True`` when the peer's breaker
-        opened (deprioritize it), ``False`` on half-open success
-        (restore it to full sampling eligibility)."""
+    def set_quarantined(self, actor_id: bytes, flag: bool,
+                        reason: str = "breaker") -> None:
+        """Quarantine verdict for one evidence class: ``True`` opens
+        (deprioritize the peer and record the reason), ``False``
+        restores — but only when the SAME evidence class quarantined
+        it: a transport half-open success must not clear an
+        equivocation verdict."""
         with self._lock:
             m = self._members.get(actor_id)
             if m:
-                m.quarantined = flag
+                self._apply_quarantine(m, flag, reason)
 
-    def quarantine_by_addr(self, addr, flag: bool) -> bool:
+    @staticmethod
+    def _apply_quarantine(m: Member, flag: bool, reason: str) -> None:
+        if flag:
+            # equivocation outranks breaker evidence: a hostile actor
+            # whose transport also flaps must stay marked hostile
+            if m.quarantine_reason != "equivocation":
+                m.quarantine_reason = reason
+            m.quarantined = True
+        elif m.quarantined and m.quarantine_reason == reason:
+            m.quarantined = False
+            m.quarantine_reason = ""
+
+    def quarantine_by_addr(self, addr, flag: bool,
+                           reason: str = "breaker") -> bool:
         """Same, keyed by gossip address (what the transport knows)."""
         addr = tuple(addr)
         with self._lock:
             for m in self._members.values():
                 if tuple(m.addr) == addr:
-                    m.quarantined = flag
+                    self._apply_quarantine(m, flag, reason)
                     return True
         return False
 

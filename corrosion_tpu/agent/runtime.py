@@ -26,6 +26,7 @@ from __future__ import annotations
 import logging
 
 import asyncio
+import hashlib
 import os
 import random
 import threading
@@ -72,6 +73,30 @@ STREAM_MUX = b"M"  # multiplexed uni+bi channels (agent/mux.py)
 _PROV_KEY_BROADCAST = (("path", "broadcast"),)
 _PROV_KEY_REBROADCAST = (("path", "rebroadcast"),)
 _PROV_KEY_SYNC = (("path", "sync"),)
+
+# widest seq span a full changeset may legally claim: one local
+# transaction's change count is bounded by what sqlite can hold in one
+# tx; anything wider is a structurally-impossible (hostile) claim that
+# would wedge partial buffering (see _screen_changeset)
+_MAX_SEQ_SPAN = 1 << 32
+
+
+def _changes_digest(changes) -> bytes:
+    """Canonical content digest of a changeset's changes — the
+    equivocation detector's identity for 'what this (actor, version)
+    actually said'.  Sorted by (db_version, seq, table, pk, cid) so
+    chunk-reassembly order cannot alias two identical contents apart."""
+    h = hashlib.blake2b(digest_size=16)
+    for ch in sorted(
+        changes,
+        key=lambda c: (int(c.db_version), int(c.seq), c.table, c.pk,
+                       c.cid),
+    ):
+        h.update(repr((
+            ch.table, ch.pk, ch.cid, ch.val, int(ch.col_version),
+            int(ch.db_version), int(ch.seq), ch.site_id, int(ch.cl),
+        )).encode())
+    return h.digest()
 
 
 class _SlowPeer(Exception):
@@ -162,6 +187,26 @@ class AgentConfig:
     # max gauge + slow-callback attribution.  0 disables.
     stall_probe_interval: float = 0.05
     stall_probe_slow_ms: float = 50.0
+    # HLC clock skew (the scenario matrix's clock-skew fault family,
+    # types/hlc.py skewed_now_ns): constant offset + linear drift
+    # applied to THIS node's HLClock physical source.  Zero in
+    # production — set per node by devcluster from the FaultPlan.
+    clock_skew_ns: int = 0
+    clock_drift: float = 0.0
+    # equivocation defense (docs/faults.md): screen structurally-
+    # impossible seq spans, detect conflicting contents re-claiming an
+    # accepted (actor, version) via bounded content digests, and
+    # quarantine the hostile actor (Members path) — dropping its
+    # further changesets so it cannot poison CRDT state
+    equivocation_detection: bool = True
+    # how long an equivocation quarantine holds before the actor's
+    # traffic is admitted again (re-offense re-quarantines: the
+    # digests survive).  Actor attribution is UNSIGNED — a hostile
+    # relay can frame an honest origin by forging its actor id — so
+    # the drop-all verdict must be a bounded window, not a permanent
+    # severance a single forged message could inflict.  0 = forever
+    # (only for harnesses that control every message source).
+    equiv_quarantine_s: float = 300.0
     pg_port: Optional[int] = None  # PostgreSQL wire protocol (None = off)
     pg_host: Optional[str] = None  # PG bind host (None = api_host)
     # PG TLS client-cert verification is its OWN knob (corro-pg
@@ -255,7 +300,14 @@ class Agent:
         self.bookie = Bookie(self.storage.conn, lock=self.storage._lock)
         # restart = resume: an older DB may predate __corro_sync_state
         self.bookie.backfill_own_sync_state(self.storage.site_id)
-        self.clock = HLClock()
+        if config.clock_skew_ns or config.clock_drift:
+            from corrosion_tpu.types.hlc import skewed_now_ns
+
+            self.clock = HLClock(now_ns=skewed_now_ns(
+                config.clock_skew_ns, config.clock_drift
+            ))
+        else:
+            self.clock = HLClock()
         self.actor_id = self.storage.site_id
         self.members = Members(self.actor_id)
         from corrosion_tpu.agent.metrics import Metrics
@@ -295,6 +347,14 @@ class Agent:
         # delete a rising staleness series at exactly the moment its
         # "stopped converging" alert should fire
         self._origin_seen_wall: Dict[bytes, float] = {}
+        # equivocation defense state: accepted-content digest per
+        # (actor, version) — bounded FIFO like the dedup caches — and
+        # the actors quarantined for hostile traffic (their further
+        # changesets drop at _pre_change until the verdict's deadline;
+        # actor -> monotonic expiry, inf when equiv_quarantine_s=0)
+        self._equiv_digests: Dict[tuple, bytes] = {}
+        self._equiv_lock = threading.Lock()
+        self._equiv_quarantined: Dict[bytes, float] = {}
         # loop health probe (agent/health.py), created on start()
         self.health = None
         self._trace_token = None  # export ownership (set in start())
@@ -2281,9 +2341,10 @@ class Agent:
         t0 = time.perf_counter()
         if live_idx:
             live = [group[k][0] for k in live_idx]
+            live_sources = [group[k][1] for k in live_idx]
             try:
                 news_flags = self._apply_complete_group(
-                    live[0].actor_id.bytes, live
+                    live[0].actor_id.bytes, live, live_sources
                 )
             except Exception:
                 # not an apply error yet: the per-changeset retry below
@@ -2291,9 +2352,11 @@ class Agent:
                 # abort itself gets its own series
                 self.metrics.counter("corro_apply_group_fallbacks_total")
                 news_flags = []
-                for cv in live:
+                for cv, src in zip(live, live_sources):
                     try:
-                        news_flags.append(self._process_changeset(cv))
+                        news_flags.append(
+                            self._process_changeset(cv, src)
+                        )
                     except Exception:
                         self.metrics.counter(
                             "corro_changes_apply_errors_total")
@@ -2323,8 +2386,10 @@ class Agent:
             out.append((cv, source, news, meta))
         return out
 
-    def _apply_complete_group(self, actor: bytes,
-                              cvs: List[ChangeV1]) -> List[bool]:
+    def _apply_complete_group(
+        self, actor: bytes, cvs: List[ChangeV1],
+        sources: Optional[List[ChangeSource]] = None,
+    ) -> List[bool]:
         """Merge several COMPLETE changesets from ``actor`` under one
         storage lock + one apply transaction.  The already-have gate is
         evaluated up front (before any mutation), and the in-memory
@@ -2332,20 +2397,46 @@ class Agent:
         fails — otherwise the rolled-back versions would read as
         'contained' and the per-changeset retry in
         ``_handle_change_group`` would silently skip them.  Bookkeeping
-        rows flush via the bookie's executemany batch variants."""
+        rows flush via the bookie's executemany batch variants.
+
+        ``sources`` gates the equivocation bookkeeping per changeset
+        (digests remembered / compared for BROADCAST only); omitted =
+        sync-like, no digest bookkeeping (harness seeding paths)."""
+        if sources is None:
+            sources = [ChangeSource.SYNC] * len(cvs)
         with self.storage._lock:
             booked = self.bookie.for_actor(actor)
             flags: List[bool] = []
             to_apply: List[ChangeV1] = []
-            batch_versions: set = set()
-            for cv in cvs:
+            # version -> (cs, source) accepted within THIS batch: a
+            # back-to-back conflicting pair lands here before any
+            # digest is remembered, so the in-batch dup must compare
+            # against the batch member directly
+            batch_cs: Dict[int, tuple] = {}
+            for cv, src in zip(cvs, sources):
                 v = int(cv.changeset.version)
-                if v in batch_versions or (
-                    booked.contains_version(v) and v not in booked.partials
-                ):
+                if v in batch_cs:
+                    first_cs, first_src = batch_cs[v]
+                    if (self.config.equivocation_detection
+                            and src is ChangeSource.BROADCAST
+                            and first_src is ChangeSource.BROADCAST
+                            and _changes_digest(cv.changeset.changes)
+                            != _changes_digest(first_cs.changes)):
+                        self._note_equivocation(actor, "content")
                     flags.append(False)
                     continue
-                batch_versions.add(v)
+                if booked.contains_version(v) and v not in booked.partials:
+                    # same duplicate gate as _process_changeset_locked:
+                    # a conflicting re-send must not slip past the
+                    # merged path's dedup either (broadcast scope —
+                    # see _check_content_equivocation)
+                    if src is ChangeSource.BROADCAST:
+                        self._check_content_equivocation(
+                            actor, cv.changeset
+                        )
+                    flags.append(False)
+                    continue
+                batch_cs[v] = (cv.changeset, src)
                 to_apply.append(cv)
                 flags.append(True)
             if not to_apply:
@@ -2379,6 +2470,15 @@ class Agent:
                 # of these versions would be skipped as already-applied
                 self.bookie.restore_actor(actor, snapshot)
                 raise
+            if self.config.equivocation_detection:
+                for cv in to_apply:
+                    cs = cv.changeset
+                    src = batch_cs[int(cs.version)][1]
+                    if src is ChangeSource.BROADCAST:
+                        self._remember_digest(
+                            actor, int(cs.version),
+                            _changes_digest(cs.changes),
+                        )
             return flags
 
     # ------------------------------------------------------------------
@@ -2392,6 +2492,116 @@ class Agent:
         if cs.is_empty_variant:
             return (cv.actor_id.bytes, "empty", cs.versions)
         return (cv.actor_id.bytes, "empty_set", cs.ranges)
+
+    # -- equivocation defense (docs/faults.md) -------------------------
+
+    def _screen_changeset(self, cs) -> Optional[str]:
+        """Structural sanity screen for full changesets; returns the
+        rejection kind or None.  A correct origin can never produce an
+        inverted seq span, a ``last_seq`` below the span end, or a
+        claimed width past ``_MAX_SEQ_SPAN`` — such metadata would
+        wedge partial-version buffering (a version whose completion
+        seq can never arrive) or lie about completeness."""
+        if not cs.is_full or cs.seqs is None or cs.last_seq is None:
+            return None
+        s, e = int(cs.seqs[0]), int(cs.seqs[1])
+        last = int(cs.last_seq)
+        if s < 0 or e < s or last < e:
+            return "span"
+        if (e - s) >= _MAX_SEQ_SPAN or last >= _MAX_SEQ_SPAN:
+            return "span"
+        for ch in cs.changes:
+            if not s <= int(ch.seq) <= e:
+                return "span"
+        return None
+
+    def _remember_digest(self, actor: bytes, v: int, digest: bytes) -> None:
+        with self._equiv_lock:
+            dig = self._equiv_digests
+            dig[(actor, v)] = digest
+            if len(dig) > self.config.seen_cache_size:
+                dig.pop(next(iter(dig)))
+
+    def _check_content_equivocation(self, actor: bytes, cs) -> bool:
+        """Compare a duplicate complete changeset's content digest
+        against the accepted one for its (actor, version); a mismatch
+        is equivocation (returns True after counting + quarantining).
+        Byte-identical replays compare equal and stay plain
+        duplicates.
+
+        BROADCAST scope only (callers gate, and digests are only
+        remembered for broadcast-applied contents): the gossiped bytes
+        of one version are immutable — the origin frames them once and
+        rebroadcast relays them verbatim — so any difference is
+        hostile.  Sync-served content is NOT: ``_collect_changes_on``
+        reconstructs a version from the CURRENT clock/data tables, so
+        a re-serve after later overwrites legitimately differs from
+        the original broadcast, and comparing across the two paths
+        would quarantine honest origins under ordinary overwrite
+        workloads.
+
+        Two windows the per-node detector deliberately leaves to the
+        CROSS-NODE checker (``ClusterObserver.no_divergence``):
+        conflicting contents split across nodes so each sees only one
+        (nothing to compare locally), and a conflicting pair racing a
+        node's first arrival before any digest is remembered — except
+        the same-apply-batch case, which ``_apply_complete_group``
+        compares directly.
+
+        Cost note: this hashes the duplicate's contents (sort + repr +
+        blake2b over its few changes) whenever an accepted digest
+        exists — broadcast fanout duplicates of recent versions pay
+        it.  That is the price of the defense: the dedup key
+        deliberately excludes content, so any cheaper per-key shortcut
+        would let a later conflicting re-send launder through the
+        cache.  ``equivocation_detection = false`` restores the plain
+        dict-hit duplicate path."""
+        if not self.config.equivocation_detection:
+            return False
+        if not (cs.is_full and cs.is_complete()):
+            return False
+        with self._equiv_lock:
+            prev = self._equiv_digests.get((actor, int(cs.version)))
+        if prev is None or prev == _changes_digest(cs.changes):
+            return False
+        self._note_equivocation(actor, "content")
+        return True
+
+    def _note_equivocation(self, actor: bytes, kind: str) -> None:
+        """Count one hostile observation and quarantine the origin
+        actor through the Members path (the breaker-quarantine shape,
+        protocol-level evidence): out of ring0, deprioritized in
+        sampling, reason surfaced in ``cluster_members`` — and its
+        further changesets drop at ``_pre_change`` for
+        ``equiv_quarantine_s``, so an equivocator cannot keep
+        poisoning CRDT state.  The verdict is a bounded WINDOW, not a
+        permanent severance: actor attribution is unsigned (mTLS
+        authenticates the channel, not the claimed origin of relayed
+        changesets), so a hostile relay could frame an honest actor —
+        an unbounded drop-all would let one forged message inflict
+        permanent divergence, worse than the attack it guards.  The
+        already-accepted first content stays: it is consistent
+        cluster-wide as long as it won every node's first arrival,
+        which the no-divergence checker verifies cross-node."""
+        self.metrics.counter(
+            "corro_sync_equivocations_total", kind=kind
+        )
+        hold = self.config.equiv_quarantine_s
+        deadline = (time.monotonic() + hold) if hold > 0 else float("inf")
+        with self._equiv_lock:
+            first = actor not in self._equiv_quarantined
+            self._equiv_quarantined[actor] = deadline
+        if first:
+            logger.warning(
+                "equivocation detected (kind=%s) from %s: quarantining",
+                kind, actor.hex(),
+            )
+            self.members.set_quarantined(actor, True,
+                                         reason="equivocation")
+            self.metrics.counter(
+                "corro_members_quarantine_transitions_total",
+                state="equivocation",
+            )
 
     def _rebroadcast_hop(self, cv: ChangeV1, meta=None) -> int:
         """Hop count for re-gossiping a received payload: received hop
@@ -2419,25 +2629,69 @@ class Agent:
         """
         if not self._pre_change(cv, source):
             return False
-        news = self._process_changeset(cv)
+        news = self._process_changeset(cv, source)
         self._post_change(cv, source, news, rebroadcast, meta=meta,
                           record_prov=record_prov)
         return news
 
     def _pre_change(self, cv: ChangeV1, source: ChangeSource) -> bool:
-        """Dedup + clock ingestion ahead of applying; False = drop."""
-        if cv.actor_id.bytes == self.actor_id:
+        """Hostile screen + dedup + clock ingestion ahead of applying;
+        False = drop."""
+        actor = cv.actor_id.bytes
+        if actor == self.actor_id:
             return False
+        deadline = self._equiv_quarantined.get(actor)
+        if deadline is not None:
+            if time.monotonic() < deadline:
+                # a detected equivocator's traffic is poison while the
+                # verdict holds: drop everything, count the volume
+                self.metrics.counter(
+                    "corro_sync_equivocations_total", kind="quarantined"
+                )
+                return False
+            # verdict expired: re-admit (bounded blast radius for a
+            # FRAMED honest actor — attribution is unsigned).  The
+            # digests survive, so a real equivocator's next conflicting
+            # dup re-quarantines immediately.
+            with self._equiv_lock:
+                self._equiv_quarantined.pop(actor, None)
+            self.members.set_quarantined(actor, False,
+                                         reason="equivocation")
+            self.metrics.counter(
+                "corro_members_quarantine_transitions_total",
+                state="equivocation_expired",
+            )
         key = self._seen_key(cv)
         if source is ChangeSource.BROADCAST:
             with self._seen_lock:
-                if key in self._seen:
-                    return False
-                self._seen[key] = None
-                if len(self._seen) > self.config.seen_cache_size:
-                    evicted = next(iter(self._seen))
-                    self._seen.pop(evicted)
-                    self._recv_hops.pop(evicted, None)
+                dup = key in self._seen
+                if not dup:
+                    self._seen[key] = None
+                    if len(self._seen) > self.config.seen_cache_size:
+                        evicted = next(iter(self._seen))
+                        self._seen.pop(evicted)
+                        self._recv_hops.pop(evicted, None)
+            if dup:
+                # the dedup cache must not LAUNDER equivocation: a
+                # conflicting re-send shares the (actor, version, seqs)
+                # key with the accepted content, so the duplicate path
+                # is exactly where conflicting contents hide
+                self._check_content_equivocation(actor, cv.changeset)
+                return False
+        # structural screen AFTER dedup: fanout duplicates drop on the
+        # dict hit without paying the O(changes) span walk — a garbage
+        # duplicate is inert either way (dropped, never applied or
+        # buffered); only first arrivals and sync deliveries pay
+        if self.config.equivocation_detection:
+            kind = self._screen_changeset(cv.changeset)
+            if kind is not None:
+                self._note_equivocation(actor, kind)
+                return False
+        # clock ingestion: a remote ts past max_delta_ns (the 300 ms
+        # gossip clock-delta rule) is REJECTED — the merge raises and
+        # the local clock stays unpolluted; the changeset itself still
+        # applies (data-plane convergence must not hinge on a peer's
+        # oscillator)
         if cv.changeset.ts is not None:
             try:
                 self.clock.update_with_timestamp(cv.changeset.ts)
@@ -2552,14 +2806,17 @@ class Agent:
                           duration_ms=dur_ms, **attrs) is not None:
             self.metrics.counter("corro_trace_spans_total")
 
-    def _process_changeset(self, cv: ChangeV1) -> bool:
+    def _process_changeset(self, cv: ChangeV1,
+                           source: ChangeSource = ChangeSource.SYNC
+                           ) -> bool:
         # hold the storage lock across the have-it-already checks AND the
         # apply transaction: concurrent apply workers mutate the same
         # booked RangeSets, and those mutations are multi-step
         with self.storage._lock:
-            return self._process_changeset_locked(cv)
+            return self._process_changeset_locked(cv, source)
 
-    def _process_changeset_locked(self, cv: ChangeV1) -> bool:
+    def _process_changeset_locked(self, cv: ChangeV1,
+                                  source: ChangeSource) -> bool:
         actor = cv.actor_id.bytes
         cs = cv.changeset
         booked = self.bookie.for_actor(actor)
@@ -2594,6 +2851,12 @@ class Agent:
 
         v = int(cs.version)
         if booked.contains_version(v) and v not in booked.partials:
+            # duplicate of an accepted version: a cache-evicted
+            # rebroadcast lands here — conflicting gossiped contents
+            # must be caught, byte-identical replays absorbed.
+            # Broadcast scope only: see _check_content_equivocation
+            if source is ChangeSource.BROADCAST:
+                self._check_content_equivocation(actor, cs)
             return False
 
         if cs.is_complete():
@@ -2606,6 +2869,11 @@ class Agent:
                     actor, v, cs.max_db_version(), int(cs.last_seq), ts
                 )
                 self.bookie.clear_partial(actor, v)
+            if (self.config.equivocation_detection
+                    and source is ChangeSource.BROADCAST):
+                self._remember_digest(
+                    actor, v, _changes_digest(cs.changes)
+                )
             return True
 
         # partial: buffer + maybe promote.  Buffered blobs are the
@@ -2640,6 +2908,10 @@ class Agent:
                     int(cs.last_seq), ts,
                 )
                 self.bookie.clear_partial(actor, v)
+                # promoted partials record NO digest: their chunks can
+                # legitimately mix broadcast and sync deliveries, and
+                # sync-served content reflects serve-time compaction —
+                # an unreliable identity for 'what the actor gossiped'
         return True
 
     # ------------------------------------------------------------------

@@ -137,6 +137,23 @@ class CrConn:
         self._ro_all: List[sqlite3.Connection] = []
         self._ro_cv = threading.Condition()
         self._ro_closed = False
+        # slow-disk fault seam (faults.FaultController.io_hook_for):
+        # callable(op: "write"|"read") -> delay seconds, consulted once
+        # per write batch and per change collection.  The sleep runs on
+        # the worker/caller thread holding the storage path — a slow
+        # disk stretches lock holds and serve windows, it does not
+        # block the event loop directly.  None in production.
+        self.io_fault = None
+
+    def _io_delay(self, op: str) -> None:
+        hook = self.io_fault
+        if hook is None:
+            return
+        d = hook(op)
+        if d and d > 0:
+            import time
+
+            time.sleep(d)
 
     def _new_ro(self) -> sqlite3.Connection:
         conn = sqlite3.connect(
@@ -710,6 +727,7 @@ END;
         outer transaction — ``runtime._run_write_group_locked``); the
         caller commits the allocation by setting ``db_version`` to the
         returned value iff the batch produced changes."""
+        self._io_delay("write")
         pending = self._state("db_version") + 1
         self._set_state("pending_db_version", pending)
         self._set_state("seq", 0)
@@ -839,6 +857,7 @@ END;
     ) -> List[Change]:
         """Shared body: one sentinel + one cell query per table over the
         whole inclusive db_version range, sorted (db_version, seq)."""
+        self._io_delay("read")
         lo, hi = db_version_range
         out: List[Change] = []
         for t, info in self._tables.items():
@@ -933,6 +952,7 @@ END;
         from corrosion_tpu.agent.locks import PRIO_NORMAL
 
         with self._lock.prio(PRIO_NORMAL, "apply", kind="apply"):
+            self._io_delay("write")
             self.conn.execute("BEGIN IMMEDIATE")
             try:
                 self._set_state("apply_mode", 1)

@@ -89,10 +89,20 @@ async def run_inprocess(
             # window — bootstrap announces on a RESPAWN into an active
             # partition — is subject to the plan
             kwargs["fault_filter"] = faults.hook_for(name)
+            # per-node HLC skew, derived (not stored) from the plan so
+            # a respawn re-acquires its identical bad oscillator
+            offset_ns, drift = faults.clock_for(name)
+            if offset_ns or drift:
+                kwargs["clock_skew_ns"] = offset_ns
+                kwargs["clock_drift"] = drift
         agent = await launch_test_agent(**kwargs, **agent_overrides)
         if faults is not None:
             faults.register(name, tuple(agent.gossip_addr))
             agent.faults = faults
+            # slow-disk hook at the storage write/collect seams; after
+            # launch so schema-apply boot writes aren't charged seeded
+            # draws (the fault model covers steady-state IO)
+            agent.storage.io_fault = faults.io_hook_for(name)
         return agent
 
     for name in topo.nodes:
@@ -227,6 +237,117 @@ class ClusterObserver:
             )
         return {"max_stall_ms": worst, "slow_callbacks": slow}
 
+    # -- no-divergence invariant (docs/faults.md, scenario matrix) -----
+
+    def no_divergence(self) -> dict:
+        """The cross-node NO-DIVERGENCE invariant the scenario matrix
+        gates every cell on:
+
+        1. **bytewise-equal table state** — every CRR table's full,
+           order-normalized contents hash identically on every node;
+        2. **consistent bookkeeping ledgers** — per origin actor, every
+           node holds the same CONTAINED version set (max version, no
+           differing gaps, same unresolved partials).  The
+           applied-vs-cleared split is a per-node compaction detail
+           and deliberately not compared;
+        3. **one content per (actor, version)** — the accepted-content
+           digests pooled across nodes never show two digests for one
+           version (the equivocation invariant, checked cross-node
+           where a single agent cannot see it).
+
+        Returns ``{"ok": bool, "violations": [...]}`` with enough
+        detail to name the diverging nodes."""
+        import hashlib
+
+        violations = []
+        names = sorted(self.agents)
+
+        table_digests: Dict[str, str] = {}
+        for name in names:
+            a = self.agents[name]
+            h = hashlib.blake2b(digest_size=16)
+            for t in sorted(a.storage.tables):
+                q = t.replace('"', '""')
+                cols, rows = a.storage.read_query(
+                    f'SELECT * FROM "{q}"'
+                )
+                h.update(repr(
+                    (t, cols, sorted(rows, key=repr))
+                ).encode())
+            table_digests[name] = h.hexdigest()
+        if len(set(table_digests.values())) > 1:
+            violations.append({
+                "kind": "table_state",
+                "digests": table_digests,
+            })
+
+        ledgers: Dict[str, dict] = {}
+        for name in names:
+            a = self.agents[name]
+            with a.storage._lock:
+                led = {}
+                for actor, bv in a.bookie.actors().items():
+                    if (bv.max_version == 0 and not bv.needed.spans()
+                            and not bv.partials):
+                        continue  # lazily-created empty entry, not state
+                    led[actor.hex()] = (
+                        bv.max_version,
+                        tuple(bv.needed.spans()),
+                        tuple(sorted(
+                            v for v, p in bv.partials.items()
+                            if not p.is_complete()
+                        )),
+                    )
+            ledgers[name] = led
+        actors = set()
+        for led in ledgers.values():
+            actors.update(led)
+        for actor in sorted(actors):
+            per_node = {
+                name: ledgers[name].get(actor) for name in names
+            }
+            if len({repr(v) for v in per_node.values()}) > 1:
+                violations.append({
+                    "kind": "ledger",
+                    "actor": actor,
+                    "per_node": {
+                        k: repr(v) for k, v in per_node.items()
+                    },
+                })
+
+        accepted: Dict[tuple, tuple] = {}
+        for name in names:
+            a = self.agents[name]
+            with a._equiv_lock:
+                items = list(a._equiv_digests.items())
+            for (actor, v), d in items:
+                prev = accepted.get((actor, v))
+                if prev is None:
+                    accepted[(actor, v)] = (name, d)
+                elif prev[1] != d:
+                    violations.append({
+                        "kind": "conflicting_contents",
+                        "actor": actor.hex(),
+                        "version": v,
+                        "nodes": [prev[0], name],
+                    })
+
+        return {"ok": not violations, "violations": violations}
+
+    def equivocations(self, scrape: Optional[Dict[str, dict]] = None
+                      ) -> Dict[str, float]:
+        """Cluster-wide ``corro_sync_equivocations_total`` by kind,
+        from the scraped exposition."""
+        out: Dict[str, float] = {}
+        for parsed in (scrape or self.scrape()).values():
+            fam = parsed.get("corro_sync_equivocations_total")
+            if fam is None:
+                continue
+            for _n, labels, v in fam["samples"]:
+                kind = labels.get("kind", "?")
+                out[kind] = out.get(kind, 0.0) + v
+        return out
+
     # -- traces --------------------------------------------------------
 
     def assemble_trace(self, trace_id: str):
@@ -263,6 +384,30 @@ class ClusterObserver:
                 max(self.staleness(scrape).values(), default=0.0)
             ),
         }
+
+
+async def run_stall_schedule(faults: "object") -> None:
+    """Execute the plan's loop-stall schedule: at each event, block the
+    event loop with a real ``time.sleep`` for the event's duration —
+    the stalled-event-loop fault family.  In-process clusters share one
+    loop, so a stall freezes every agent at once (the worst case); the
+    agents' own ``LoopHealthProbe`` must observe and attribute it.
+    Event times are seconds relative to the controller's clock, like
+    crashes."""
+    import time as _time
+
+    loop = asyncio.get_running_loop()
+    for ev in sorted(faults.plan.loop_stalls, key=lambda e: e.at):
+        delay = ev.at - faults.elapsed()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        loop.call_soon(_time.sleep, ev.duration_ms / 1e3)
+        # yield so the stall actually executes before bookkeeping
+        await asyncio.sleep(0)
+        faults.injected["stall"] += 1
+        faults.stall_log.append(
+            (faults.elapsed(), ev.node, ev.duration_ms)
+        )
 
 
 async def run_crash_schedule(faults: "object") -> None:

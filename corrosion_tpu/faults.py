@@ -74,10 +74,26 @@ class CrashEvent:
 
 
 @dataclass(frozen=True)
+class LoopStall:
+    """Block ``node``'s event loop for ``duration_ms`` at ``at``
+    seconds after the schedule clock starts — the stalled-event-loop
+    fault family.  Executed by ``devcluster.run_stall_schedule``; the
+    agents' own :class:`~corrosion_tpu.agent.health.LoopHealthProbe`
+    is what must observe it (the fault exists to exercise the probe
+    and the loop-affine paths behind it)."""
+
+    node: str
+    at: float
+    duration_ms: float
+
+
+@dataclass(frozen=True)
 class FaultPlan:
     """Seeded, replayable fault regime — the live-cluster analogue of
     ``EpidemicConfig``'s ``loss``/``partition_blocks``/``heal_tick``
-    plus a churn (crash/restart) schedule."""
+    plus a churn (crash/restart) schedule and the adversarial families
+    (clock skew, one-way partitions, slow IO, loop stalls) the
+    scenario matrix drives (``sim/scenarios.py``)."""
 
     seed: int = 0
     # per-link, per-message drop probability (sim: EpidemicConfig.loss)
@@ -92,6 +108,29 @@ class FaultPlan:
     partition_blocks: int = 1
     heal_after: Optional[float] = None
     crashes: Tuple[CrashEvent, ...] = ()
+    # -- adversarial families (docs/faults.md, scenario matrix) --------
+    # one-way partitions: directed (src_block, dst_block) pairs severed
+    # while the partition is active.  Empty = symmetric (every
+    # cross-block pair, both directions — the original behavior).
+    # `link_decision` is already directional (src and dst are distinct
+    # hash inputs); this makes the PARTITION directional too.
+    oneway_blocks: Tuple[Tuple[int, int], ...] = ()
+    # per-node HLC clock skew: constant offset up to ±clock_skew_max_ns
+    # plus linear drift up to ±clock_drift_max_ppm, derived per node by
+    # `node_clock` as a pure function of (seed, node) — injected at the
+    # HLClock now_ns seam (types/hlc.py skewed_now_ns), so the 300 ms
+    # max-delta gossip-clock rule is exercised by real traffic
+    clock_skew_max_ns: int = 0
+    clock_drift_max_ppm: float = 0.0
+    # slow-disk injection (seconds, base + uniform[0, jitter) per
+    # operation, seeded per (node, op, n)) at the storage write/collect
+    # seams (CrConn.io_fault): the delay runs on the worker/caller
+    # thread holding the storage path, never directly on the event loop
+    disk_write_delay: float = 0.0
+    disk_write_jitter: float = 0.0
+    disk_read_delay: float = 0.0
+    disk_read_jitter: float = 0.0
+    loop_stalls: Tuple[LoopStall, ...] = ()
 
     def link_decision(self, src: str, dst: str, channel: str,
                       n: int) -> FaultAction:
@@ -122,6 +161,81 @@ class FaultPlan:
             return 0
         return idx * self.partition_blocks // n_nodes
 
+    def blocks_severed(self, src_block: int, dst_block: int) -> bool:
+        """Is traffic src_block → dst_block cut while the partition is
+        active?  Symmetric plans (no ``oneway_blocks``) sever every
+        cross-block pair both ways; one-way plans sever exactly the
+        listed directed pairs."""
+        if src_block == dst_block:
+            return False
+        if not self.oneway_blocks:
+            return True
+        return (src_block, dst_block) in self.oneway_blocks
+
+    def node_clock(self, node: str) -> Tuple[int, float]:
+        """``(offset_ns, drift_ratio)`` for ``node`` — a pure function
+        of (seed, node), so a restart (or a replay) re-derives the
+        identical skew.  Offset is uniform in ±clock_skew_max_ns, drift
+        uniform in ±clock_drift_max_ppm (returned as a ratio)."""
+        if not self.clock_skew_max_ns and not self.clock_drift_max_ppm:
+            return (0, 0.0)
+        h = hashlib.blake2b(
+            f"{self.seed}:{node}:clock".encode(), digest_size=16
+        ).digest()
+        u1 = int.from_bytes(h[:8], "big") / 2.0**64
+        u2 = int.from_bytes(h[8:], "big") / 2.0**64
+        offset = int((2.0 * u1 - 1.0) * self.clock_skew_max_ns)
+        drift = (2.0 * u2 - 1.0) * self.clock_drift_max_ppm * 1e-6
+        return (offset, drift)
+
+    def io_decision(self, node: str, op: str, n: int) -> float:
+        """Seeded slow-disk delay (seconds) for ``node``'s nth ``op``
+        (``"write"`` | ``"read"``) — pure in (seed, node, op, n), the
+        same replay contract as :meth:`link_decision`."""
+        if op == "write":
+            base, jitter = self.disk_write_delay, self.disk_write_jitter
+        else:
+            base, jitter = self.disk_read_delay, self.disk_read_jitter
+        if base <= 0.0 and jitter <= 0.0:
+            return 0.0
+        h = hashlib.blake2b(
+            f"{self.seed}:{node}:disk_{op}:{n}".encode(), digest_size=8
+        ).digest()
+        u = int.from_bytes(h, "big") / 2.0**64
+        return base + jitter * u
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        """Reconstruct a plan from :meth:`FaultController.as_dict`
+        output — the introspection surface round-trips (asserted in
+        ``tests/test_faults.py``), so a replay can be driven from an
+        admin ``faults`` dump."""
+        return cls(
+            seed=d["seed"],
+            drop=d["drop"],
+            delay=d["delay"],
+            delay_jitter=d["delay_jitter"],
+            partition_blocks=d["partition_blocks"],
+            heal_after=d["heal_after"],
+            crashes=tuple(
+                CrashEvent(c["node"], c["at"], c["restart_at"])
+                for c in d.get("crashes", ())
+            ),
+            oneway_blocks=tuple(
+                (int(a), int(b)) for a, b in d.get("oneway_blocks", ())
+            ),
+            clock_skew_max_ns=d.get("clock_skew_max_ns", 0),
+            clock_drift_max_ppm=d.get("clock_drift_max_ppm", 0.0),
+            disk_write_delay=d.get("disk_write_delay", 0.0),
+            disk_write_jitter=d.get("disk_write_jitter", 0.0),
+            disk_read_delay=d.get("disk_read_delay", 0.0),
+            disk_read_jitter=d.get("disk_read_jitter", 0.0),
+            loop_stalls=tuple(
+                LoopStall(s["node"], s["at"], s["duration_ms"])
+                for s in d.get("loop_stalls", ())
+            ),
+        )
+
 
 class FaultController:
     """Binds a :class:`FaultPlan` to a live cluster.
@@ -134,6 +248,7 @@ class FaultController:
 
     def __init__(self, plan: FaultPlan,
                  now: Optional[Callable[[], float]] = None):
+        import threading
         import time
 
         self.plan = plan
@@ -142,6 +257,11 @@ class FaultController:
         self._addr_to_node: Dict[Addr, str] = {}
         self._node_idx: Dict[str, int] = {}
         self._counters: Dict[Tuple[str, str, str], int] = {}
+        # io hooks run on WORKER threads (apply pool, serve pool, write
+        # callers) unlike the loop-affine link hooks: counter ticks and
+        # log appends must be atomic or concurrent IO would lose ticks
+        # and break the per-stream replay contract
+        self._io_lock = threading.Lock()
         # the partition is armed by split(), not at boot: cluster
         # formation (membership dissemination) happens whole, then the
         # harness splits at measurement start — the live analogue of
@@ -150,11 +270,14 @@ class FaultController:
         self._healed = False
         self.decision_log = bytearray()
         self.injected: Dict[str, int] = {"drop": 0, "partition": 0,
-                                         "delay": 0}
+                                         "delay": 0, "disk": 0,
+                                         "stall": 0}
         # crash orchestration bookkeeping (devcluster.run_inprocess)
         self.agents: Optional[Dict[str, object]] = None
         self.respawn: Dict[str, Callable] = {}
         self.crash_log: List[Tuple[float, str, str]] = []
+        # loop-stall orchestration (devcluster.run_stall_schedule)
+        self.stall_log: List[Tuple[float, str, float]] = []
 
     # -- registration ---------------------------------------------------
 
@@ -197,6 +320,10 @@ class FaultController:
         self._sever_cross_block()
 
     def _sever_cross_block(self) -> None:
+        # OUTBOUND caches are per-agent, so dropping only the conns in
+        # severed DIRECTIONS keeps a one-way partition one-way: with
+        # (0, 1) severed, block-1 agents keep their cached conns toward
+        # block 0 and stay able to dial it
         if not self.agents:
             return
         n = len(self._node_idx)
@@ -208,7 +335,9 @@ class FaultController:
             sb = self.plan.block_of(si, n)
             for addr, peer in list(self._addr_to_node.items()):
                 di = self._node_idx.get(peer)
-                if di is not None and self.plan.block_of(di, n) != sb:
+                if di is None:
+                    continue
+                if self.plan.blocks_severed(sb, self.plan.block_of(di, n)):
                     try:
                         transport.drop(tuple(addr))
                     except Exception:
@@ -228,6 +357,9 @@ class FaultController:
         return True
 
     def _partitioned(self, src: str, dst: str) -> bool:
+        """Is the DIRECTED link src → dst cut right now?  Symmetric
+        plans answer the same for both directions; one-way plans only
+        for the listed (src_block, dst_block) pairs."""
         if not self.partition_active():
             return False
         n = len(self._node_idx)
@@ -235,8 +367,9 @@ class FaultController:
         di = self._node_idx.get(dst)
         if si is None or di is None:
             return False
-        return (self.plan.block_of(si, n)
-                != self.plan.block_of(di, n))
+        return self.plan.blocks_severed(
+            self.plan.block_of(si, n), self.plan.block_of(di, n)
+        )
 
     # -- the decision path ----------------------------------------------
 
@@ -282,9 +415,39 @@ class FaultController:
 
         return hook
 
+    def io_hook_for(self, name: str) -> Callable[[str], float]:
+        """The per-agent slow-disk hook for ``CrConn.io_fault``:
+        ``hook(op) -> delay_seconds``.  Each decision consumes a
+        per-(node, op) counter tick, so every (node, op) STREAM of
+        delays replays identically — the same per-stream contract the
+        link hooks have.  (Global decision_log order across concurrent
+        streams is scheduling-dependent in live runs, for IO and links
+        alike; the replay tests drive streams serially.)"""
+
+        def hook(op: str) -> float:
+            key = (name, name, f"disk_{op}")
+            with self._io_lock:
+                n = self._counters.get(key, 0)
+                self._counters[key] = n + 1
+                d = self.plan.io_decision(name, op, n)
+                if d > 0:
+                    self.injected["disk"] += 1
+                self.decision_log += FaultAction(delay=d).encode()
+            return d
+
+        return hook
+
+    def clock_for(self, name: str) -> Tuple[int, float]:
+        """``(offset_ns, drift_ratio)`` the node's HLClock should run
+        with (``types/hlc.py skewed_now_ns``) — derived, not stored, so
+        a respawned node gets its identical skew back."""
+        return self.plan.node_clock(name)
+
     # -- introspection (admin `faults` command) -------------------------
 
     def as_dict(self) -> dict:
+        """Introspection dump (admin ``faults`` command).  The PLAN
+        half round-trips through :meth:`FaultPlan.from_dict`."""
         p = self.plan
         return {
             "seed": p.seed,
@@ -298,6 +461,17 @@ class FaultController:
                 {"node": c.node, "at": c.at, "restart_at": c.restart_at}
                 for c in p.crashes
             ],
+            "oneway_blocks": [list(pair) for pair in p.oneway_blocks],
+            "clock_skew_max_ns": p.clock_skew_max_ns,
+            "clock_drift_max_ppm": p.clock_drift_max_ppm,
+            "disk_write_delay": p.disk_write_delay,
+            "disk_write_jitter": p.disk_write_jitter,
+            "disk_read_delay": p.disk_read_delay,
+            "disk_read_jitter": p.disk_read_jitter,
+            "loop_stalls": [
+                {"node": s.node, "at": s.at, "duration_ms": s.duration_ms}
+                for s in p.loop_stalls
+            ],
             "nodes": len(self._node_idx),
             "injected": dict(self.injected),
             "decisions": len(self.decision_log) // _DECISION.size,
@@ -305,4 +479,123 @@ class FaultController:
                 {"t": round(t, 3), "event": ev, "node": node}
                 for t, ev, node in self.crash_log
             ],
+            "stall_log": [
+                {"t": round(t, 3), "node": node, "duration_ms": ms}
+                for t, node, ms in self.stall_log
+            ],
         }
+
+
+class EquivocatingPeer:
+    """A hostile gossip origin: one actor id, conflicting changesets.
+
+    The scenario matrix's fault family (d): the peer emits
+
+    * **conflicting contents** — two COMPLETE changesets claiming the
+      same ``(actor, version)`` with different cell values (a correct
+      CRDT origin can never do this: a version is one committed
+      transaction);
+    * **replayed duplicates** — byte-identical re-sends of an accepted
+      changeset (fanout noise amplified; must be absorbed, not
+      counted as equivocation);
+    * **garbage seq spans** — structurally impossible seq metadata
+      (inverted spans, ``last_seq`` below the span end, absurd claimed
+      widths) that would poison partial-version buffering.
+
+    Agents must detect the first and third
+    (``corro_sync_equivocations_total{kind=}``), quarantine the actor
+    through the ``Members`` path, and accept ZERO divergent rows —
+    the no-divergence invariant the campaign runner gates on.
+
+    Changesets are crafted with the real wire types, so they are
+    indistinguishable from legitimate traffic until inspected.  The
+    actor id and payload CONTENTS derive from ``seed``; the HLC
+    timestamps are stamped at craft time — provenance lag (wall-now
+    minus the changeset ts) needs a live time base, and a seed-derived
+    ts would record absurd lags into the cell's p99.
+    """
+
+    def __init__(self, seed: int = 0, table: str = "tests"):
+        self.seed = seed
+        self.table = table
+        self.actor_id = hashlib.blake2b(
+            f"equivocator:{seed}".encode(), digest_size=16
+        ).digest()
+        self._version = 0
+
+    def _ts(self):
+        from corrosion_tpu.types.hlc import Timestamp
+        import time
+
+        return Timestamp.pack(time.time_ns(), 0)
+
+    def _changeset(self, version: int, row_id: int, text: str,
+                   seqs=None, last_seq=None, seq: int = 0):
+        from corrosion_tpu.agent.pack import pack_values
+        from corrosion_tpu.types import ActorId, Changeset, ChangeV1
+        from corrosion_tpu.types.base import (
+            CrsqlDbVersion,
+            CrsqlSeq,
+            Version,
+        )
+        from corrosion_tpu.types.change import Change
+
+        ch = Change(
+            table=self.table,
+            pk=pack_values([row_id]),
+            cid="text",
+            val=text,
+            col_version=1,
+            db_version=CrsqlDbVersion(version),
+            seq=CrsqlSeq(seq),
+            site_id=self.actor_id,
+            cl=1,
+        )
+        cs = Changeset.full(
+            Version(version),
+            (ch,),
+            seqs if seqs is not None else (CrsqlSeq(0), CrsqlSeq(0)),
+            last_seq if last_seq is not None else CrsqlSeq(0),
+            self._ts(),
+        )
+        return ChangeV1(ActorId(self.actor_id), cs)
+
+    def next_version(self) -> int:
+        self._version += 1
+        return self._version
+
+    def honest(self, row_id: int, text: str):
+        """A well-formed changeset (the bait: accepted normally)."""
+        return self._changeset(self.next_version(), row_id, text)
+
+    def conflicting_pair(self, row_id: int):
+        """Two complete changesets for ONE version with different
+        contents — the content-equivocation attack."""
+        v = self.next_version()
+        a = self._changeset(v, row_id, f"equiv-a-{v}")
+        b = self._changeset(v, row_id, f"equiv-b-{v}")
+        return a, b
+
+    def garbage_span(self, row_id: int):
+        """A changeset whose seq metadata is structurally impossible
+        (inverted span + last_seq below the span end)."""
+        from corrosion_tpu.types.base import CrsqlSeq
+
+        v = self.next_version()
+        return self._changeset(
+            v, row_id, f"garbage-{v}",
+            seqs=(CrsqlSeq(5), CrsqlSeq(2)), last_seq=CrsqlSeq(1),
+            seq=5,
+        )
+
+    def absurd_width(self, row_id: int):
+        """A changeset claiming a seq span wider than any transaction
+        could produce (the unbounded-buffering attack)."""
+        from corrosion_tpu.types.base import CrsqlSeq
+
+        v = self.next_version()
+        return self._changeset(
+            v, row_id, f"wide-{v}",
+            seqs=(CrsqlSeq(0), CrsqlSeq(2**40)),
+            last_seq=CrsqlSeq(2**40),
+        )

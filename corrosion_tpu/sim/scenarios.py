@@ -1,0 +1,570 @@
+"""Adversarial scenario matrix: co-simulation campaigns over the four
+hostile fault families, gated on convergence + NO-DIVERGENCE.
+
+CHAOS validated the live cluster against the kernel under the sim's own
+fault family (loss / partition / churn).  This module runs the matrix
+the BFT-simulation literature demands for trustworthy headline numbers
+(PAPERS.md: "Simulating BFT Protocol Implementations at Scale" runs
+implementations against adversarial scenarios next to a model;
+"CRDT Emulation, Simulation, and Representation Independence" motivates
+the no-divergence property as the gate):
+
+* ``clock_skew``     — per-node HLC offset + drift at the ``HLClock``
+  seam (``types/hlc.py skewed_now_ns``), exercising the 300 ms
+  max-delta gossip-clock rule and the provenance negative-lag clamp;
+* ``asym_partition`` — a ONE-WAY partition (``FaultPlan.oneway_blocks``)
+  healing by wall clock: the severed direction drops while the reverse
+  keeps flowing — the TOCTOU-hardened ``open_bi`` recheck applies
+  per direction;
+* ``slow_io``        — seeded slow-disk delays at the storage
+  write/collect seams plus a scheduled event-loop stall, observed by
+  the agents' own ``LoopHealthProbe``;
+* ``equivocation``   — a hostile origin re-claiming an accepted
+  ``(actor, version)`` with conflicting contents, replaying duplicates,
+  and shipping garbage seq spans; agents must detect
+  (``corro_sync_equivocations_total``), quarantine (``Members`` path),
+  and accept zero divergent rows;
+* ``compound``       — loss + one-way partition + clock skew at once.
+
+Every cell runs a live in-process cluster next to the epidemic kernel's
+prediction (the CHAOS/OBS comparison), scraped through
+``ClusterObserver``, and gates on:
+
+1. full convergence of the cell's write workload;
+2. ``ClusterObserver.no_divergence()`` — bytewise-equal table state,
+   consistent bookkeeping ledgers, one accepted content per
+   ``(actor, version)``;
+3. family-specific assertions (skew applied, stall observed, hostile
+   actor quarantined with zero divergent rows, ...).
+
+``bench.py --scenarios`` writes the matrix to ``SCENARIOS_N32.json``;
+``tests/test_scenarios.py`` runs one small cell per family in tier-1
+and the full N=32 matrix under ``@slow``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Dict, List, Optional
+
+from corrosion_tpu.faults import (
+    EquivocatingPeer,
+    FaultController,
+    FaultPlan,
+    LoopStall,
+)
+
+# the simdiff/chaos time base: one kernel tick ≈ the agents' broadcast
+# flush interval (launch_test_agent pins bcast_flush_interval=0.02)
+TICK_S = 0.02
+
+FAMILIES = (
+    "clock_skew",
+    "asym_partition",
+    "slow_io",
+    "equivocation",
+    "compound",
+)
+
+
+def build_plan(family: str, seed: int, heal_after: float,
+               stall_ms: float) -> FaultPlan:
+    """The seeded FaultPlan for one matrix cell.  Parameters sit at
+    the aggressive end of what a WAN deployment sees: 200 ms skew
+    straddles the 300 ms max-delta rule once drift accumulates, 2–6 ms
+    per-IO delays are a saturated disk, a ~stall_ms loop stall is a GC
+    pause / noisy neighbor."""
+    if family == "clock_skew":
+        return FaultPlan(
+            seed=seed,
+            clock_skew_max_ns=200_000_000,  # ±200 ms constant offset
+            clock_drift_max_ppm=200.0,      # ±200 ppm linear drift
+        )
+    if family == "asym_partition":
+        return FaultPlan(
+            seed=seed,
+            partition_blocks=2,
+            oneway_blocks=((0, 1),),  # block0 → block1 severed only
+            heal_after=heal_after,
+        )
+    if family == "slow_io":
+        return FaultPlan(
+            seed=seed,
+            disk_write_delay=0.002,
+            disk_write_jitter=0.004,
+            disk_read_delay=0.002,
+            disk_read_jitter=0.004,
+            loop_stalls=(LoopStall("n0", at=0.05, duration_ms=stall_ms),),
+        )
+    if family == "equivocation":
+        return FaultPlan(seed=seed)
+    if family == "compound":
+        return FaultPlan(
+            seed=seed,
+            drop=0.05,
+            partition_blocks=2,
+            oneway_blocks=((0, 1),),
+            heal_after=heal_after,
+            clock_skew_max_ns=150_000_000,
+        )
+    raise ValueError(f"unknown scenario family {family!r}")
+
+
+def sim_prediction(family: str, n: int, heal_after: float,
+                   seeds: int = 8) -> Dict:
+    """The epidemic kernel's prediction for the cell, with its
+    modeling residual named.  The kernel models loss + SYMMETRIC
+    partitions; skew / slow IO / equivocation do not change its
+    message dynamics, so those cells compare against the fault-free
+    (or loss-only) prediction and record the residual."""
+    from corrosion_tpu.sim.chaos import sim_chaos_trace
+    from corrosion_tpu.sim.obs import sim_obs_trace
+
+    heal_tick = max(1, int(round(heal_after / TICK_S)))
+    if family in ("asym_partition", "compound"):
+        loss = 0.05 if family == "compound" else 0.0
+        pred = sim_chaos_trace(
+            n, loss=loss, partition_blocks=2, heal_tick=heal_tick,
+            seeds=seeds,
+        )
+        pred["residual"] = (
+            "kernel partitions are symmetric; the live cell severs one "
+            "direction only, so its reachable direction keeps flowing "
+            "and live convergence reads at or below this prediction"
+        )
+        return pred
+    pred = sim_obs_trace(n, seeds=seeds)
+    pred["residual"] = (
+        "the kernel does not model clock skew / disk latency / hostile "
+        "peers — they alter timestamps, lock holds and screening, not "
+        "the message dynamics — so the cell compares against the "
+        "fault-free prediction"
+    )
+    return pred
+
+
+async def _deliver(agent, cv, source) -> None:
+    """Feed one crafted changeset into an agent's REAL ingest pipeline
+    (bounded queue → change loop → apply workers), loop-affine."""
+    agent.enqueue_change(cv, source)
+
+
+async def _run_hostile_attack(agents: Dict[str, "object"],
+                              seed: int, wait_for) -> Dict:
+    """The equivocating-peer script: bait → conflicting re-send (split
+    across the broadcast and sync detection sites) → replayed
+    duplicates → garbage spans (from a SECOND hostile actor, since the
+    first is quarantined the moment its conflict is seen) →
+    post-quarantine probe.  Returns what the harness knows
+    ground-truth about, for the cell's gates."""
+    from corrosion_tpu.types import ChangeSource
+
+    peer = EquivocatingPeer(seed=seed)
+    spanner = EquivocatingPeer(seed=seed + 1000)
+    targets = list(agents.values())
+    # the hostile peers "joined" the cluster before turning: make them
+    # members everywhere so quarantine has a record to mark (and the
+    # admin cluster_members output a row to show)
+    for a in targets:
+        a.members.upsert(peer.actor_id, ("127.0.0.1", 9))
+        a.members.upsert(spanner.actor_id, ("127.0.0.1", 10))
+
+    def all_contain(version: int):
+        return all(
+            a.bookie.for_actor(peer.actor_id).contains_version(version)
+            for a in targets
+        )
+
+    # 1. bait: a well-formed version accepted everywhere
+    bait = peer.honest(9100, "bait")
+    for a in targets:
+        await _deliver(a, bait, ChangeSource.BROADCAST)
+    await wait_for(lambda: all_contain(1), timeout=20)
+
+    # 2. conflicting contents for ONE version: content A accepted
+    #    everywhere first, then content B re-claims it on the gossip
+    #    path.  Detection is BROADCAST-scope by design: gossiped bytes
+    #    are immutable per version, while sync re-serves legitimately
+    #    reflect serve-time compaction (docs/faults.md)
+    a_cv, b_cv = peer.conflicting_pair(9101)
+    for a in targets:
+        await _deliver(a, a_cv, ChangeSource.BROADCAST)
+    await wait_for(lambda: all_contain(2), timeout=20)
+    for a in targets:
+        await _deliver(a, b_cv, ChangeSource.BROADCAST)
+    # replayed duplicates of the ACCEPTED content: absorbed on both
+    # paths, never counted as equivocation
+    for i, a in enumerate(targets):
+        src = ChangeSource.BROADCAST if i % 2 == 0 else ChangeSource.SYNC
+        await _deliver(a, a_cv, src)
+
+    # 3. garbage seq spans (screened before any buffering) — from the
+    #    second hostile actor, which is not yet quarantined
+    garbage = spanner.garbage_span(9102)
+    wide = spanner.absurd_width(9103)
+    for a in targets:
+        await _deliver(a, garbage, ChangeSource.BROADCAST)
+        await _deliver(a, wide, ChangeSource.SYNC)
+
+    # 4. wait for every node to have detected + quarantined BOTH
+    def all_quarantined():
+        return all(
+            peer.actor_id in a._equiv_quarantined
+            and spanner.actor_id in a._equiv_quarantined
+            for a in targets
+        )
+
+    await wait_for(all_quarantined, timeout=20)
+
+    # 5. post-quarantine probe: a fresh well-formed version must DROP
+    post = peer.honest(9104, "post-quarantine")
+    for a in targets:
+        await _deliver(a, post, ChangeSource.BROADCAST)
+
+    return {
+        "actor": peer.actor_id.hex(),
+        "span_actor": spanner.actor_id.hex(),
+        "accepted_versions": [1, 2],
+        "post_quarantine_version": int(post.changeset.version),
+    }
+
+
+async def agent_scenario_cell(
+    family: str,
+    n: int = 9,
+    seed: int = 0,
+    writes: int = 6,
+    heal_after: float = 0.8,
+    stall_ms: float = 150.0,
+    timeout: float = 60.0,
+    base_dir: Optional[str] = None,
+) -> Dict:
+    """Run one matrix cell on a live cluster; returns the measurement
+    record with its ``gates`` dict (every gate must be True)."""
+    from corrosion_tpu.agent.testing import seed_full_membership, wait_for
+    from corrosion_tpu.devcluster import (
+        ClusterObserver,
+        Topology,
+        run_inprocess,
+        run_stall_schedule,
+    )
+
+    plan = build_plan(family, seed, heal_after, stall_ms)
+    ctrl = FaultController(plan)
+    topo = Topology.parse("\n".join(f"n0 -> n{i}" for i in range(1, n)))
+    agents = await run_inprocess(
+        topo,
+        base_dir=base_dir,
+        faults=ctrl,
+        ring0_enabled=False,   # uniform sampling: the kernel's model
+        subs_enabled=False,
+        api_port=None,
+        uni_cache_size=16,
+        suspect_timeout=10.0,  # faults must not down-mark the cluster
+        breaker_cooldown=0.5,
+    )
+    stall_task = None
+    try:
+        await wait_for(
+            lambda: all(
+                len(a.members.alive()) == n - 1 for a in agents.values()
+            ),
+            timeout=max(30.0, 2.0 * n),
+        )
+        seed_full_membership(list(agents.values()))
+        obs = ClusterObserver(agents)
+        obs.mark()
+
+        # stall-probe sample cursor per node: the boot of N in-process
+        # agents stalls the shared loop too (synchronous schema DDL),
+        # so the stall gate must look only at samples recorded AFTER
+        # the schedule arms.  The cursor is the CUMULATIVE histogram
+        # count (monotone, trim-immune) — the value ring itself trims
+        # past ~1279 samples, so a stored index would drift
+        def _stall_ring(a):
+            rings = a.metrics.histogram_samples("corro_loop_stall_ms")
+            return next(iter(rings.values()), [])
+
+        def _stall_count(a):
+            n, _s = a.metrics.histogram_stats("corro_loop_stall_ms")
+            return n
+
+        pre_stall_counts = {
+            name: _stall_count(a) for name, a in agents.items()
+        }
+
+        def _new_stall_samples(name):
+            a = agents[name]
+            n_new = _stall_count(a) - pre_stall_counts[name]
+            if n_new <= 0:
+                return []
+            return _stall_ring(a)[-n_new:]
+
+        ctrl.restart_clock()
+        if plan.partition_blocks > 1:
+            ctrl.split()
+        if plan.loop_stalls:
+            stall_task = asyncio.ensure_future(run_stall_schedule(ctrl))
+
+        hostile = None
+        if family == "equivocation":
+            hostile = await _run_hostile_attack(agents, seed, wait_for)
+
+        # spread write workload; under a partition, one writer per
+        # block so only post-heal machinery can reach the union.  The
+        # second writer is the FIRST index whose block differs
+        # (block_of is idx*blocks//n — ceil(n/blocks), not n//blocks)
+        names = list(agents)
+        if plan.partition_blocks > 1:
+            other = next(
+                i for i in range(n)
+                if plan.block_of(i, n) != plan.block_of(0, n)
+            )
+            writers = [names[0], names[other]]
+        else:
+            writers = names[:: max(1, n // 3)]
+        t0 = time.perf_counter()
+        versions = []
+        for w in range(writes):
+            origin = agents[writers[w % len(writers)]]
+            res = await asyncio.to_thread(
+                origin.execute_transaction,
+                [("INSERT INTO tests (id, text) VALUES (?, ?)",
+                  (8000 + w, f"{family}-{w}"))],
+            )
+            versions.append((origin.actor_id, res["version"]))
+            await asyncio.sleep(0.02)
+
+        def converged() -> bool:
+            for a in agents.values():
+                for actor, v in versions:
+                    if a.actor_id != actor and not a.bookie.for_actor(
+                        actor
+                    ).contains_version(v):
+                        return False
+            return True
+
+        converged_ok = True
+        try:
+            await wait_for(converged, timeout=timeout, interval=0.02)
+        except TimeoutError:
+            # a non-converging cell is a RESULT, not a crash: record
+            # the failed gate so the campaign artifact names it
+            converged_ok = False
+        wall = time.perf_counter() - t0
+        if stall_task is not None:
+            try:
+                await asyncio.wait_for(stall_task, timeout=timeout)
+            except asyncio.TimeoutError:
+                stall_task.cancel()
+            stall_task = None
+
+        scrape = obs.scrape()
+        lag = obs.convergence_lag()
+        nodiv = obs.no_divergence()
+        equiv = obs.equivocations(scrape)
+        loop_health = obs.loop_health(scrape)
+
+        gates = {
+            "converged": converged_ok,
+            "no_divergence": nodiv["ok"],
+            # the provenance negative-lag clamp: a skewed-ahead origin
+            # must clamp to 0, never record negative
+            "lags_non_negative": all(
+                s >= 0.0
+                for a in agents.values()
+                for ring in a.metrics.histogram_samples(
+                    "corro_change_lag_seconds"
+                ).values()
+                for s in ring
+            ),
+        }
+        detail: Dict = {}
+        if family in ("clock_skew", "compound"):
+            skews = {
+                name: plan.node_clock(name)[0] for name in agents
+            }
+            gates["skew_applied"] = any(abs(v) > 0 for v in skews.values())
+            detail["clock_skew_ns"] = skews
+        if family == "asym_partition" or family == "compound":
+            gates["partition_fired"] = ctrl.injected["partition"] > 0
+        if family == "slow_io":
+            gates["disk_delays_fired"] = ctrl.injected["disk"] > 0
+            gates["stall_injected"] = ctrl.injected["stall"] >= len(
+                plan.loop_stalls
+            )
+            # the agents' OWN probe must have seen the injected stall —
+            # judged on post-boot samples only (the sample cursor)
+            gates["stall_observed"] = any(
+                max(_new_stall_samples(name), default=0.0)
+                >= 0.5 * stall_ms
+                for name in agents
+            )
+        if family == "equivocation":
+            hostile_actors = [
+                bytes.fromhex(hostile["actor"]),
+                bytes.fromhex(hostile["span_actor"]),
+            ]
+            gates["content_detected"] = equiv.get("content", 0) >= 1
+            gates["span_detected"] = equiv.get("span", 0) >= 1
+            gates["hostile_quarantined_everywhere"] = all(
+                actor in a._equiv_quarantined
+                and (a.members.get(actor) is not None
+                     and a.members.get(actor).quarantined
+                     and a.members.get(actor).quarantine_reason
+                     == "equivocation")
+                for a in agents.values()
+                for actor in hostile_actors
+            )
+            # zero divergent rows: no node ever applied the conflicting
+            # re-send, the garbage spans, or post-quarantine traffic
+            def _count_like(a, pat):
+                _, rows = a.storage.read_query(
+                    "SELECT COUNT(*) FROM tests WHERE text LIKE ?",
+                    (pat,),
+                )
+                return rows[0][0]
+
+            gates["zero_divergent_rows"] = all(
+                _count_like(a, "equiv-b-%") == 0
+                and _count_like(a, "garbage-%") == 0
+                and _count_like(a, "wide-%") == 0
+                and _count_like(a, "post-quarantine") == 0
+                for a in agents.values()
+            )
+            detail["hostile"] = hostile
+            detail["equivocations"] = equiv
+
+        return {
+            "family": family,
+            "n_nodes": n,
+            "seed": seed,
+            "writes": writes,
+            "wall_to_converge_s": round(wall, 3),
+            "live_p99_s": lag.get("p99_s"),
+            "live_p50_s": lag.get("p50_s"),
+            "lag_samples": lag.get("count", 0),
+            "msgs_per_node": round(obs.msgs_per_node(scrape), 2),
+            "loop_health": loop_health,
+            "injected": dict(ctrl.injected),
+            "no_divergence": nodiv,
+            "gates": gates,
+            "passed": all(gates.values()),
+            "detail": detail,
+        }
+    finally:
+        if stall_task is not None and not stall_task.done():
+            stall_task.cancel()
+            try:
+                await stall_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        for a in list(agents.values()):
+            try:
+                await a.stop()
+            except Exception:
+                pass
+
+
+async def run_scenarios(
+    n: int = 32,
+    seed: int = 0,
+    families: Optional[List[str]] = None,
+    sim_seeds: int = 8,
+    heal_after: float = 0.64,
+    out_path: Optional[str] = None,
+    base_dir: Optional[str] = None,
+    sim: bool = True,
+) -> Dict:
+    """The campaign: every family's cell on a live N-node cluster next
+    to the kernel prediction, one JSON artifact, all gates asserted
+    in-record."""
+    import os
+
+    families = list(families or FAMILIES)
+    unknown = [f for f in families if f not in FAMILIES]
+    if unknown:
+        # validate UP FRONT: a typo must not surface mid-campaign
+        # after earlier N=32 cells already burned their minutes
+        raise ValueError(
+            f"unknown scenario families {unknown}; valid: {FAMILIES}"
+        )
+    results = {}
+    for family in families:
+        # seed offset by the family's FIXED position in FAMILIES, not
+        # its position in a --scenario-families subset: replaying one
+        # failing cell must reproduce the matrix run's exact draws
+        i = FAMILIES.index(family)
+        cell_dir = (
+            os.path.join(base_dir, family) if base_dir else None
+        )
+        prediction = (
+            sim_prediction(family, n, heal_after, seeds=sim_seeds)
+            if sim else None
+        )
+        try:
+            cell = await agent_scenario_cell(
+                family, n=n, seed=seed + i, heal_after=heal_after,
+                base_dir=cell_dir,
+                timeout=120.0,
+            )
+        except Exception as e:  # noqa: BLE001 - one cell crashing
+            # must not discard the completed cells' results
+            cell = {
+                "family": family,
+                "n_nodes": n,
+                "seed": seed + i,
+                "error": f"{type(e).__name__}: {e}",
+                "live_p99_s": None,
+                "msgs_per_node": None,
+                "no_divergence": {"ok": False, "violations": []},
+                "gates": {"converged": False},
+                "passed": False,
+            }
+        pred_p99 = None
+        if prediction is not None:
+            pred_p99 = prediction.get("predicted_wall_p99_s")
+            if pred_p99 is None and prediction.get(
+                "ticks_to_converge_p99"
+            ) is not None:
+                pred_p99 = prediction["ticks_to_converge_p99"] * TICK_S
+        results[family] = {
+            "agents": cell,
+            "sim": prediction,
+            "diff": {
+                "live_p99_s": cell["live_p99_s"],
+                "kernel_predicted_wall_p99_s": pred_p99,
+                "msgs_per_node_live": cell["msgs_per_node"],
+                "msgs_per_node_kernel": (
+                    prediction.get("msgs_per_node")
+                    if prediction else None
+                ),
+            },
+        }
+
+    all_passed = all(r["agents"]["passed"] for r in results.values())
+    no_div = all(
+        r["agents"]["no_divergence"]["ok"] for r in results.values()
+    )
+    out = {
+        "n_nodes": n,
+        "metric": "adversarial_scenario_matrix",
+        "families": list(results),
+        "all_cells_converged": all(
+            r["agents"]["gates"].get("converged", False)
+            for r in results.values()
+        ),
+        "no_divergence_all_cells": no_div,
+        "all_gates_passed": all_passed,
+        "tick_seconds": TICK_S,
+        "cells": results,
+    }
+    if not all_passed:
+        out["error"] = "one or more scenario gates failed"
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1, allow_nan=False)
+            f.write("\n")
+    return out

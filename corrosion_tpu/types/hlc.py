@@ -57,6 +57,27 @@ class Timestamp(int):
         return f"Timestamp(phys_ns={self.physical_ns}, logical={self.logical})"
 
 
+def skewed_now_ns(offset_ns: int = 0, drift: float = 0.0,
+                  base=time.time_ns):
+    """A ``now_ns`` source with a constant offset plus linear drift —
+    the clock-skew fault seam (``faults.FaultPlan.node_clock``).
+
+    ``drift`` is a ratio (e.g. ``50e-6`` = +50 ppm) applied to time
+    elapsed since this factory was called, so a long-running node's
+    clock error grows the way a real bad oscillator's does.  With both
+    parameters zero the base source is returned untouched (the
+    production path pays nothing)."""
+    if not offset_ns and not drift:
+        return base
+    t0 = base()
+
+    def now_ns() -> int:
+        t = base()
+        return int(t + offset_ns + (t - t0) * drift)
+
+    return now_ns
+
+
 class ClockDriftError(Exception):
     """Remote timestamp too far ahead of local physical time."""
 

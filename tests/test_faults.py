@@ -128,6 +128,151 @@ def test_unregistered_destination_is_never_faulted():
 
 
 # ---------------------------------------------------------------------------
+# the adversarial families: determinism + round-trip (scenario matrix)
+# ---------------------------------------------------------------------------
+
+
+def _adversarial_plan(seed=21):
+    from corrosion_tpu.faults import LoopStall
+
+    return FaultPlan(
+        seed=seed,
+        drop=0.1,
+        partition_blocks=2,
+        oneway_blocks=((0, 1),),
+        clock_skew_max_ns=200_000_000,
+        clock_drift_max_ppm=150.0,
+        disk_write_delay=0.001,
+        disk_write_jitter=0.002,
+        disk_read_delay=0.0005,
+        disk_read_jitter=0.001,
+        loop_stalls=(LoopStall("n0", at=0.1, duration_ms=80.0),),
+        crashes=(CrashEvent("n1", at=0.5, restart_at=1.0),),
+    )
+
+
+def test_new_families_replay_byte_identical():
+    """The PR 2 determinism property extended to the new families: the
+    full decision stream — link draws, one-way partition drops, and
+    slow-disk delays — is byte-identical across replays, and per-node
+    clock skew re-derives identically."""
+    plan = _adversarial_plan()
+
+    def drive(ctrl):
+        for i, name in enumerate("abcd"):
+            ctrl.register(name, ("127.0.0.1", i + 1))
+        ctrl.start()
+        ctrl.split()
+        hooks = {n: ctrl.hook_for(n) for n in "abcd"}
+        io = {n: ctrl.io_hook_for(n) for n in "abcd"}
+        for _ in range(40):
+            hooks["a"]("uni", ("127.0.0.1", 3))  # severed direction
+            hooks["c"]("uni", ("127.0.0.1", 1))  # open direction
+            hooks["b"]("bi", ("127.0.0.1", 4))
+            io["a"]("write")
+            io["c"]("read")
+        ctrl.heal()
+        for _ in range(20):
+            hooks["a"]("uni", ("127.0.0.1", 3))
+            io["a"]("write")
+        return bytes(ctrl.decision_log), dict(ctrl.injected)
+
+    log1, inj1 = drive(FaultController(plan))
+    log2, inj2 = drive(FaultController(plan))
+    assert log1 == log2
+    assert inj1 == inj2
+    assert inj1["partition"] > 0 and inj1["disk"] > 0
+    # clock skew is derived, not drawn: identical across controllers,
+    # distinct across nodes, bounded by the plan
+    skews = [plan.node_clock(f"n{i}") for i in range(8)]
+    assert skews == [plan.node_clock(f"n{i}") for i in range(8)]
+    assert len({s[0] for s in skews}) > 1
+    for off, drift in skews:
+        assert abs(off) <= plan.clock_skew_max_ns
+        assert abs(drift) <= plan.clock_drift_max_ppm * 1e-6
+    # a different seed re-derives differently
+    other = _adversarial_plan(seed=22)
+    assert other.node_clock("n0") != plan.node_clock("n0")
+
+
+def test_oneway_partition_is_directional():
+    """One-way block matrices: only the listed (src_block, dst_block)
+    directions sever; symmetric plans (no matrix) sever both."""
+    plan = _adversarial_plan()
+    ctrl = FaultController(plan)
+    for i, name in enumerate(("a", "b")):  # a → block 0, b → block 1
+        ctrl.register(name, ("127.0.0.1", i + 1))
+    ctrl.start()
+    ctrl.split()
+    assert ctrl.filter("a", "b", "uni").reason == "partition"
+    act = ctrl.filter("b", "a", "partition_check")
+    assert not act.drop  # reverse direction open — incl. the TOCTOU probe
+    ctrl.heal()
+    assert ctrl.filter("a", "b", "partition_check").drop is False
+
+    sym = FaultPlan(seed=1, partition_blocks=2)
+    sctrl = FaultController(sym)
+    for i, name in enumerate(("a", "b")):
+        sctrl.register(name, ("127.0.0.1", i + 1))
+    sctrl.start()
+    sctrl.split()
+    assert sctrl.filter("a", "b", "uni").reason == "partition"
+    assert sctrl.filter("b", "a", "uni").reason == "partition"
+
+
+def test_as_dict_round_trips_all_fault_families():
+    """FaultController.as_dict → FaultPlan.from_dict reconstructs the
+    identical plan (every new field included), so a replay can be
+    driven from an admin `faults` dump."""
+    plan = _adversarial_plan()
+    ctrl = FaultController(plan)
+    ctrl.register("a", ("127.0.0.1", 1))
+    d = ctrl.as_dict()
+    assert FaultPlan.from_dict(d) == plan
+    # and it is JSON-clean (the admin socket ships it as JSON)
+    import json
+
+    assert FaultPlan.from_dict(json.loads(json.dumps(d))) == plan
+
+
+def test_io_decisions_are_seeded_and_bounded():
+    plan = _adversarial_plan()
+    ds = [plan.io_decision("n0", "write", n) for n in range(100)]
+    assert ds == [plan.io_decision("n0", "write", n) for n in range(100)]
+    for d in ds:
+        assert plan.disk_write_delay <= d <= (
+            plan.disk_write_delay + plan.disk_write_jitter
+        )
+    # distinct per node and per op
+    assert ds != [plan.io_decision("n1", "write", n) for n in range(100)]
+    reads = [plan.io_decision("n0", "read", n) for n in range(100)]
+    for d in reads:
+        assert plan.disk_read_delay <= d <= (
+            plan.disk_read_delay + plan.disk_read_jitter
+        )
+
+
+def test_storage_io_fault_seam_consults_hook(tmp_path):
+    """CrConn.io_fault is consulted once per write batch and once per
+    change collection — the slow-disk injection seams."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+
+    a = make_offline_agent(tmpdir=str(tmp_path))
+    try:
+        calls = []
+        a.storage.io_fault = lambda op: calls.append(op) or 0.0
+        a.execute_transaction([
+            ("INSERT INTO tests (id, text) VALUES (1, 'x')",)
+        ])
+        assert "write" in calls
+        calls.clear()
+        a.storage.collect_changes((1, 10))
+        assert calls == ["read"]
+    finally:
+        a.storage.close()
+
+
+# ---------------------------------------------------------------------------
 # backoff retry helper
 # ---------------------------------------------------------------------------
 

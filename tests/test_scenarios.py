@@ -1,0 +1,480 @@
+"""Adversarial scenario matrix: campaign cells + no-divergence.
+
+One small, short cell per fault family runs in tier-1 (N≤9 —
+``sim/scenarios.py agent_scenario_cell`` with every gate asserted);
+the full N=32 matrix is ``@slow`` and feeds ``SCENARIOS_N32.json``
+via ``bench.py --scenarios``.  Unit-level coverage of the pieces the
+cells compose — the one-way ``open_bi`` TOCTOU recheck, the HLC
+max-delta rule under injected skew, equivocation observability through
+the admin surface, and the no-divergence checker actually catching a
+seeded divergence — lives here too.
+"""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.faults import (
+    EquivocatingPeer,
+    FaultController,
+    FaultPlan,
+)
+
+
+@pytest.fixture
+def run():
+    def _run(coro):
+        return asyncio.run(coro)
+
+    return _run
+
+
+# ---------------------------------------------------------------------------
+# tier-1 matrix cells (one per family, small N, every gate asserted)
+# ---------------------------------------------------------------------------
+
+
+def _cell(run, tmp_path, family, **kw):
+    from corrosion_tpu.sim.scenarios import agent_scenario_cell
+
+    kwargs = dict(
+        n=5, seed=3, writes=4, heal_after=0.5, stall_ms=150.0,
+        timeout=45.0, base_dir=str(tmp_path),
+    )
+    kwargs.update(kw)
+    result = run(agent_scenario_cell(family, **kwargs))
+    assert result["passed"], result["gates"]
+    assert result["no_divergence"]["ok"], result["no_divergence"]
+    assert result["live_p99_s"] is not None and result["live_p99_s"] >= 0
+    return result
+
+
+def test_scenario_cell_clock_skew(run, tmp_path):
+    r = _cell(run, tmp_path, "clock_skew")
+    # the skew family must actually skew: at least one node's derived
+    # offset is nonzero, and no recorded lag ever went negative
+    assert any(v != 0 for v in r["detail"]["clock_skew_ns"].values())
+
+
+def test_scenario_cell_asym_partition(run, tmp_path):
+    r = _cell(run, tmp_path, "asym_partition")
+    assert r["injected"]["partition"] > 0
+
+
+def test_scenario_cell_slow_io(run, tmp_path):
+    r = _cell(run, tmp_path, "slow_io")
+    assert r["injected"]["disk"] > 0
+    assert r["injected"]["stall"] == 1
+
+
+def test_scenario_cell_equivocation(run, tmp_path):
+    r = _cell(run, tmp_path, "equivocation")
+    eq = r["detail"]["equivocations"]
+    assert eq.get("content", 0) >= 1
+    assert eq.get("span", 0) >= 1
+    assert eq.get("quarantined", 0) >= 1  # post-quarantine drops
+
+
+def test_scenario_cell_compound(run, tmp_path):
+    r = _cell(run, tmp_path, "compound")
+    assert r["injected"]["partition"] > 0
+
+
+# ---------------------------------------------------------------------------
+# one-way open_bi TOCTOU: a partition arming mid-connect must not hand
+# back a live session in the (freshly) blocked direction
+# ---------------------------------------------------------------------------
+
+
+def test_openbi_oneway_toctou(run, tmp_path):
+    async def main():
+        from corrosion_tpu.devcluster import Topology, run_inprocess
+        from corrosion_tpu.agent.testing import wait_for
+
+        plan = FaultPlan(
+            seed=1, partition_blocks=2, oneway_blocks=((0, 1),),
+        )
+        ctrl = FaultController(plan)
+        topo = Topology.parse("n0 -> n1")
+        agents = await run_inprocess(
+            topo, base_dir=str(tmp_path), faults=ctrl,
+            subs_enabled=False, api_port=None,
+        )
+        try:
+            await wait_for(
+                lambda: all(
+                    len(a.members.alive()) == 1 for a in agents.values()
+                ),
+                timeout=20,
+            )
+            n0, n1 = agents["n0"], agents["n1"]
+            # wrap n0's hook: the FIRST "bi" consult passes (pre-split
+            # state), then the split arms while the connect is in
+            # flight — the TOCTOU window.  The post-connect
+            # partition_check recheck must refuse the session.
+            inner = n0.transport.fault_filter
+            armed = {"done": False}
+
+            def racing_hook(channel, addr):
+                act = inner(channel, addr)
+                if channel == "bi" and not armed["done"]:
+                    armed["done"] = True
+                    ctrl.split()
+                return act
+
+            n0.transport.fault_filter = racing_hook
+            with pytest.raises(OSError):
+                await n0.transport.open_bi(tuple(n1.gossip_addr))
+            assert armed["done"]
+            # the REVERSE direction stays open: n1 → n0 is not in the
+            # one-way block matrix, sessions flow while the partition
+            # is active
+            chan = await n1.transport.open_bi(tuple(n0.gossip_addr))
+            assert chan is not None
+        finally:
+            for a in agents.values():
+                try:
+                    await a.stop()
+                except Exception:
+                    pass
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# HLC max-delta regression under injected skew
+# ---------------------------------------------------------------------------
+
+
+def test_hlc_rejects_updates_beyond_max_delta():
+    """The 300 ms gossip clock-delta rule (types/hlc.py): a remote
+    timestamp generated by a clock skewed past max_delta_ns is
+    rejected — the local clock never ingests it — while a skew inside
+    the bound merges normally."""
+    import time
+
+    from corrosion_tpu.types.hlc import (
+        MAX_CLOCK_DELTA_NS,
+        ClockDriftError,
+        HLClock,
+        skewed_now_ns,
+    )
+
+    local = HLClock()
+    ahead = HLClock(now_ns=skewed_now_ns(MAX_CLOCK_DELTA_NS + 200_000_000))
+    ts = ahead.new_timestamp()
+    before = int(local.last)
+    with pytest.raises(ClockDriftError):
+        local.update_with_timestamp(ts)
+    assert int(local.last) == before  # rejected, not ingested
+
+    slightly_ahead = HLClock(now_ns=skewed_now_ns(50_000_000))
+    ts2 = slightly_ahead.new_timestamp()
+    local.update_with_timestamp(ts2)  # inside the bound: merges
+    assert int(local.last) == int(ts2)
+
+    # drift accumulates: a 1%-fast clock pulls ahead of its base
+    base = time.time_ns()
+    fast = skewed_now_ns(0, 0.01, base=time.time_ns)
+    time.sleep(0.05)
+    assert fast() > time.time_ns()
+
+
+def test_agent_survives_skewed_changeset_and_clamps_lag(tmp_path):
+    """A changeset stamped by a skewed-AHEAD origin clock: the data
+    still applies (convergence must not hinge on a peer's oscillator),
+    the local HLC rejects the merge, and the provenance lag clamps to
+    0 instead of going negative (the PR 6 negative-lag clamp)."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.types import ChangeSource
+
+    a = make_offline_agent(tmpdir=str(tmp_path))
+    try:
+        peer = EquivocatingPeer(seed=7)
+        cv = peer.honest(1, "from-the-future")
+        # re-stamp the changeset 2 s in the future (~a badly skewed
+        # origin), far past the 300 ms delta rule
+        import dataclasses
+        import time
+
+        from corrosion_tpu.types.hlc import Timestamp
+
+        future_ts = Timestamp.pack(time.time_ns() + 2_000_000_000, 0)
+        cv = dataclasses.replace(
+            cv, changeset=dataclasses.replace(cv.changeset, ts=future_ts)
+        )
+        before = int(a.clock.last)
+        assert a.handle_change(cv, ChangeSource.SYNC, rebroadcast=False)
+        assert int(a.clock.last) == before  # merge rejected
+        # the row applied anyway
+        _, rows = a.storage.read_query(
+            "SELECT text FROM tests WHERE id=1"
+        )
+        assert rows == [("from-the-future",)]
+        # provenance lag clamped at 0, never negative
+        rings = a.metrics.histogram_samples("corro_change_lag_seconds")
+        samples = [s for ring in rings.values() for s in ring]
+        assert samples and all(s == 0.0 for s in samples)
+    finally:
+        a.storage.close()
+
+
+# ---------------------------------------------------------------------------
+# equivocation observability: counter + quarantine reason in admin output
+# ---------------------------------------------------------------------------
+
+
+def test_equivocation_admin_observability(tmp_path):
+    from corrosion_tpu.agent.admin import _handle
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.types import ChangeSource
+
+    a = make_offline_agent(tmpdir=str(tmp_path))
+    try:
+        peer = EquivocatingPeer(seed=5)
+        a.members.upsert(peer.actor_id, ("127.0.0.1", 9))
+        ca, cb = peer.conflicting_pair(1)
+        assert a.handle_change(ca, ChangeSource.BROADCAST,
+                               rebroadcast=False)
+        assert not a.handle_change(cb, ChangeSource.BROADCAST,
+                                   rebroadcast=False)
+        assert a.metrics.get_counter(
+            "corro_sync_equivocations_total", kind="content"
+        ) == 1
+        members = _handle(a, {"cmd": "cluster_members"})["ok"]
+        row = next(
+            m for m in members if m["actor"] == peer.actor_id.hex()
+        )
+        assert row["quarantined"] is True
+        assert row["quarantine_reason"] == "equivocation"
+        # a transport-breaker "restore" must NOT clear the verdict
+        a.members.quarantine_by_addr(("127.0.0.1", 9), False)
+        assert a.members.get(peer.actor_id).quarantined
+        # the rendered exposition carries the counter (the scrape
+        # surface ClusterObserver.equivocations pools)
+        from corrosion_tpu.agent.metrics import parse_prometheus_text
+
+        parsed = parse_prometheus_text(
+            a.metrics.render(a.metric_gauges())
+        )
+        fam = parsed["corro_sync_equivocations_total"]
+        assert any(
+            labels.get("kind") == "content" and v == 1
+            for _n, labels, v in fam["samples"]
+        )
+    finally:
+        a.storage.close()
+
+
+def test_sync_reserve_content_drift_is_not_equivocation(tmp_path):
+    """BROADCAST scope of content detection: a sync re-serve of an
+    already-held version with DIFFERENT contents is legitimate — the
+    serve path reconstructs versions from the current tables, so later
+    overwrites shrink/change a re-collected changeset.  Comparing
+    across paths would quarantine honest origins under ordinary
+    overwrite workloads; the sync duplicate must absorb silently."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.types import ChangeSource
+
+    a = make_offline_agent(tmpdir=str(tmp_path))
+    try:
+        peer = EquivocatingPeer(seed=13)
+        ca, cb = peer.conflicting_pair(1)
+        assert a.handle_change(ca, ChangeSource.BROADCAST,
+                               rebroadcast=False)
+        # same (actor, version), different content, SYNC source — a
+        # compacted re-serve shape: absorbed, no detection
+        assert not a.handle_change(cb, ChangeSource.SYNC,
+                                   rebroadcast=False)
+        assert a.metrics.get_counter_sum(
+            "corro_sync_equivocations_total"
+        ) == 0
+        assert peer.actor_id not in a._equiv_quarantined
+        # ...while the same conflicting content on the GOSSIP path is
+        # hostile (gossiped bytes are immutable per version)
+        assert not a.handle_change(cb, ChangeSource.BROADCAST,
+                                   rebroadcast=False)
+        assert a.metrics.get_counter(
+            "corro_sync_equivocations_total", kind="content"
+        ) == 1
+        # and a version first applied from SYNC records no digest, so
+        # its later (legit, differing) broadcast never false-positives
+        peer2 = EquivocatingPeer(seed=14)
+        sa, sb = peer2.conflicting_pair(1)
+        assert a.handle_change(sa, ChangeSource.SYNC, rebroadcast=False)
+        assert not a.handle_change(sb, ChangeSource.BROADCAST,
+                                   rebroadcast=False)
+        assert peer2.actor_id not in a._equiv_quarantined
+    finally:
+        a.storage.close()
+
+
+def test_equivocation_quarantine_expires_and_rearms(tmp_path):
+    """The verdict is a bounded window (attribution is unsigned, so a
+    framed honest actor must not be severed forever): traffic drops
+    while it holds, re-admits after `equiv_quarantine_s` (member
+    restored), and a real equivocator's next conflicting re-send
+    re-quarantines immediately (digests survive expiry)."""
+    import time
+
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.types import ChangeSource
+
+    a = make_offline_agent(tmpdir=str(tmp_path), equiv_quarantine_s=0.2)
+    try:
+        peer = EquivocatingPeer(seed=17)
+        a.members.upsert(peer.actor_id, ("127.0.0.1", 9))
+        ca, cb = peer.conflicting_pair(1)
+        assert a.handle_change(ca, ChangeSource.BROADCAST,
+                               rebroadcast=False)
+        assert not a.handle_change(cb, ChangeSource.BROADCAST,
+                                   rebroadcast=False)
+        assert peer.actor_id in a._equiv_quarantined
+        # while the verdict holds: dropped
+        v2 = peer.honest(2, "held")
+        assert not a.handle_change(v2, ChangeSource.BROADCAST,
+                                   rebroadcast=False)
+        time.sleep(0.25)
+        # expired: re-admitted, member restored
+        v3 = peer.honest(3, "paroled")
+        assert a.handle_change(v3, ChangeSource.BROADCAST,
+                               rebroadcast=False)
+        assert peer.actor_id not in a._equiv_quarantined
+        assert not a.members.get(peer.actor_id).quarantined
+        assert a.metrics.get_counter(
+            "corro_members_quarantine_transitions_total",
+            state="equivocation_expired",
+        ) == 1
+        # re-offense: the surviving digest re-quarantines at once
+        assert not a.handle_change(cb, ChangeSource.BROADCAST,
+                                   rebroadcast=False)
+        assert peer.actor_id in a._equiv_quarantined
+        assert a.members.get(peer.actor_id).quarantine_reason \
+            == "equivocation"
+    finally:
+        a.storage.close()
+
+
+def test_same_batch_conflicting_pair_detected(tmp_path):
+    """A back-to-back conflicting pair landing in ONE merged apply
+    batch is compared directly (no remembered digest exists yet) —
+    the in-batch gate in _apply_complete_group."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.types import ChangeSource
+
+    a = make_offline_agent(tmpdir=str(tmp_path))
+    try:
+        peer = EquivocatingPeer(seed=15)
+        ca, cb = peer.conflicting_pair(1)
+        src = ChangeSource.BROADCAST
+        flags = a._apply_complete_group(
+            peer.actor_id, [ca, cb], [src, src]
+        )
+        assert flags == [True, False]
+        assert a.metrics.get_counter(
+            "corro_sync_equivocations_total", kind="content"
+        ) == 1
+        assert peer.actor_id in a._equiv_quarantined
+        # a byte-identical in-batch replay is NOT equivocation
+        b_peer = EquivocatingPeer(seed=16)
+        bait = b_peer.honest(1, "same")
+        flags = a._apply_complete_group(
+            b_peer.actor_id, [bait, bait], [src, src]
+        )
+        assert flags == [True, False]
+        assert b_peer.actor_id not in a._equiv_quarantined
+    finally:
+        a.storage.close()
+
+
+def test_breaker_quarantine_reason_still_breaker(tmp_path):
+    """The transport-evidence path keeps its reason (and its restore
+    semantics): breaker open → reason 'breaker', half-open success →
+    restored."""
+    from corrosion_tpu.agent.members import Members
+
+    ms = Members(b"self" * 4)
+    actor = b"\x01" * 16
+    ms.upsert(actor, ("127.0.0.1", 7))
+    ms.quarantine_by_addr(("127.0.0.1", 7), True)
+    m = ms.get(actor)
+    assert m.quarantined and m.quarantine_reason == "breaker"
+    ms.quarantine_by_addr(("127.0.0.1", 7), False)
+    assert not ms.get(actor).quarantined
+    assert ms.get(actor).quarantine_reason == ""
+
+
+# ---------------------------------------------------------------------------
+# the no-divergence checker must actually catch divergence
+# ---------------------------------------------------------------------------
+
+
+def test_no_divergence_checker_catches_seeded_divergence(tmp_path):
+    """Feed two agents conflicting contents for one (actor, version),
+    each node seeing only ITS content — the single-node detector is
+    structurally blind here (nothing to compare against locally).  The
+    cluster-level checker must flag both the table-state and
+    conflicting-contents invariants — proving the campaign gate can
+    actually fail, and that cross-node pooling covers the per-node
+    detector's blind spot."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.devcluster import ClusterObserver
+    from corrosion_tpu.types import ChangeSource
+
+    for sub in ("a", "b", "c", "d"):
+        (tmp_path / sub).mkdir()
+    a = make_offline_agent(tmpdir=str(tmp_path / "a"))
+    b = make_offline_agent(tmpdir=str(tmp_path / "b"))
+    try:
+        peer = EquivocatingPeer(seed=11)
+        ca, cb = peer.conflicting_pair(1)
+        assert a.handle_change(ca, ChangeSource.BROADCAST,
+                               rebroadcast=False)
+        assert b.handle_change(cb, ChangeSource.BROADCAST,
+                               rebroadcast=False)
+        obs = ClusterObserver({"a": a, "b": b})
+        nodiv = obs.no_divergence()
+        assert not nodiv["ok"]
+        kinds = {v["kind"] for v in nodiv["violations"]}
+        assert "table_state" in kinds
+        assert "conflicting_contents" in kinds
+
+        # and a genuinely identical pair is clean
+        c = make_offline_agent(tmpdir=str(tmp_path / "c"))
+        d = make_offline_agent(tmpdir=str(tmp_path / "d"))
+        try:
+            honest = EquivocatingPeer(seed=12).honest(1, "same")
+            assert c.handle_change(honest, ChangeSource.BROADCAST,
+                                   rebroadcast=False)
+            assert d.handle_change(honest, ChangeSource.BROADCAST,
+                                   rebroadcast=False)
+            clean = ClusterObserver({"c": c, "d": d}).no_divergence()
+            assert clean["ok"], clean
+        finally:
+            c.storage.close()
+            d.storage.close()
+    finally:
+        a.storage.close()
+        b.storage.close()
+
+
+# ---------------------------------------------------------------------------
+# the full matrix (bench.py --scenarios writes SCENARIOS_N32.json)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_scenario_matrix_n32(run, tmp_path):
+    async def main():
+        from corrosion_tpu.sim.scenarios import run_scenarios
+
+        out = tmp_path / "SCENARIOS_N32.json"
+        result = await run_scenarios(
+            n=32, out_path=str(out), base_dir=str(tmp_path / "cluster")
+        )
+        assert result["all_cells_converged"], result
+        assert result["no_divergence_all_cells"], result
+        assert result["all_gates_passed"], result
+        assert out.exists()
+
+    run(main())
