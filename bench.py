@@ -718,7 +718,9 @@ _PLANE_OFF = {
 
 def _write_overhead_ab(n_tx: int, writers: int,
                        committed=None, measured=None, reps: int = 3,
-                       max_regression: float = 0.05) -> dict:
+                       max_regression: float = 0.05,
+                       off_overrides: dict | None = None,
+                       plane_desc: str | None = None) -> dict:
     """Paired A/B of the observability plane's write-path cost at one
     shape: ``reps`` temporally-adjacent (plane-off, plane-on) pairs of
     combined-mode runs, arm order alternating per pair so warm-up and
@@ -729,10 +731,16 @@ def _write_overhead_ab(n_tx: int, writers: int,
     import statistics
     import tempfile
 
+    if off_overrides is None:
+        off_overrides = _PLANE_OFF
+        plane_desc = (
+            "plane = provenance + broadcast trace propagation "
+            "+ stall probe"
+        )
     pairs = []
     with tempfile.TemporaryDirectory(prefix="corro-write-ab-") as d:
         for rep in range(reps):
-            arms = (("off", _PLANE_OFF), ("on", None))
+            arms = (("off", off_overrides), ("on", None))
             if rep % 2:
                 arms = arms[::-1]
             tx = {}
@@ -752,8 +760,7 @@ def _write_overhead_ab(n_tx: int, writers: int,
         "method": (
             f"paired in-run A/B, {reps} adjacent off/on pairs at the "
             "headline shape (arm order alternating), median per-pair "
-            "ratio; plane = provenance + broadcast trace propagation "
-            "+ stall probe"
+            f"ratio; {plane_desc}"
         ),
         "n_tx": n_tx,
         "writers": writers,
@@ -943,6 +950,35 @@ def run_write_bench(sizes=(1000, 10000), writers=(1, 8, 32),
 
 
 # -- config #1: real 3-node devcluster ---------------------------------
+
+
+def run_timeline_bench(n: int = 32,
+                       out_path: str = "TIMELINE_N32.json") -> dict:
+    """The flight-recorder timeline campaign (``sim/timeline.py``):
+    recorder off/on paired A/B at the WRITE_BENCH headline shape first
+    (the <5% overhead gate — the recorder must earn its default-on),
+    then the live N-node partition-heal cell whose coverage trajectory
+    gates against the kernel's per-tick curve."""
+    import sys
+
+    old_swi = sys.getswitchinterval()
+    sys.setswitchinterval(0.002)
+    try:
+        gate = _write_overhead_ab(
+            10_000, 32,
+            off_overrides={"flight_interval_s": 0.0},
+            plane_desc=(
+                "plane = flight recorder (periodic metric snapshots "
+                "+ typed event journal)"
+            ),
+        )
+    finally:
+        sys.setswitchinterval(old_swi)
+    from corrosion_tpu.sim.timeline import run_timeline
+
+    return asyncio.run(run_timeline(
+        n=n, out_path=out_path, overhead_gate=gate,
+    ))
 
 
 async def _devcluster3() -> dict:
@@ -1217,6 +1253,14 @@ def main() -> None:
     ap.add_argument("--scenario-families", default=None,
                     help="comma-separated subset of scenario families "
                          "(default: all)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="run the flight-recorder timeline campaign "
+                         "(live N=32 partition-heal trajectory gated "
+                         "against the kernel's per-tick coverage "
+                         "curve, plus the recorder off/on overhead "
+                         "A/B), write TIMELINE_N32.json, and exit")
+    ap.add_argument("--timeline-nodes", type=int, default=32,
+                    help="cluster size for --timeline")
     ap.add_argument("--obs", action="store_true",
                     help="run the observability soak (live cluster "
                          "measuring its OWN convergence via telemetry, "
@@ -1286,6 +1330,15 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "CALIB_MSGS.json"
         )
         _emit(run_msgs_calibration(out_path=out_path))
+        return
+    if args.timeline:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            f"TIMELINE_N{args.timeline_nodes}.json",
+        )
+        _emit(run_timeline_bench(
+            n=args.timeline_nodes, out_path=out_path,
+        ))
         return
     if args.obs:
         from corrosion_tpu.sim.obs import run_obs

@@ -170,6 +170,36 @@ def _handle(agent: "Agent", msg: dict) -> dict:
         # own convergence-lag measurement (docs/telemetry.md)
         return {"ok": agent.health_snapshot()}
 
+    if cmd == "flight_dump":
+        # the flight ring: recorder state + every held record
+        # (snapshots and events), oldest first
+        if agent.flight is None:
+            return {"ok": None}
+        return {
+            "ok": {
+                "recorder": agent.flight.snapshot(),
+                "entries": agent.flight.entries(
+                    limit=int(msg.get("limit", 0))
+                ),
+            }
+        }
+
+    if cmd == "flight_events":
+        # the typed event journal alone (the ring minus snapshots)
+        if agent.flight is None:
+            return {"ok": None}
+        return {
+            "ok": agent.flight.entries(
+                limit=int(msg.get("limit", 0)), kind="event"
+            )
+        }
+
+    if cmd == "sync_sessions":
+        # live sync sessions, both roles: peer, age, needs-remaining,
+        # session byte volume (docs/telemetry.md per-session sync
+        # observability)
+        return {"ok": agent.sync_sessions()}
+
     if cmd == "actor_version":
         actor = bytes.fromhex(msg.get("actor", agent.actor_id.hex()))
         bv = agent.bookie.for_actor(actor)

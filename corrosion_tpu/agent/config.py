@@ -122,6 +122,14 @@ def load_config(path: Optional[str] = None, **overrides) -> AgentConfig:
         kwargs["trace_export_path"] = traces["path"]
         if "max_bytes" in traces:
             kwargs["trace_export_max_bytes"] = int(traces["max_bytes"])
+    # [telemetry.flight] path: append flight-ring records (metric
+    # snapshots + typed events) as JSON lines, bounded with the same
+    # one-rotation/drop-counter discipline as the spans export
+    flight = data.get("telemetry", {}).get("flight")
+    if isinstance(flight, dict) and flight.get("path"):
+        kwargs["flight_export_path"] = flight["path"]
+        if "max_bytes" in flight:
+            kwargs["flight_export_max_bytes"] = int(flight["max_bytes"])
     # [gossip.tls] (config.rs TlsConfig: cert-file/key-file/ca-file/
     # insecure + [gossip.tls.client] cert-file/key-file/required)
     tls = gossip.get("tls", {})
@@ -164,6 +172,9 @@ def load_config(path: Optional[str] = None, **overrides) -> AgentConfig:
         "bcast_trace_propagation",
         "stall_probe_interval",
         "stall_probe_slow_ms",
+        # flight recorder (docs/telemetry.md)
+        "flight_interval_s",
+        "flight_ring_max",
         # equivocation defense (docs/faults.md)
         "equivocation_detection",
     ):

@@ -113,6 +113,43 @@ class Metrics:
         with self._lock:
             return self._histo_agg.get(name, {}).get(key, (0, 0.0))
 
+    @staticmethod
+    def _series_key(name: str, key: LabelKV) -> str:
+        """Flat ``name{k=v,...}`` identity for one labeled series — the
+        flight recorder's snapshot/delta key (JSON-safe, stable)."""
+        if not key:
+            return name
+        return name + "{" + ",".join(f"{k}={v}" for k, v in key) + "}"
+
+    def snapshot_state(self) -> Tuple[Dict[str, float], Dict[str, float],
+                                      Dict[str, Tuple[float, float]]]:
+        """One-lock flight snapshot (``agent/recorder.py``): every
+        counter value, every gauge, and each histogram series' windowed
+        (p50, p99) — flattened to ``name{labels}`` keys.  One lock hold
+        per snapshot interval, per the PR 3-6 batching discipline."""
+        with self._lock:
+            counters = {
+                self._series_key(name, key): v
+                for name, series in self._counters.items()
+                for key, v in series.items()
+            }
+            gauges = {
+                self._series_key(name, key): v
+                for name, series in self._gauges.items()
+                for key, v in series.items()
+            }
+            quantiles = {}
+            for name, series in self._histos.items():
+                for key, buf in series.items():
+                    if not buf:
+                        continue
+                    s = sorted(buf)
+                    quantiles[self._series_key(name, key)] = (
+                        percentile_sorted(s, 0.5),
+                        percentile_sorted(s, 0.99),
+                    )
+        return counters, gauges, quantiles
+
     # -- exposition ------------------------------------------------------
 
     def render(self, extra_gauges: Iterable[Tuple[str, float, dict]] = ()) -> str:

@@ -337,6 +337,11 @@ class SubscriptionHandle:
         self.db_path = db_path
         # zero-receiver GC bookkeeping (pubsub.rs:131-227 parity)
         self.last_receiver_at = time.time()
+        # last SUCCESSFUL refresh/delta round (wall): the per-sub
+        # staleness base — corro_subs_staleness_seconds{id=} rises from
+        # here, so a sub silently losing its refreshes (counted in
+        # corro_subs_refresh_failures_total) is visible as a rising age
+        self.last_ok_at = time.time()
         self._lock = threading.RLock()
         # row identity -> (row_id, cells); change log for catch-up
         self.rows: Dict[str, Tuple[int, list]] = {}
@@ -619,6 +624,12 @@ CREATE TABLE IF NOT EXISTS pk_groups (
 
     def refresh(self, initial: bool = False) -> None:
         """Re-evaluate the whole query and emit diff events."""
+        self._refresh_inner(initial)
+        # only a COMPLETED pass moves the staleness base (an exception
+        # above propagates to the drain round's failure counter)
+        self.last_ok_at = time.time()
+
+    def _refresh_inner(self, initial: bool = False) -> None:
         self.manager.agent.metrics.counter("corro_subs_refresh_total")
         if self.incremental and self.agg:
             cols, rows = self.manager.agent.storage.read_query(
@@ -667,6 +678,7 @@ CREATE TABLE IF NOT EXISTS pk_groups (
             pks = table_pks.get(self.pk_items[0][0])
             if pks:
                 self._delta_agg(pks)
+            self.last_ok_at = time.time()
             return
         work = []
         anchor_alias = self.pk_items[0][1] if self.pk_items else None
@@ -691,6 +703,7 @@ CREATE TABLE IF NOT EXISTS pk_groups (
                 self._delta_nullable(alias, pks)
             else:
                 self._delta_scoped(alias, pks)
+        self.last_ok_at = time.time()
 
     def _scope_rows(self, alias: str, pk_values: List[tuple]):
         """Evaluate the exec query scoped to ``alias``'s pk tuples."""
@@ -1296,6 +1309,45 @@ class SubsManager:
                 for h in self._subs.values()
             ]
 
+    def metric_gauges(self) -> List[tuple]:
+        """Scrape-time subscription-plane gauges (the ROADMAP
+        incremental-subs observability feed), emitted next to
+        ``corro_subs_refresh_failures_total``:
+
+        * ``corro_subs_pending_depth`` — queued candidate work
+          (full-refresh candidates + pk candidates), the pre-existing
+          gauge, now computed here;
+        * ``corro_subs_matcher_queue_depth`` — the matcher worker's
+          whole backlog: queued candidates plus the round currently
+          draining (a long-running refresh is load even after its
+          candidates left the queue);
+        * ``corro_subs_staleness_seconds{id=…}`` — seconds since each
+          subscription's last SUCCESSFUL refresh/delta round; a rising
+          series is a sub silently serving stale rows (its failures
+          count in the refresh-failures counter)."""
+        now = time.time()
+        with self._lock:
+            pending = len(self._pending) + sum(
+                len(p)
+                for per in self._pending_pks.values()
+                for p in per.values()
+            )
+            draining = 1 if self._draining else 0
+            stale = [
+                (h.id, max(0.0, now - h.last_ok_at))
+                for h in self._subs.values()
+            ]
+        out = [
+            ("corro_subs_pending_depth", float(pending), {}),
+            ("corro_subs_matcher_queue_depth",
+             float(pending + draining), {}),
+        ]
+        out.extend(
+            ("corro_subs_staleness_seconds", round(age, 3), {"id": sid})
+            for sid, age in stale
+        )
+        return out
+
     # -- change intake ---------------------------------------------------
 
     def on_change(self, cv: ChangeV1) -> None:
@@ -1444,17 +1496,22 @@ class SubsManager:
     # -- table-level updates (updates.rs parity) -------------------------
 
     def table_updates(self, table: str):
-        """Generator of {"change": [kind, pk_cells]} events for one table."""
+        """Iterator of {"change": [kind, pk_cells]} events for one table.
+
+        The queue registers EAGERLY (at call time), not lazily at the
+        first ``next()``: since group commit moved ``on_change`` fan-out
+        onto the wbcast worker, a write committed between creating the
+        stream and first consuming it is delivered asynchronously — with
+        lazy registration that event raced the first ``next()`` and,
+        losing, was dropped, leaving the consumer blocked forever (an
+        intermittent test_table_updates_stream hang).  An iterator
+        OBJECT (not a generator): a generator abandoned before its
+        first ``next()`` never runs its ``finally``, which would leak
+        the eagerly-registered queue — close() is explicit and
+        GC-backed."""
         q: queue.Queue = queue.Queue(maxsize=4096)
         self._update_streams.setdefault(table, []).append(q)
-        try:
-            while True:
-                try:
-                    yield q.get(timeout=30.0)
-                except queue.Empty:
-                    continue
-        finally:
-            self._update_streams.get(table, []).remove(q)
+        return _TableUpdateStream(self, table, q)
 
     def _notify_updates(self, table: str, changes: List) -> None:
         streams = self._update_streams.get(table)
@@ -1474,3 +1531,44 @@ class SubsManager:
                 except queue.Full:
                     pass
 
+
+
+class _TableUpdateStream:
+    """Blocking iterator over one table's update queue.
+
+    Cleanup is explicit (``close``) and GC-backed (``__del__``): the
+    queue registered eagerly in :meth:`SubsManager.table_updates`, so a
+    consumer that errors out before its first ``next()`` must still
+    unregister — a generator's ``finally`` never runs for a
+    never-started generator."""
+
+    def __init__(self, manager: "SubsManager", table: str,
+                 q: "queue.Queue"):
+        self._manager = manager
+        self._table = table
+        self._q = q
+        self._closed = False
+
+    def __iter__(self) -> "_TableUpdateStream":
+        return self
+
+    def __next__(self) -> dict:
+        while True:
+            try:
+                return self._q.get(timeout=30.0)
+            except queue.Empty:
+                continue
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._manager._update_streams.get(
+                self._table, []
+            ).remove(self._q)
+        except ValueError:
+            pass
+
+    def __del__(self) -> None:  # GC fallback for abandoned streams
+        self.close()

@@ -187,6 +187,16 @@ class AgentConfig:
     # max gauge + slow-callback attribution.  0 disables.
     stall_probe_interval: float = 0.05
     stall_probe_slow_ms: float = 50.0
+    # flight recorder (agent/recorder.py, docs/telemetry.md): periodic
+    # HLC-stamped metric snapshots + the typed event journal in a
+    # bounded in-memory ring, merged cluster-wide by
+    # ClusterObserver.flight_timeline.  0 disables the whole recorder
+    # (snapshots AND journal).  The optional jsonl export reuses the
+    # spans-export rotation/drop discipline ([telemetry.flight] path).
+    flight_interval_s: float = 1.0
+    flight_ring_max: int = 512
+    flight_export_path: Optional[str] = None
+    flight_export_max_bytes: int = 64 * 1024 * 1024
     # HLC clock skew (the scenario matrix's clock-skew fault family,
     # types/hlc.py skewed_now_ns): constant offset + linear drift
     # applied to THIS node's HLClock physical source.  Zero in
@@ -357,6 +367,31 @@ class Agent:
         self._equiv_quarantined: Dict[bytes, float] = {}
         # loop health probe (agent/health.py), created on start()
         self.health = None
+        # flight recorder (agent/recorder.py): created NOW — event
+        # seams fire before start() (e.g. a bootstrap breaker open) and
+        # the journal must hold them; the snapshot loop starts with the
+        # other tasks.  flight_interval_s = 0 disables the plane.
+        if config.flight_interval_s > 0:
+            from corrosion_tpu.agent.recorder import FlightRecorder
+
+            self.flight = FlightRecorder(
+                self.metrics, self.clock,
+                interval=config.flight_interval_s,
+                ring_max=config.flight_ring_max,
+                export_path=config.flight_export_path,
+                export_max_bytes=config.flight_export_max_bytes,
+                crash_path=os.path.join(
+                    os.path.dirname(config.db_path) or ".",
+                    "flight_crash.jsonl",
+                ),
+            )
+        else:
+            self.flight = None
+        # live sync sessions (client + server), admin `sync_sessions`:
+        # id -> {role, peer, started (monotonic), needs_total,
+        # needs_done, bytes}
+        self._sync_live: Dict[int, dict] = {}
+        self._sync_sess_seq = 0
         self._trace_token = None  # export ownership (set in start())
         self._trace_dropped_seen = 0  # last synced export-drop total
         self._acks: Dict[int, asyncio.Future] = {}
@@ -431,6 +466,46 @@ class Agent:
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
+
+    def _flight_event(self, kind: str, /, **attrs) -> None:
+        """Journal one typed event into the flight ring (no-op when the
+        recorder is disabled).  Emission sites are the protocol seams —
+        see recorder.EVENT_KINDS for the registry.  ``kind`` is
+        positional-only: several events legitimately carry a ``kind``
+        ATTRIBUTE (e.g. an equivocation verdict's detection kind)."""
+        f = self.flight
+        if f is None:
+            return
+        try:
+            f.event(kind, **attrs)
+        except Exception:
+            # telemetry must never break the seam it observes: the
+            # emission sites sit inside quarantine/fallback/serve paths
+            # whose correctness outranks the journal.  Counted loudly —
+            # a silent journaling bug would hollow out the flight ring
+            self.metrics.counter("corro_flight_journal_errors_total")
+            logger.exception("flight event %r failed", kind)
+
+    def _spawn_task(self, coro, name: str) -> asyncio.Task:
+        """Create one long-lived agent task under the crash-dump
+        supervisor: an UNHANDLED exception (not cancellation — the
+        agent owns those) flushes the flight ring to disk before the
+        task dies, so the history leading up to a dead loop survives
+        it instead of evaporating with the process state."""
+        async def supervised():
+            try:
+                await coro
+            except asyncio.CancelledError:
+                raise
+            except BaseException as e:
+                logger.exception("agent task %r died", name)
+                if self.flight is not None:
+                    self.flight.crash_dump(
+                        f"task {name}: {type(e).__name__}: {e}"
+                    )
+                raise
+
+        return asyncio.create_task(supervised())
 
     async def start(self) -> None:
         if self.config.trace_export_path:
@@ -523,14 +598,14 @@ class Agent:
 
             self.subs = SubsManager(self, self.config.subs_path)
         self._tasks = [
-            asyncio.create_task(self._announce_loop()),
-            asyncio.create_task(self._probe_loop()),
-            asyncio.create_task(self._suspect_reaper()),
-            asyncio.create_task(self._gossip_loop()),
-            asyncio.create_task(self._broadcast_loop()),
-            asyncio.create_task(self._change_loop()),
-            asyncio.create_task(self._sync_loop()),
-            asyncio.create_task(self._maintenance_loop()),
+            self._spawn_task(self._announce_loop(), "announce"),
+            self._spawn_task(self._probe_loop(), "probe"),
+            self._spawn_task(self._suspect_reaper(), "suspect"),
+            self._spawn_task(self._gossip_loop(), "gossip"),
+            self._spawn_task(self._broadcast_loop(), "broadcast"),
+            self._spawn_task(self._change_loop(), "change"),
+            self._spawn_task(self._sync_loop(), "sync"),
+            self._spawn_task(self._maintenance_loop(), "maintenance"),
         ]
         if self.config.stall_probe_interval > 0:
             from corrosion_tpu.agent.health import LoopHealthProbe
@@ -540,7 +615,13 @@ class Agent:
                 interval=self.config.stall_probe_interval,
                 slow_ms=self.config.stall_probe_slow_ms,
             )
-            self._tasks.append(asyncio.create_task(self.health.run()))
+            self._tasks.append(
+                self._spawn_task(self.health.run(), "health")
+            )
+        if self.flight is not None:
+            self._tasks.append(
+                self._spawn_task(self.flight.run(), "flight")
+            )
         if self.config.api_port is not None:
             from corrosion_tpu.agent.http import start_http_api
 
@@ -644,6 +725,8 @@ class Agent:
             # symmetric with start(), but only if OUR sink is still the
             # active one — another agent in this process may own it now
             tracing.disable_export_if(getattr(self, "_trace_token", None))
+        if self.flight is not None:
+            self.flight.close()
         self._persist_members()
         self.storage.close()
 
@@ -744,12 +827,9 @@ class Agent:
             float(self._write_combiner.depth()), {},
         ))
         if self.subs is not None:
-            with self.subs._lock:
-                depth = len(self.subs._pending) + sum(
-                    len(p) for per in self.subs._pending_pks.values()
-                    for p in per.values()
-                )
-            extra.append(("corro_subs_pending_depth", float(depth), {}))
+            # subscription-plane gauges (pubsub.py): pending/matcher
+            # queue depths + per-subscription staleness
+            extra.extend(self.subs.metric_gauges())
         # transport ConnStats aggregates (transport.rs:235-419 export)
         if self.transport is not None:
             stats = list(self.transport.stats.values())
@@ -859,6 +939,7 @@ class Agent:
         return {
             "actor": self.actor_id.hex(),
             "loop": self.health.snapshot() if self.health else None,
+            "flight": self.flight.snapshot() if self.flight else None,
             "queues": {
                 "changes": len(self._ingest),
                 "bcast": self._bcast_queue.qsize() if self._loop else 0,
@@ -869,6 +950,18 @@ class Agent:
             "convergence_lag": lag,
             "origin_staleness_s": staleness,
         }
+
+    def provenance_first_seen(self) -> Dict[tuple, tuple]:
+        """Snapshot of the provenance first-seen stamps:
+        ``(actor_bytes, version) -> (wall_seconds, hlc_int)`` for every
+        remote version whose first arrival this node recorded (bounded
+        by ``seen_cache_size``).  The timeline plane's per-node raw
+        material (``ClusterObserver.coverage_curve``)."""
+        with self._prov_lock:
+            return {
+                k: v for k, v in self._prov_seen.items()
+                if v is not None
+            }
 
     def _members_table(self) -> None:
         self.storage.conn.execute(
@@ -1348,6 +1441,7 @@ class Agent:
             self.metrics.counter(
                 "corro_write_group_fallbacks_total", reason="stmt"
             )
+            self._flight_event("write_group_fallback", reason="stmt")
         return self._execute_transaction_single(statements, on_conn)
 
     def _execute_transaction_single(self, statements: Sequence,
@@ -1509,6 +1603,9 @@ class Agent:
             # original error
             self.metrics.counter(
                 "corro_write_group_fallbacks_total", reason="abort"
+            )
+            self._flight_event(
+                "write_group_fallback", reason="abort", batches=len(reqs)
             )
             if aborted.recovered:
                 try:
@@ -1929,6 +2026,13 @@ class Agent:
         self.metrics.counter(
             "corro_members_quarantine_transitions_total",
             state="open" if opened else "restored",
+        )
+        addr_s = f"{addr[0]}:{addr[1]}"
+        self._flight_event(
+            "breaker_open" if opened else "breaker_close", addr=addr_s
+        )
+        self._flight_event(
+            "quarantine", addr=addr_s, on=opened, reason="breaker"
         )
 
     async def _broadcast_loop(self) -> None:
@@ -2351,6 +2455,10 @@ class Agent:
                 # may fully recover — only ITS failures count, the merge
                 # abort itself gets its own series
                 self.metrics.counter("corro_apply_group_fallbacks_total")
+                self._flight_event(
+                    "apply_group_fallback",
+                    actor=live[0].actor_id.bytes.hex(), size=len(live),
+                )
                 news_flags = []
                 for cv, src in zip(live, live_sources):
                     try:
@@ -2591,6 +2699,10 @@ class Agent:
         with self._equiv_lock:
             first = actor not in self._equiv_quarantined
             self._equiv_quarantined[actor] = deadline
+        # per-VERDICT journal record (the drop-volume "quarantined"
+        # kind stays counter-only: one line per dropped message would
+        # flood the bounded ring during an attack)
+        self._flight_event("equivocation", actor=actor.hex(), kind=kind)
         if first:
             logger.warning(
                 "equivocation detected (kind=%s) from %s: quarantining",
@@ -2601,6 +2713,10 @@ class Agent:
             self.metrics.counter(
                 "corro_members_quarantine_transitions_total",
                 state="equivocation",
+            )
+            self._flight_event(
+                "quarantine", actor=actor.hex(), on=True,
+                reason="equivocation",
             )
 
     def _rebroadcast_hop(self, cv: ChangeV1, meta=None) -> int:
@@ -2660,6 +2776,10 @@ class Agent:
             self.metrics.counter(
                 "corro_members_quarantine_transitions_total",
                 state="equivocation_expired",
+            )
+            self._flight_event(
+                "quarantine", actor=actor.hex(), on=False,
+                reason="expired",
             )
         key = self._seen_key(cv)
         if source is ChangeSource.BROADCAST:
@@ -2747,6 +2867,11 @@ class Agent:
         if not self.config.provenance:
             return
         now = time.time()
+        # ONE arrival-HLC observation for the whole batch (mirroring the
+        # single wall-clock read above): the items share one arrival
+        # instant, and per-item observe_timestamp calls would take the
+        # contended HLClock lock N times inside the _prov_lock hold
+        hlc_now = int(self.clock.observe_timestamp())
         lags = []
         with self._prov_lock:
             seen = self._prov_seen
@@ -2762,7 +2887,16 @@ class Agent:
                 key = (actor, int(cs.version))
                 if key in seen:
                     continue
-                seen[key] = None
+                # the first-seen STAMP (wall + the batch's arrival-HLC
+                # observation): the timeline plane's raw material —
+                # ClusterObserver derives the time-resolved coverage
+                # curve of an (actor, version) wave from these across
+                # nodes.  An observation, not `clock.last` (after
+                # _pre_change merged the changeset ts, `last` can EQUAL
+                # the origin commit ts and would stamp every arrival at
+                # its own commit instant) and not new_timestamp
+                # (telemetry must not advance the protocol clock)
+                seen[key] = (now, hlc_now)
                 if len(seen) > self.config.seen_cache_size:
                     seen.pop(next(iter(seen)))
                 origin = ts.wall_seconds()
@@ -3405,6 +3539,64 @@ class Agent:
         else:
             self.enqueue_change(cv, ChangeSource.SYNC)
 
+    # -- per-session sync observability --------------------------------
+    #
+    # Round-level timers and session-count gauges existed before; these
+    # add the per-SESSION layer: a live-session registry behind admin
+    # `sync_sessions` (peer, age, needs-remaining), one
+    # corro_sync_session_seconds{role=} sample per session, and the
+    # session's byte volume counted by role/direction.
+
+    def _sync_session_begin(self, role: str, peer: str,
+                            needs_total: int) -> dict:
+        self._sync_sess_seq += 1
+        live = {
+            "id": self._sync_sess_seq, "role": role, "peer": peer,
+            "started": time.monotonic(), "needs_total": needs_total,
+            "needs_done": 0, "changes": 0, "bytes": 0,
+        }
+        self._sync_live[live["id"]] = live
+        return live
+
+    def _sync_session_end(self, live: dict, role: str,
+                          direction: str) -> None:
+        self._sync_live.pop(live["id"], None)
+        self.metrics.histogram(
+            "corro_sync_session_seconds",
+            time.monotonic() - live["started"], role=role,
+        )
+        if live["bytes"]:
+            self.metrics.counter(
+                "corro_sync_session_bytes_total", live["bytes"],
+                role=role, dir=direction,
+            )
+
+    def sync_sessions(self) -> List[dict]:
+        """Live sync sessions, both roles (admin ``sync_sessions``).
+
+        Per-need completion is a SERVER-side notion (the server runs
+        one job per need; the client just reads the stream until the
+        server half-closes), so ``needs_done``/``needs_remaining`` are
+        null for client sessions — their progress signal is
+        ``changes`` (changesets ingested so far), which must keep
+        moving for a healthy backfill."""
+        now = time.monotonic()
+        out = []
+        for e in list(self._sync_live.values()):
+            client = e["role"] == "client"
+            out.append({
+                "id": e["id"], "role": e["role"], "peer": e["peer"],
+                "age_s": round(now - e["started"], 3),
+                "needs_total": e["needs_total"],
+                "needs_done": None if client else e["needs_done"],
+                "needs_remaining": None if client else max(
+                    0, e["needs_total"] - e["needs_done"]
+                ),
+                "changes": e["changes"],
+                "bytes": e["bytes"],
+            })
+        return out
+
     async def _sync_session(self, s: dict) -> Tuple[int, bool]:
         """Send this session's allocated requests, then ingest served
         changesets until the server closes its side.
@@ -3417,11 +3609,20 @@ class Agent:
         m, reader, writer = s["member"], s["reader"], s["writer"]
         frames = s["frames"]
         count = 0
+        needs_total = sum(len(v) for v in s["needs"].values())
+        live = self._sync_session_begin(
+            "client", m.actor_id.hex(), needs_total
+        )
+        self._flight_event(
+            "sync_client_start", peer=m.actor_id.hex(), needs=needs_total
+        )
+        complete = False
         try:
             for msg in s["backlog"]:
                 if isinstance(msg, ChangeV1):
                     await self._ingest_sync_change(msg)
                     count += 1
+                    live["changes"] = count
                 elif isinstance(msg, Timestamp):
                     try:
                         self.clock.update_with_timestamp(msg)
@@ -3440,6 +3641,7 @@ class Agent:
                 data = await asyncio.wait_for(reader.read(65536), timeout=10.0)
                 if not data:
                     break  # server closed: session complete
+                live["bytes"] += len(data)
                 for payload in frames.feed(data):
                     msg = speedy.decode_sync_message(payload)
                     if isinstance(msg, Timestamp):
@@ -3450,8 +3652,10 @@ class Agent:
                     elif isinstance(msg, ChangeV1):
                         await self._ingest_sync_change(msg)
                         count += 1
+                        live["changes"] = count
             self.members.update_sync_ts(m.actor_id, time.time())
             self.metrics.counter("corro_sync_client_rounds_total")
+            complete = True
             # per-change accounting happens at enqueue_change
             return count, True
         except (asyncio.TimeoutError, OSError, ConnectionError,
@@ -3459,6 +3663,11 @@ class Agent:
             return count, False
         finally:
             writer.close()
+            self._sync_session_end(live, "client", "received")
+            self._flight_event(
+                "sync_client_end", peer=m.actor_id.hex(),
+                changes=count, bytes=live["bytes"], complete=complete,
+            )
 
     async def _serve_tcp(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
@@ -3585,10 +3794,13 @@ class Agent:
             sess = {"chunk": self.SYNC_CHUNK_MAX}
             total_needs = 0
             srv_span = None  # opened once the SyncStart is decoded
+            live = None  # session registry entry, once the peer is known
 
             async def run_need(actor_b: bytes, need: SyncNeedV1) -> None:
                 async with job_sem:
                     await self._serve_need(writer, actor_b, need, sess)
+                    if live is not None:
+                        live["needs_done"] += 1
 
             try:
                 frames = speedy.FrameReader()
@@ -3601,6 +3813,10 @@ class Agent:
                         return
                     payloads = frames.feed(data)
                 _bi, cluster = speedy.decode_bi_payload(payloads[0])
+                peer_hex = _bi.actor_id.bytes.hex()
+                live = self._sync_session_begin("server", peer_hex, 0)
+                sess["live"] = live
+                self._flight_event("sync_server_start", peer=peer_hex)
                 # re-parent on the client's traceparent so both ends of
                 # the round log the same trace id (sync.rs:32-67)
                 srv_span = tracing.span(
@@ -3668,6 +3884,8 @@ class Agent:
                                     jobs.add(t)
                                 if eof:
                                     break
+                            if live is not None:
+                                live["needs_total"] = total_needs
                 # requests done (EOF or stall): wait for serving to end
                 if jobs:
                     results = await asyncio.gather(
@@ -3701,6 +3919,12 @@ class Agent:
                 if srv_span is not None:
                     srv_span.span.set(needs=total_needs)
                     srv_span.__exit__(None, None, None)
+                if live is not None:
+                    self._sync_session_end(live, "server", "served")
+                    self._flight_event(
+                        "sync_server_end", peer=live["peer"],
+                        needs=total_needs, bytes=live["bytes"],
+                    )
                 for t in jobs:
                     t.cancel()
                 writer.close()
@@ -4059,6 +4283,10 @@ class Agent:
         1 KiB), then aborts the session outright
         (peer.rs:344-348,796-811)."""
         writer.write(blob)
+        if sess is not None and "live" in sess:
+            # per-session served-byte accounting: every serve path
+            # (oracle and batched) funnels its writes through here
+            sess["live"]["bytes"] += len(blob)
         t0 = time.monotonic()
         try:
             await asyncio.wait_for(

@@ -218,6 +218,32 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=lambda a: cmd_admin(a, "sync_generate"))
     sp = syncp.add_parser("reconcile-gaps")
     sp.set_defaults(fn=lambda a: cmd_admin(a, "sync_reconcile_gaps"))
+    sp = syncp.add_parser(
+        "sessions",
+        help="live sync sessions (both roles): peer, age, "
+             "needs-remaining, bytes",
+    )
+    sp.set_defaults(fn=lambda a: cmd_admin(a, "sync_sessions"))
+
+    flight = sub.add_parser(
+        "flight", help="the flight recorder's bounded ring"
+    ).add_subparsers(dest="sub", required=True)
+    sp = flight.add_parser(
+        "dump", help="recorder state + every held record (snapshots "
+                     "and events), oldest first"
+    )
+    sp.add_argument("--limit", type=int, default=0,
+                    help="trailing records only (0 = all held)")
+    sp.set_defaults(fn=lambda a: cmd_admin(
+        a, "flight_dump", limit=a.limit
+    ))
+    sp = flight.add_parser(
+        "events", help="the typed event journal alone"
+    )
+    sp.add_argument("--limit", type=int, default=0)
+    sp.set_defaults(fn=lambda a: cmd_admin(
+        a, "flight_events", limit=a.limit
+    ))
 
     sp = sub.add_parser("locks")
     sp.set_defaults(fn=lambda a: cmd_admin(a, "locks"))

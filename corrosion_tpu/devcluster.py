@@ -133,9 +133,17 @@ class ClusterObserver:
       --trace <id>`` against each node's admin socket.
     """
 
-    def __init__(self, agents: Dict[str, "object"]):
+    def __init__(self, agents: Dict[str, "object"],
+                 faults: Optional["object"] = None):
         self.agents = dict(agents)
         self._base_msgs = 0.0
+        # the FaultController, when the cluster runs under one: the
+        # timeline merge pulls its flight_orphans (crashed
+        # incarnations' rings, kept by run_crash_schedule) so a death
+        # doesn't erase the history that led up to it
+        self.faults = faults
+        # extra orphaned rings a harness attaches manually
+        self.extra_rings: List[Tuple[str, list]] = []
 
     # -- scrape --------------------------------------------------------
 
@@ -348,6 +356,113 @@ class ClusterObserver:
                 out[kind] = out.get(kind, 0.0) + v
         return out
 
+    # -- flight timeline (docs/telemetry.md, flight recorder) ----------
+
+    def flight_timeline(self, limit: int = 0,
+                        kind: Optional[str] = None) -> List[dict]:
+        """ONE cluster timeline: every node's flight ring (snapshots +
+        typed events) merged on the HLC axis.  The HLC is the merge
+        key — it advances on every message receipt, so two nodes'
+        records interleave in causal order even when the clock-skew
+        fault family has pulled their wall clocks hundreds of ms apart.
+        Wall time breaks HLC ties; ``kind`` ("snap"/"event") filters
+        before the trailing ``limit``."""
+        entries: List[dict] = []
+        sources: List[Tuple[str, list]] = [
+            (name, a.flight.entries(kind=kind))
+            for name, a in self.agents.items()
+            if getattr(a, "flight", None) is not None
+        ]
+        orphans = list(self.extra_rings)
+        if self.faults is not None:
+            orphans.extend(getattr(self.faults, "flight_orphans", ()))
+        for node, ring in orphans:
+            if kind is not None:
+                ring = [e for e in ring if e["t"] == kind]
+            sources.append((node, ring))
+        for node, ring in sources:
+            for e in ring:
+                entries.append(dict(e, node=node))
+        entries.sort(key=lambda e: (e["hlc"], e["wall"], e["node"]))
+        if limit > 0:
+            entries = entries[-limit:]
+        return entries
+
+    def flight_events(self, limit: int = 0) -> List[dict]:
+        """The merged typed-event journal alone (the timeline minus
+        the metric snapshots)."""
+        return self.flight_timeline(limit=limit, kind="event")
+
+    def coverage_curve(self, tracked: List[tuple]) -> dict:
+        """The time-resolved coverage curve of tracked
+        ``(actor_bytes, version)`` waves, from the provenance
+        first-seen stamps: for each wave, t0 is the ORIGIN's own HLC
+        commit ts (the changeset timestamp bookkeeping recorded) and
+        each remote node contributes its first-arrival HLC stamp, so
+        the whole curve lives on the HLC axis.  Coverage at offset t =
+        fraction of (node, wave) pairs holding the wave within t of
+        its commit (the origin counts at t=0).  Returns the pooled
+        sorted offsets plus threshold crossing times — the trajectory
+        the timeline bench gates against the epidemic kernel's
+        per-tick prediction."""
+        from corrosion_tpu.types import Timestamp
+
+        n = len(self.agents)
+        first_seen = {
+            name: a.provenance_first_seen()
+            for name, a in self.agents.items()
+        }
+        dts: List[float] = []
+        missing = 0
+        waves = 0
+        for actor, version in tracked:
+            version = int(version)
+            origin = next(
+                (a for a in self.agents.values()
+                 if a.actor_id == actor), None,
+            )
+            if origin is None:
+                continue
+            ts0 = origin.bookie.version_ts(actor, version)
+            if ts0 is None:
+                continue
+            waves += 1
+            t0 = Timestamp(ts0).wall_seconds()
+            dts.append(0.0)  # the origin holds its wave at commit
+            for name, a in self.agents.items():
+                if a.actor_id == actor:
+                    continue
+                stamp = first_seen[name].get((actor, version))
+                if stamp is None:
+                    # no provenance record (e.g. a pre-provenance
+                    # arrival path): counted, never invented — the
+                    # curve plateaus below 1.0 instead of lying
+                    missing += 1
+                    continue
+                _wall, hlc = stamp
+                dts.append(
+                    max(0.0, Timestamp(hlc).wall_seconds() - t0)
+                )
+        dts.sort()
+        expected = n * waves
+        thresholds = (0.5, 0.75, 0.9, 0.99, 1.0)
+        t_at = {}
+        for c in thresholds:
+            need = int(-(-c * expected // 1))  # ceil
+            t_at[str(c)] = (
+                round(dts[need - 1], 4)
+                if 0 < need <= len(dts) else None
+            )
+        return {
+            "n_nodes": n,
+            "waves": waves,
+            "expected": expected,
+            "samples": len(dts),
+            "missing": missing,
+            "offsets_s": [round(d, 4) for d in dts],
+            "t_at_coverage": t_at,
+        }
+
     # -- traces --------------------------------------------------------
 
     def assemble_trace(self, trace_id: str):
@@ -425,6 +540,11 @@ async def run_crash_schedule(faults: "object") -> None:
         if ev.restart_at is not None:
             events.append((ev.restart_at, "restart", ev.node))
     events.sort()
+    if not hasattr(faults, "flight_orphans"):
+        # a crashed incarnation's flight ring would die with it: keep
+        # it so ClusterObserver.flight_timeline (extra_rings) can still
+        # assemble the history that led up to the death
+        faults.flight_orphans = []
     for at, kind, node in events:
         delay = at - faults.elapsed()
         if delay > 0:
@@ -432,9 +552,19 @@ async def run_crash_schedule(faults: "object") -> None:
         if kind == "crash":
             agent = faults.agents.get(node)
             if agent is not None:
+                if agent.flight is not None:
+                    # the crash MARKER rides the dying ring — the
+                    # timeline's record of when and why history stops
+                    agent.flight.event("crash", node=node)
+                    faults.flight_orphans.append(
+                        (node, agent.flight.entries())
+                    )
                 await agent.stop(graceful=False)
         else:
-            faults.agents[node] = await faults.respawn[node](node)
+            agent = await faults.respawn[node](node)
+            faults.agents[node] = agent
+            if agent.flight is not None:
+                agent.flight.event("restart", node=node)
         faults.crash_log.append((faults.elapsed(), kind, node))
 
 

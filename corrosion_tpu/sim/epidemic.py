@@ -288,6 +288,74 @@ def stats_at_convergence(allflags, *series):
     return converged, first, [s[rows, first_idx] for s in series]
 
 
+@partial(jax.jit, static_argnames=("cfg",))
+def _scan_chunk_coverage(state: EpidemicState, seed_key, target_row,
+                         cfg: EpidemicConfig):
+    """Run ``cfg.chunk_ticks`` rounds recording the PER-TICK coverage
+    fraction — the share of nodes whose rows equal the target — per
+    universe.  The time-resolved sibling of ``_scan_chunk``'s all-or-
+    nothing convergence flags: the flight-recorder timeline gates the
+    live cluster's coverage TRAJECTORY against this curve, not just its
+    endpoint."""
+    S = cfg.n_universes
+
+    def body(st, _):
+        key = jax.random.fold_in(seed_key, st.tick)
+        nxt = epidemic_tick(st, key, cfg)
+        holds = jnp.all(
+            nxt.rows.reshape((S or 1), cfg.n_nodes, cfg.n_rows)
+            == target_row[None, None, :],
+            axis=2,
+        )
+        return nxt, jnp.mean(holds.astype(jnp.float32), axis=1)
+
+    return jax.lax.scan(body, state, xs=None, length=cfg.chunk_ticks)
+
+
+def run_epidemic_coverage(cfg: EpidemicConfig, n_seeds: int = 8,
+                          seed: int = 0):
+    """Per-tick predicted coverage curve, seed-flattened (one scan for
+    all universes; ``track_sent`` unsupported — the curve predictor
+    runs the flat layout only).  Returns::
+
+        {"coverage": [mean coverage at tick 1..T],
+         "coverage_p10": ..., "coverage_p90": ...,  # seed spread
+         "ticks_run": T, "converged_frac": ...}
+
+    The scan stops once every universe holds coverage 1.0 (or
+    ``max_ticks``)."""
+    if cfg.track_sent:
+        raise ValueError(
+            "run_epidemic_coverage runs the seed-flattened layout only "
+            "(track_sent needs the [N, N] vmap path)"
+        )
+    flat_cfg = replace(cfg, n_universes=n_seeds)
+    key = jax.random.PRNGKey(seed)
+    state = epidemic_init(flat_cfg)
+    target = state.rows[0]
+    chunks = []
+    ticks_done = 0
+    while ticks_done < cfg.max_ticks:
+        state, cov = _scan_chunk_coverage(state, key, target, flat_cfg)
+        cov = np.asarray(cov).T  # [C, S] -> [S, C]
+        chunks.append(cov)
+        ticks_done += cfg.chunk_ticks
+        if (cov[:, -1] >= 1.0).all():
+            break
+    allcov = np.concatenate(chunks, axis=1)  # [S, T]
+    return {
+        "coverage": [float(v) for v in allcov.mean(axis=0)],
+        "coverage_p10": [
+            float(v) for v in np.percentile(allcov, 10, axis=0)
+        ],
+        "coverage_p90": [
+            float(v) for v in np.percentile(allcov, 90, axis=0)
+        ],
+        "ticks_run": int(allcov.shape[1]),
+        "converged_frac": float((allcov[:, -1] >= 1.0).mean()),
+    }
+
+
 def run_epidemic(cfg: EpidemicConfig, seed: int = 0):
     """Single-universe run.  Returns a stats dict (host values)."""
     stats = run_epidemic_seeds(cfg, n_seeds=1, seed=seed)

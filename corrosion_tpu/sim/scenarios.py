@@ -264,6 +264,9 @@ async def agent_scenario_cell(
         uni_cache_size=16,
         suspect_timeout=10.0,  # faults must not down-mark the cluster
         breaker_cooldown=0.5,
+        # fast flight snapshots: even a short tier-1 cell's timeline
+        # attachment carries real metric history, not just events
+        flight_interval_s=0.25,
     )
     stall_task = None
     try:
@@ -274,7 +277,7 @@ async def agent_scenario_cell(
             timeout=max(30.0, 2.0 * n),
         )
         seed_full_membership(list(agents.values()))
-        obs = ClusterObserver(agents)
+        obs = ClusterObserver(agents, faults=ctrl)
         obs.mark()
 
         # stall-probe sample cursor per node: the boot of N in-process
@@ -367,6 +370,27 @@ async def agent_scenario_cell(
         equiv = obs.equivocations(scrape)
         loop_health = obs.loop_health(scrape)
 
+        # the cell's flight-recorder attachment: a red cell ships its
+        # own post-mortem — the merged typed-event journal (bounded),
+        # snapshot count, and the write waves' coverage trajectory
+        events = obs.flight_events()
+        kind_counts: Dict[str, int] = {}
+        for e in events:
+            kind_counts[e["kind"]] = kind_counts.get(e["kind"], 0) + 1
+        timeline = {
+            "snapshots": len(obs.flight_timeline(kind="snap")),
+            "event_counts": kind_counts,
+            "events": [
+                {
+                    "node": e["node"], "kind": e["kind"],
+                    "hlc": e["hlc"], "wall": round(e["wall"], 3),
+                    "attrs": e.get("attrs", {}),
+                }
+                for e in events[-200:]
+            ],
+            "coverage": obs.coverage_curve(versions),
+        }
+
         gates = {
             "converged": converged_ok,
             "no_divergence": nodiv["ok"],
@@ -450,6 +474,7 @@ async def agent_scenario_cell(
             "loop_health": loop_health,
             "injected": dict(ctrl.injected),
             "no_divergence": nodiv,
+            "timeline": timeline,
             "gates": gates,
             "passed": all(gates.values()),
             "detail": detail,
@@ -520,6 +545,7 @@ async def run_scenarios(
                 "live_p99_s": None,
                 "msgs_per_node": None,
                 "no_divergence": {"ok": False, "violations": []},
+                "timeline": None,
                 "gates": {"converged": False},
                 "passed": False,
             }

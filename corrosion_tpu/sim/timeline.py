@@ -1,0 +1,388 @@
+"""Timeline campaign: the live cluster's convergence TRAJECTORY gated
+against the epidemic kernel's per-tick prediction.
+
+CHAOS/OBS/SCENARIOS compare *endpoints* — converged or not, p99 lag vs
+prediction.  This campaign compares the *shape of the run*: a
+partition-heal cell writes on both sides of a symmetric 2-block split,
+the flight recorder (``agent/recorder.py``) journals the run and the
+provenance first-seen stamps give each ``(actor, version)`` wave's
+time-resolved coverage curve (``ClusterObserver.coverage_curve``,
+HLC-aligned), and the kernel predicts the same curve per tick
+(``epidemic.run_epidemic_coverage``).  The gate asserts the live curve
+has the predicted SHAPE, with every tolerance named in-record:
+
+* **plateau** — just before the heal (the maximal guaranteed-pre-heal
+  offset) both curves must sit at the severed-block fraction: live vs
+  predicted coverage within ``PLATEAU_TOL`` absolute;
+* **held** — neither curve may reach (near-)full coverage before the
+  heal: the partition actually partitioned, in both worlds;
+* **recovery** — post-heal the live curve must complete, and its full-
+  coverage offset must land within ``RECOVERY_FACTOR`` × the kernel's
+  (+ ``RECOVERY_SLACK_S``): the kernel's tick grid does not model TCP
+  reconnects, breaker cooldowns, or the anti-entropy cadence, a
+  residual CHAOS_N32 already documents at ≈3-4× wall — the factor
+  bounds it instead of pretending it away.
+
+``bench.py --timeline`` writes ``TIMELINE_N32.json`` with the curves,
+the assembled cluster timeline (merged flight rings), and — computed by
+the bench harness next to it — the recorder's own paired off/on A/B on
+the WRITE_BENCH headline shape (<5%).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Dict, List, Optional
+
+from corrosion_tpu.faults import FaultController, FaultPlan
+
+# the simdiff/chaos time base: one kernel tick ≈ the agents' broadcast
+# flush interval (launch_test_agent pins bcast_flush_interval=0.02)
+TICK_S = 0.02
+
+# named trajectory tolerances (recorded in the artifact)
+#
+# The plateau is probed at the MAXIMAL guaranteed-pre-heal offset —
+# (heal delay − the last write's offset from the split − a guard) — so
+# in-block propagation has the whole partition window to complete: a
+# loaded host propagates in-block in hundreds of ms, and probing at a
+# fixed small fraction of the heal delay made the gate a host-speed
+# lottery rather than a shape check.
+PLATEAU_GUARD_S = 0.1      # keep the probe strictly before the heal
+PLATEAU_PROBE_MIN_S = 0.1  # floor when writes ran long
+PLATEAU_TOL = 0.20         # |live - predicted| plateau coverage, absolute
+FULL_COV = 0.99            # "full coverage" threshold for gating
+RECOVERY_FACTOR = 6.0      # live full-coverage offset vs kernel's
+RECOVERY_SLACK_S = 2.0     # additive slack on top of the factor
+
+
+def kernel_coverage_prediction(
+    n: int,
+    heal_tick: int,
+    fanout: int = 3,
+    max_transmissions: int = 5,
+    seeds: int = 8,
+) -> Dict:
+    """The kernel's per-tick coverage curve for the partition-heal
+    family (loss-free, symmetric 2-block split healing at
+    ``heal_tick``) — the prediction the live trajectory gates against.
+    Seed-flattened layout (no per-payload sent tracking: it needs the
+    [N, N] vmap path; at loss 0 the exclusion shifts msgs, not the
+    coverage dynamics)."""
+    from corrosion_tpu.sim.epidemic import (
+        EpidemicConfig,
+        run_epidemic_coverage,
+    )
+
+    cfg = EpidemicConfig(
+        n_nodes=n,
+        n_rows=4,
+        fanout_ring0=0,
+        fanout_global=fanout,
+        ring0_size=1,
+        max_transmissions=max_transmissions,
+        loss=0.0,
+        partition_blocks=2,
+        heal_tick=heal_tick,
+        backoff_ticks=2.5,
+        sync_interval=8,
+        sync_peers=1,
+        max_ticks=512,
+        chunk_ticks=16,
+    )
+    cov = run_epidemic_coverage(cfg, n_seeds=seeds, seed=0)
+    curve = cov["coverage"]
+    times = [round((i + 1) * TICK_S, 4) for i in range(len(curve))]
+
+    def t_at(c: float) -> Optional[float]:
+        for t, v in zip(times, curve):
+            if v >= c:
+                return t
+        return None
+
+    return {
+        "runtime": "tpu-sim",
+        "n_nodes": n,
+        "heal_tick": heal_tick,
+        "heal_s": round(heal_tick * TICK_S, 4),
+        "tick_seconds": TICK_S,
+        "times_s": times,
+        "coverage": [round(v, 4) for v in curve],
+        "coverage_p10": [round(v, 4) for v in cov["coverage_p10"]],
+        "coverage_p90": [round(v, 4) for v in cov["coverage_p90"]],
+        "converged_frac": cov["converged_frac"],
+        "t_at_coverage": {
+            str(c): t_at(c) for c in (0.5, 0.75, 0.9, 0.99, 1.0)
+        },
+    }
+
+
+def curve_value_at(times: List[float], coverage: List[float],
+                   t: float) -> float:
+    """Predicted coverage at offset ``t`` (step interpolation; 0 before
+    the first tick)."""
+    v = 0.0
+    for tt, cc in zip(times, coverage):
+        if tt > t:
+            break
+        v = cc
+    return v
+
+
+async def agent_timeline_cell(
+    n: int = 32,
+    writes: int = 6,
+    heal_after: float = 1.28,
+    seed: int = 0,
+    timeout: float = 90.0,
+    base_dir: Optional[str] = None,
+    event_limit: int = 400,
+) -> Dict:
+    """The live partition-heal cell: writes land on BOTH sides of the
+    split immediately after it arms (so every wave's commit sits well
+    before the heal), the run converges through heal + anti-entropy,
+    and the flight plane yields the assembled timeline + the coverage
+    trajectory."""
+    from corrosion_tpu.agent.testing import seed_full_membership, wait_for
+    from corrosion_tpu.devcluster import (
+        ClusterObserver,
+        Topology,
+        run_inprocess,
+    )
+
+    plan = FaultPlan(
+        seed=seed, partition_blocks=2, heal_after=heal_after
+    )
+    ctrl = FaultController(plan)
+    topo = Topology.parse("\n".join(f"n0 -> n{i}" for i in range(1, n)))
+    agents = await run_inprocess(
+        topo,
+        base_dir=base_dir,
+        faults=ctrl,
+        ring0_enabled=False,   # uniform sampling: the kernel's model
+        subs_enabled=False,
+        api_port=None,
+        uni_cache_size=16,
+        suspect_timeout=10.0,  # the split must not down-mark members
+        breaker_cooldown=0.5,
+        # fast snapshots: a sub-5 s cell still gets a real timeline
+        flight_interval_s=0.25,
+    )
+    try:
+        await wait_for(
+            lambda: all(
+                len(a.members.alive()) == n - 1 for a in agents.values()
+            ),
+            timeout=max(30.0, 2.0 * n),
+        )
+        seed_full_membership(list(agents.values()))
+        obs = ClusterObserver(agents, faults=ctrl)
+        obs.mark()
+
+        names = list(agents)
+        other = next(
+            i for i in range(n)
+            if plan.block_of(i, n) != plan.block_of(0, n)
+        )
+        writers = [names[0], names[other]]
+
+        ctrl.restart_clock()
+        ctrl.split()
+        split_wall = time.time()
+
+        # the write burst, one origin per block, back to back: every
+        # wave's commit lands within a fraction of the heal delay, so
+        # the wave-relative plateau probe below stays mid-partition
+        # for all of them
+        versions: List[tuple] = []
+        for w in range(writes):
+            origin = agents[writers[w % 2]]
+            res = await asyncio.to_thread(
+                origin.execute_transaction,
+                [("INSERT INTO tests (id, text) VALUES (?, ?)",
+                  (9000 + w, f"timeline-{w}"))],
+            )
+            versions.append((origin.actor_id, res["version"]))
+            await asyncio.sleep(0.01)
+        last_write_off = time.time() - split_wall
+
+        def converged() -> bool:
+            for a in agents.values():
+                for actor, v in versions:
+                    if a.actor_id != actor and not a.bookie.for_actor(
+                        actor
+                    ).contains_version(v):
+                        return False
+            return True
+
+        t0 = time.perf_counter()
+        converged_ok = True
+        try:
+            await wait_for(converged, timeout=timeout, interval=0.02)
+        except TimeoutError:
+            converged_ok = False
+        wall = time.perf_counter() - t0
+        # one more snapshot round so the post-convergence state is in
+        # every ring before assembly
+        await asyncio.sleep(0.3)
+
+        curve = obs.coverage_curve(versions)
+        events = obs.flight_events()
+        kind_counts: Dict[str, int] = {}
+        for e in events:
+            k = e["kind"]
+            kind_counts[k] = kind_counts.get(k, 0) + 1
+        snapshots = len(obs.flight_timeline(kind="snap"))
+        lag = obs.convergence_lag()
+        scrape = obs.scrape()
+
+        return {
+            "runtime": "agents",
+            "n_nodes": n,
+            "writes": writes,
+            "heal_after_s": heal_after,
+            "converged": converged_ok,
+            "wall_to_converge_s": round(wall, 3),
+            "last_write_offset_s": round(last_write_off, 3),
+            "coverage": curve,
+            "live_p99_s": lag.get("p99_s"),
+            "msgs_per_node": round(obs.msgs_per_node(scrape), 2),
+            "timeline": {
+                "snapshots": snapshots,
+                "event_counts": kind_counts,
+                "events": [
+                    {
+                        "node": e["node"], "kind": e["kind"],
+                        "hlc": e["hlc"],
+                        "wall_off_s": round(e["wall"] - split_wall, 3),
+                        "attrs": e.get("attrs", {}),
+                    }
+                    for e in events[-event_limit:]
+                ],
+            },
+        }
+    finally:
+        for a in list(agents.values()):
+            try:
+                await a.stop()
+            except Exception:
+                pass
+
+
+def trajectory_gates(live: Dict, pred: Dict,
+                     heal_after: float) -> Dict:
+    """The named-tolerance trajectory comparison: plateau / held /
+    recovery, each gate a boolean next to its operands."""
+    probe_t = max(
+        PLATEAU_PROBE_MIN_S,
+        heal_after - live.get("last_write_offset_s", 0.0)
+        - PLATEAU_GUARD_S,
+    )
+    curve = live["coverage"]
+    offsets = curve["offsets_s"]
+    expected = max(1, curve["expected"])
+    live_plateau = sum(1 for d in offsets if d <= probe_t) / expected
+    pred_plateau = curve_value_at(
+        pred["times_s"], pred["coverage"], probe_t
+    )
+    live_full = curve["t_at_coverage"].get(str(FULL_COV))
+    pred_full = pred["t_at_coverage"].get(str(FULL_COV))
+    recovery_budget = (
+        None if pred_full is None
+        else round(RECOVERY_FACTOR * pred_full + RECOVERY_SLACK_S, 3)
+    )
+    gates = {
+        "converged": bool(live["converged"]),
+        # mid-partition both worlds sit at the severed-block fraction
+        "plateau_matches": abs(live_plateau - pred_plateau)
+        <= PLATEAU_TOL,
+        # the partition held: neither curve near-full before the heal
+        "partition_held": live_plateau < FULL_COV
+        and pred_plateau < FULL_COV,
+        # post-heal the live wave completes within the named budget
+        "recovery_within_budget": (
+            live_full is not None
+            and recovery_budget is not None
+            and live_full <= recovery_budget
+        ),
+    }
+    return {
+        "gates": gates,
+        "plateau_probe_s": round(probe_t, 4),
+        "live_plateau_cov": round(live_plateau, 4),
+        "predicted_plateau_cov": round(pred_plateau, 4),
+        "plateau_tolerance": PLATEAU_TOL,
+        "live_full_coverage_s": live_full,
+        "predicted_full_coverage_s": pred_full,
+        "recovery_budget_s": recovery_budget,
+        "recovery_factor": RECOVERY_FACTOR,
+        "recovery_slack_s": RECOVERY_SLACK_S,
+        "residual": (
+            "the kernel's tick grid does not model TCP reconnects, "
+            "breaker cooldowns or the anti-entropy cadence; live "
+            "recovery runs a documented ~3-4x slower than predicted "
+            "(CHAOS_N32), bounded here by recovery_factor instead of "
+            "hidden"
+        ),
+    }
+
+
+async def run_timeline(
+    n: int = 32,
+    writes: int = 6,
+    # heal_tick = 64: double the chaos family's 0.64 s so in-block
+    # propagation reliably completes (plateaus) inside the partition
+    # window even on a loaded host — the plateau gate checks shape,
+    # not host speed
+    heal_after: float = 1.28,
+    seeds: int = 8,
+    out_path: Optional[str] = None,
+    base_dir: Optional[str] = None,
+    sim: bool = True,
+    overhead_gate: Optional[Dict] = None,
+) -> Dict:
+    """The timeline campaign: live partition-heal trajectory vs the
+    kernel's per-tick curve, one JSON artifact, all gates asserted
+    in-record.  ``overhead_gate`` (the recorder off/on A/B the bench
+    harness measures) is embedded verbatim when provided."""
+    heal_tick = max(1, int(round(heal_after / TICK_S)))
+    prediction = (
+        kernel_coverage_prediction(n, heal_tick, seeds=seeds)
+        if sim else None
+    )
+    live = await agent_timeline_cell(
+        n, writes=writes, heal_after=heal_after, base_dir=base_dir,
+    )
+    out: Dict = {
+        "n_nodes": n,
+        "metric": "partition_heal_trajectory_vs_kernel",
+        "tick_seconds": TICK_S,
+        "agents": live,
+        "sim": prediction,
+    }
+    if prediction is not None:
+        traj = trajectory_gates(live, prediction, heal_after)
+        out["trajectory"] = traj
+        out["all_gates_passed"] = all(traj["gates"].values())
+        out["value"] = traj["live_full_coverage_s"]
+        out["unit"] = "s_full_coverage_offset"
+        if not out["all_gates_passed"]:
+            out["error"] = (
+                "live coverage trajectory diverged from the kernel "
+                "prediction beyond the named tolerances"
+            )
+    if overhead_gate is not None:
+        out["overhead_gate"] = overhead_gate
+        if overhead_gate.get("pass") is False:
+            out.setdefault(
+                "error",
+                "flight-recorder overhead gate failed: recorder-on "
+                "write throughput regressed > 5% vs recorder-off",
+            )
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1, allow_nan=False)
+            f.write("\n")
+    return out

@@ -110,6 +110,20 @@ class HLClock:
             self._last = ts
             return ts
 
+    def observe_timestamp(self) -> Timestamp:
+        """The stamp :meth:`new_timestamp` WOULD mint, without advancing
+        the clock — for observations (flight-recorder records,
+        provenance first-seen stamps): telemetry must never mutate
+        protocol clock state, so e.g. the 'merge rejected, local clock
+        unpolluted' invariant of the 300 ms delta rule stays assertable
+        to the exact tick.  Two observations inside one ~65 µs grain may
+        stamp equal; observation streams are sorted, not deduped."""
+        with self._lock:
+            phys = self._now_ns() & ~_LOGICAL_MASK
+            if phys > self._last.physical_ns:
+                return Timestamp.pack(phys, 0)
+            return Timestamp(int(self._last) + 1)
+
     def update_with_timestamp(self, remote: Timestamp) -> None:
         with self._lock:
             now = self._now_ns()

@@ -1,0 +1,175 @@
+"""Committed-artifact schema lint (tier-1).
+
+The bench JSONs committed at the repo root are load-bearing: ROADMAP
+claims, docs tables, and the overhead/trajectory gates all cite them.
+A refactor that silently changes an artifact's shape (or commits a
+failing one) should fail fast here, not months later when someone
+re-reads the numbers.  The schemas are deliberately MINIMAL — required
+keys and types, plus the health invariants each artifact asserts
+in-record — so benches stay free to grow new fields.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+NUM = (int, float)
+
+
+def _load(name: str) -> dict:
+    p = REPO / name
+    assert p.exists(), f"committed artifact {name} is missing"
+    with open(p) as f:
+        return json.load(f)
+
+
+def _check(doc, schema, path="$"):
+    """Minimal structural validation: dict schema = required keys with
+    nested schemas; a type/tuple-of-types = isinstance; a callable =
+    predicate (must return True)."""
+    if isinstance(schema, dict):
+        assert isinstance(doc, dict), f"{path}: expected object"
+        for key, sub in schema.items():
+            assert key in doc, f"{path}: missing required key {key!r}"
+            _check(doc[key], sub, f"{path}.{key}")
+    elif isinstance(schema, (type, tuple)):
+        assert isinstance(doc, schema), (
+            f"{path}: expected {schema}, got {type(doc).__name__}"
+        )
+    else:  # predicate
+        assert schema(doc), f"{path}: predicate failed on {doc!r}"
+
+
+def _gate_passed(g):
+    # overhead gates either ran (pass True) or were skipped at smoke
+    # scale — a committed artifact must never carry pass=False
+    return isinstance(g, dict) and g.get("pass") is not False
+
+
+def test_chaos_artifact_schema():
+    doc = _load("CHAOS_N32.json")
+    _check(doc, {
+        "n_nodes": int,
+        "fault_family": dict,
+        "sim": {"converged_frac": NUM, "msgs_per_node": NUM},
+        "agents": {"converged_frac": lambda v: v == 1.0},
+        "diff": dict,
+    })
+    assert "error" not in doc
+
+
+def test_obs_artifact_schema():
+    doc = _load("OBS_N32.json")
+    _check(doc, {
+        "n_nodes": int,
+        "metric": str,
+        "value": NUM,
+        "tolerance": NUM,
+        "within_tolerance": lambda v: v is True,
+        "agents": {
+            "ground_truth": {"p99_s": NUM},
+            "telemetry": {"lag": {"p99_s": NUM}},
+        },
+        "sim": dict,
+        "diff": dict,
+    })
+    assert "error" not in doc
+
+
+def test_scenarios_artifact_schema():
+    doc = _load("SCENARIOS_N32.json")
+    _check(doc, {
+        "n_nodes": int,
+        "metric": str,
+        "families": list,
+        "all_cells_converged": lambda v: v is True,
+        "no_divergence_all_cells": lambda v: v is True,
+        "all_gates_passed": lambda v: v is True,
+        "cells": dict,
+    })
+    assert set(doc["families"]) == set(doc["cells"])
+    for family, cell in doc["cells"].items():
+        _check(cell, {
+            "agents": {
+                "gates": dict,
+                "no_divergence": {"ok": lambda v: v is True},
+                # the flight-recorder attachment: every cell ships its
+                # own post-mortem (events + snapshots + coverage)
+                "timeline": {
+                    "snapshots": lambda v: isinstance(v, int) and v > 0,
+                    "event_counts": dict,
+                    "events": list,
+                    "coverage": {"expected": int, "offsets_s": list},
+                },
+                "passed": lambda v: v is True,
+            },
+            "diff": dict,
+        }, f"$.cells.{family}")
+
+
+def test_timeline_artifact_schema():
+    doc = _load("TIMELINE_N32.json")
+    _check(doc, {
+        "n_nodes": int,
+        "metric": str,
+        "agents": {
+            "converged": lambda v: v is True,
+            "coverage": {
+                "expected": int,
+                "offsets_s": list,
+                "t_at_coverage": dict,
+            },
+            "timeline": {
+                "snapshots": lambda v: isinstance(v, int) and v > 0,
+                "event_counts": dict,
+                "events": list,
+            },
+        },
+        "sim": {
+            "times_s": list,
+            "coverage": list,
+            "t_at_coverage": dict,
+        },
+        "trajectory": {
+            "gates": dict,
+            "plateau_tolerance": NUM,
+            "recovery_budget_s": NUM,
+        },
+        "all_gates_passed": lambda v: v is True,
+        "overhead_gate": _gate_passed,
+    })
+    assert all(doc["trajectory"]["gates"].values())
+    assert "error" not in doc
+    # the overhead A/B actually ran at the headline shape
+    assert doc["overhead_gate"]["pass"] is True
+    assert doc["overhead_gate"]["ratio"] >= 0.95
+
+
+@pytest.mark.parametrize("name,value_floor", [
+    ("APPLY_BENCH.json", 3.0),
+    ("SYNC_BENCH.json", 3.0),
+    ("WRITE_BENCH.json", 2.5),
+])
+def test_perf_bench_artifact_schemas(name, value_floor):
+    doc = _load(name)
+    _check(doc, {
+        "metric": str,
+        "value": NUM,
+        "unit": str,
+        "conditions": str,
+        # APPLY/WRITE commit a point list; SYNC a per-mode dict
+        "points": lambda v: isinstance(v, (list, dict)) and len(v) > 0,
+    })
+    assert "error" not in doc
+    # the committed headline must actually clear its own gate
+    assert doc["value"] >= value_floor, (
+        f"{name}: committed headline {doc['value']} under its "
+        f"{value_floor}x gate"
+    )
+    if "overhead_gate" in doc:
+        assert _gate_passed(doc["overhead_gate"])

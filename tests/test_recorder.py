@@ -1,0 +1,334 @@
+"""Flight recorder bounds + timeline assembly.
+
+The recorder's contract is that it can run always-on: the ring must
+stay inside its configured budget under sustained snapshot + event
+load, the jsonl export must rotate exactly once and count every drop
+after that, and the crash-dump hook must flush the ring when an agent
+task dies on an unhandled exception.  The timeline half: per-node
+rings merge on the HLC axis, and the trajectory gates compare a
+coverage curve against a predicted one with named tolerances.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from corrosion_tpu.agent.metrics import Metrics
+from corrosion_tpu.agent.recorder import EVENT_KINDS, FlightRecorder
+from corrosion_tpu.types import HLClock
+
+
+def _recorder(tmp_path=None, **kw):
+    return FlightRecorder(Metrics(), HLClock(), **kw)
+
+
+# -- ring bounds -------------------------------------------------------
+
+
+def test_ring_stays_within_budget_under_sustained_load():
+    """Sustained snapshot + event load must never grow the ring past
+    ring_max — the recorder is always-on and its memory is the ring."""
+    rec = _recorder(ring_max=64)
+    rec.metrics.counter("corro_test_total")
+    for i in range(500):
+        rec.event("write_group_fallback", reason="stmt")
+        if i % 3 == 0:
+            rec.metrics.counter("corro_test_total")
+            rec.snapshot_once()
+    assert len(rec.entries()) == 64
+    assert rec.events == 500
+    assert rec.snapshots == 167
+    # newest records won: the ring's tail is the latest history
+    assert rec.entries()[-1]["t"] in ("event", "snap")
+    hlcs = [e["hlc"] for e in rec.entries()]
+    assert hlcs == sorted(hlcs)  # per-node records strictly ordered
+
+
+def test_unregistered_event_kind_raises():
+    rec = _recorder()
+    with pytest.raises(ValueError):
+        rec.event("not_a_registered_kind")
+
+
+def test_snapshot_carries_counter_deltas_not_totals():
+    rec = _recorder()
+    rec.metrics.counter("corro_test_total", 5.0)
+    first = rec.snapshot_once()
+    assert first["counters_delta"]["corro_test_total"] == 5.0
+    rec.metrics.counter("corro_test_total", 2.0)
+    second = rec.snapshot_once()
+    assert second["counters_delta"]["corro_test_total"] == 2.0
+    third = rec.snapshot_once()
+    # unchanged series are omitted entirely — a snapshot is a diff
+    assert "corro_test_total" not in third["counters_delta"]
+
+
+# -- jsonl export: one rotation, drops counted -------------------------
+
+
+def test_export_rotates_exactly_once_then_counts_drops(tmp_path):
+    path = str(tmp_path / "flight.jsonl")
+    rec = _recorder(export_path=path, export_max_bytes=2048,
+                    ring_max=32)
+    for _ in range(200):
+        rec.event("write_group_fallback", reason="stmt")
+    # events only ENQUEUE export lines (disk I/O must stay off the
+    # seams that emit them); the snapshot worker / close / crash dump
+    # flush — here, explicitly
+    rec.flush_export()
+    assert os.path.exists(path + ".1")  # exactly one rotation target
+    assert os.path.getsize(path + ".1") <= 2048 + 256
+    assert os.path.getsize(path) <= 2048
+    assert rec.export_dropped > 0
+    assert rec.metrics.get_counter(
+        "corro_flight_export_dropped_total"
+    ) == float(rec.export_dropped)
+    # the exported lines are valid json records
+    with open(path + ".1") as f:
+        for line in f:
+            assert json.loads(line)["t"] == "event"
+    # total on-disk footprint stays <= 2 x max_bytes: later events keep
+    # dropping (at flush) instead of rotating again
+    before = rec.export_dropped
+    rec.event("write_group_fallback", reason="abort")
+    rec.flush_export()
+    assert rec.export_dropped == before + 1
+
+
+# -- crash dump --------------------------------------------------------
+
+
+def test_crash_dump_flushes_on_unhandled_task_exception(tmp_path):
+    """An agent task dying on an unhandled exception must flush the
+    flight ring to the crash path — the supervisor wiring, tested
+    through a real (offline) agent."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+
+    a = make_offline_agent(tmpdir=str(tmp_path))
+    try:
+        assert a.flight is not None
+        a.flight.event("write_group_fallback", reason="stmt")
+
+        async def boom():
+            raise RuntimeError("injected")
+
+        async def drive():
+            t = a._spawn_task(boom(), "boom")
+            with pytest.raises(RuntimeError):
+                await t
+
+        asyncio.run(drive())
+        crash = os.path.join(str(tmp_path), "flight_crash.jsonl")
+        assert os.path.exists(crash)
+        recs = [json.loads(l) for l in open(crash)]
+        kinds = [r.get("kind") for r in recs if r["t"] == "event"]
+        assert "write_group_fallback" in kinds
+        assert "crash_dump" in kinds  # the flush marker itself
+        dump = next(r for r in recs if r.get("kind") == "crash_dump")
+        assert "injected" in dump["attrs"]["reason"]
+    finally:
+        a.storage.close()
+
+
+def test_cancellation_does_not_crash_dump(tmp_path):
+    from corrosion_tpu.agent.testing import make_offline_agent
+
+    a = make_offline_agent(tmpdir=str(tmp_path))
+    try:
+        async def forever():
+            await asyncio.sleep(3600)
+
+        async def drive():
+            t = a._spawn_task(forever(), "forever")
+            await asyncio.sleep(0)
+            t.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await t
+
+        asyncio.run(drive())
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), "flight_crash.jsonl")
+        )
+    finally:
+        a.storage.close()
+
+
+# -- timeline assembly + trajectory gates ------------------------------
+
+
+def test_flight_timeline_merges_on_hlc_axis(tmp_path):
+    """Two nodes with skewed WALL stamps still merge in HLC order."""
+    from corrosion_tpu.devcluster import ClusterObserver
+
+    class FakeAgent:
+        def __init__(self, rec):
+            self.flight = rec
+
+    from corrosion_tpu.types import Timestamp
+
+    a, b = _recorder(ring_max=16), _recorder(ring_max=16)
+    a.event("breaker_open", addr="x:1")
+    # b's clock merged a's EVENT stamp (observation stamps don't
+    # advance a's own clock): b's next observation is strictly after,
+    # even inside one 65 µs HLC grain, regardless of wall order
+    b.clock.update_with_timestamp(Timestamp(a.entries()[-1]["hlc"]))
+    b.event("breaker_close", addr="x:1")
+    obs = ClusterObserver({"a": FakeAgent(a), "b": FakeAgent(b)})
+    # scramble wall stamps: HLC must still win the merge order
+    ents_a = a.entries()
+    ents_a[0]["wall"] += 1e6
+    tl = obs.flight_events()
+    assert [e["kind"] for e in tl] == ["breaker_open", "breaker_close"]
+    assert [e["node"] for e in tl] == ["a", "b"]
+
+
+def test_trajectory_gates_named_tolerances():
+    from corrosion_tpu.sim.timeline import (
+        FULL_COV,
+        PLATEAU_TOL,
+        trajectory_gates,
+    )
+
+    pred = {
+        "times_s": [0.02 * (i + 1) for i in range(64)],
+        # plateau at 0.5 until tick 32 (0.64 s), then full
+        "coverage": [min(0.5, 0.1 * (i + 1)) if i < 32 else 1.0
+                     for i in range(64)],
+        "t_at_coverage": {str(FULL_COV): 0.66, "1.0": 0.66},
+    }
+    live_ok = {
+        "converged": True,
+        "coverage": {
+            "expected": 10,
+            # half the pairs in fast, the rest well after the heal
+            "offsets_s": [0.0] * 5 + [1.1] * 5,
+            "t_at_coverage": {str(FULL_COV): 1.1, "1.0": 1.1},
+        },
+    }
+    out = trajectory_gates(live_ok, pred, heal_after=0.64)
+    assert out["gates"]["plateau_matches"]
+    assert out["gates"]["partition_held"]
+    assert out["gates"]["recovery_within_budget"]
+    assert out["plateau_tolerance"] == PLATEAU_TOL
+    assert out["recovery_budget_s"] is not None
+
+    # a run that never plateaued (partition did not hold) fails the
+    # plateau gate; one that recovers past the budget fails recovery
+    live_no_plateau = {
+        "converged": True,
+        "coverage": {
+            "expected": 10,
+            "offsets_s": [0.0] * 10,
+            "t_at_coverage": {str(FULL_COV): 0.0, "1.0": 0.0},
+        },
+    }
+    out2 = trajectory_gates(live_no_plateau, pred, heal_after=0.64)
+    assert not out2["gates"]["plateau_matches"]
+    assert not out2["gates"]["partition_held"]
+    live_slow = {
+        "converged": True,
+        "coverage": {
+            "expected": 10,
+            "offsets_s": [0.0] * 5 + [99.0] * 5,
+            "t_at_coverage": {str(FULL_COV): 99.0, "1.0": 99.0},
+        },
+    }
+    out3 = trajectory_gates(live_slow, pred, heal_after=0.64)
+    assert not out3["gates"]["recovery_within_budget"]
+
+
+def test_kernel_coverage_curve_shape():
+    """The per-tick prediction shows the partition signature: a
+    plateau at the severed-block fraction, then full coverage only
+    after the heal tick."""
+    from corrosion_tpu.sim.timeline import (
+        TICK_S,
+        curve_value_at,
+        kernel_coverage_prediction,
+    )
+
+    pred = kernel_coverage_prediction(16, heal_tick=16, seeds=4)
+    assert pred["coverage"][-1] == 1.0
+    plateau = curve_value_at(
+        pred["times_s"], pred["coverage"], 16 * TICK_S - 0.001
+    )
+    assert 0.2 <= plateau <= 0.75  # severed-block fraction, not full
+    full_t = pred["t_at_coverage"]["1.0"]
+    assert full_t is not None and full_t > 16 * TICK_S - 1e-9
+
+
+def test_small_timeline_cell_end_to_end(tmp_path):
+    """A small live partition-heal cell produces a timeline (snapshots
+    + events) and a coverage curve with the plateau signature."""
+    from corrosion_tpu.sim.timeline import agent_timeline_cell
+
+    live = asyncio.run(agent_timeline_cell(
+        n=5, writes=4, heal_after=0.5, timeout=60.0,
+        base_dir=str(tmp_path),
+    ))
+    assert live["converged"]
+    cov = live["coverage"]
+    assert cov["waves"] == 4
+    assert cov["expected"] == 20
+    # every wave reached every node and provenance saw it
+    assert cov["samples"] + cov["missing"] == cov["expected"]
+    assert cov["t_at_coverage"]["1.0"] is not None
+    tl = live["timeline"]
+    assert tl["snapshots"] > 0
+    assert tl["event_counts"].get("sync_client_start", 0) > 0
+
+
+def test_crash_schedule_markers_reach_merged_timeline(tmp_path):
+    """run_crash_schedule journals `crash` into the dying ring (kept as
+    a controller orphan) and `restart` into the respawn; the observer
+    built with faults=ctrl must surface BOTH in the merged timeline —
+    a death must not erase the history that led up to it."""
+    from corrosion_tpu.devcluster import (
+        ClusterObserver,
+        Topology,
+        run_crash_schedule,
+        run_inprocess,
+    )
+    from corrosion_tpu.faults import CrashEvent, FaultController, FaultPlan
+
+    async def main():
+        plan = FaultPlan(
+            seed=3,
+            crashes=(CrashEvent("n1", at=0.05, restart_at=0.3),),
+        )
+        ctrl = FaultController(plan)
+        agents = await run_inprocess(
+            Topology.parse("n0 -> n1"), faults=ctrl,
+            base_dir=str(tmp_path), subs_enabled=False, api_port=None,
+            flight_interval_s=0.25,
+        )
+        try:
+            ctrl.restart_clock()
+            await run_crash_schedule(ctrl)
+            obs = ClusterObserver(ctrl.agents, faults=ctrl)
+            kinds = [
+                (e["node"], e["kind"]) for e in obs.flight_events()
+            ]
+            assert ("n1", "crash") in kinds
+            assert ("n1", "restart") in kinds
+            # the orphaned ring came from the controller, not the
+            # (respawned) live agent
+            assert ctrl.flight_orphans and ctrl.flight_orphans[0][0] == "n1"
+        finally:
+            for a in list(ctrl.agents.values()):
+                try:
+                    await a.stop()
+                except Exception:
+                    pass
+
+    asyncio.run(main())
+
+
+def test_event_kinds_registry_is_closed():
+    """Every registered kind has a non-empty description (the doc lint
+    in test_telemetry.py pins the registry against docs/telemetry.md)."""
+    assert all(isinstance(v, str) and v for v in EVENT_KINDS.values())

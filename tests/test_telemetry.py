@@ -271,6 +271,80 @@ def _documented_series() -> set:
     return documented
 
 
+def _emitted_event_kinds() -> set:
+    """Every flight-event kind LITERAL passed to an emission call
+    (``_flight_event(...)`` / ``<x>.event(...)``) in corrosion_tpu/ —
+    including both arms of a conditional first argument."""
+    names = set()
+    for p in sorted((REPO / "corrosion_tpu").rglob("*.py")):
+        tree = ast.parse(p.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            callee = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if callee not in ("_flight_event", "event"):
+                continue
+            for sub in ast.walk(node.args[0]):
+                if isinstance(sub, ast.Constant) and isinstance(
+                    sub.value, str
+                ):
+                    names.add(sub.value)
+    # `.event(` also matches unrelated calls; keep only kind-shaped
+    # literals so e.g. a threading.Event subclass can't pollute the set
+    return {n for n in names if re.fullmatch(r"[a-z][a-z0-9_]*", n)}
+
+
+def _documented_event_kinds() -> set:
+    """Backticked first-column cells of docs/telemetry.md's 'Flight
+    event registry' table."""
+    text = (REPO / "docs" / "telemetry.md").read_text()
+    m = re.search(
+        r"### Flight event registry\n(.*?)(?:\n#+ |\Z)", text, re.S
+    )
+    assert m, "docs/telemetry.md lost its 'Flight event registry' section"
+    kinds = set()
+    for line in m.group(1).splitlines():
+        mm = re.match(r"\|\s*`([a-z][a-z0-9_]*)`\s*\|", line)
+        if mm and mm.group(1) != "kind":
+            kinds.add(mm.group(1))
+    return kinds
+
+
+def test_event_registry_docs_and_emission_in_lockstep():
+    """The typed-event sibling of the series lint: every kind the
+    journal can carry (recorder.EVENT_KINDS) must be emitted somewhere,
+    documented in docs/telemetry.md, and nothing undeclared may be
+    emitted or documented."""
+    from corrosion_tpu.agent.recorder import EVENT_KINDS
+
+    registry = set(EVENT_KINDS)
+    emitted = _emitted_event_kinds()
+    documented = _documented_event_kinds()
+    assert registry, "empty event registry"
+    undocumented = sorted(registry - documented)
+    assert not undocumented, (
+        "registered flight-event kinds missing from docs/telemetry.md's "
+        f"event-registry table: {undocumented}"
+    )
+    phantom_docs = sorted(documented - registry)
+    assert not phantom_docs, (
+        "documented flight-event kinds absent from recorder.EVENT_KINDS: "
+        f"{phantom_docs}"
+    )
+    unregistered = sorted(emitted - registry)
+    assert not unregistered, (
+        f"emission sites pass kinds outside the registry: {unregistered}"
+    )
+    never_emitted = sorted(registry - emitted)
+    assert not never_emitted, (
+        "registered kinds with no emission site in corrosion_tpu/: "
+        f"{never_emitted}"
+    )
+
+
 def test_docs_and_emitted_series_in_lockstep():
     """Doc-drift lint: every `corro_*` series emitted in corrosion_tpu/
     must be named in docs/telemetry.md, and vice-versa — the build
