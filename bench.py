@@ -1253,6 +1253,20 @@ def main() -> None:
     ap.add_argument("--scenario-families", default=None,
                     help="comma-separated subset of scenario families "
                          "(default: all)")
+    ap.add_argument("--virtual-time", action="store_true",
+                    help="run --scenarios / --timeline on the "
+                         "virtual-time cluster (sim/vcluster.py): "
+                         "every agent timer advances by event-queue "
+                         "pops, so the full campaign stack runs at "
+                         "N=512-1024 in seconds of wall time; adds "
+                         "the scale-only families (restart storm, "
+                         "hostile-fraction sweeps, compound "
+                         "crash-composed cells) and, for --timeline, "
+                         "the N=32 virtual-vs-real parity cell")
+    ap.add_argument("--n", type=int, default=None,
+                    help="cluster size shorthand: overrides "
+                         "--scenario-nodes / --timeline-nodes "
+                         "(default 512 under --virtual-time)")
     ap.add_argument("--timeline", action="store_true",
                     help="run the flight-recorder timeline campaign "
                          "(live N=32 partition-heal trajectory gated "
@@ -1332,12 +1346,20 @@ def main() -> None:
         _emit(run_msgs_calibration(out_path=out_path))
         return
     if args.timeline:
+        n = args.n or (
+            512 if args.virtual_time else args.timeline_nodes
+        )
         out_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)),
-            f"TIMELINE_N{args.timeline_nodes}.json",
+            f"TIMELINE_N{n}.json",
         )
+        if args.virtual_time:
+            from corrosion_tpu.sim.timeline import run_virtual_timeline
+
+            _emit(run_virtual_timeline(n=n, out_path=out_path))
+            return
         _emit(run_timeline_bench(
-            n=args.timeline_nodes, out_path=out_path,
+            n=n, out_path=out_path,
         ))
         return
     if args.obs:
@@ -1363,19 +1385,29 @@ def main() -> None:
         ))
         return
     if args.scenarios:
-        from corrosion_tpu.sim.scenarios import run_scenarios
-
+        n = args.n or (
+            512 if args.virtual_time else args.scenario_nodes
+        )
         out_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)),
-            f"SCENARIOS_N{args.scenario_nodes}.json",
+            f"SCENARIOS_N{n}.json",
         )
         families = (
             [f.strip() for f in args.scenario_families.split(",")
              if f.strip()]
             if args.scenario_families else None
         )
+        if args.virtual_time:
+            from corrosion_tpu.sim.scenarios import run_virtual_scenarios
+
+            _emit(run_virtual_scenarios(
+                n=n, families=families, out_path=out_path,
+            ))
+            return
+        from corrosion_tpu.sim.scenarios import run_scenarios
+
         _emit(asyncio.run(run_scenarios(
-            n=args.scenario_nodes, families=families,
+            n=n, families=families,
             out_path=out_path,
         )))
         return

@@ -41,8 +41,16 @@ class LoopHealthProbe:
     """One agent's event-loop stall probe + attribution watchdog."""
 
     def __init__(self, metrics, interval: float = 0.05,
-                 slow_ms: float = 50.0, package: str = "corrosion_tpu"):
+                 slow_ms: float = 50.0, package: str = "corrosion_tpu",
+                 clock=None):
+        from corrosion_tpu.clock import SYSTEM_CLOCK
+
         self.metrics = metrics
+        # the probe's wakeup timer rides the injectable clock; the
+        # watchdog THREAD stays on real time — its whole job is an
+        # out-of-band view of the loop, and thread-side waits are not
+        # agent timers (docs/sim.md, virtual-time table)
+        self._clock = clock or SYSTEM_CLOCK
         self.interval = max(0.001, float(interval))
         self.slow_ms = float(slow_ms)
         self.package = package
@@ -71,7 +79,7 @@ class LoopHealthProbe:
         try:
             while True:
                 self._beat = time.monotonic()
-                await asyncio.sleep(self.interval)
+                await self._clock.sleep(self.interval)
                 now = loop.time()
                 stall_ms = max(0.0, (now - last - self.interval) * 1e3)
                 last = now

@@ -33,7 +33,10 @@ class Member:
     state: MemberState = MemberState.ALIVE
     incarnation: int = 0
     cluster_id: int = 0
-    rtts: deque = field(default_factory=lambda: deque(maxlen=RTT_SAMPLES))
+    # RTT ring, allocated on the FIRST sample (None until then): most
+    # members of a large cluster are never probed between samples, and
+    # the per-record deque allocation is ~1 s of a 512-node boot
+    rtts: Optional[deque] = None
     last_sync_ts: float = 0.0
     last_seen: float = field(default_factory=time.monotonic)
     # quarantine: a peer is deprioritized in fanout sampling the way
@@ -45,6 +48,11 @@ class Member:
     # verdict expiry or an identity renewal)
     quarantined: bool = False
     quarantine_reason: str = ""
+
+    def note_rtt(self, rtt_ms: float) -> None:
+        if self.rtts is None:
+            self.rtts = deque(maxlen=RTT_SAMPLES)
+        self.rtts.append(rtt_ms)
 
     @property
     def rtt_ms(self) -> Optional[float]:
@@ -62,12 +70,24 @@ class Member:
 
 class Members:
     """Thread-safe membership view (written by the SWIM loop, read by
-    broadcast fanout and sync peer selection)."""
+    broadcast fanout and sync peer selection).
 
-    def __init__(self, self_actor: bytes):
+    ``clock`` sources every ``last_seen`` stamp (the injectable-clock
+    seam, ``corrosion_tpu/clock.py``): under a virtual-time campaign
+    member freshness ages on the event heap, not the wall."""
+
+    def __init__(self, self_actor: bytes, clock=None):
+        from corrosion_tpu.clock import SYSTEM_CLOCK
+
         self.self_actor = self_actor
+        self._clock = clock or SYSTEM_CLOCK
         self._members: Dict[bytes, Member] = {}
         self._lock = threading.RLock()
+        # alive() result cache, invalidated by membership/state
+        # mutations: broadcast fanout samples call alive() per flush
+        # and the O(N) rebuild dominates big-cluster flush rounds.
+        # Callers receive the SHARED list and must not mutate it.
+        self._alive_cache: Optional[List[Member]] = None
 
     def upsert(
         self,
@@ -89,10 +109,13 @@ class Members:
                 self._members[actor_id] = Member(
                     actor_id=actor_id, addr=tuple(addr), state=state,
                     incarnation=incarnation, cluster_id=cluster_id,
+                    last_seen=self._clock.monotonic(),
                 )
+                self._alive_cache = None
                 return True
             if (incarnation, rank[state]) <= (m.incarnation, rank[m.state]):
                 return False
+            self._alive_cache = None
             if tuple(addr) != tuple(m.addr) \
                     and m.quarantine_reason != "equivocation":
                 # the peer moved (e.g. restarted on a fresh ephemeral
@@ -106,7 +129,7 @@ class Members:
             m.state = state
             m.incarnation = incarnation
             m.addr = tuple(addr)
-            m.last_seen = time.monotonic()
+            m.last_seen = self._clock.monotonic()
             return True
 
     def revive(self, actor_id: bytes) -> None:
@@ -120,11 +143,13 @@ class Members:
             m = self._members.get(actor_id)
             if m and m.state is MemberState.SUSPECT:
                 m.state = MemberState.ALIVE
-                m.last_seen = time.monotonic()
+                m.last_seen = self._clock.monotonic()
+                self._alive_cache = None
 
     def remove(self, actor_id: bytes) -> None:
         with self._lock:
             self._members.pop(actor_id, None)
+            self._alive_cache = None
 
     def get(self, actor_id: bytes) -> Optional[Member]:
         with self._lock:
@@ -134,8 +159,8 @@ class Members:
         with self._lock:
             m = self._members.get(actor_id)
             if m:
-                m.rtts.append(rtt_ms)
-                m.last_seen = time.monotonic()
+                m.note_rtt(rtt_ms)
+                m.last_seen = self._clock.monotonic()
 
     def update_sync_ts(self, actor_id: bytes, ts: float) -> None:
         with self._lock:
@@ -179,11 +204,18 @@ class Members:
         return False
 
     def alive(self) -> List[Member]:
+        """Non-DOWN members.  The returned list is CACHED and shared
+        between calls until the next membership/state mutation —
+        read-only by contract (every in-tree caller filters or samples
+        from it)."""
         with self._lock:
-            return [
-                m for m in self._members.values()
-                if m.state is not MemberState.DOWN
-            ]
+            cached = self._alive_cache
+            if cached is None:
+                cached = self._alive_cache = [
+                    m for m in self._members.values()
+                    if m.state is not MemberState.DOWN
+                ]
+            return cached
 
     def all(self) -> List[Member]:
         with self._lock:

@@ -144,11 +144,13 @@ async def read_frames(reader: asyncio.StreamReader):
         yield cls, ch, payload
 
 
-async def _pause_while_backlogged(channels) -> None:
+async def _pause_while_backlogged(channels, clock=None) -> None:
+    if clock is None:
+        from corrosion_tpu.clock import SYSTEM_CLOCK as clock
     while any(
         _backlog(r) > CHANNEL_BUF_CAP for r in channels.values()
     ):
-        await asyncio.sleep(0.01)
+        await clock.sleep(0.01)
 
 
 def lane_of(addr: Addr, lanes: int = LANES) -> int:
@@ -262,10 +264,11 @@ class MuxConnection:
     any number of concurrent bi channels."""
 
     def __init__(self, reader: asyncio.StreamReader,
-                 writer: asyncio.StreamWriter, metrics=None):
+                 writer: asyncio.StreamWriter, metrics=None, clock=None):
         self.reader = reader
         self.writer = writer
         self.metrics = metrics
+        self._clock = clock  # backpressure-poll time source (None = real)
         self.wlock = asyncio.Lock()
         self._channels: Dict[int, asyncio.StreamReader] = {}
         self._next_id = 1
@@ -329,7 +332,8 @@ class MuxConnection:
     async def _pump(self) -> None:
         try:
             async for cls, ch, payload in read_frames(self.reader):
-                await _pause_while_backlogged(self._channels)
+                await _pause_while_backlogged(self._channels,
+                                              clock=self._clock)
                 if cls == CLASS_BI_S2C:
                     r = self._channels.get(ch)
                     if r is None:
@@ -438,7 +442,9 @@ async def serve_mux(agent, reader: asyncio.StreamReader,
     tombstones = TombstoneSet()
     try:
         async for cls, ch, payload in read_frames(reader):
-            await _pause_while_backlogged(channels)
+            await _pause_while_backlogged(
+                channels, clock=getattr(agent, "_clock", None)
+            )
             if cls == CLASS_UNI:
                 agent._ingest_uni_payloads(uni_frames.feed(payload))
                 if agent.metrics is not None:

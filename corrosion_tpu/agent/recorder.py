@@ -40,7 +40,6 @@ from __future__ import annotations
 import json
 import os
 import threading
-import time
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -85,9 +84,17 @@ class FlightRecorder:
                  export_path: Optional[str] = None,
                  export_max_bytes: int = 64 * 1024 * 1024,
                  crash_path: Optional[str] = None,
-                 node: Optional[str] = None):
+                 node: Optional[str] = None,
+                 timebase=None):
+        from corrosion_tpu.clock import SYSTEM_CLOCK
+
         self.metrics = metrics
         self.clock = clock
+        # ``clock`` is the HLC (the merge axis); ``timebase`` is the
+        # agent's injectable Clock — the wall half of every stamp and
+        # the snapshot cadence, so a virtual-time campaign journals
+        # deterministic timestamps
+        self.timebase = timebase or SYSTEM_CLOCK
         self.interval = max(0.01, float(interval))
         self.node = node
         self._ring: deque = deque(maxlen=max(8, int(ring_max)))
@@ -125,7 +132,7 @@ class FlightRecorder:
         new_timestamp would mint, without advancing the clock —
         telemetry must not mutate protocol clock state), the merge axis
         the cluster timeline sorts on."""
-        return int(self.clock.observe_timestamp()), time.time()
+        return int(self.clock.observe_timestamp()), self.timebase.wall()
 
     # -- the event journal ---------------------------------------------
 
@@ -153,7 +160,7 @@ class FlightRecorder:
         import asyncio
 
         while True:
-            await asyncio.sleep(self.interval)
+            await self.timebase.sleep(self.interval)
             # off-loop: the snapshot sorts every histogram window for
             # its quantiles — worker-thread work, not loop work (the
             # stall probe must never attribute a stall to its sibling)

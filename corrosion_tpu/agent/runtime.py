@@ -265,6 +265,16 @@ class AgentConfig:
     tls_client_required: bool = False  # mTLS: peers must present certs
     tls_client_cert_file: Optional[str] = None
     tls_client_key_file: Optional[str] = None
+    # the one injectable time source (corrosion_tpu/clock.py) behind
+    # every agent timer: sleeps, monotonic state stamps, wall clocks
+    # and the HLC physical source.  None = SYSTEM_CLOCK — real time,
+    # behavior- and wire-byte-identical to the pre-clock agent
+    clock: Optional[object] = None
+    # fixed site (actor) id for a FRESH database; None = random uuid4
+    # as before.  The virtual-time cluster derives ids from its seed so
+    # two runs of one campaign are byte-identical; a restart from an
+    # existing directory keeps the persisted id either way
+    site_id: Optional[bytes] = None
 
 
 async def _cancel_tasks(tasks, rounds: int = 5, timeout: float = 2.0):
@@ -299,12 +309,18 @@ class Agent:
     def __init__(self, config: AgentConfig):
         self.config = config
         from corrosion_tpu.agent.locks import LockRegistry
+        from corrosion_tpu.clock import SYSTEM_CLOCK
 
+        # the injectable time source (docs/sim.md, virtual time): every
+        # timer/stamp below reads THIS, so a virtual-time campaign can
+        # drive hundreds of agents off one event heap
+        self._clock = config.clock or SYSTEM_CLOCK
         # lock tracking costs a few ops per acquisition on the hottest
         # lock; only pay for it when the admin surface can read it
         self.lock_registry = LockRegistry()
         self.storage = CrConn(
             config.db_path,
+            site_id=config.site_id,
             lock_registry=self.lock_registry if config.admin_path else None,
         )
         self.bookie = Bookie(self.storage.conn, lock=self.storage._lock)
@@ -314,12 +330,13 @@ class Agent:
             from corrosion_tpu.types.hlc import skewed_now_ns
 
             self.clock = HLClock(now_ns=skewed_now_ns(
-                config.clock_skew_ns, config.clock_drift
+                config.clock_skew_ns, config.clock_drift,
+                base=self._clock.wall_ns,
             ))
         else:
-            self.clock = HLClock()
+            self.clock = HLClock(now_ns=self._clock.wall_ns)
         self.actor_id = self.storage.site_id
-        self.members = Members(self.actor_id)
+        self.members = Members(self.actor_id, clock=self._clock)
         from corrosion_tpu.agent.metrics import Metrics
 
         self.metrics = Metrics()
@@ -365,6 +382,13 @@ class Agent:
         self._equiv_digests: Dict[tuple, bytes] = {}
         self._equiv_lock = threading.Lock()
         self._equiv_quarantined: Dict[bytes, float] = {}
+        # digests survive restarts (__corro_equiv_digests): an
+        # equivocator must not be able to wait out a reboot of its
+        # victim — the conflicting re-send after a restart compares
+        # against the RELOADED digest and re-quarantines immediately.
+        # Gated: with detection off nothing ever reads or writes them
+        if config.equivocation_detection:
+            self._load_equiv_digests()
         # loop health probe (agent/health.py), created on start()
         self.health = None
         # flight recorder (agent/recorder.py): created NOW — event
@@ -376,6 +400,7 @@ class Agent:
 
             self.flight = FlightRecorder(
                 self.metrics, self.clock,
+                timebase=self._clock,
                 interval=config.flight_interval_s,
                 ring_max=config.flight_ring_max,
                 export_path=config.flight_export_path,
@@ -568,6 +593,7 @@ class Agent:
             rng=random.Random(
                 int.from_bytes(self.actor_id[4:8], "big") ^ 0x5EED
             ),
+            clock=self._clock,
         )
         if self.fault_filter is not None:
             self.transport.fault_filter = self.fault_filter
@@ -614,6 +640,7 @@ class Agent:
                 self.metrics,
                 interval=self.config.stall_probe_interval,
                 slow_ms=self.config.stall_probe_slow_ms,
+                clock=self._clock,
             )
             self._tasks.append(
                 self._spawn_task(self.health.run(), "health")
@@ -858,7 +885,7 @@ class Agent:
         # per-origin-actor staleness (provenance plane): wall-now minus
         # the freshest origin-commit ts applied from that actor — a
         # rising series means we stopped converging on its writes
-        now_wall = time.time()
+        now_wall = self._clock.wall()
         for actor, ts_wall in self._staleness_entries(now_wall):
             extra.append((
                 "corro_change_staleness_seconds",
@@ -913,7 +940,7 @@ class Agent:
         convergence lag (windowed quantiles from the agent's own
         provenance measurement), and per-origin staleness — the
         always-on form of the gates the benches enforce."""
-        now_wall = time.time()
+        now_wall = self._clock.wall()
         staleness = {
             actor.hex(): round(max(0.0, now_wall - ts), 3)
             for actor, ts in self._staleness_entries(now_wall)
@@ -1173,7 +1200,7 @@ class Agent:
                         )
             if known or not targets:
                 delay = min(delay * 2, 30.0)
-            await asyncio.sleep(delay)
+            await self._clock.sleep(delay)
 
     def _load_incarnation(self) -> int:
         row = self.storage.conn.execute(
@@ -1259,7 +1286,7 @@ class Agent:
         from corrosion_tpu.agent import swim_foca
 
         while True:
-            await asyncio.sleep(interval)
+            await self._clock.sleep(interval)
             try:
                 sent = swim_foca.gossip_round(
                     self, self.config.gossip_fanout
@@ -1273,7 +1300,7 @@ class Agent:
 
     async def _probe_loop(self) -> None:
         while True:
-            await asyncio.sleep(self.config.probe_interval)
+            await self._clock.sleep(self.config.probe_interval)
             alive = self.members.alive()
             if not alive:
                 continue
@@ -1288,11 +1315,15 @@ class Agent:
         nonce = self._next_probe_number()
         fut = self._loop.create_future()
         self._acks[nonce] = fut
-        t0 = time.monotonic()
+        t0 = self._clock.monotonic()
         self._swim_probe(m, nonce)
         try:
-            await asyncio.wait_for(fut, timeout or self.config.probe_timeout)
-            self.members.record_rtt(m.actor_id, (time.monotonic() - t0) * 1e3)
+            await self._clock.wait_for(
+                fut, timeout or self.config.probe_timeout
+            )
+            self.members.record_rtt(
+                m.actor_id, (self._clock.monotonic() - t0) * 1e3
+            )
             self._suspects.pop(m.actor_id, None)
             self.members.revive(m.actor_id)
             return True
@@ -1316,7 +1347,7 @@ class Agent:
         for h in helpers:
             self._swim_ping_req(h, target, nonce)
         try:
-            await asyncio.wait_for(fut, self.config.probe_timeout * 2)
+            await self._clock.wait_for(fut, self.config.probe_timeout * 2)
             self._suspects.pop(target.actor_id, None)
             self.members.revive(target.actor_id)
             return True
@@ -1329,7 +1360,7 @@ class Agent:
         if self.members.upsert(
             m.actor_id, m.addr, MemberState.SUSPECT, m.incarnation
         ):
-            self._suspects[m.actor_id] = time.monotonic()
+            self._suspects[m.actor_id] = self._clock.monotonic()
             self._swim_update_tx[m.actor_id] = 0  # fresh news
 
     def _suspect_deadline(self) -> float:
@@ -1352,14 +1383,14 @@ class Agent:
         member that hears a suspicion).  Shared by both wire ingest
         paths so they cannot diverge."""
         if state is MemberState.SUSPECT:
-            self._suspects.setdefault(actor, time.monotonic())
+            self._suspects.setdefault(actor, self._clock.monotonic())
         else:
             self._suspects.pop(actor, None)
 
     def _reap_suspects(self) -> None:
         """One suspicion-deadline pass (extracted so tests can drive
         it without the loop's cadence)."""
-        now = time.monotonic()
+        now = self._clock.monotonic()
         deadline = self._suspect_deadline()
         for actor, since in list(self._suspects.items()):
             if now - since >= deadline:
@@ -1373,7 +1404,7 @@ class Agent:
 
     async def _suspect_reaper(self) -> None:
         while True:
-            await asyncio.sleep(self.config.probe_interval)
+            await self._clock.sleep(self.config.probe_interval)
             self._reap_suspects()
 
     # ------------------------------------------------------------------
@@ -2047,19 +2078,19 @@ class Agent:
         from corrosion_tpu.agent.transport import TokenBucket
 
         cfg = self.config
-        bucket = TokenBucket(cfg.bcast_rate_limit)
+        bucket = TokenBucket(cfg.bcast_rate_limit, clock=self._clock)
         # (due_time, frame, cv, remaining, sent_to) — sent_to mirrors the
         # reference's per-payload sent_to set (broadcast/mod.rs:683-690):
         # a payload is never retransmitted to a peer that already got it
         pending: List[tuple] = []
         buffer: List[tuple] = []  # (frame, cv, remaining, sent_to)
         buf_bytes = 0
-        last_flush = time.monotonic()
+        last_flush = self._clock.monotonic()
 
         async def flush():
             nonlocal buffer, buf_bytes, last_flush
             batch, buffer, buf_bytes = buffer, [], 0
-            last_flush = time.monotonic()
+            last_flush = self._clock.monotonic()
             if not batch:
                 return
             # per-destination frame groups: each payload picks its own
@@ -2083,7 +2114,7 @@ class Agent:
                 # and keeps the entry alive (empty targets = every alive
                 # member already got it)
                 if remaining > 1 and targets:
-                    due = time.monotonic() + cfg.rebroadcast_delay * (
+                    due = self._clock.monotonic() + cfg.rebroadcast_delay * (
                         cfg.max_transmissions - remaining + 1
                     )
                     pending.append((due, frame, cv, remaining - 1, sent_to))
@@ -2133,7 +2164,7 @@ class Agent:
 
         while True:
             self._bcast_wakeups += 1
-            now = time.monotonic()
+            now = self._clock.monotonic()
             # requeued retransmissions that are due
             due_now = [p for p in pending if p[0] <= now]
             if due_now:
@@ -2153,7 +2184,7 @@ class Agent:
             else:
                 timeout = None
             try:
-                cv, remaining, hop, tp = await asyncio.wait_for(
+                cv, remaining, hop, tp = await self._clock.wait_for(
                     self._bcast_queue.get(), timeout=timeout
                 )
                 frame = self.encode_broadcast_frame(cv, hop, tp)
@@ -2163,7 +2194,8 @@ class Agent:
                 pass
             if buf_bytes >= cfg.bcast_buffer_cutoff or (
                 buffer
-                and time.monotonic() - last_flush >= cfg.bcast_flush_interval
+                and self._clock.monotonic() - last_flush
+                >= cfg.bcast_flush_interval
             ):
                 await flush()
 
@@ -2278,10 +2310,10 @@ class Agent:
             # apply_queue_len or a short tick passes (handlers.rs:755)
             batch: List[tuple] = []
             cost = 0
-            deadline = self._loop.time() + cfg.apply_queue_timeout
+            deadline = self._clock.monotonic() + cfg.apply_queue_timeout
             while cost < cfg.apply_queue_len:
                 if not self._ingest:
-                    remaining = deadline - self._loop.time()
+                    remaining = deadline - self._clock.monotonic()
                     if remaining <= 0 or batch:
                         break
                     try:
@@ -2623,12 +2655,50 @@ class Agent:
                 return "span"
         return None
 
+    def _load_equiv_digests(self) -> None:
+        """Boot-time reload of the accepted-content digests (newest
+        ``seen_cache_size``, re-inserted oldest-first so the in-memory
+        FIFO keeps evicting in age order)."""
+        self.storage.conn.execute(
+            "CREATE TABLE IF NOT EXISTS __corro_equiv_digests ("
+            " actor_id BLOB NOT NULL, version INTEGER NOT NULL,"
+            " digest BLOB NOT NULL, PRIMARY KEY (actor_id, version))"
+        )
+        rows = self.storage.conn.execute(
+            "SELECT actor_id, version, digest FROM __corro_equiv_digests"
+            " ORDER BY rowid DESC LIMIT ?",
+            (self.config.seen_cache_size,),
+        ).fetchall()
+        for actor, v, digest in reversed(rows):
+            self._equiv_digests[(bytes(actor), int(v))] = bytes(digest)
+
     def _remember_digest(self, actor: bytes, v: int, digest: bytes) -> None:
+        """Record the accepted content digest for ``(actor, v)`` —
+        in-memory FIFO + durable write-through.  Callers hold the
+        storage lock (both sites sit inside apply paths), so the
+        durable row commits on the shared write connection without a
+        re-acquire; persistence failure never blocks the apply seam."""
+        evicted = None
         with self._equiv_lock:
             dig = self._equiv_digests
             dig[(actor, v)] = digest
             if len(dig) > self.config.seen_cache_size:
-                dig.pop(next(iter(dig)))
+                evicted = next(iter(dig))
+                dig.pop(evicted)
+        try:
+            self.storage.conn.execute(
+                "INSERT OR REPLACE INTO __corro_equiv_digests"
+                " (actor_id, version, digest) VALUES (?, ?, ?)",
+                (actor, v, digest),
+            )
+            if evicted is not None:
+                self.storage.conn.execute(
+                    "DELETE FROM __corro_equiv_digests"
+                    " WHERE actor_id = ? AND version = ?",
+                    evicted,
+                )
+        except Exception:
+            logger.debug("equiv digest persist failed", exc_info=True)
 
     def _check_content_equivocation(self, actor: bytes, cs) -> bool:
         """Compare a duplicate complete changeset's content digest
@@ -2695,7 +2765,8 @@ class Agent:
             "corro_sync_equivocations_total", kind=kind
         )
         hold = self.config.equiv_quarantine_s
-        deadline = (time.monotonic() + hold) if hold > 0 else float("inf")
+        deadline = (self._clock.monotonic() + hold) if hold > 0 \
+            else float("inf")
         with self._equiv_lock:
             first = actor not in self._equiv_quarantined
             self._equiv_quarantined[actor] = deadline
@@ -2758,7 +2829,7 @@ class Agent:
             return False
         deadline = self._equiv_quarantined.get(actor)
         if deadline is not None:
-            if time.monotonic() < deadline:
+            if self._clock.monotonic() < deadline:
                 # a detected equivocator's traffic is poison while the
                 # verdict holds: drop everything, count the volume
                 self.metrics.counter(
@@ -2866,7 +2937,7 @@ class Agent:
         A/B's whole budget."""
         if not self.config.provenance:
             return
-        now = time.time()
+        now = self._clock.wall()
         # ONE arrival-HLC observation for the whole batch (mirroring the
         # single wall-clock read above): the items share one arrival
         # instant, and per-item observe_timestamp calls would take the
@@ -3138,7 +3209,7 @@ class Agent:
         database takes 100ms+, and running it on the event loop stalled
         SWIM acks every maintenance tick."""
         while True:
-            await asyncio.sleep(self.config.maintenance_interval)
+            await self._clock.sleep(self.config.maintenance_interval)
             try:
                 await self._loop.run_in_executor(
                     self._apply_pool, self._maintenance_pass
@@ -3203,7 +3274,7 @@ class Agent:
             )
         )
         while True:
-            await asyncio.sleep(next(delays))
+            await self._clock.sleep(next(delays))
             try:
                 await self.sync_round()
             except Exception:
@@ -3552,7 +3623,8 @@ class Agent:
         self._sync_sess_seq += 1
         live = {
             "id": self._sync_sess_seq, "role": role, "peer": peer,
-            "started": time.monotonic(), "needs_total": needs_total,
+            "started": self._clock.monotonic(),
+            "needs_total": needs_total,
             "needs_done": 0, "changes": 0, "bytes": 0,
         }
         self._sync_live[live["id"]] = live
@@ -3563,7 +3635,7 @@ class Agent:
         self._sync_live.pop(live["id"], None)
         self.metrics.histogram(
             "corro_sync_session_seconds",
-            time.monotonic() - live["started"], role=role,
+            self._clock.monotonic() - live["started"], role=role,
         )
         if live["bytes"]:
             self.metrics.counter(
@@ -3580,7 +3652,7 @@ class Agent:
         null for client sessions — their progress signal is
         ``changes`` (changesets ingested so far), which must keep
         moving for a healthy backfill."""
-        now = time.monotonic()
+        now = self._clock.monotonic()
         out = []
         for e in list(self._sync_live.values()):
             client = e["role"] == "client"
@@ -3653,7 +3725,7 @@ class Agent:
                         await self._ingest_sync_change(msg)
                         count += 1
                         live["changes"] = count
-            self.members.update_sync_ts(m.actor_id, time.time())
+            self.members.update_sync_ts(m.actor_id, self._clock.wall())
             self.metrics.counter("corro_sync_client_rounds_total")
             complete = True
             # per-change accounting happens at enqueue_change
@@ -4287,7 +4359,7 @@ class Agent:
             # per-session served-byte accounting: every serve path
             # (oracle and batched) funnels its writes through here
             sess["live"]["bytes"] += len(blob)
-        t0 = time.monotonic()
+        t0 = self._clock.monotonic()
         try:
             await asyncio.wait_for(
                 writer.drain(), timeout=self.SYNC_SLOW_ABORT
@@ -4295,7 +4367,7 @@ class Agent:
         except asyncio.TimeoutError:
             raise _SlowPeer("peer too slow: send exceeded abort budget")
         if sess is not None:
-            elapsed = time.monotonic() - t0
+            elapsed = self._clock.monotonic() - t0
             if elapsed > self.SYNC_ADAPT_THRESHOLD:
                 if sess["chunk"] <= self.SYNC_CHUNK_MIN:
                     raise _SlowPeer(

@@ -30,16 +30,21 @@ MAX_UDP_PAYLOAD = 1178
 
 class TokenBucket:
     """Byte-rate limiter (the 10 MiB/s broadcast governor,
-    broadcast/mod.rs:455-458)."""
+    broadcast/mod.rs:455-458).  ``clock`` injects the time source
+    (``corrosion_tpu/clock.py``); default = real time."""
 
-    def __init__(self, rate: float, burst: Optional[float] = None):
+    def __init__(self, rate: float, burst: Optional[float] = None,
+                 clock=None):
+        from corrosion_tpu.clock import SYSTEM_CLOCK
+
         self.rate = float(rate)
         self.burst = float(burst if burst is not None else rate)
+        self._clock = clock or SYSTEM_CLOCK
         self._tokens = self.burst
-        self._last = time.monotonic()
+        self._last = self._clock.monotonic()
 
     def _refill(self) -> None:
-        now = time.monotonic()
+        now = self._clock.monotonic()
         self._tokens = min(
             self.burst, self._tokens + (now - self._last) * self.rate
         )
@@ -52,7 +57,7 @@ class TokenBucket:
                 self._tokens -= n
                 return
             need = (n - self._tokens) / self.rate
-            await asyncio.sleep(min(need, 1.0))
+            await self._clock.sleep(min(need, 1.0))
 
 
 class ConnStats:
@@ -104,11 +109,16 @@ class CircuitBreaker:
     timeout burns zero wall-clock on the corpse."""
 
     __slots__ = ("threshold", "cooldown", "failures", "opened_at",
-                 "half_open_inflight")
+                 "half_open_inflight", "_now")
 
-    def __init__(self, threshold: int = 5, cooldown: float = 3.0):
+    def __init__(self, threshold: int = 5, cooldown: float = 3.0,
+                 now=None):
         self.threshold = threshold
         self.cooldown = cooldown
+        # the cooldown's time source (injectable-clock seam): a
+        # virtual-time campaign ages breaker cooldowns on the event
+        # heap instead of the wall
+        self._now = now or time.monotonic
         self.failures = 0
         self.opened_at: Optional[float] = None
         self.half_open_inflight = False
@@ -120,7 +130,7 @@ class CircuitBreaker:
     def allow(self, now: Optional[float] = None) -> bool:
         if self.opened_at is None:
             return True
-        now = time.monotonic() if now is None else now
+        now = self._now() if now is None else now
         if now - self.opened_at < self.cooldown:
             return False
         # cooldown passed: admit one half-open trial at a time
@@ -144,18 +154,18 @@ class CircuitBreaker:
         self.half_open_inflight = False
         if self.opened_at is not None:
             # half-open trial failed: restart the cooldown
-            self.opened_at = time.monotonic() if now is None else now
+            self.opened_at = self._now() if now is None else now
             return False
         self.failures += 1
         if self.failures >= self.threshold:
-            self.opened_at = time.monotonic() if now is None else now
+            self.opened_at = self._now() if now is None else now
             return True
         return False
 
     def state(self) -> str:
         if self.opened_at is None:
             return "closed"
-        if time.monotonic() - self.opened_at >= self.cooldown:
+        if self._now() - self.opened_at >= self.cooldown:
             return "half-open"
         return "open"
 
@@ -195,9 +205,15 @@ class Transport:
                  breaker_threshold: int = 5,
                  breaker_cooldown: float = 3.0,
                  on_breaker: Optional[Callable[[Addr, bool], None]] = None,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 clock=None):
+        from corrosion_tpu.clock import SYSTEM_CLOCK
+
         self._uni: Dict[Addr, UniConnection] = {}
         self.metrics = metrics
+        # the injectable time source behind cooldowns, RTT stamps,
+        # fault delays and redial backoff sleeps
+        self._clock = clock or SYSTEM_CLOCK
         self.connect_timeout = connect_timeout
         self.on_rtt = on_rtt  # callback(addr, rtt_seconds)
         self.ssl_context = ssl_context  # TLS for uni/bi streams (or None)
@@ -241,7 +257,7 @@ class Transport:
                 oldest = sorted(self.stats, key=lambda a: self.stats[a].last_used)
                 for a in oldest[: len(self.stats) - 2 * self.max_cached]:
                     del self.stats[a]
-        s.last_used = time.monotonic()
+        s.last_used = self._clock.monotonic()
         return s
 
     def _record_rtt_stat(self, addr: Addr, rtt_s: float) -> None:
@@ -263,7 +279,8 @@ class Transport:
                           if not br.is_open and br.failures == 0]:
                     del self.breakers[a]
             b = self.breakers[addr] = CircuitBreaker(
-                self.breaker_threshold, self.breaker_cooldown
+                self.breaker_threshold, self.breaker_cooldown,
+                now=self._clock.monotonic,
             )
         return b
 
@@ -299,14 +316,14 @@ class Transport:
         return {a: b.state() for a, b in self.breakers.items()}
 
     async def _open(self, addr: Addr, header: bytes) -> UniConnection:
-        t0 = time.monotonic()
+        t0 = self._clock.monotonic()
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(
                 addr[0], addr[1], ssl=self.ssl_context
             ),
             timeout=self.connect_timeout,
         )
-        rtt = time.monotonic() - t0
+        rtt = self._clock.monotonic() - t0
         self._stat(addr).connects += 1
         self._record_rtt_stat(addr, rtt)
         if self.on_rtt is not None:
@@ -344,14 +361,14 @@ class Transport:
             m = self._muxes.get(addr)
             if m is not None and not m.closed:
                 return m
-            t0 = time.monotonic()
+            t0 = self._clock.monotonic()
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(
                     addr[0], addr[1], ssl=self.ssl_context
                 ),
                 timeout=self.connect_timeout,
             )
-            rtt = time.monotonic() - t0
+            rtt = self._clock.monotonic() - t0
             self._stat(addr).connects += 1
             self._record_rtt_stat(addr, rtt)
             if self.on_rtt is not None:
@@ -361,7 +378,8 @@ class Transport:
                     "corro_transport_connect_seconds", rtt)
             writer.write(STREAM_MUX)
             await writer.drain()
-            m = MuxConnection(reader, writer, metrics=self.metrics)
+            m = MuxConnection(reader, writer, metrics=self.metrics,
+                              clock=self._clock)
             self._muxes[addr] = m
             excess = len(self._muxes) - self.max_cached
             if excess > 0:
@@ -390,7 +408,7 @@ class Transport:
         act = self._fault("uni", addr)
         if act is not None:
             if act.delay:
-                await asyncio.sleep(act.delay)
+                await self._clock.sleep(act.delay)
             if act.drop:
                 self._stat(addr).faults_dropped += 1
                 if self.metrics is not None:
@@ -431,6 +449,7 @@ class Transport:
                     Backoff(self.redial_base, self.redial_cap,
                             max_retries=self.redial_retries,
                             rng=self._rng),
+                    sleep=self._clock.sleep,
                 )
             except (OSError, ConnectionError, asyncio.TimeoutError):
                 self._stat(addr).failures += 1
@@ -504,7 +523,7 @@ class Transport:
         act = self._fault("bi", addr)
         if act is not None:
             if act.delay:
-                await asyncio.sleep(act.delay)
+                await self._clock.sleep(act.delay)
             if act.drop:
                 self._stat(addr).faults_dropped += 1
                 if self.metrics is not None:
@@ -539,6 +558,7 @@ class Transport:
                     Backoff(self.redial_base, self.redial_cap,
                             max_retries=self.redial_retries,
                             rng=self._rng),
+                    sleep=self._clock.sleep,
                 )
             except (OSError, ConnectionError, asyncio.TimeoutError):
                 self._stat(addr).failures += 1
@@ -562,7 +582,7 @@ class Transport:
                 raise OSError("fault injected: bi stream dropped")
             self._breaker_success(addr)
             return chan
-        t0 = time.monotonic()
+        t0 = self._clock.monotonic()
         try:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(
@@ -574,7 +594,7 @@ class Transport:
             self._stat(addr).failures += 1
             self._breaker_failure(addr)
             raise
-        rtt = time.monotonic() - t0
+        rtt = self._clock.monotonic() - t0
         self._stat(addr).connects += 1
         self._record_rtt_stat(addr, rtt)
         if self.on_rtt is not None:

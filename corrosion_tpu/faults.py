@@ -515,9 +515,14 @@ class EquivocatingPeer:
     ts would record absurd lags into the cell's p99.
     """
 
-    def __init__(self, seed: int = 0, table: str = "tests"):
+    def __init__(self, seed: int = 0, table: str = "tests",
+                 now_ns: Optional[Callable[[], int]] = None):
         self.seed = seed
         self.table = table
+        # injectable craft-time clock (the Clock seam): a virtual-time
+        # campaign stamps hostile changesets on the virtual wall so two
+        # runs with one seed emit byte-identical attacks
+        self.now_ns = now_ns
         self.actor_id = hashlib.blake2b(
             f"equivocator:{seed}".encode(), digest_size=16
         ).digest()
@@ -527,7 +532,8 @@ class EquivocatingPeer:
         from corrosion_tpu.types.hlc import Timestamp
         import time
 
-        return Timestamp.pack(time.time_ns(), 0)
+        ns = (self.now_ns or time.time_ns)()
+        return Timestamp.pack(ns, 0)
 
     def _changeset(self, version: int, row_id: int, text: str,
                    seqs=None, last_seq=None, seq: int = 0):

@@ -31,7 +31,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from corrosion_tpu.models.common import partition_ok
+from corrosion_tpu.models.common import partition_ok, severance_matrix
 from corrosion_tpu.ops.merge import merge_keys, scatter_merge
 
 
@@ -55,6 +55,10 @@ class BroadcastParams:
     # the sender's own universe — so one UNBATCHED scatter serves all
     # universes (batched scatter serializes on TPU, ~70x slower)
     universe: Optional[int] = None
+    # one-way partitions (FaultPlan.oneway_blocks): exactly these
+    # directed (src_block, dst_block) pairs sever while the partition
+    # is active; None = symmetric (the original behavior)
+    oneway_blocks: Optional[tuple] = None
 
     @property
     def fanout(self) -> int:
@@ -133,7 +137,8 @@ def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
         ok = jnp.broadcast_to(active[:, None], (n, k)) & avail
         if params.loss > 0.0:
             ok &= jax.random.uniform(key_l, (n, k)) >= params.loss
-        ok &= partition_ok(partition_id, targets, partition_active)
+        ok &= partition_ok(partition_id, targets, partition_active,
+                           oneway=params.oneway_blocks)
 
         # masked delivery: dead messages point past the end and get
         # dropped.  Scatter-max is associative, so K column scatters
@@ -307,10 +312,19 @@ def _deliver_perm(rows, active, hops, key_t, key_l, params: BroadcastParams,
         if params.loss > 0.0:
             valid &= ~drop[:, j]
         if partition_id is not None:
-            valid &= ~(
-                (partition_id.astype(jnp.int32) != g[:, r_width + 1])
-                & partition_active
-            )
+            # direction of flow is sender → receiver: the gathered
+            # column carries the SENDER's block id
+            spid = g[:, r_width + 1].astype(jnp.int32)
+            rpid = partition_id.astype(jnp.int32)
+            if params.oneway_blocks:
+                sev = severance_matrix(params.oneway_blocks)
+                b = sev.shape[0]
+                cross = sev[
+                    jnp.minimum(spid, b - 1), jnp.minimum(rpid, b - 1)
+                ]
+            else:
+                cross = rpid != spid
+            valid &= ~(cross & partition_active)
         new_rows = merge_keys(
             new_rows, jnp.where(valid[:, None], g[:, :r_width], rows)
         )

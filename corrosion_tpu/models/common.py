@@ -45,17 +45,52 @@ def rand_peers(key, n: int, shape, universe: Optional[int] = None):
     return base + (local + offs) % u
 
 
-def partition_ok(partition_id, senders_axis_targets, active):
+def severance_matrix(oneway) -> jnp.ndarray:
+    """Static directed-severance lookup for one-way partitions:
+    ``[B, B]`` bool where ``m[s, d]`` = traffic from block ``s`` to
+    block ``d`` is cut.  Sized one past the largest listed block so
+    clamped ids (blocks never named by a pair) land on an all-False
+    pad row/column — unlisted directions always flow, matching
+    ``FaultPlan.blocks_severed``.  Built from a static config tuple,
+    so under jit it constant-folds into the compiled tick."""
+    import numpy as np
+
+    b = max(max(s, d) for s, d in oneway) + 2
+    m = np.zeros((b, b), dtype=bool)
+    for s, d in oneway:
+        m[s][d] = True
+    return jnp.asarray(m)
+
+
+def partition_ok(partition_id, senders_axis_targets, active,
+                 oneway=None, bidirectional: bool = False):
     """True where a message does NOT cross an active partition boundary.
 
     partition_id: [N] block ids or None (no partition).
     senders_axis_targets: [N, ...] target indices (row i = sender i).
     active: traced bool (partition currently in force).
+    oneway: static tuple of directed ``(src_block, dst_block)`` pairs —
+            exactly those directions sever (``FaultPlan.oneway_blocks``);
+            None/empty = symmetric (every cross-block pair, both ways).
+    bidirectional: the link needs BOTH directions up (a sync session's
+            bi-stream: the dial runs src→dst, the served chunks flow
+            dst→src) — only distinguishable from one-way plans; a
+            symmetric partition already cuts both ways.
     """
     if partition_id is None:
         return True
-    cross = (
-        partition_id.reshape((-1,) + (1,) * (senders_axis_targets.ndim - 1))
-        != partition_id[senders_axis_targets]
+    src = partition_id.reshape(
+        (-1,) + (1,) * (senders_axis_targets.ndim - 1)
     )
+    dst = partition_id[senders_axis_targets]
+    if oneway:
+        sev = severance_matrix(oneway)
+        b = sev.shape[0]
+        s = jnp.minimum(src.astype(jnp.int32), b - 1)
+        d = jnp.minimum(dst.astype(jnp.int32), b - 1)
+        cross = sev[s, d]
+        if bidirectional:
+            cross = cross | sev[d, s]
+    else:
+        cross = src != dst
     return ~(cross & active)

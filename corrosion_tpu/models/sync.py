@@ -41,6 +41,10 @@ class SyncParams:
     # seed-flattening (models/common.py): peer draws stay inside the
     # sender's own universe of this width when set
     universe: Optional[int] = None
+    # one-way partitions: a sync session needs BOTH directions up (the
+    # dial is client→server, the served chunks server→client), so any
+    # listed severed direction between the pair kills the session
+    oneway_blocks: Optional[tuple] = None
 
 
 def bitmap_needs(ours, theirs):
@@ -93,7 +97,10 @@ def sync_step(rows, msgs_sent, key, params: SyncParams,
     peers = rand_peers(key, n, (n, p), universe=params.universe)  # [N, P]
 
     reachable = jnp.ones((n, p), dtype=bool)
-    reachable &= partition_ok(partition_id, peers, partition_active)
+    reachable &= partition_ok(
+        partition_id, peers, partition_active,
+        oneway=params.oneway_blocks, bidirectional=True,
+    )
 
     # pull-merge: what each peer would give us
     peer_rows = rows[peers]  # [N, P, R]

@@ -46,6 +46,8 @@ def sim_chaos_trace(
     fanout: int = 3,
     max_transmissions: int = 5,
     seeds: int = 8,
+    oneway_blocks=None,
+    track_sent: bool = None,
 ) -> Dict:
     """Epidemic-kernel prediction for the faulted regime: loss +
     partition-heal with anti-entropy enabled (the headline family at
@@ -62,8 +64,17 @@ def sim_chaos_trace(
         loss=loss,
         partition_blocks=partition_blocks,
         heal_tick=heal_tick,
+        oneway_blocks=(
+            tuple(tuple(p) for p in oneway_blocks)
+            if oneway_blocks else None
+        ),
         backoff_ticks=2.5,  # the agents' rebroadcast_delay/flush ratio
-        track_sent=True,  # chaos N is calibration-scale
+        # the exact sent_to-excluding sampler carries [N, N] memory and
+        # the slow vmap path: calibration-scale only.  Past ~128 nodes
+        # (the virtual campaigns' N=512 predictions) the flat
+        # perm-fanout path predicts the same coverage dynamics with
+        # msgs as a documented lower bound (models/broadcast.py)
+        track_sent=(n <= 128) if track_sent is None else track_sent,
         sync_interval=8,  # anti-entropy must heal what faults dropped
         sync_peers=1,
         max_ticks=512,
@@ -81,6 +92,9 @@ def sim_chaos_trace(
         "loss": loss,
         "partition_blocks": partition_blocks,
         "heal_tick": heal_tick,
+        "oneway_blocks": (
+            [list(p) for p in oneway_blocks] if oneway_blocks else None
+        ),
         "converged_frac": stats["converged_frac"],
         "ticks_to_converge_p50": fin(stats["ticks_p50"]),
         "ticks_to_converge_p99": fin(stats["ticks_p99"]),

@@ -55,6 +55,11 @@ class EpidemicConfig:
     # cross-traffic is dropped until `heal_tick`
     partition_blocks: int = 1
     heal_tick: int = 0
+    # one-way partitions (the asym_partition scenario family): exactly
+    # these directed (src_block, dst_block) pairs sever while the
+    # partition is active; None = symmetric.  Gossip severs per listed
+    # direction; anti-entropy sessions need both directions up
+    oneway_blocks: Optional[tuple] = None
     # nth retransmission waits backoff_ticks*n (reference 100ms*n);
     # 0 = send every tick (synchronous rounds)
     backoff_ticks: float = 0.0
@@ -97,6 +102,7 @@ class EpidemicConfig:
             loss=self.loss,
             backoff_ticks=self.backoff_ticks,
             universe=self._universe,
+            oneway_blocks=self.oneway_blocks,
         )
 
     @property
@@ -106,6 +112,7 @@ class EpidemicConfig:
             peers_per_round=self.sync_peers,
             cells_per_chunk=self.cells_per_chunk,
             universe=self._universe,
+            oneway_blocks=self.oneway_blocks,
         )
 
 

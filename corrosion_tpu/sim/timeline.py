@@ -386,3 +386,277 @@ async def run_timeline(
             json.dump(out, f, indent=1, allow_nan=False)
             f.write("\n")
     return out
+
+
+# ---------------------------------------------------------------------------
+# virtual-time trajectory campaign (sim/vcluster.py): the partition-heal
+# cell at N=512–1024 in seconds of wall time, plus the N=32
+# virtual-vs-real parity cell that keeps the virtual path honest
+# ---------------------------------------------------------------------------
+
+# named parity tolerances (virtual vs real, same seed & shape — the
+# virtual scheduler models timers and link latency, not TCP dynamics,
+# so the comparison is banded, not exact)
+PARITY_PLATEAU_TOL = 0.25   # |virtual - live| plateau coverage
+PARITY_MSGS_FACTOR = 6.0    # msgs/node ratio band (either direction)
+PARITY_RECOVERY_FACTOR = 6.0  # full-coverage offset ratio band
+PARITY_RECOVERY_SLACK_S = 2.0
+
+
+def virtual_timeline_cell(
+    n: int = 512,
+    writes: int = 6,
+    heal_after: float = 1.28,
+    seed: int = 0,
+    timeout: float = 60.0,
+    base_dir: Optional[str] = None,
+    probe_interval: Optional[float] = None,
+) -> Dict:
+    """The partition-heal trajectory cell on VIRTUAL time: same
+    record shape as :func:`agent_timeline_cell` (the trajectory gates
+    apply unchanged), ``timeout`` in virtual seconds.  The virtual
+    flush interval equals ``TICK_S``, so the kernel's tick grid maps
+    onto the virtual timeline exactly as it maps onto the live one."""
+    import time as _time
+
+    from corrosion_tpu.sim.vcluster import VirtualCluster
+
+    plan = FaultPlan(
+        seed=seed, partition_blocks=2, heal_after=heal_after
+    )
+    overrides = {}
+    if probe_interval is not None:
+        overrides["probe_interval"] = probe_interval
+    elif n >= 256:
+        overrides["probe_interval"] = 1.0
+    wall0 = _time.perf_counter()
+    c = VirtualCluster(
+        n, seed=seed, plan=plan, base_dir=base_dir, **overrides
+    )
+    try:
+        other = next(
+            i for i in range(n)
+            if plan.block_of(i, n) != plan.block_of(0, n)
+        )
+        writers = [0, other]
+        c.ctrl.split()
+        split_virt = c.clock.monotonic()
+        split_wall = c.clock.wall()
+
+        versions: List[tuple] = []
+        for w in range(writes):
+            origin = writers[w % 2]
+            v = c.write(
+                origin,
+                "INSERT INTO tests (id, text) VALUES (?, ?)",
+                (9000 + w, f"timeline-{w}"),
+            )
+            versions.append((c.agents[f"n{origin}"].actor_id, v))
+            c.run_for(0.01)
+        last_write_off = c.clock.monotonic() - split_virt
+
+        converged_ok = c.run_until_true(
+            lambda: c.converged(versions), timeout=timeout
+        )
+        virt_s = c.clock.monotonic() - split_virt
+        # one more snapshot round before assembly
+        c.run_for(0.3)
+
+        obs = c.observer()
+        curve = obs.coverage_curve(versions)
+        events = obs.flight_events()
+        kind_counts: Dict[str, int] = {}
+        for e in events:
+            k = e["kind"]
+            kind_counts[k] = kind_counts.get(k, 0) + 1
+        snapshots = len(obs.flight_timeline(kind="snap"))
+        lag = obs.convergence_lag()
+        scrape = obs.scrape()
+
+        return {
+            "runtime": "virtual-agents",
+            "n_nodes": n,
+            "writes": writes,
+            "heal_after_s": heal_after,
+            "converged": converged_ok,
+            "wall_to_converge_s": round(virt_s, 3),
+            "virtual_to_converge_s": round(virt_s, 3),
+            "campaign_wall_s": round(_time.perf_counter() - wall0, 3),
+            "last_write_offset_s": round(last_write_off, 3),
+            "coverage": curve,
+            "live_p99_s": lag.get("p99_s"),
+            "msgs_per_node": round(obs.msgs_per_node(scrape), 2),
+            "timeline": {
+                "snapshots": snapshots,
+                "event_counts": kind_counts,
+                "events": [
+                    {
+                        "node": e["node"], "kind": e["kind"],
+                        "hlc": e["hlc"],
+                        "wall_off_s": round(e["wall"] - split_wall, 3),
+                        "attrs": e.get("attrs", {}),
+                    }
+                    for e in events[-400:]
+                ],
+            },
+        }
+    finally:
+        c.close()
+
+
+def _plateau_cov(cell: Dict, probe_t: float) -> float:
+    curve = cell["coverage"]
+    expected = max(1, curve["expected"])
+    return sum(1 for d in curve["offsets_s"] if d <= probe_t) / expected
+
+
+def virtual_real_parity(
+    n: int = 32,
+    writes: int = 6,
+    heal_after: float = 1.28,
+    seed: int = 0,
+    base_dir: Optional[str] = None,
+) -> Dict:
+    """The N=32 parity cell: the SAME partition-heal shape (same seed,
+    same heal window, same writer layout) on the virtual scheduler and
+    on the live socket cluster, compared within named tolerances —
+    what keeps the virtual path honest against the system it stands in
+    for.  Banded, not exact: the virtual scheduler models timers and
+    per-link latency; the live run adds TCP connects, worker-thread
+    scheduling and host noise on top."""
+    import os
+
+    live = asyncio.run(agent_timeline_cell(
+        n, writes=writes, heal_after=heal_after, seed=seed,
+        base_dir=os.path.join(base_dir, "live") if base_dir else None,
+    ))
+    virt = virtual_timeline_cell(
+        n, writes=writes, heal_after=heal_after, seed=seed,
+        base_dir=os.path.join(base_dir, "virtual") if base_dir else None,
+    )
+    probe_t = max(
+        PLATEAU_PROBE_MIN_S,
+        heal_after
+        - max(live.get("last_write_offset_s", 0.0),
+              virt.get("last_write_offset_s", 0.0))
+        - PLATEAU_GUARD_S,
+    )
+    live_plateau = _plateau_cov(live, probe_t)
+    virt_plateau = _plateau_cov(virt, probe_t)
+    live_full = live["coverage"]["t_at_coverage"].get(str(FULL_COV))
+    virt_full = virt["coverage"]["t_at_coverage"].get(str(FULL_COV))
+    msgs_ratio = (
+        virt["msgs_per_node"] / live["msgs_per_node"]
+        if live["msgs_per_node"] else None
+    )
+    recovery_ok = (
+        live_full is not None and virt_full is not None
+        and virt_full
+        <= PARITY_RECOVERY_FACTOR * live_full + PARITY_RECOVERY_SLACK_S
+        and live_full
+        <= PARITY_RECOVERY_FACTOR * virt_full + PARITY_RECOVERY_SLACK_S
+    )
+    gates = {
+        "both_converged": bool(
+            live["converged"] and virt["converged"]
+        ),
+        "plateau_close": abs(live_plateau - virt_plateau)
+        <= PARITY_PLATEAU_TOL,
+        "msgs_within_factor": (
+            msgs_ratio is not None
+            and 1.0 / PARITY_MSGS_FACTOR
+            <= msgs_ratio <= PARITY_MSGS_FACTOR
+        ),
+        "recovery_within_factor": recovery_ok,
+    }
+    return {
+        "n_nodes": n,
+        "seed": seed,
+        "heal_after_s": heal_after,
+        "gates": gates,
+        "passed": all(gates.values()),
+        "plateau_probe_s": round(probe_t, 4),
+        "live_plateau_cov": round(live_plateau, 4),
+        "virtual_plateau_cov": round(virt_plateau, 4),
+        "plateau_tolerance": PARITY_PLATEAU_TOL,
+        "live_full_coverage_s": live_full,
+        "virtual_full_coverage_s": virt_full,
+        "recovery_factor": PARITY_RECOVERY_FACTOR,
+        "recovery_slack_s": PARITY_RECOVERY_SLACK_S,
+        "msgs_per_node_live": live["msgs_per_node"],
+        "msgs_per_node_virtual": virt["msgs_per_node"],
+        "msgs_factor": PARITY_MSGS_FACTOR,
+        "live_wall_to_converge_s": live["wall_to_converge_s"],
+        "virtual_campaign_wall_s": virt.get("campaign_wall_s"),
+        "residual": (
+            "the virtual scheduler models timers + per-link latency; "
+            "the live cell adds TCP connects, thread scheduling and "
+            "host noise — hence banded tolerances, not equality"
+        ),
+    }
+
+
+def run_virtual_timeline(
+    n: int = 512,
+    writes: int = 6,
+    heal_after: float = 1.28,
+    seeds: int = 8,
+    out_path: Optional[str] = None,
+    base_dir: Optional[str] = None,
+    sim: bool = True,
+    parity_n: Optional[int] = 32,
+) -> Dict:
+    """The virtual-time timeline campaign: the N=512 partition-heal
+    trajectory gated against the kernel's per-tick curve (same
+    tolerances as the live campaign), plus the N=32 virtual-vs-real
+    parity cell, one JSON artifact."""
+    import os
+
+    heal_tick = max(1, int(round(heal_after / TICK_S)))
+    prediction = (
+        kernel_coverage_prediction(n, heal_tick, seeds=seeds)
+        if sim else None
+    )
+    live = virtual_timeline_cell(
+        n, writes=writes, heal_after=heal_after,
+        base_dir=os.path.join(base_dir, "cell") if base_dir else None,
+    )
+    out: Dict = {
+        "n_nodes": n,
+        "metric": "virtual_partition_heal_trajectory_vs_kernel",
+        "runtime": "virtual",
+        "tick_seconds": TICK_S,
+        "agents": live,
+        "sim": prediction,
+    }
+    if prediction is not None:
+        traj = trajectory_gates(live, prediction, heal_after)
+        out["trajectory"] = traj
+        out["all_gates_passed"] = all(traj["gates"].values())
+        out["value"] = traj["live_full_coverage_s"]
+        out["unit"] = "s_full_coverage_offset"
+        if not out["all_gates_passed"]:
+            out["error"] = (
+                "virtual coverage trajectory diverged from the kernel "
+                "prediction beyond the named tolerances"
+            )
+    if parity_n:
+        parity = virtual_real_parity(
+            parity_n, writes=writes, heal_after=heal_after,
+            base_dir=(
+                os.path.join(base_dir, "parity") if base_dir else None
+            ),
+        )
+        out["parity_n32"] = parity
+        if not parity["passed"]:
+            out.setdefault(
+                "error",
+                "virtual-vs-real parity cell failed its named "
+                "tolerances",
+            )
+            out["all_gates_passed"] = False
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1, allow_nan=False)
+            f.write("\n")
+    return out

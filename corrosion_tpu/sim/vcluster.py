@@ -1,0 +1,907 @@
+"""Virtual-time cluster: hundreds of REAL agents on one event heap.
+
+Every prior live artifact (CHAOS/OBS/SCENARIOS/TIMELINE) tops out at
+N=32 because agents burn wall-clock in sleeps — SWIM timers, broadcast
+flush intervals, sync backoff, breaker cooldowns, partition heal
+delays.  This module is the unlock the ROADMAP names: with every agent
+time source behind the injectable :class:`~corrosion_tpu.clock.Clock`
+(PR: virtual-time cluster), a :class:`~corrosion_tpu.clock.VirtualClock`
+plus a discrete-event scheduler drives N=512–1024 in-process agents
+through the full fault-campaign stack in *seconds* of wall time
+(LiveStack, PAPERS.md: cluster-scale full-stack simulation by putting
+unmodified node software on virtual time; "Simulating BFT Protocol
+Implementations at Scale", PAPERS.md: the hostile-fraction sweeps that
+only become possible at that scale).
+
+What is REAL here (extending ``agent/det.py``'s tick substrate to a
+continuous virtual timeline + the seeded ``FaultPlan`` seams):
+
+* full ``Agent`` objects — real SQLite storage with CRR triggers, real
+  bookkeeping, real speedy wire bytes (``encode_broadcast_frame`` /
+  ``decode_uni_frame_meta``), real ``handle_change`` ingest with dedup,
+  equivocation defense (quarantine windows age on the virtual clock),
+  rebroadcast-on-learn, real ``Members`` suspicion state, real
+  ``generate_sync``/``_serve_need`` anti-entropy down to the frames;
+* real ``FaultController`` decisions — per-link drop/delay/partition
+  (one-way included), seeded slow-IO draws, crash/restart schedules,
+  per-node HLC skew — with ``now=clock.monotonic`` so heal windows
+  and schedule times elapse virtually;
+* real per-peer ``CircuitBreaker`` objects (cooldowns on the virtual
+  clock) driving the real ``Members`` quarantine path.
+
+What the scheduler replaces is exactly the *timing and socket layer*:
+timer fires, fault-plan delays, crash/restart schedules and SWIM probe
+rounds all advance by event-queue pops instead of sleeps, and frames
+hand off in-memory with per-link virtual latency instead of TCP.
+
+Determinism: single-threaded, seeded per-agent PRNG streams
+(``det_seed_for``), seeded site ids, a FIXED virtual wall epoch, and
+heap ties broken by insertion order — two runs with one
+``(seed, FaultPlan, campaign)`` produce byte-identical flight-recorder
+event journals and identical end-state checksums
+(``tests/test_vtime.py``).  The batched serve path and its thread
+pools are therefore OFF by default here (``sync_batched_serve=False``:
+the per-version oracle is thread-free; it also avoids 2×N serve
+threads at N=1024).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import random
+from typing import Callable, Dict, List, Optional, Tuple
+
+from corrosion_tpu.clock import VirtualClock
+from corrosion_tpu.faults import FaultController, FaultPlan
+
+
+class _TransportStub:
+    """The slice of ``Transport`` an unstarted agent's peers of code
+    touch: the breaker registry (``_breaker_open`` / ``metric_gauges``)
+    and per-peer stats."""
+
+    def __init__(self):
+        self.breakers: Dict[tuple, object] = {}
+        self.stats: Dict[tuple, object] = {}
+
+
+class _Pending:
+    """One queued broadcast payload on one agent — the virtual form of
+    the live loop's ``pending`` tuples (and det.py's ``_Entry``)."""
+
+    __slots__ = ("cv", "frame", "remaining", "next_due", "sent_to")
+
+    def __init__(self, cv, frame: bytes, remaining: int, next_due: float):
+        self.cv = cv
+        self.frame = frame
+        self.remaining = remaining
+        self.next_due = next_due
+        self.sent_to: set = set()
+
+
+#: default per-link one-way latency (seconds) — loopback-scale, like
+#: the live in-process cluster; FaultPlan delay/jitter adds on top
+LINK_RTT_S = 0.002
+
+#: virtual agents mirror launch_test_agent's fast-timer posture, plus
+#: the virtual-mode specifics documented in the module docstring
+VIRTUAL_DEFAULTS = dict(
+    probe_interval=0.25,
+    probe_timeout=0.15,
+    suspect_timeout=10.0,
+    rebroadcast_delay=0.05,
+    sync_interval_min=0.15,
+    sync_interval_max=0.4,
+    bcast_flush_interval=0.02,
+    flight_interval_s=0.25,
+    breaker_cooldown=0.5,
+    subs_enabled=False,
+    api_port=None,
+    ring0_enabled=False,
+    stall_probe_interval=0.0,  # the scheduler's stall beat replaces it
+    sync_batched_serve=False,  # thread-free determinism (module doc)
+)
+
+#: the scheduler's stall-beat cadence — the virtual analogue of
+#: ``AgentConfig.stall_probe_interval`` (a beat that fires late because
+#: a jump passed it measures the stall, exactly like the live probe's
+#: late wakeup)
+STALL_BEAT_S = 0.05
+
+
+def vsite_id(seed: int, index: int) -> bytes:
+    """Seeded site (actor) id — a pure function of (seed, index) so a
+    campaign's actor ids are replay-stable."""
+    return hashlib.blake2b(
+        f"vsite:{seed}:{index}".encode(), digest_size=16
+    ).digest()
+
+
+class VirtualCluster:
+    """N real agents under the virtual-time discrete-event scheduler."""
+
+    def __init__(
+        self,
+        n: int,
+        seed: int = 0,
+        plan: Optional[FaultPlan] = None,
+        base_dir: Optional[str] = None,
+        clock: Optional[VirtualClock] = None,
+        link_rtt_s: float = LINK_RTT_S,
+        **agent_overrides,
+    ):
+        import os
+        import tempfile
+
+        from corrosion_tpu.agent.runtime import AgentConfig
+
+        self.n = n
+        self.seed = seed
+        self.clock = clock or VirtualClock()
+        self.link_rtt_s = link_rtt_s
+        self.plan = plan or FaultPlan(seed=seed)
+        self.ctrl = FaultController(self.plan, now=self.clock.monotonic)
+        self._own_dir = base_dir is None
+        self.base_dir = base_dir or tempfile.mkdtemp(prefix="corro-vt-")
+        os.makedirs(self.base_dir, exist_ok=True)
+        self._overrides = dict(VIRTUAL_DEFAULTS)
+        self._overrides.update(agent_overrides)
+        self.names = [f"n{i}" for i in range(n)]
+        self._idx: Dict[str, int] = {nm: i for i, nm in enumerate(self.names)}
+        self.agents: Dict[str, object] = {}
+        self._addr_idx: Dict[tuple, int] = {}
+        self._crashed: set = set()
+        self._entries: List[Dict[tuple, _Pending]] = [{} for _ in range(n)]
+        self._flush_armed: List[Optional[object]] = [None] * n
+        # recurring-chain handles (probe/sync/snapshot), cancelled on
+        # crash: a chain event already queued past restart_at would
+        # otherwise survive the death and run a DUPLICATE chain next
+        # to the one _restart arms
+        self._chain_events: List[List[object]] = [[] for _ in range(n)]
+        self._sync_backoff: List[Optional[object]] = [None] * n
+        self._busy_until: List[float] = [0.0] * n
+        self._incarnations: List[int] = [0] * n
+        # per-agent lifetime stall max (the live LoopHealthProbe keeps
+        # ITS OWN max; a reborn node starts from zero)
+        self._stall_max_by_agent: Dict[str, float] = {}
+        self._configs: List[AgentConfig] = []
+        # one private loop reused for every serve coroutine: a fresh
+        # asyncio.run per sync session costs more than the session at
+        # N=512 scale
+        self._serve_loop = asyncio.new_event_loop()
+
+        # template DB: one node's schema+trigger DDL, file-copied to
+        # the other N-1 with the site row rewritten — the DDL is ~2/3
+        # of a 512-agent boot and identical across nodes
+        self._template = os.path.join(self.base_dir, "_template.db")
+        self._make_template()
+        for i, name in enumerate(self.names):
+            d = os.path.join(self.base_dir, name)
+            os.makedirs(d, exist_ok=True)
+            self.ctrl.register(name, ("virt", i))
+            self._addr_idx[("virt", i)] = i
+            self._configs.append(self._make_config(i, d))
+            self.agents[name] = self._spawn(i)
+        # full static membership in index order (the det.py contract:
+        # Members.sample's population ordering is ascending node index)
+        self._seed_membership()
+        self.ctrl.agents = self.agents
+        self.ctrl.flight_orphans = []
+        self.ctrl.start()
+
+        # recurring duties, deterministically staggered per agent
+        for i in range(n):
+            self._arm_agent_loops(i)
+        self.clock.schedule(STALL_BEAT_S, self._stall_beat)
+        for ev in self.plan.loop_stalls:
+            self.clock.schedule_at(ev.at, self._make_stall(ev))
+        for ev in self.plan.crashes:
+            self.clock.schedule_at(
+                ev.at, lambda _d, nm=ev.node: self._crash(nm)
+            )
+            if ev.restart_at is not None:
+                self.clock.schedule_at(
+                    ev.restart_at, lambda _d, nm=ev.node: self._restart(nm)
+                )
+
+    # -- construction ---------------------------------------------------
+
+    def _make_config(self, i: int, node_dir: str):
+        from corrosion_tpu.agent.runtime import AgentConfig
+        from corrosion_tpu.agent.testing import TEST_SCHEMA
+
+        offset_ns, drift = self.ctrl.clock_for(self.names[i])
+        return AgentConfig(
+            db_path=f"{node_dir}/corrosion.db",
+            schema_sql=TEST_SCHEMA,
+            clock=self.clock,
+            site_id=vsite_id(self.seed, i),
+            clock_skew_ns=offset_ns,
+            clock_drift=drift,
+            **self._overrides,
+        )
+
+    def _make_template(self) -> None:
+        """Build the one template database every fresh node copies:
+        full schema + CRR triggers applied once, WAL folded in so the
+        copy is a single file."""
+        import sqlite3
+
+        from corrosion_tpu.agent.schema import apply_schema
+        from corrosion_tpu.agent.storage import CrConn
+        from corrosion_tpu.agent.testing import TEST_SCHEMA
+
+        st = CrConn(self._template, site_id=b"\x00" * 16)
+        apply_schema(st, TEST_SCHEMA)
+        st.conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+        st.close()
+        con = sqlite3.connect(self._template)
+        con.execute("PRAGMA journal_mode=DELETE")
+        con.close()
+
+    def _instantiate_db(self, i: int) -> None:
+        """Fresh node from the template: copy + rewrite the self-site
+        row to the node's seeded id (a RESTART skips this — the
+        existing directory is the node's durable identity)."""
+        import os
+        import shutil
+        import sqlite3
+
+        path = self._configs[i].db_path
+        if os.path.exists(path):
+            return
+        shutil.copyfile(self._template, path)
+        con = sqlite3.connect(path)
+        # durability is not a property under test at instantiation
+        # time (the campaign's crash model closes storage cleanly):
+        # skip the per-node fsync — at N=512 the commits alone cost
+        # ~1 s of boot
+        con.execute("PRAGMA synchronous=OFF")
+        con.execute(
+            "UPDATE __corro_sites SET site_id = ? WHERE ordinal = 1",
+            (self._configs[i].site_id,),
+        )
+        con.commit()
+        con.close()
+
+    def _seed_membership(self) -> None:
+        """Full static ALIVE membership, written directly (the upsert
+        path costs ~2.5s of a 512-node boot for N² records whose merge
+        rules are all trivially 'new')."""
+        from corrosion_tpu.agent.members import Member
+
+        now = self.clock.monotonic()
+        infos = [
+            (a.actor_id, ("virt", j))
+            for j, a in enumerate(self.agents.values())
+        ]
+        for a in self.agents.values():
+            with a.members._lock:
+                mm = a.members._members
+                for actor, addr in infos:
+                    if actor != a.actor_id and actor not in mm:
+                        mm[actor] = Member(
+                            actor_id=actor, addr=addr, last_seen=now
+                        )
+                a.members._alive_cache = None
+
+    def _spawn(self, i: int):
+        from corrosion_tpu.agent.det import _SyncLoop, det_seed_for
+        from corrosion_tpu.agent.runtime import Agent
+
+        self._instantiate_db(i)
+        a = Agent(self._configs[i])
+        # per-node deterministic PRNG stream; a respawn moves to a
+        # derived stream (pure in (seed, i, incarnation)) so the reborn
+        # node doesn't replay its previous life's draws
+        a._rng = random.Random(
+            det_seed_for(self.seed, i) ^ (self._incarnations[i] * 0x9E3779B9)
+        )
+        a._loop = _SyncLoop()  # queue-or-defer paths run inline
+        a.transport = _TransportStub()
+        a.faults = self.ctrl
+        a.gossip_addr = ("virt", i)
+        # slow-disk seam: the seeded decision is consulted (counted +
+        # logged) but the delay is charged to VIRTUAL busy time — a
+        # real sleep would burn wall clock without moving the heap
+        inner = self.ctrl.io_hook_for(self.names[i])
+
+        def io_hook(op: str, _i=i, _inner=inner) -> float:
+            d = _inner(op)
+            if d > 0:
+                now = self.clock.monotonic()
+                self._busy_until[_i] = max(self._busy_until[_i], now) + d
+            return 0.0
+
+        a.storage.io_fault = io_hook
+        return a
+
+    def _chain(self, i: int, at: float, fn) -> None:
+        """Schedule one link of a per-agent recurring chain, keeping
+        the handle so :meth:`_crash` can sever the whole chain."""
+        self._chain_events[i].append(self.clock.schedule_at(at, fn))
+
+    def _arm_agent_loops(self, i: int) -> None:
+        from corrosion_tpu.utils.backoff import Backoff
+
+        a = self.agents[self.names[i]]
+        cfg = a.config
+        now = self.clock.monotonic()
+        stagger = ((i * 0.6180339887) % 1.0)
+        self._chain(
+            i, now + cfg.probe_interval * (1.0 + stagger),
+            lambda due, _i=i: self._probe_round(_i, due),
+        )
+        self._sync_backoff[i] = iter(
+            Backoff(base=cfg.sync_interval_min, cap=cfg.sync_interval_max,
+                    rng=a._rng)
+        )
+        self._chain(
+            i, now + next(self._sync_backoff[i]) * (1.0 + stagger),
+            lambda due, _i=i: self._sync_round(_i, due),
+        )
+        if a.flight is not None and cfg.flight_interval_s > 0:
+            self._chain(
+                i, now + cfg.flight_interval_s * (1.0 + stagger),
+                lambda due, _i=i: self._snapshot(_i, due),
+            )
+
+    # -- workload -------------------------------------------------------
+
+    def write(self, origin: int, sql: str, args: tuple = ()) -> int:
+        """One local write on ``origin``; broadcast collection runs
+        inline (``_SyncLoop``) and the payload flushes at the next
+        armed flush event."""
+        res = self.agents[self.names[origin]].execute_transaction(
+            [(sql, args)]
+        )
+        self._arm_flush(origin)
+        return res["version"]
+
+    def inject(self, targets: List[int], cv, source,
+               delay: float = 0.0, rebroadcast: bool = True) -> None:
+        """Schedule a crafted changeset (e.g. an ``EquivocatingPeer``
+        payload) into each target's REAL ingest path at ``now+delay`` —
+        the virtual form of the live harness's ``_deliver``.
+
+        ``rebroadcast=False`` delivers point-to-point without relay
+        amplification: with the payload already injected at EVERY
+        node, re-gossiping it adds only duplicate traffic — at N=512
+        with 32 hostiles that is ~10^5 redundant decodes per wave.
+        The single-equivocator matrix family keeps relay on, so the
+        rebroadcast-path defense coverage is not lost."""
+        for j in targets:
+            self.clock.schedule(
+                delay, lambda _d, _j=j, _cv=cv: self._ingest_injected(
+                    _j, _cv, source, rebroadcast
+                )
+            )
+
+    def _ingest_injected(self, j: int, cv, source,
+                         rebroadcast: bool = True) -> None:
+        if j in self._crashed_idx():
+            return
+        a = self.agents[self.names[j]]
+        a.handle_change(cv, source, rebroadcast=rebroadcast)
+        if rebroadcast:
+            self._arm_flush(j)
+
+    # -- the scheduler's duties ----------------------------------------
+
+    def _crashed_idx(self) -> set:
+        return {self._idx[nm] for nm in self._crashed}
+
+    def _arm_flush(self, i: int, at: Optional[float] = None) -> None:
+        """Ensure a flush event is armed for agent ``i`` no later than
+        ``at`` (default: one flush interval out — the live loop's
+        fresh-payload latency)."""
+        if self.names[i] in self._crashed:
+            return
+        a = self.agents[self.names[i]]
+        now = self.clock.monotonic()
+        at = max(
+            at if at is not None else now + a.config.bcast_flush_interval,
+            self._busy_until[i],
+        )
+        armed = self._flush_armed[i]
+        if armed is not None and not armed.cancelled and armed.due <= at:
+            return
+        if armed is not None:
+            self.clock.cancel(armed)
+        self._flush_armed[i] = self.clock.schedule_at(
+            at, lambda due, _i=i: self._flush(_i, due)
+        )
+
+    def _flush(self, i: int, _due: float) -> None:
+        """One broadcast flush for agent ``i``: drain the queue, send
+        due payloads through the fault plan, requeue retransmissions —
+        the live ``_broadcast_loop`` body on the virtual heap."""
+        self._flush_armed[i] = None
+        name = self.names[i]
+        if name in self._crashed:
+            return
+        a = self.agents[name]
+        cfg = a.config
+        now = self.clock.monotonic()
+        entries = self._entries[i]
+        while not a._bcast_queue.empty():
+            cv, remaining, hop, tp = a._bcast_queue.get_nowait()
+            key = a._seen_key(cv)
+            if key in entries:
+                continue
+            entries[key] = _Pending(
+                cv, a.encode_broadcast_frame(cv, hop, tp), remaining, now
+            )
+        crashed = self._crashed_idx()
+        sends = 0
+        for key in list(entries):
+            e = entries[key]
+            if e.next_due > now or e.remaining < 1:
+                continue
+            local = e.cv.actor_id.bytes == a.actor_id
+            targets = a.members.sample(
+                cfg.fanout, a._rng,
+                ring0_first=(cfg.ring0_enabled and local and not e.sent_to),
+                exclude=e.sent_to,
+            )
+            if not targets:
+                del entries[key]  # coverage exhausted
+                continue
+            for m in targets:
+                addr = tuple(m.addr)
+                j = self._addr_idx.get(addr)
+                if j is None:
+                    # a member record with no cluster node behind it
+                    # (e.g. a registered-then-hostile actor): the live
+                    # transport fails to connect — breaker evidence
+                    self._breaker_failure(a, addr)
+                    continue
+                if j in crashed:
+                    # a dead peer is a genuine send failure: breaker
+                    # evidence, no sent_to mark (stays eligible)
+                    self._breaker_failure(a, addr)
+                    continue
+                # in-flight fault semantics (faults.py): drops and
+                # partitions are sender-invisible — the send "succeeds"
+                e.sent_to.add(m.actor_id)
+                self._breaker_success(a, addr)
+                sends += 1
+                act = self.ctrl.filter(name, self.names[j], "uni")
+                if act.drop:
+                    continue
+                self.clock.schedule(
+                    self.link_rtt_s + act.delay,
+                    lambda _d, _j=j, _f=e.frame: self._deliver(_j, _f),
+                )
+            e.remaining -= 1
+            if e.remaining < 1:
+                del entries[key]
+            else:
+                send_count = cfg.max_transmissions - e.remaining
+                e.next_due = now + cfg.rebroadcast_delay * send_count
+        if sends:
+            a.metrics.counter("corro_broadcast_sent_total", sends)
+            a.metrics.counter("corro_broadcast_flushes_total")
+        # re-arm: retransmissions wake at their due time; fresh queue
+        # items (raced in during this event) at the flush interval
+        nxt = min((e.next_due for e in entries.values()), default=None)
+        if not a._bcast_queue.empty():
+            self._arm_flush(i)
+        elif nxt is not None:
+            self._arm_flush(i, at=max(nxt, now + 1e-4))
+
+    def _deliver(self, j: int, frame: bytes) -> None:
+        """Delivery phase: the real wire + ingest path (det.py's
+        contract), then re-arm the receiver's flush for any
+        rebroadcast-on-learn it queued inline."""
+        from corrosion_tpu.bridge import speedy
+        from corrosion_tpu.types import ChangeSource
+
+        if j in self._crashed_idx():
+            return
+        a = self.agents[self.names[j]]
+        for payload in speedy.FrameReader().feed(frame):
+            decoded = a.decode_uni_frame_meta(payload)
+            if decoded is not None:
+                cv, tp, hop = decoded
+                a.handle_change(cv, ChangeSource.BROADCAST, meta=(tp, hop))
+        if not a._bcast_queue.empty():
+            self._arm_flush(j)
+
+    # -- SWIM probes on the heap ---------------------------------------
+
+    def _udp_leg_ok(self, src: str, dst: str) -> bool:
+        act = self.ctrl.filter(src, dst, "udp")
+        return not act.drop
+
+    def _probe_round(self, i: int, due: float) -> None:
+        name = self.names[i]
+        if name in self._crashed:
+            return
+        a = self.agents[name]
+        self._chain_events[i] = [
+            e for e in self._chain_events[i] if not e.cancelled
+            and e.due > self.clock.monotonic()
+        ]
+        self._chain(
+            i, max(due + a.config.probe_interval, self._busy_until[i]),
+            lambda d, _i=i: self._probe_round(_i, d),
+        )
+        alive = a.members.alive()
+        if alive:
+            m = a._rng.choice(alive)
+            tj = self._addr_idx.get(tuple(m.addr))
+            target = self.names[tj] if tj is not None else None
+            t_up = target is not None and target not in self._crashed
+            ok = (
+                t_up
+                and self._udp_leg_ok(name, target)
+                and self._udp_leg_ok(target, name)
+            )
+            if not ok and target is not None:
+                # indirect probe via helpers (consumes the same rng
+                # draw the live loop's helper sample does)
+                helpers = [
+                    h for h in alive if h.actor_id != m.actor_id
+                ]
+                if helpers:
+                    helpers = a._rng.sample(
+                        helpers,
+                        min(a.config.num_indirect_probes, len(helpers)),
+                    )
+                    for h in helpers:
+                        hj = self._addr_idx.get(tuple(h.addr))
+                        if hj is None:
+                            continue  # no node behind the record
+                        hname = self.names[hj]
+                        if hname in self._crashed:
+                            continue
+                        if (
+                            self._udp_leg_ok(name, hname)
+                            and self._udp_leg_ok(hname, target)
+                            and t_up
+                            and self._udp_leg_ok(target, hname)
+                            and self._udp_leg_ok(hname, name)
+                        ):
+                            ok = True
+                            break
+            if ok:
+                a.members.record_rtt(
+                    m.actor_id, self.link_rtt_s * 2e3
+                )
+                a._suspects.pop(m.actor_id, None)
+                a.members.revive(m.actor_id)
+            else:
+                a._mark_suspect(m)
+        a._reap_suspects()
+
+    # -- anti-entropy on the heap --------------------------------------
+
+    def _breaker(self, a, addr: tuple):
+        from corrosion_tpu.agent.transport import CircuitBreaker
+
+        b = a.transport.breakers.get(addr)
+        if b is None:
+            b = a.transport.breakers[addr] = CircuitBreaker(
+                a.config.breaker_threshold, a.config.breaker_cooldown,
+                now=self.clock.monotonic,
+            )
+        return b
+
+    def _breaker_failure(self, a, addr: tuple) -> None:
+        if self._breaker(a, addr).record_failure():
+            a.metrics.counter("corro_transport_breaker_opens_total")
+            a._on_breaker(addr, True)
+
+    def _breaker_success(self, a, addr: tuple) -> None:
+        if self._breaker(a, addr).record_success():
+            a.metrics.counter("corro_transport_breaker_closes_total")
+            a._on_breaker(addr, False)
+
+    def _sync_round(self, i: int, due: float) -> None:
+        """One client sync round for agent ``i`` — det.py's
+        ``_det_sync_round`` extended with fault/breaker/journal
+        semantics: REAL ``generate_sync`` / ``_choose_sync_peers`` /
+        ``_allocate_needs`` / ``_serve_need`` down to the frame bytes;
+        the scheduler replaces the socket/timing layer, and a severed
+        direction (either way — the bi-stream needs both) or a crashed
+        peer is a session failure feeding the breaker."""
+        name = self.names[i]
+        if name in self._crashed:
+            return
+        a = self.agents[name]
+        self._chain(
+            i, max(due + next(self._sync_backoff[i]),
+                   self._busy_until[i]),
+            lambda d, _i=i: self._sync_round(_i, d),
+        )
+        ours = a.generate_sync()
+        chosen = a._choose_sync_peers(ours)
+        if not chosen:
+            return
+        sessions = []
+        for m in chosen:
+            addr = tuple(m.addr)
+            j = self._addr_idx.get(addr)
+            if j is None:
+                self._breaker_failure(a, addr)
+                continue
+            peer = self.names[j]
+            if not self._breaker(a, addr).allow():
+                continue
+            act = self.ctrl.filter(name, peer, "bi")
+            if (
+                peer in self._crashed
+                or act.drop
+                or self.ctrl._partitioned(peer, name)
+            ):
+                self._breaker_failure(a, addr)
+                continue
+            self._breaker_success(a, addr)
+            sessions.append({
+                "member": m,
+                "theirs": self.agents[peer].generate_sync(),
+                "j": j,
+            })
+        if not sessions:
+            return
+        a._allocate_needs(sessions, ours)
+        for s in sessions:
+            self._sync_session(a, s)
+
+    def _sync_session(self, a, s: dict) -> None:
+        from corrosion_tpu.agent.det import _CollectWriter
+        from corrosion_tpu.bridge import speedy
+        from corrosion_tpu.types import ChangeSource, Timestamp
+
+        m = s["member"]
+        server = self.agents[self.names[s["j"]]]
+        batches = list(a._request_batches(s["needs"]))
+        needs_total = sum(len(v) for v in s["needs"].values())
+        peer_hex = m.actor_id.hex()
+        live = a._sync_session_begin("client", peer_hex, needs_total)
+        a._flight_event(
+            "sync_client_start", peer=peer_hex, needs=needs_total
+        )
+        srv_live = server._sync_session_begin(
+            "server", a.actor_id.hex(), needs_total
+        )
+        server._flight_event(
+            "sync_server_start", peer=a.actor_id.hex()
+        )
+        served: List = []
+        w = _CollectWriter()
+        if batches:
+            sess = {"chunk": server.SYNC_CHUNK_MAX, "live": srv_live}
+
+            async def serve_all():
+                for batch in batches:
+                    for actor, needs in batch:
+                        for need in needs:
+                            await server._serve_need(
+                                w, actor.bytes, need, sess
+                            )
+                            srv_live["needs_done"] += 1
+
+            self._serve_loop.run_until_complete(serve_all())
+            reader = speedy.FrameReader()
+            for payload in reader.feed(b"".join(w.chunks)):
+                served.append(speedy.decode_sync_message(payload))
+        count = 0
+        for msg in served:
+            if isinstance(msg, Timestamp):
+                try:
+                    a.clock.update_with_timestamp(msg)
+                except Exception:
+                    pass
+            elif hasattr(msg, "actor_id"):  # ChangeV1
+                a.handle_change(msg, ChangeSource.SYNC)
+                count += 1
+        live["changes"] = count
+        live["bytes"] = sum(len(c) for c in w.chunks)
+        a.members.update_sync_ts(m.actor_id, self.clock.wall())
+        a.metrics.counter("corro_sync_client_rounds_total")
+        a._sync_session_end(live, "client", "received")
+        a._flight_event(
+            "sync_client_end", peer=peer_hex,
+            changes=count, bytes=live["bytes"], complete=True,
+        )
+        server._sync_session_end(srv_live, "server", "served")
+        server._flight_event(
+            "sync_server_end", peer=a.actor_id.hex(),
+            needs=srv_live["needs_done"], bytes=srv_live["bytes"],
+        )
+
+    # -- recorder snapshots / stall beats ------------------------------
+
+    def _snapshot(self, i: int, due: float) -> None:
+        name = self.names[i]
+        if name in self._crashed:
+            return
+        a = self.agents[name]
+        self._chain(
+            i, max(due + a.config.flight_interval_s,
+                   self._busy_until[i]),
+            lambda d, _i=i: self._snapshot(_i, d),
+        )
+        a.flight.snapshot_once()
+
+    def _stall_beat(self, due: float) -> None:
+        """The virtual LoopHealthProbe: a beat that fires late (a
+        ``jump`` passed it) measures the stall for EVERY agent — the
+        in-process cluster shares one loop, so a stall freezes them
+        all at once (the live ``run_stall_schedule`` semantics)."""
+        self.clock.schedule(STALL_BEAT_S, self._stall_beat)
+        late_ms = (self.clock.monotonic() - due) * 1e3
+        if late_ms < 0.5:
+            return
+        crashed = self._crashed
+        for name, a in self.agents.items():
+            if name in crashed:
+                continue
+            a.metrics.histogram("corro_loop_stall_ms", late_ms)
+            # per-AGENT lifetime max, like the live probe's: a reborn
+            # node's fresh registry starts from zero and must not be
+            # gated on some other incarnation's cluster-wide record
+            if late_ms > self._stall_max_by_agent.get(name, 0.0):
+                self._stall_max_by_agent[name] = late_ms
+                a.metrics.gauge("corro_loop_stall_max_ms", late_ms)
+
+    def _make_stall(self, ev) -> Callable[[float], None]:
+        def fire(_due: float) -> None:
+            self.clock.jump(ev.duration_ms / 1e3)
+            self.ctrl.injected["stall"] += 1
+            self.ctrl.stall_log.append(
+                (self.ctrl.elapsed(), ev.node, ev.duration_ms)
+            )
+
+        return fire
+
+    # -- crash / restart -----------------------------------------------
+
+    def _crash(self, name: str) -> None:
+        if name in self._crashed:
+            return
+        agent = self.agents[name]
+        if agent.flight is not None:
+            agent.flight.event("crash", node=name)
+            self.ctrl.flight_orphans.append(
+                (name, agent.flight.entries())
+            )
+        try:
+            agent.storage.close()
+        except Exception:
+            pass
+        i = self._idx[name]
+        self._entries[i].clear()
+        armed = self._flush_armed[i]
+        if armed is not None:
+            self.clock.cancel(armed)
+            self._flush_armed[i] = None
+        for ev in self._chain_events[i]:
+            self.clock.cancel(ev)
+        self._chain_events[i] = []
+        self._crashed.add(name)
+        self.ctrl.crash_log.append((self.ctrl.elapsed(), "crash", name))
+
+    def _restart(self, name: str) -> None:
+        """Respawn from the SAME node directory — resume, not re-seed:
+        the reborn agent reloads its persisted site id, incarnation,
+        bookkeeping and equivocation digests, re-derives its (identical)
+        bad oscillator from the plan, and catches up through
+        anti-entropy."""
+        if name not in self._crashed:
+            return
+        i = self._idx[name]
+        self._incarnations[i] += 1
+        self._crashed.discard(name)
+        self._stall_max_by_agent.pop(name, None)
+        agent = self._spawn(i)
+        self.agents[name] = agent
+        self.ctrl.agents = self.agents
+        if agent.flight is not None:
+            agent.flight.event("restart", node=name)
+        # membership: the reborn node announces (virtual form of the
+        # announce/gossip round) — peers refresh its record with the
+        # bumped incarnation; it re-learns every live peer
+        for j, peer in enumerate(self.agents.values()):
+            if peer is agent or self.names[j] in self._crashed:
+                continue
+            peer.members.upsert(
+                agent.actor_id, ("virt", i), incarnation=agent.incarnation
+            )
+            peer._suspects.pop(agent.actor_id, None)
+            agent.members.upsert(peer.actor_id, ("virt", j))
+        self._arm_agent_loops(i)
+        self.ctrl.crash_log.append(
+            (self.ctrl.elapsed(), "restart", name)
+        )
+
+    # -- driving --------------------------------------------------------
+
+    def run_for(self, dt: float) -> int:
+        return self.clock.run_until(self.clock.monotonic() + dt)
+
+    def run_until_true(self, pred: Callable[[], bool],
+                       timeout: float, step: float = 0.25) -> bool:
+        """Advance virtual time in ``step`` slices until ``pred()``
+        holds (checked between slices) or ``timeout`` virtual seconds
+        pass.  The virtual ``wait_for``."""
+        deadline = self.clock.monotonic() + timeout
+        while True:
+            if pred():
+                return True
+            if self.clock.monotonic() >= deadline:
+                return False
+            self.run_for(min(step, deadline - self.clock.monotonic()))
+
+    # -- measurement ----------------------------------------------------
+
+    def observer(self):
+        from corrosion_tpu.devcluster import ClusterObserver
+
+        live = {
+            nm: a for nm, a in self.agents.items()
+            if nm not in self._crashed
+        }
+        return ClusterObserver(live, faults=self.ctrl)
+
+    def converged(self, versions: List[Tuple[bytes, int]]) -> bool:
+        """Every live node holds every tracked (actor, version)."""
+        for nm, a in self.agents.items():
+            if nm in self._crashed:
+                continue
+            for actor, v in versions:
+                if a.actor_id != actor and not a.bookie.for_actor(
+                    actor
+                ).contains_version(v):
+                    return False
+        return True
+
+    def journal_bytes(self) -> bytes:
+        """The merged typed-event journal, canonically serialized —
+        the byte-determinism surface: two runs with one (seed, plan,
+        campaign) must produce EQUAL bytes."""
+        events = self.observer().flight_events()
+        return json.dumps(events, sort_keys=True).encode()
+
+    def state_checksum(self) -> str:
+        """End-state checksum over every live node's CRR table bytes
+        and bookkeeping ledgers — the determinism test's second half
+        (and a compact no-divergence witness: all-equal per-node
+        digests ⇒ bytewise-equal table state)."""
+        h = hashlib.blake2b(digest_size=16)
+        for nm in self.names:
+            if nm in self._crashed:
+                continue
+            a = self.agents[nm]
+            h.update(nm.encode())
+            for t in sorted(a.storage.tables):
+                q = t.replace('"', '""')
+                cols, rows = a.storage.read_query(f'SELECT * FROM "{q}"')
+                h.update(repr((t, cols, sorted(rows, key=repr))).encode())
+            with a.storage._lock:
+                for actor, bv in sorted(
+                    a.bookie.actors().items(), key=lambda kv: kv[0]
+                ):
+                    h.update(repr((
+                        actor, bv.max_version, tuple(bv.needed.spans()),
+                        tuple(sorted(bv.partials)),
+                    )).encode())
+        return h.hexdigest()
+
+    def close(self) -> None:
+        import shutil
+
+        for nm, a in self.agents.items():
+            if nm in self._crashed:
+                continue
+            try:
+                a.storage.close()
+            except Exception:
+                pass
+        self._serve_loop.close()
+        if self._own_dir:
+            shutil.rmtree(self.base_dir, ignore_errors=True)

@@ -42,7 +42,7 @@ def test_drop_policy_noop_under_cap():
 
 def _member(i: int, rtt: float) -> Member:
     m = Member(actor_id=bytes([i]) * 16, addr=("127.0.0.1", 10000 + i))
-    m.rtts.append(rtt)
+    m.note_rtt(rtt)
     return m
 
 

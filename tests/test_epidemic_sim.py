@@ -90,3 +90,68 @@ def test_track_hops_off_converges_with_null_hop_stats():
     assert stats["converged_frac"] == 1.0
     assert stats["hops_p50"] is None and stats["hops_p99"] is None
     assert stats["msgs_per_node_mean"] > 0
+
+
+def test_oneway_partition_severs_exactly_the_listed_direction():
+    """The directed-partition shape (EpidemicConfig.oneway_blocks): a
+    writer in block 0 with 0→1 severed plateaus at the block fraction
+    until the heal — while with only the REVERSE direction severed its
+    wave crosses freely and converges before the heal.  The symmetric
+    plan severs both ways, so the 0→1-only cell must match its
+    pre-heal plateau and the 1→0-only cell must beat it."""
+    from corrosion_tpu.sim.epidemic import run_epidemic_coverage
+
+    base = dict(
+        n_nodes=64, n_rows=4, fanout_ring0=0, fanout_global=3,
+        ring0_size=1, max_transmissions=5, partition_blocks=2,
+        heal_tick=24, backoff_ticks=2.5, sync_interval=8, sync_peers=1,
+        max_ticks=256, chunk_ticks=8,
+    )
+    probe = 22  # just before the heal
+    sev = run_epidemic_coverage(
+        EpidemicConfig(**base, oneway_blocks=((0, 1),)), n_seeds=4,
+    )
+    sym = run_epidemic_coverage(
+        EpidemicConfig(**base), n_seeds=4,
+    )
+    free = run_epidemic_coverage(
+        EpidemicConfig(**base, oneway_blocks=((1, 0),)), n_seeds=4,
+    )
+    # severed direction: held at the block fraction, like symmetric
+    assert abs(sev["coverage"][probe] - 0.5) < 0.1
+    assert abs(sym["coverage"][probe] - 0.5) < 0.1
+    # reachable direction: the wave crossed before the heal
+    assert free["coverage"][probe] > 0.9
+    # all three heal to full coverage
+    for cov in (sev, sym, free):
+        assert cov["converged_frac"] == 1.0
+
+
+def test_oneway_sync_needs_both_directions():
+    """Anti-entropy sessions ride a bi-stream: ANY severed direction
+    between the pair kills the session (the live open_bi semantics).
+    With gossip disabled entirely (max_transmissions=0 after the
+    writer's budget burns into its own block — here: fanout into a
+    1-wide ring0 only), sync alone must NOT cross a one-way partition
+    in either direction while it is active."""
+    import jax
+    import jax.numpy as jnp
+
+    from corrosion_tpu.models.sync import SyncParams, sync_step
+
+    n = 8
+    pid = jnp.asarray([0, 0, 0, 0, 1, 1, 1, 1], jnp.int32)
+    rows = jnp.zeros((n, 2), jnp.int32).at[0].set(5)
+    params = SyncParams(
+        n_nodes=n, peers_per_round=4, oneway_blocks=((0, 1),)
+    )
+    r = rows
+    for t in range(6):
+        r, _ = sync_step(
+            r, jnp.zeros((n,), jnp.int32), jax.random.PRNGKey(t),
+            params, partition_id=pid, partition_active=True,
+        )
+    # block 0 converged internally; block 1 saw nothing (a 1→0 pull
+    # session would move data 0→1 over the severed return leg)
+    assert bool(jnp.all(r[:4] == 5))
+    assert bool(jnp.all(r[4:] == 0))

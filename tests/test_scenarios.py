@@ -387,6 +387,68 @@ def test_same_batch_conflicting_pair_detected(tmp_path):
         a.storage.close()
 
 
+def test_equiv_digests_survive_restart(tmp_path):
+    """An equivocator must not be able to wait out a REBOOT of its
+    victim: accepted-content digests persist (__corro_equiv_digests)
+    and reload on boot, so a conflicting re-send arriving after the
+    detector restarted still compares against the accepted content and
+    re-quarantines — while a byte-identical replay stays an absorbed
+    duplicate."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.types import ChangeSource
+
+    peer = EquivocatingPeer(seed=23)
+    ca, cb = peer.conflicting_pair(1)
+    a = make_offline_agent(tmpdir=str(tmp_path))
+    try:
+        assert a.handle_change(ca, ChangeSource.BROADCAST,
+                               rebroadcast=False)
+        assert (peer.actor_id, 1) in a._equiv_digests
+    finally:
+        a.storage.close()
+
+    # restart from the same directory: in-memory state (dedup cache,
+    # digests, quarantine) is gone — only what was persisted survives
+    b = make_offline_agent(tmpdir=str(tmp_path))
+    try:
+        assert b._equiv_digests[(peer.actor_id, 1)] \
+            == a._equiv_digests[(peer.actor_id, 1)]
+        # byte-identical replay: absorbed, not equivocation
+        assert not b.handle_change(ca, ChangeSource.BROADCAST,
+                                   rebroadcast=False)
+        assert peer.actor_id not in b._equiv_quarantined
+        # the conflicting re-send the reboot was supposed to launder:
+        # caught against the reloaded digest, actor re-quarantined
+        assert not b.handle_change(cb, ChangeSource.BROADCAST,
+                                   rebroadcast=False)
+        assert b.metrics.get_counter(
+            "corro_sync_equivocations_total", kind="content"
+        ) == 1
+        assert peer.actor_id in b._equiv_quarantined
+    finally:
+        b.storage.close()
+
+
+def test_equiv_digest_table_bounded(tmp_path):
+    """The durable digest table evicts in step with the in-memory FIFO
+    — a hostile flood cannot grow it past the cache bound."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+
+    a = make_offline_agent(tmpdir=str(tmp_path), seen_cache_size=8)
+    try:
+        for v in range(1, 14):
+            with a.storage._lock:
+                a._remember_digest(b"\x07" * 16, v, bytes(16))
+        assert len(a._equiv_digests) == 8
+        (n,) = a.storage.conn.execute(
+            "SELECT COUNT(*) FROM __corro_equiv_digests"
+        ).fetchone()
+        assert n == 8
+        assert min(v for _a, v in a._equiv_digests) == 6
+    finally:
+        a.storage.close()
+
+
 def test_breaker_quarantine_reason_still_breaker(tmp_path):
     """The transport-evidence path keeps its reason (and its restore
     semantics): breaker open → reason 'breaker', half-open success →

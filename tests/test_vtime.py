@@ -1,0 +1,426 @@
+"""Virtual-time cluster: clock units, campaign smokes, determinism.
+
+The injectable :class:`~corrosion_tpu.clock.Clock` puts every agent
+timer behind one seam; :class:`~corrosion_tpu.sim.vcluster.
+VirtualCluster` drives real agents through fault campaigns on a
+discrete-event heap.  Tier-1 coverage:
+
+* VirtualClock unit behavior (ordering, lateness, jump, run_until);
+* one fast campaign cell per fault family at N=64 (seconds of wall
+  time — the whole point of the refactor);
+* the determinism contract: two runs with the same (seed, FaultPlan,
+  campaign) produce BYTE-IDENTICAL flight-recorder event journals and
+  identical end-state checksums;
+* a small virtual-vs-real parity cell (the N=32 cell ships in
+  TIMELINE_N512.json via ``bench.py --timeline --virtual-time``).
+"""
+
+import json
+import logging
+
+import pytest
+
+from corrosion_tpu.clock import (
+    SYSTEM_CLOCK,
+    VIRTUAL_EPOCH_NS,
+    SystemClock,
+    VirtualClock,
+)
+from corrosion_tpu.faults import CrashEvent, FaultPlan
+
+# the per-node "quarantining" warning is expected output for the
+# hostile families; at N=64 it would drown the test log
+logging.getLogger("corrosion_tpu.agent.runtime").setLevel(logging.ERROR)
+
+
+# ---------------------------------------------------------------------------
+# VirtualClock units
+# ---------------------------------------------------------------------------
+
+
+def test_virtual_clock_orders_and_ties_by_insertion():
+    clk = VirtualClock()
+    fired = []
+    clk.schedule(2.0, lambda d: fired.append(("b", d)))
+    clk.schedule(1.0, lambda d: fired.append(("a", d)))
+    clk.schedule(2.0, lambda d: fired.append(("c", d)))  # tie: after b
+    while clk.advance():
+        pass
+    assert fired == [("a", 1.0), ("b", 2.0), ("c", 2.0)]
+    assert clk.monotonic() == 2.0
+
+
+def test_virtual_clock_jump_models_a_stall():
+    """A jump moves time WITHOUT running the events inside it: they
+    fire late, and the callback can measure its own lateness — the
+    loop-stall model the scheduler's stall beat uses."""
+    clk = VirtualClock()
+    late = []
+    clk.schedule(0.10, lambda due: late.append(clk.monotonic() - due))
+    clk.jump(0.25)
+    clk.advance()
+    assert late and abs(late[0] - 0.15) < 1e-9
+
+
+def test_virtual_clock_run_until_and_cancel():
+    clk = VirtualClock()
+    fired = []
+    ev = clk.schedule(0.5, lambda d: fired.append("cancelled"))
+    clk.schedule(0.7, lambda d: fired.append("kept"))
+    clk.cancel(ev)
+    ran = clk.run_until(1.0)
+    assert ran == 1 and fired == ["kept"]
+    assert clk.monotonic() == 1.0
+    assert clk.pending() == 0
+
+
+def test_virtual_wall_epoch_is_fixed():
+    a, b = VirtualClock(), VirtualClock()
+    assert a.wall_ns() == b.wall_ns() == VIRTUAL_EPOCH_NS
+    a.jump(1.5)
+    assert a.wall_ns() == VIRTUAL_EPOCH_NS + 1_500_000_000
+    assert abs(a.wall() - (VIRTUAL_EPOCH_NS / 1e9 + 1.5)) < 1e-6
+
+
+def test_system_clock_is_the_stdlib():
+    import asyncio
+    import time
+
+    assert SystemClock.monotonic is time.monotonic
+    assert SystemClock.wall is time.time
+    assert SystemClock.wall_ns is time.time_ns
+    assert SystemClock.sleep is asyncio.sleep
+    assert SystemClock.wait_for is asyncio.wait_for
+    assert isinstance(SYSTEM_CLOCK, SystemClock)
+
+
+def test_virtual_clock_sleep_resolves_on_advance():
+    import asyncio
+
+    async def main():
+        clk = VirtualClock()
+        results = []
+
+        async def sleeper():
+            await clk.sleep(0.3)
+            results.append(clk.monotonic())
+
+        task = asyncio.ensure_future(sleeper())
+        await asyncio.sleep(0)  # let the sleeper register its timer
+        while clk.advance():
+            await asyncio.sleep(0)
+        await task
+        assert results == [0.3]
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# the agent's clock seam: a virtual clock behind a real agent
+# ---------------------------------------------------------------------------
+
+
+def test_agent_quarantine_window_ages_on_injected_clock(tmp_path):
+    """``equiv_quarantine_s`` elapses on the INJECTED clock: no real
+    time passes, yet advancing the virtual clock expires the verdict —
+    the seam the virtual campaigns rely on."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.faults import EquivocatingPeer
+    from corrosion_tpu.types import ChangeSource
+
+    clk = VirtualClock()
+    a = make_offline_agent(
+        tmpdir=str(tmp_path), clock=clk, equiv_quarantine_s=5.0
+    )
+    try:
+        peer = EquivocatingPeer(seed=3, now_ns=clk.wall_ns)
+        a.members.upsert(peer.actor_id, ("x", 1))
+        ca, cb = peer.conflicting_pair(1)
+        assert a.handle_change(ca, ChangeSource.BROADCAST,
+                               rebroadcast=False)
+        assert not a.handle_change(cb, ChangeSource.BROADCAST,
+                                   rebroadcast=False)
+        assert peer.actor_id in a._equiv_quarantined
+        # held while the window is open (virtual time unmoved)
+        assert not a.handle_change(peer.honest(2, "held"),
+                                   ChangeSource.BROADCAST,
+                                   rebroadcast=False)
+        clk.jump(6.0)  # the window elapses without any wall time
+        assert a.handle_change(peer.honest(3, "paroled"),
+                               ChangeSource.BROADCAST,
+                               rebroadcast=False)
+        assert peer.actor_id not in a._equiv_quarantined
+    finally:
+        a.storage.close()
+
+
+def test_agent_hlc_rides_injected_wall(tmp_path):
+    """The HLC physical source reads the injected clock's wall — so
+    HLC stamps (and therefore journal merge keys) are deterministic
+    under a fixed virtual epoch."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+
+    clk = VirtualClock()
+    a = make_offline_agent(tmpdir=str(tmp_path), clock=clk)
+    try:
+        ts = a.clock.new_timestamp()
+        assert abs(ts.wall_seconds() - clk.wall()) < 1e-3
+        clk.jump(2.0)
+        ts2 = a.clock.new_timestamp()
+        assert abs(ts2.wall_seconds() - clk.wall()) < 1e-3
+    finally:
+        a.storage.close()
+
+
+# ---------------------------------------------------------------------------
+# campaign smokes: one cell per fault family at N=64, virtual time
+# ---------------------------------------------------------------------------
+
+
+def _vcell(tmp_path, family, **kw):
+    from corrosion_tpu.sim.scenarios import virtual_scenario_cell
+
+    kwargs = dict(
+        n=64, seed=3, writes=4, heal_after=0.5, stall_ms=150.0,
+        timeout=60.0, base_dir=str(tmp_path),
+    )
+    kwargs.update(kw)
+    r = virtual_scenario_cell(family, **kwargs)
+    assert r["passed"], r["gates"]
+    assert r["no_divergence"]["ok"], r["no_divergence"]
+    assert r["timeline"]["snapshots"] > 0
+    return r
+
+
+def test_vcell_clock_skew(tmp_path):
+    r = _vcell(tmp_path, "clock_skew")
+    assert r["detail"]["clock_skew_ns_nonzero"] > 0
+
+
+def test_vcell_asym_partition(tmp_path):
+    r = _vcell(tmp_path, "asym_partition")
+    assert r["injected"]["partition"] > 0
+
+
+def test_vcell_slow_io(tmp_path):
+    r = _vcell(tmp_path, "slow_io")
+    assert r["injected"]["disk"] > 0
+    assert r["injected"]["stall"] == 1
+
+
+def test_vcell_equivocation(tmp_path):
+    r = _vcell(tmp_path, "equivocation")
+    eq = r["detail"]["equivocations"]
+    assert eq.get("content", 0) >= 1
+    assert eq.get("span", 0) >= 1
+    assert eq.get("quarantined", 0) >= 1
+
+
+def test_vcell_compound(tmp_path):
+    r = _vcell(tmp_path, "compound")
+    assert r["injected"]["partition"] > 0
+
+
+def test_vcell_restart_storm(tmp_path):
+    r = _vcell(tmp_path, "restart_storm")
+    assert r["gates"]["crash_schedule_ran"]
+    assert r["timeline"]["event_counts"].get("crash", 0) >= 2
+    assert r["timeline"]["event_counts"].get("restart", 0) >= 2
+
+
+def test_vcell_hostile_sweep_8(tmp_path):
+    r = _vcell(tmp_path, "hostile_sweep_8")
+    assert r["detail"]["hostiles"] == 8
+    assert r["detail"]["equivocations"].get("content", 0) >= 8
+
+
+def test_vcell_equiv_during_heal(tmp_path):
+    r = _vcell(tmp_path, "equiv_during_heal")
+    assert r["injected"]["partition"] > 0
+    assert r["gates"]["hostile_quarantined_everywhere"]
+
+
+def test_vcell_skew_during_restart(tmp_path):
+    r = _vcell(tmp_path, "skew_during_restart")
+    assert r["gates"]["crash_schedule_ran"]
+    assert r["gates"]["skew_applied"]
+
+
+# ---------------------------------------------------------------------------
+# determinism: byte-identical journals, identical end-state checksums
+# ---------------------------------------------------------------------------
+
+
+def _campaign(tmp_path, tag):
+    """A deliberately fault-dense campaign: loss + partition heal +
+    crash/restart + an equivocator, N=16."""
+    from corrosion_tpu.faults import EquivocatingPeer
+    from corrosion_tpu.sim.vcluster import VirtualCluster
+    from corrosion_tpu.types import ChangeSource
+
+    plan = FaultPlan(
+        seed=7, drop=0.05, partition_blocks=2, heal_after=1.0,
+        crashes=(CrashEvent("n3", at=0.5, restart_at=1.5),),
+    )
+    c = VirtualCluster(
+        16, seed=7, plan=plan, base_dir=str(tmp_path / tag)
+    )
+    try:
+        c.ctrl.split()
+        peer = EquivocatingPeer(seed=99, now_ns=c.clock.wall_ns)
+        for a in c.agents.values():
+            a.members.upsert(peer.actor_id, ("hostile", 0))
+        ca, cb = peer.conflicting_pair(1)
+        c.inject(list(range(16)), ca, ChangeSource.BROADCAST)
+        c.inject(list(range(16)), cb, ChangeSource.BROADCAST,
+                 delay=0.3)
+        versions = []
+        for w in range(4):
+            origin = [0, 8][w % 2]
+            v = c.write(
+                origin,
+                "INSERT INTO tests (id, text) VALUES (?, ?)",
+                (100 + w, f"d-{w}"),
+            )
+            versions.append((c.agents[f"n{origin}"].actor_id, v))
+            c.run_for(0.05)
+        assert c.run_until_true(
+            lambda: len(c.ctrl.crash_log) == 2 and not c._crashed
+            and c.converged(versions),
+            timeout=40,
+        )
+        c.run_for(0.5)
+        return (
+            c.journal_bytes(),
+            c.state_checksum(),
+            bytes(c.ctrl.decision_log),
+            dict(c.ctrl.injected),
+        )
+    finally:
+        c.close()
+
+
+def test_virtual_campaign_is_byte_deterministic(tmp_path):
+    """Two runs, same (seed, FaultPlan, campaign): byte-identical
+    flight-recorder event journals, identical no-divergence state
+    checksums, identical fault decision logs."""
+    j1, cs1, log1, inj1 = _campaign(tmp_path, "run1")
+    j2, cs2, log2, inj2 = _campaign(tmp_path, "run2")
+    assert j1 == j2
+    assert cs1 == cs2
+    assert log1 == log2
+    assert inj1 == inj2
+    # the journal is substantive, not vacuously equal
+    events = json.loads(j1)
+    kinds = {e["kind"] for e in events}
+    assert "crash" in kinds and "restart" in kinds
+    assert "sync_client_end" in kinds
+    assert len(events) > 20
+
+
+def test_different_seed_changes_the_journal(tmp_path):
+    """The negative control: a different campaign seed must NOT
+    reproduce the journal (otherwise the determinism assertion is
+    comparing constants)."""
+    from corrosion_tpu.sim.vcluster import VirtualCluster
+
+    def mini(seed, tag):
+        c = VirtualCluster(
+            8, seed=seed,
+            plan=FaultPlan(seed=seed, drop=0.1),
+            base_dir=str(tmp_path / tag),
+        )
+        try:
+            v = c.write(
+                0, "INSERT INTO tests (id, text) VALUES (?, ?)",
+                (1, "x"),
+            )
+            actor = c.agents["n0"].actor_id
+            assert c.run_until_true(
+                lambda: c.converged([(actor, v)]), timeout=30
+            )
+            return c.journal_bytes(), c.state_checksum()
+        finally:
+            c.close()
+
+    j1, _ = mini(1, "s1")
+    j2, _ = mini(2, "s2")
+    assert j1 != j2
+
+
+def test_virtual_restart_resumes_identity_and_digests(tmp_path):
+    """A virtual crash/restart resumes from the same node directory:
+    same actor id, bumped incarnation, and the persisted equivocation
+    digests re-arm the detector in the reborn node."""
+    from corrosion_tpu.faults import EquivocatingPeer
+    from corrosion_tpu.sim.vcluster import VirtualCluster
+    from corrosion_tpu.types import ChangeSource
+
+    plan = FaultPlan(
+        seed=5, crashes=(CrashEvent("n2", at=0.3, restart_at=0.8),),
+    )
+    c = VirtualCluster(8, seed=5, plan=plan, base_dir=str(tmp_path))
+    try:
+        peer = EquivocatingPeer(seed=42, now_ns=c.clock.wall_ns)
+        for a in c.agents.values():
+            a.members.upsert(peer.actor_id, ("hostile", 0))
+        ca, cb = peer.conflicting_pair(1)
+        c.inject(list(range(8)), ca, ChangeSource.BROADCAST)
+        c.run_for(0.1)
+        actor_before = c.agents["n2"].actor_id
+        inc_before = c.agents["n2"].incarnation
+        # the whole schedule (crash AND restart) must actually run —
+        # "nothing crashed" is vacuously true before the crash fires
+        assert c.run_until_true(
+            lambda: len(c.ctrl.crash_log) == 2 and not c._crashed,
+            timeout=10,
+        )
+        reborn = c.agents["n2"]
+        assert reborn.actor_id == actor_before
+        assert reborn.incarnation == inc_before + 1
+        # the reloaded digest catches the post-reboot conflicting
+        # re-send immediately
+        assert (peer.actor_id, 1) in reborn._equiv_digests
+        assert not reborn.handle_change(cb, ChangeSource.BROADCAST,
+                                        rebroadcast=False)
+        assert peer.actor_id in reborn._equiv_quarantined
+    finally:
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# virtual-vs-real parity (small tier-1 cell; N=32 ships in the
+# TIMELINE_N512 artifact)
+# ---------------------------------------------------------------------------
+
+
+def test_virtual_real_parity_small(tmp_path):
+    from corrosion_tpu.sim.timeline import virtual_real_parity
+
+    p = virtual_real_parity(
+        n=10, heal_after=1.0, seed=0, base_dir=str(tmp_path)
+    )
+    assert p["passed"], p["gates"]
+
+
+# ---------------------------------------------------------------------------
+# virtual timeline trajectory vs the kernel (the N=512 gate's shape,
+# smoke-scale)
+# ---------------------------------------------------------------------------
+
+
+def test_virtual_timeline_trajectory_gates_n64(tmp_path):
+    from corrosion_tpu.sim.timeline import (
+        kernel_coverage_prediction,
+        trajectory_gates,
+        virtual_timeline_cell,
+    )
+
+    cell = virtual_timeline_cell(
+        64, heal_after=1.28, seed=0, timeout=40,
+        base_dir=str(tmp_path),
+    )
+    assert cell["converged"]
+    pred = kernel_coverage_prediction(64, 64, seeds=4)
+    traj = trajectory_gates(cell, pred, 1.28)
+    assert all(traj["gates"].values()), traj
