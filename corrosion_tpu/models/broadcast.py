@@ -59,10 +59,42 @@ class BroadcastParams:
     # directed (src_block, dst_block) pairs sever while the partition
     # is active; None = symmetric (the original behavior)
     oneway_blocks: Optional[tuple] = None
+    # scenario families beyond uniform fanout (EpidemicConfig mirrors):
+    # - het_ring: node i (universe-local) sits on RTT tier
+    #   1 + i*rtt_tiers//u of a ring by id; its retransmit gap and its
+    #   first post-learn forward scale with the tier;
+    # - wan_two_region: node i lives in region i*wan_blocks//u; gossip
+    #   crossing regions suffers an EXTRA i.i.d. wan_cross_loss drop on
+    #   top of ``loss`` (long-RTT datagram timeouts).  Anti-entropy
+    #   sessions cross unharmed (QUIC streams with retries) — see
+    #   models/sync.py.  ``uniform`` executes the pre-topology path.
+    topology: str = "uniform"
+    rtt_tiers: int = 4
+    wan_blocks: int = 2
+    wan_cross_loss: float = 0.25
 
     @property
     def fanout(self) -> int:
         return self.fanout_ring0 + self.fanout_global
+
+
+def _rtt_tier(params: "BroadcastParams"):
+    """[N] int32 het_ring RTT tier (1..rtt_tiers, universe-local), or
+    None on other topologies — static arithmetic, constant-folds."""
+    if params.topology != "het_ring":
+        return None
+    u = params.universe or params.n_nodes
+    local = jnp.arange(params.n_nodes, dtype=jnp.int32) % u
+    return 1 + (local * params.rtt_tiers) // u
+
+
+def _wan_region(params: "BroadcastParams"):
+    """[N] int32 wan_two_region region id (universe-local), else None."""
+    if params.topology != "wan_two_region" or params.wan_cross_loss <= 0.0:
+        return None
+    u = params.universe or params.n_nodes
+    local = jnp.arange(params.n_nodes, dtype=jnp.int32) % u
+    return (local * params.wan_blocks) // u
 
 
 # sentinel hop depth for "not yet infected" (far above any real depth)
@@ -139,6 +171,14 @@ def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
             ok &= jax.random.uniform(key_l, (n, k)) >= params.loss
         ok &= partition_ok(partition_id, targets, partition_active,
                            oneway=params.oneway_blocks)
+        region = _wan_region(params)
+        if region is not None:
+            # the extra draw only exists on the wan topology, so every
+            # other config's RNG stream is byte-identical
+            wan_drop = jax.random.uniform(
+                jax.random.fold_in(key_l, 1), (n, k)
+            ) < params.wan_cross_loss
+            ok &= ~((region[:, None] != region[targets]) & wan_drop)
 
         # masked delivery: dead messages point past the end and get
         # dropped.  Scatter-max is associative, so K column scatters
@@ -186,14 +226,20 @@ def broadcast_step(rows, tx_remaining, msgs_sent, key, params: BroadcastParams,
     nxt = None
     if next_send is not None:
         # nth retransmission waits backoff*n ticks; a fresh payload
-        # (learner) forwards on the very next tick
+        # (learner) forwards on the very next tick — both scaled by the
+        # node's RTT tier on the het_ring topology
         send_count = params.max_transmissions - tx  # nth send just made
         gap = jnp.maximum(
             1,
             jnp.round(params.backoff_ticks * send_count).astype(jnp.int32),
         )
+        tier = _rtt_tier(params)
+        first = 1
+        if tier is not None:
+            gap = gap * tier
+            first = tier
         nxt = jnp.where(active, tick + gap, next_send)
-        nxt = jnp.where(learned, tick + 1, nxt)
+        nxt = jnp.where(learned, tick + first, nxt)
     new_hops = None
     if hops is not None:
         new_hops = jnp.where(learned, jnp.minimum(hops, cand), hops)
@@ -299,6 +345,12 @@ def _deliver_perm(rows, active, hops, key_t, key_l, params: BroadcastParams,
 
     if params.loss > 0.0:
         drop = jax.random.uniform(key_l, (n, k)) < params.loss
+    region = _wan_region(params)
+    if region is not None:
+        # wan-only extra draw: other configs' streams stay byte-equal
+        wan_drop = jax.random.uniform(
+            jax.random.fold_in(key_l, 1), (n, k)
+        ) < params.wan_cross_loss
 
     new_rows = rows
     cand = jnp.full((n,), HOP_UNSET, jnp.int32)
@@ -311,6 +363,8 @@ def _deliver_perm(rows, active, hops, key_t, key_l, params: BroadcastParams,
         valid = sh < HOP_UNSET  # sender was actively transmitting
         if params.loss > 0.0:
             valid &= ~drop[:, j]
+        if region is not None:
+            valid &= ~((region[sender] != region) & wan_drop[:, j])
         if partition_id is not None:
             # direction of flow is sender → receiver: the gathered
             # column carries the SENDER's block id

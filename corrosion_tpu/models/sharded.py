@@ -35,6 +35,8 @@ protocol semantics.  Two fabrics share that contract:
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -267,6 +269,253 @@ def sharded_broadcast_step_ring(mesh, params: BroadcastParams,
             in_specs=(node_sharded, node_sharded, node_sharded, P()),
             out_specs=(node_sharded, node_sharded, node_sharded, P()),
         )
+    )
+
+
+@lru_cache(maxsize=8)
+def sharded_frontier_exact_step(mesh, cfg):
+    """Mesh-native frontier-sparse exact tick (the sparse twin of
+    ``sim/calibrate.py``'s ``sharded_packed_exact_step``): ``step(state,
+    keys) -> state`` on GLOBAL seed-batched FrontierExactState arrays
+    laid out per ``frontier_shardings``.
+
+    The layout inverts the dense kernel's exchange pattern into the
+    delta style the frontier representation affords:
+
+    * the RING — the only O(N·cap) leaf — row-shards over ``nodes``
+      (every use of row *i* is sender-local: the validity test reads
+      sender *i*'s own ring, marking writes it);
+    * every [S, N] dense leaf (infected/tx/next_send/msgs) is
+      REPLICATED and each shard runs the full cheap bookkeeping
+      itself — so the ``active``/``infected`` masks the dense fabric
+      all_gathers every tick (and again for every sync round) never
+      cross this fabric at all;
+    * the ONLY per-tick exchange is the rejection loop's validity
+      delta: each round, one tiled ``all_gather`` of the [S, n_local]
+      still-bad bits for the rows each shard owns.  Ticks with an
+      empty frontier skip the whole phase (no exchange, no draws).
+
+    Bitwise identical per seed to the single-chip
+    ``frontier_exact_tick`` — and through it to ``packed_exact_tick``
+    (tests/test_sharding.py pins it with a negative control)."""
+    import jax.numpy as jnp
+
+    from corrosion_tpu.sim.calibrate import (
+        FrontierExactState,
+        _frontier_state_specs,
+    )
+
+    if cfg.n_nodes % mesh.shape["nodes"] != 0:
+        raise ValueError(
+            f"n_nodes {cfg.n_nodes} must divide over "
+            f"{mesh.shape['nodes']} node shards"
+        )
+    specs = _frontier_state_specs()
+
+    def local(state, keys):
+        out = _sharded_frontier_tick_local(*state, keys, cfg)
+        return FrontierExactState(*out)
+
+    return jax.jit(
+        _shard_map(
+            local, mesh,
+            in_specs=(specs, P()),
+            out_specs=specs,
+        )
+    )
+
+
+def _sharded_frontier_tick_local(infected, tx, next_send, ring_l, msgs,
+                                 ticks, keys, cfg, writer: int = 0):
+    """One frontier tick on ONE shard for a seed batch.
+
+    Shapes: infected/tx/next_send/msgs [S, N] REPLICATED (identical on
+    every shard); ring_l [S, n_local, cap] my shard's ring rows; ticks
+    [S] lockstep; keys [S, 2] per-seed tick keys.  Consumes the RNG
+    stream in exactly ``packed_exact_tick``'s order (replicated integer
+    draws, the fabric idiom above)."""
+    from corrosion_tpu.sim.calibrate import (
+        _backoff_next_send,
+        _frontier_invalid,
+        _partition_of,
+        _sync_pull,
+        _wan_filter,
+    )
+
+    n, k = cfg.n_nodes, cfg.fanout
+    S = infected.shape[0]
+    n_local = ring_l.shape[1]
+    cap = ring_l.shape[2]
+    shard = jax.lax.axis_index("nodes")
+    my_lo = shard * n_local
+    idx_l = my_lo + jnp.arange(n_local, dtype=jnp.int32)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    s_rows = jnp.arange(S, dtype=jnp.int32)
+
+    def slice_l(x):  # [S, n] -> my [S, n_local] block
+        return jax.lax.dynamic_slice_in_dim(x, my_lo, n_local, axis=1)
+
+    active = infected & (tx > 0) & (next_send <= ticks[:, None])  # [S, N]
+    part = _partition_of(cfg)
+    part_active = ticks < cfg.heal_tick  # [S]
+
+    ks = jax.vmap(lambda kk: jax.random.split(kk, 3))(keys)
+    k_draw, k_loss, k_sync = ks[:, 0], ks[:, 1], ks[:, 2]
+
+    def do_broadcast(args):
+        infected, tx, next_send, ring_l, msgs = args
+
+        def draw(r):
+            return jax.vmap(
+                lambda kd: jax.random.randint(
+                    jax.random.fold_in(kd, r), (n, k), 0, n
+                )
+            )(k_draw)  # [S, n, k] replicated
+
+        def invalid_local(cand):
+            """[S, n_local]: my rows' invalid bits — the per-round
+            validity DELTA, the only thing that crosses the fabric."""
+            cand_l = jax.lax.dynamic_slice_in_dim(cand, my_lo, n_local, 1)
+            return _frontier_invalid(cfg, ring_l, idx_l, cand_l, writer)
+
+        cand = draw(0)
+        bad = gather_nodes(
+            invalid_local(cand) & slice_l(active), axis=1
+        )  # [S, n]
+
+        def cond(carry):
+            _, bad, _ = carry
+            return jnp.any(bad)
+
+        def body(carry):
+            cand, bad, r = carry
+            cand = jnp.where(bad[:, :, None], draw(r), cand)
+            bad_l = invalid_local(cand) & slice_l(bad)
+            return cand, gather_nodes(bad_l, axis=1), r + 1
+
+        cand, _, _ = jax.lax.while_loop(
+            cond, body, (cand, bad, jnp.int32(1))
+        )
+
+        delivered = jnp.broadcast_to(active[:, :, None], (S, n, k))
+        if cfg.loss > 0.0:
+            keep = jax.vmap(
+                lambda kl: jax.random.uniform(kl, (n, k))
+            )(k_loss) >= cfg.loss
+            delivered &= keep
+        if part is not None:
+            delivered &= ~(
+                (part[None, :, None] != part[cand])
+                & part_active[:, None, None]
+            )
+        delivered = _wan_filter(delivered, cand, k_loss, cfg)
+
+        # delivery is replicated: every shard commits the same scatter
+        tgt = jnp.where(delivered, cand, n).reshape(S, n * k)
+        new_infected = (
+            infected.at[s_rows[:, None], tgt].set(True, mode="drop")
+        )
+
+        # mark on send — sender-local: my rows' targets into MY ring
+        # rows at slots [sends_made*k, sends_made*k + k)
+        cand_l = jax.lax.dynamic_slice_in_dim(cand, my_lo, n_local, 1)
+        active_l = slice_l(active)
+        send_base = (cfg.max_transmissions - slice_l(tx)) * k
+        slot = send_base[:, :, None] + jnp.arange(k, dtype=jnp.int32)
+        slot = jnp.where(active_l[:, :, None], slot, cap)
+        new_ring_l = ring_l.at[
+            s_rows[:, None, None],
+            jnp.arange(n_local, dtype=jnp.int32)[None, :, None],
+            slot,
+        ].set(cand_l, mode="drop")
+        msgs = msgs + jnp.where(active, k, 0)
+
+        tx = jnp.where(active, tx - 1, tx)
+        learned = new_infected & ~infected
+        next_send = _backoff_next_send(
+            active, learned, tx, next_send, ticks[:, None], cfg
+        )
+        tx = jnp.where(learned, cfg.max_transmissions, tx)
+        return new_infected, tx, next_send, new_ring_l, msgs
+
+    infected, tx, next_send, ring_l, msgs = jax.lax.cond(
+        jnp.any(active), do_broadcast, lambda args: args,
+        (infected, tx, next_send, ring_l, msgs),
+    )
+
+    if cfg.sync_interval > 0:
+        # fully replicated — the dense fabric needed an infected
+        # all_gather here; the replicated layout needs nothing
+        def do_sync(args):
+            infected, msgs = args
+            p = cfg.sync_peers
+            peers = jax.vmap(
+                lambda kk: jax.random.randint(kk, (n, p), 0, n)
+            )(k_sync)  # [S, n, p] replicated
+            reachable = jnp.ones((S, n, p), bool)
+            if part is not None:
+                reachable &= ~(
+                    (part[None, :, None] != part[peers])
+                    & part_active[:, None, None]
+                )
+            healed, pay = _sync_pull(infected, peers, reachable, cfg)
+            return infected | healed, msgs + pay
+
+        infected, msgs = jax.lax.cond(
+            ticks[0] % cfg.sync_interval == cfg.sync_interval - 1,
+            do_sync,
+            lambda args: args,
+            (infected, msgs),
+        )
+
+    return infected, tx, next_send, ring_l, msgs, ticks + 1
+
+
+@lru_cache(maxsize=8)
+def make_sharded_frontier_chunk(mesh, cfg):
+    """Jitted mesh-native frontier scan chunk: ``chunk(state,
+    seed_keys) -> (state', (conv [C, S], msgs_mean [C, S], msgs_p99
+    [C, S]))`` — the sparse twin of ``make_sharded_exact_chunk``
+    (donated state; stats come straight off the REPLICATED leaves, no
+    gather; cached by (mesh, cfg) so warm and measured runs share one
+    compiled executable)."""
+    import jax.numpy as jnp
+
+    from corrosion_tpu.sim.calibrate import (
+        FrontierExactState,
+        _frontier_state_specs,
+    )
+
+    if cfg.n_nodes % mesh.shape["nodes"] != 0:
+        raise ValueError(
+            f"n_nodes {cfg.n_nodes} must divide over "
+            f"{mesh.shape['nodes']} node shards"
+        )
+    specs = _frontier_state_specs()
+
+    def local_chunk(state, seed_keys):
+        def body(carry, _):
+            keys_t = jax.vmap(jax.random.fold_in)(seed_keys, carry[5])
+            nxt = _sharded_frontier_tick_local(*carry, keys_t, cfg)
+            msgs_f = nxt[4].astype(jnp.float32)
+            return nxt, (
+                jnp.all(nxt[0], axis=1),
+                jnp.mean(msgs_f, axis=1),
+                jnp.percentile(msgs_f, 99, axis=1),
+            )
+
+        carry, stats = jax.lax.scan(
+            body, tuple(state), xs=None, length=cfg.chunk_ticks,
+        )
+        return FrontierExactState(*carry), stats
+
+    return jax.jit(
+        _shard_map(
+            local_chunk, mesh,
+            in_specs=(specs, P()),
+            out_specs=(specs, (P(), P(), P())),
+        ),
+        donate_argnums=(0,),
     )
 
 

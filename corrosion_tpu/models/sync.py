@@ -43,7 +43,13 @@ class SyncParams:
     universe: Optional[int] = None
     # one-way partitions: a sync session needs BOTH directions up (the
     # dial is client→server, the served chunks server→client), so any
-    # listed severed direction between the pair kills the session
+    # listed severed direction between the pair kills the session.
+    # NOTE deliberately NO wan_cross_loss here: the wan_two_region
+    # topology (models/broadcast.py) drops cross-region GOSSIP only —
+    # anti-entropy sessions ride QUIC streams with retries, so a
+    # session that forms either completes or (under a partition) never
+    # forms at all.  Cross-region healing therefore flows through sync,
+    # which is what makes the WAN family converge.
     oneway_blocks: Optional[tuple] = None
 
 

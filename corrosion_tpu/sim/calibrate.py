@@ -261,8 +261,29 @@ class HeadlineExactConfig:
     handshake_msgs: int = 2  # sync session accounting (models/sync.py)
     max_ticks: int = 192
     chunk_ticks: int = 16
+    # scenario families beyond uniform fanout (mirrors EpidemicConfig):
+    # - ``het_ring``: node i sits on RTT tier 1 + i*rtt_tiers//n of a
+    #   ring by id — its retransmit gap (and its first forward after
+    #   learning) scales with the tier, so the convergence tail is
+    #   driven by the slow arc of the ring;
+    # - ``wan_two_region``: node i lives in region i*wan_blocks//n;
+    #   gossip sends crossing regions suffer an EXTRA i.i.d. drop of
+    #   ``wan_cross_loss`` on top of ``loss`` (long-RTT datagram
+    #   timeouts), while anti-entropy sessions cross unharmed (the
+    #   reference syncs over QUIC streams with retries).
+    # ``uniform`` executes exactly the pre-topology code path.
+    topology: str = "uniform"
+    rtt_tiers: int = 4
+    wan_blocks: int = 2
+    wan_cross_loss: float = 0.25
 
     def __post_init__(self):
+        if self.topology not in ("uniform", "het_ring", "wan_two_region"):
+            raise ValueError(f"unknown topology {self.topology!r}")
+        if self.topology == "het_ring" and self.rtt_tiers < 1:
+            raise ValueError("het_ring needs rtt_tiers >= 1")
+        if self.topology == "wan_two_region" and self.wan_blocks < 2:
+            raise ValueError("wan_two_region needs wan_blocks >= 2")
         # rejection sampling needs the excluded set to stay far below N
         # (it also guarantees coverage never exhausts, so the retire
         # path of the small-N kernels cannot trigger)
@@ -333,6 +354,113 @@ def _partition_of(cfg: HeadlineExactConfig):
     return idx * cfg.partition_blocks // cfg.n_nodes
 
 
+def _rtt_tier_of(cfg: HeadlineExactConfig):
+    """[N] int32 RTT tier (1..rtt_tiers) of the het_ring topology, or
+    None on other topologies.  Static arithmetic, so under jit it
+    constant-folds into the compiled tick."""
+    if cfg.topology != "het_ring":
+        return None
+    idx = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
+    return 1 + (idx * cfg.rtt_tiers) // cfg.n_nodes
+
+
+def _region_of(cfg: HeadlineExactConfig):
+    """[N] int32 WAN region of the wan_two_region topology, else None."""
+    if cfg.topology != "wan_two_region" or cfg.wan_cross_loss <= 0.0:
+        return None
+    idx = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
+    return (idx * cfg.wan_blocks) // cfg.n_nodes
+
+
+def _wan_filter(delivered, cand, k_loss, cfg: HeadlineExactConfig):
+    """Apply the WAN extra cross-region drop to a [..., N, K] delivered
+    mask (shared by the packed oracle, the frontier kernel, and both
+    mesh kernels).  ``k_loss`` is one key, or a [S, 2] stack of them
+    for the seed-batched shard kernels — the draw vmaps to stay
+    replicated-identical to the oracle's per-seed stream.  The extra
+    uniform draw only exists on the wan topology, so every other
+    config's RNG stream is byte-identical to the pre-topology
+    kernel."""
+    region = _region_of(cfg)
+    if region is None:
+        return delivered
+    n, k = cfg.n_nodes, cfg.fanout
+
+    def draw(kl):
+        return jax.random.uniform(jax.random.fold_in(kl, 1), (n, k))
+
+    wan_drop = (
+        jax.vmap(draw)(k_loss) if k_loss.ndim == 2 else draw(k_loss)
+    ) < cfg.wan_cross_loss
+    src = region.reshape((1,) * (cand.ndim - 2) + (n, 1))
+    cross = src != region[cand]
+    return delivered & ~(cross & wan_drop)
+
+
+def _sync_pull(infected, peers, reachable, cfg: HeadlineExactConfig):
+    """The anti-entropy pull algebra shared by every exact kernel
+    (packed oracle, frontier, and both mesh kernels): ``infected``
+    [..., N], ``peers``/``reachable`` [..., N, P] — returns
+    ``(healed [..., N], pay [..., N])``, the nodes a reachable
+    infected peer heals this round and the per-node session message
+    charges (handshake split + one chunk per serving session —
+    ``models/sync.py session_msgs`` reduced to single-payload).
+    Callers apply them to their own (possibly row-sliced) leaves."""
+    shape = infected.shape
+    n = shape[-1]
+    p = peers.shape[-1]
+    B = 1
+    for d in shape[:-1]:
+        B *= d
+    inf_f = infected.reshape(B, n)
+    peers_f = peers.reshape(B, n * p)
+    reach_f = reachable.reshape(B, n, p)
+    inf_peers = jnp.take_along_axis(inf_f, peers_f, axis=1).reshape(
+        B, n, p
+    )
+    ahead = inf_peers & ~inf_f[:, :, None] & reach_f
+    healed = jnp.any(ahead, axis=2)
+    client_pay = (
+        jnp.sum(reach_f, axis=2) * (cfg.handshake_msgs // 2)
+    ).astype(jnp.int32)
+    per_server = (
+        (cfg.handshake_msgs - cfg.handshake_msgs // 2) * reach_f + ahead
+    ).astype(jnp.int32)
+    b_rows = jnp.arange(B, dtype=jnp.int32)
+    server_pay = (
+        jnp.zeros((B, n), jnp.int32)
+        .at[b_rows[:, None], peers_f]
+        .add(per_server.reshape(B, n * p))
+    )
+    return (
+        healed.reshape(shape),
+        (client_pay + server_pay).reshape(shape),
+    )
+
+
+def _backoff_next_send(active, learned, tx, next_send, tick,
+                       cfg: HeadlineExactConfig, idx=None):
+    """Shared budget/backoff arithmetic (post-decrement ``tx``): the nth
+    retransmission waits ``max(1, round(backoff*n))`` ticks, scaled by
+    the node's RTT tier on the het_ring topology; a fresh learner
+    forwards after one tick (its tier's worth on het_ring).  ``idx``
+    slices the tier to the caller's rows when its leaves are sharded
+    (the dense mesh kernel) rather than full-width/replicated."""
+    send_count = cfg.max_transmissions - tx
+    gap = jnp.maximum(
+        1, jnp.round(cfg.backoff_ticks * send_count).astype(jnp.int32)
+    )
+    tier = _rtt_tier_of(cfg)
+    first = 1
+    if tier is not None:
+        if idx is not None:
+            tier = tier[idx]
+        gap = gap * tier
+        first = tier
+    nxt = jnp.where(active, tick + gap, next_send)
+    return jnp.where(learned, tick + first, nxt)
+
+
 def _sent_bit(sent, rows, targets):
     """Broadcasted bool: is ``targets``'s bit set in ``rows``' packed
     sent_to rows?"""
@@ -387,6 +515,7 @@ def packed_exact_tick(
         delivered &= jax.random.uniform(k_loss, (n, k)) >= cfg.loss
     if part is not None:
         delivered &= ~((part[:, None] != part[cand]) & part_active)
+    delivered = _wan_filter(delivered, cand, k_loss, cfg)
 
     new_infected = infected.at[
         jnp.where(delivered, cand, n).reshape(-1)
@@ -403,14 +532,10 @@ def packed_exact_tick(
     # budget/backoff — det/agent semantics (coverage never exhausts at
     # rejection scale, so the retire path does not exist here)
     tx = jnp.where(active, tx - 1, tx)
-    send_count = cfg.max_transmissions - tx
-    gap = jnp.maximum(
-        1, jnp.round(cfg.backoff_ticks * send_count).astype(jnp.int32)
-    )
-    next_send = jnp.where(active, tick + gap, next_send)
     learned = new_infected & ~infected
+    next_send = _backoff_next_send(active, learned, tx, next_send, tick,
+                                   cfg)
     tx = jnp.where(learned, cfg.max_transmissions, tx)
-    next_send = jnp.where(learned, tick + 1, next_send)
 
     # anti-entropy pull on the kernel cadence (models/sync.py sync_step
     # reduced to single-payload: a reachable infected peer heals the
@@ -424,21 +549,8 @@ def packed_exact_tick(
             reachable = jnp.ones((n, p), bool)
             if part is not None:
                 reachable &= ~((part[:, None] != part[peers]) & part_active)
-            ahead = infected[peers] & ~infected[:, None] & reachable
-            healed = jnp.any(ahead, axis=1)
-            client_pay = (
-                jnp.sum(reachable, axis=1) * (cfg.handshake_msgs // 2)
-            ).astype(jnp.int32)
-            per_server = (
-                (cfg.handshake_msgs - cfg.handshake_msgs // 2)
-                * reachable + ahead
-            ).astype(jnp.int32)
-            server_pay = (
-                jnp.zeros((n,), jnp.int32)
-                .at[peers.reshape(-1)]
-                .add(per_server.reshape(-1))
-            )
-            return infected | healed, msgs + client_pay + server_pay
+            healed, pay = _sync_pull(infected, peers, reachable, cfg)
+            return infected | healed, msgs + pay
 
         new_infected, msgs = jax.lax.cond(
             tick % cfg.sync_interval == cfg.sync_interval - 1,
@@ -615,6 +727,7 @@ def _sharded_tick_local(infected_l, tx_l, next_send_l, sent_l, msgs_l,
             (part[None, :, None] != part[cand])
             & part_active[:, None, None]
         )
+    delivered = _wan_filter(delivered, cand, k_loss, cfg)
 
     # delivery: every shard knows every (replicated) tuple, so each
     # commits its own rows from one full-width scatter then slices
@@ -636,18 +749,12 @@ def _sharded_tick_local(infected_l, tx_l, next_send_l, sent_l, msgs_l,
     new_msgs_l = msgs_l + jnp.where(active_l, k, 0)
 
     new_tx_l = jnp.where(active_l, tx_l - 1, tx_l)
-    send_count = cfg.max_transmissions - new_tx_l
-    gap = jnp.maximum(
-        1, jnp.round(cfg.backoff_ticks * send_count).astype(jnp.int32)
-    )
-    new_next_send_l = jnp.where(
-        active_l, ticks[:, None] + gap, next_send_l
-    )
     learned_l = new_infected_l & ~infected_l
-    new_tx_l = jnp.where(learned_l, cfg.max_transmissions, new_tx_l)
-    new_next_send_l = jnp.where(
-        learned_l, ticks[:, None] + 1, new_next_send_l
+    new_next_send_l = _backoff_next_send(
+        active_l, learned_l, new_tx_l, next_send_l, ticks[:, None],
+        cfg, idx=idx_l,
     )
+    new_tx_l = jnp.where(learned_l, cfg.max_transmissions, new_tx_l)
 
     if cfg.sync_interval > 0:
         # gather OUTSIDE the cond so both branches stay collective-free
@@ -665,27 +772,8 @@ def _sharded_tick_local(infected_l, tx_l, next_send_l, sent_l, msgs_l,
                     (part[None, :, None] != part[peers])
                     & part_active[:, None, None]
                 )
-            inf_peers = jnp.take_along_axis(
-                infected_all, peers.reshape(S, n * p), axis=1
-            ).reshape(S, n, p)
-            ahead = inf_peers & ~infected_all[:, :, None] & reachable
-            healed = jnp.any(ahead, axis=2)  # [S, n]
-            client_pay = (
-                jnp.sum(reachable, axis=2) * (cfg.handshake_msgs // 2)
-            ).astype(jnp.int32)
-            per_server = (
-                (cfg.handshake_msgs - cfg.handshake_msgs // 2)
-                * reachable + ahead
-            ).astype(jnp.int32)
-            server_pay = (
-                jnp.zeros((S, n), jnp.int32)
-                .at[s_rows[:, None], peers.reshape(S, n * p)]
-                .add(per_server.reshape(S, n * p))
-            )
-            return (
-                infected_l | slice_l(healed),
-                msgs_l + slice_l(client_pay + server_pay),
-            )
+            healed, pay = _sync_pull(infected_all, peers, reachable, cfg)
+            return infected_l | slice_l(healed), msgs_l + slice_l(pay)
 
         new_infected_l, new_msgs_l = jax.lax.cond(
             ticks[0] % cfg.sync_interval == cfg.sync_interval - 1,
@@ -809,11 +897,333 @@ def make_sharded_exact_chunk(mesh, cfg: HeadlineExactConfig):
     )
 
 
+# ---------------------------------------------------------------------------
+# Frontier-sparse exact sampler (N = 256k-1M+)
+# ---------------------------------------------------------------------------
+#
+# The bitpacked kernel's [N, ceil(N/8)] ``sent_to`` bitmap is O(N^2/8)
+# bytes — 1.25 GB at 100k, 8.2 GB at 256k, ~125 GB at 1M: past the 256k
+# stretch point the next order of magnitude is a REPRESENTATION problem
+# (TeraAgent, PAPERS.md, distributes half a trillion agents on exactly
+# this move: sparse, delta-encoded state exchange over shards).  The
+# protocol itself is frontier-sparse: a node transmits at most
+# ``max_transmissions * fanout`` targets per payload, so its entire
+# exclusion set fits a CAPPED RECENT-TARGET RING of that many slots —
+# O(N * budget * fanout) bytes total (128 MB at 1M vs 125 GB dense),
+# and the per-tick ring test is a ``cap``-wide compare instead of a
+# byte gather from a cache-hostile gigabyte bitmap.
+#
+# Exactness is preserved structurally, not statistically:
+#
+# * each active send appends its k fresh targets at ring slots
+#   ``sends_made * k + j`` — slots never collide and never overflow,
+#   because ``tx`` decrements once per active tick and a node learns
+#   (gets a fresh budget) at most once;
+# * the ORIGIN's ring0 tier (seeded at init, up to ring0_size-1
+#   targets) is the one exclusion that would not fit the ring — but the
+#   tier is a contiguous index block, so membership is ARITHMETIC
+#   (``_ring0_tier_hit``), not stored;
+# * the RNG stream (candidate rounds, loss, sync peers) is consumed in
+#   exactly the bitpacked kernel's order, so for the same per-seed keys
+#   the trajectory — infected set, per-node msgs, tx, next_send, and
+#   the ring DECODED back to a bitmap — is BITWISE ``packed_exact_tick``
+#   (tests/test_frontier.py pins it at N<=256 with a seeded-corruption
+#   negative control; tests/test_sharding.py pins the mesh twin).
+#
+# Per-tick work is frontier-gated: ticks with an EMPTY frontier (no
+# node has anything left to send — the long sync-only tail after the
+# broadcast wave dies) skip the entire draw/test/mark phase via
+# ``lax.cond``, and the rejection loop's extra rounds only run while
+# some frontier row still holds an invalid tuple.
+
+
+def frontier_ring_cap(cfg: HeadlineExactConfig) -> int:
+    """Ring slots per node: the protocol's own bound on distinct
+    targets a non-origin node can ever send this payload to."""
+    return cfg.max_transmissions * cfg.fanout
+
+
+class FrontierExactState(NamedTuple):
+    infected: jnp.ndarray  # [N] bool
+    tx: jnp.ndarray  # [N] int32 remaining transmissions
+    next_send: jnp.ndarray  # [N] int32
+    ring: jnp.ndarray  # [N, cap] int32 sent-target ring (N = empty slot)
+    msgs: jnp.ndarray  # [N] int32 (broadcast + sync session msgs)
+    tick: jnp.ndarray  # scalar int32
+
+
+def frontier_exact_init(
+    cfg: HeadlineExactConfig, key, writer: int = 0
+) -> FrontierExactState:
+    """Bitwise ``packed_exact_init`` on every dense leaf (same tier
+    loss draw from ``key``); the origin's ring0 tier is NOT stored —
+    its membership test is arithmetic (``_ring0_tier_hit``)."""
+    n = cfg.n_nodes
+    cap = frontier_ring_cap(cfg)
+    infected = jnp.zeros((n,), bool).at[writer].set(True)
+    tx = jnp.zeros((n,), jnp.int32).at[writer].set(cfg.max_transmissions)
+    next_send = jnp.zeros((n,), jnp.int32)
+    ring = jnp.full((n, cap), n, jnp.int32)
+    msgs = jnp.zeros((n,), jnp.int32)
+    if cfg.ring0_size > 1:
+        idx = jnp.arange(n, dtype=jnp.int32)
+        block = jnp.minimum(cfg.ring0_size, n)
+        in_tier = (idx // block == writer // block) & (idx != writer)
+        delivered = in_tier
+        if cfg.loss > 0.0:
+            keep = jax.random.uniform(key, (n,)) >= cfg.loss
+            delivered = in_tier & keep
+        infected = infected | delivered
+        tx = jnp.where(delivered, cfg.max_transmissions, tx)
+        next_send = jnp.where(delivered, 1, next_send)
+        msgs = msgs.at[writer].add(in_tier.sum().astype(jnp.int32))
+    return FrontierExactState(
+        infected, tx, next_send, ring, msgs, jnp.zeros((), jnp.int32)
+    )
+
+
+def _ring0_tier_hit(cfg: HeadlineExactConfig, rows_idx, cand,
+                    writer: int = 0):
+    """Arithmetic replacement for the origin's seeded tier bits:
+    ``cand`` targets that ``packed_exact_init`` marked in the writer's
+    ``sent_to`` row.  rows_idx: [..., rows]; cand: [..., rows, K]."""
+    if cfg.ring0_size <= 1:
+        return jnp.zeros(cand.shape, bool)
+    block = min(cfg.ring0_size, cfg.n_nodes)
+    in_tier = (cand // block == writer // block) & (cand != writer)
+    return (rows_idx[..., None] == writer) & in_tier
+
+
+def _frontier_invalid(cfg: HeadlineExactConfig, ring, rows_idx, cand,
+                      writer: int = 0):
+    """[..., rows] bool: rows whose k-tuple has a self/sent/duplicate
+    hit — the sent test is a cap-wide compare against the row's OWN
+    ring plus the origin's arithmetic tier (``packed_exact_tick``'s
+    ``invalid_rows`` over the sparse representation).
+    ring: [..., rows, cap]; rows_idx: [rows]; cand: [..., rows, K]."""
+    k = cfg.fanout
+    self_hit = cand == rows_idx[..., None]
+    ring_hit = jnp.any(
+        ring[..., None, :] == cand[..., None], axis=-1
+    )
+    tier_hit = _ring0_tier_hit(cfg, rows_idx, cand, writer)
+    dup = jnp.zeros(cand.shape[:-1], bool)
+    for a in range(k):
+        for b in range(a + 1, k):
+            dup |= cand[..., a] == cand[..., b]
+    return jnp.any(self_hit | ring_hit | tier_hit, axis=-1) | dup
+
+
+@partial(jax.jit, static_argnames=("cfg", "writer"))
+def frontier_exact_tick(
+    state: FrontierExactState, key, cfg: HeadlineExactConfig,
+    writer: int = 0,
+) -> FrontierExactState:
+    """One exact-sampler tick over the frontier-sparse representation.
+    Consumes the RNG stream in exactly ``packed_exact_tick``'s order;
+    ``writer`` must match the init's (the arithmetic ring0 tier)."""
+    n, k = cfg.n_nodes, cfg.fanout
+    cap = state.ring.shape[-1]
+    infected, tx, next_send, ring, msgs, tick = state
+    idx = jnp.arange(n, dtype=jnp.int32)
+    active = infected & (tx > 0) & (next_send <= tick)
+    part = _partition_of(cfg)
+    part_active = tick < cfg.heal_tick
+
+    k_draw, k_loss, k_sync = jax.random.split(key, 3)
+
+    def do_broadcast(args):
+        infected, tx, next_send, ring, msgs = args
+
+        def invalid_rows(cand):
+            return _frontier_invalid(cfg, ring, idx, cand, writer)
+
+        cand = jax.random.randint(
+            jax.random.fold_in(k_draw, 0), (n, k), 0, n
+        )
+        bad = invalid_rows(cand) & active
+
+        def cond(carry):
+            _, bad, _ = carry
+            return jnp.any(bad)
+
+        def body(carry):
+            cand, bad, r = carry
+            fresh = jax.random.randint(
+                jax.random.fold_in(k_draw, r), (n, k), 0, n
+            )
+            cand = jnp.where(bad[:, None], fresh, cand)
+            return cand, invalid_rows(cand) & bad, r + 1
+
+        cand, _, _ = jax.lax.while_loop(
+            cond, body, (cand, bad, jnp.int32(1))
+        )
+
+        delivered = jnp.broadcast_to(active[:, None], (n, k))
+        if cfg.loss > 0.0:
+            delivered &= jax.random.uniform(k_loss, (n, k)) >= cfg.loss
+        if part is not None:
+            delivered &= ~((part[:, None] != part[cand]) & part_active)
+        delivered = _wan_filter(delivered, cand, k_loss, cfg)
+
+        new_infected = infected.at[
+            jnp.where(delivered, cand, n).reshape(-1)
+        ].set(True, mode="drop")
+
+        # mark on send: the nth active tick appends its k fresh targets
+        # at slots [n*k, n*k+k) — tx decrements once per active tick and
+        # a node learns at most once, so slots never collide/overflow
+        send_base = (cfg.max_transmissions - tx) * k
+        slot = send_base[:, None] + jnp.arange(k, dtype=jnp.int32)
+        slot = jnp.where(active[:, None], slot, cap)
+        new_ring = ring.at[idx[:, None], slot].set(cand, mode="drop")
+        msgs = msgs + jnp.where(active, k, 0)
+
+        tx = jnp.where(active, tx - 1, tx)
+        learned = new_infected & ~infected
+        next_send = _backoff_next_send(
+            active, learned, tx, next_send, tick, cfg
+        )
+        tx = jnp.where(learned, cfg.max_transmissions, tx)
+        return new_infected, tx, next_send, new_ring, msgs
+
+    # empty frontier => the whole draw/test/mark phase is a no-op in
+    # the bitpacked kernel too (no draws are ever consumed: per-tick
+    # keys are re-derived, not carried) — skip it
+    infected, tx, next_send, ring, msgs = jax.lax.cond(
+        jnp.any(active), do_broadcast, lambda args: args,
+        (infected, tx, next_send, ring, msgs),
+    )
+
+    if cfg.sync_interval > 0:
+        def do_sync(args):
+            infected, msgs = args
+            p = cfg.sync_peers
+            peers = jax.random.randint(k_sync, (n, p), 0, n)
+            reachable = jnp.ones((n, p), bool)
+            if part is not None:
+                reachable &= ~((part[:, None] != part[peers]) & part_active)
+            healed, pay = _sync_pull(infected, peers, reachable, cfg)
+            return infected | healed, msgs + pay
+
+        infected, msgs = jax.lax.cond(
+            tick % cfg.sync_interval == cfg.sync_interval - 1,
+            do_sync,
+            lambda args: args,
+            (infected, msgs),
+        )
+
+    return FrontierExactState(
+        infected, tx, next_send, ring, msgs, tick + 1
+    )
+
+
+def frontier_sent_bitmap(state: FrontierExactState,
+                         cfg: HeadlineExactConfig,
+                         writer: int = 0) -> np.ndarray:
+    """Decode the ring (+ the arithmetic ring0 tier) back to the dense
+    [N, ceil(N/8)] bitmap — the parity operand the bit-match suite
+    compares against ``packed_exact_tick``'s ``sent``."""
+    n = cfg.n_nodes
+    nb = -(-n // 8)
+    bitmap = np.zeros((n, nb), np.uint8)
+    ring = np.asarray(state.ring)
+    cap = ring.shape[1]
+    rows = np.repeat(np.arange(n), cap)
+    tgt = ring.reshape(-1)
+    live = tgt < n
+    np.bitwise_or.at(
+        bitmap, (rows[live], tgt[live] // 8),
+        (np.uint8(1) << (tgt[live] % 8).astype(np.uint8)),
+    )
+    if cfg.ring0_size > 1:
+        idx = np.arange(n)
+        block = min(cfg.ring0_size, n)
+        in_tier = (idx // block == writer // block) & (idx != writer)
+        t = idx[in_tier]
+        np.bitwise_or.at(
+            bitmap, (np.full(t.shape, writer), t // 8),
+            (np.uint8(1) << (t % 8).astype(np.uint8)),
+        )
+    return bitmap
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def _frontier_scan_chunk_batch(state: FrontierExactState, seed_keys,
+                               cfg: HeadlineExactConfig):
+    """Seed-batched frontier chunk — the sparse twin of
+    ``_packed_scan_chunk_batch`` (leading [S] axis, donated state,
+    [C, S] stats)."""
+
+    def body(st, _):
+        keys_t = jax.vmap(jax.random.fold_in)(seed_keys, st.tick)
+        nxt = jax.vmap(
+            lambda s, kk: frontier_exact_tick(s, kk, cfg)
+        )(st, keys_t)
+        msgs_f = nxt.msgs.astype(jnp.float32)
+        return nxt, (
+            jnp.all(nxt.infected, axis=1),
+            jnp.mean(msgs_f, axis=1),
+            jnp.percentile(msgs_f, 99, axis=1),
+        )
+
+    return jax.lax.scan(body, state, xs=None, length=cfg.chunk_ticks)
+
+
+def _frontier_state_specs():
+    """PartitionSpecs for a seed-batched FrontierExactState on a
+    ``nodes`` mesh: the ring (the only O(N * cap) leaf) row-shards;
+    every [S, N] dense leaf is REPLICATED — each shard runs the full
+    cheap bookkeeping itself, so no active/infected mask ever crosses
+    the fabric (the delta-exchange layout; see models/sharded.py)."""
+    from jax.sharding import PartitionSpec as P
+
+    return FrontierExactState(
+        infected=P(),
+        tx=P(),
+        next_send=P(),
+        ring=P(None, "nodes", None),
+        msgs=P(),
+        tick=P(),
+    )
+
+
+def frontier_shardings(mesh) -> FrontierExactState:
+    """NamedShardings for a seed-batched FrontierExactState (one
+    source of truth with the shard_map specs, like
+    ``exact_shardings``)."""
+    from jax.sharding import NamedSharding
+
+    return jax.tree.map(
+        lambda p: NamedSharding(mesh, p), _frontier_state_specs()
+    )
+
+
+def frontier_seed_batch(cfg: HeadlineExactConfig, n_seeds: int,
+                        n_shards: int = 1,
+                        hbm_budget_bytes: Optional[int] = None) -> int:
+    """Seed-batching policy for the frontier kernel: the ring is the
+    governing state at O(N * cap * 4) bytes per seed (vs the dense
+    kernel's O(N^2/8) bitmap), so far more seeds fit the same budget.
+    Only the ring shards; the [S, N] dense leaves (~16 B/node) are
+    REPLICATED on every device (``_frontier_state_specs``), so their
+    term never divides by the shard count."""
+    cap = frontier_ring_cap(cfg)
+    per_seed = (
+        (cfg.n_nodes // max(1, n_shards)) * cap * 4 + cfg.n_nodes * 16
+    )
+    budget = (DEFAULT_EXACT_HBM_BUDGET if hbm_budget_bytes is None
+              else hbm_budget_bytes)
+    fit = max(1, int(budget // max(1, 2 * per_seed)))
+    return max(1, min(n_seeds, fit, 32))
+
+
 def run_exact_headline(
     cfg: HeadlineExactConfig, n_seeds: int = 4, seed: int = 0,
     mesh=None, seed_batch: Optional[int] = None,
     warm_chunks: Optional[int] = None,
     hbm_budget_bytes: Optional[int] = None,
+    kernel: str = "dense",
 ) -> Dict:
     """Seed-parallel exact-sampler epidemics at headline scale.
 
@@ -826,19 +1236,40 @@ def run_exact_headline(
     ``warm_chunks`` stops after that many scan chunks (compile warming
     without paying a full run).
 
+    ``kernel`` selects the representation: ``"dense"`` (the bitpacked
+    [N, N/8] ``sent_to`` kernel) or ``"sparse"`` (the frontier kernel:
+    capped recent-target rings, O(N * budget * fanout) state — the only
+    representation that reaches N=1M).  Per-seed trajectories are
+    bitwise identical across kernels AND across sharding, so the choice
+    never moves the published numbers (pinned by tests/test_frontier.py
+    and tests/test_sharding.py); the result records which one ran under
+    ``"kernel"`` (``sharded-`` prefixed when a mesh was used).
+
     Returns the same stat keys as ``run_epidemic_seeds`` (msgs/ticks at
     each seed's own convergence tick) with ``delivery_model: exact``.
     """
     from corrosion_tpu.sim.epidemic import stats_at_convergence
 
+    if kernel not in ("dense", "sparse"):
+        raise ValueError(f"unknown kernel {kernel!r}")
+    sparse = kernel == "sparse"
     t0 = time.perf_counter()
     n_shards = int(mesh.shape["nodes"]) if mesh is not None else 1
-    sb = seed_batch or exact_seed_batch(
+    batch_policy = frontier_seed_batch if sparse else exact_seed_batch
+    sb = seed_batch or batch_policy(
         cfg, n_seeds, n_shards, hbm_budget_bytes
     )
-    chunk_fn = (
-        make_sharded_exact_chunk(mesh, cfg) if mesh is not None else None
-    )
+    init_fn = frontier_exact_init if sparse else packed_exact_init
+    chunk_fn = None
+    if mesh is not None:
+        if sparse:
+            from corrosion_tpu.models.sharded import (
+                make_sharded_frontier_chunk,
+            )
+
+            chunk_fn = make_sharded_frontier_chunk(mesh, cfg)
+        else:
+            chunk_fn = make_sharded_exact_chunk(mesh, cfg)
     firsts: List[float] = []
     means: List[float] = []
     p99s: List[float] = []
@@ -858,24 +1289,30 @@ def run_exact_headline(
             for s in range(lo, lo + S)
         ])
         state = jax.vmap(
-            lambda kk: packed_exact_init(
-                cfg, jax.random.fold_in(kk, 2**20)
-            )
+            lambda kk: init_fn(cfg, jax.random.fold_in(kk, 2**20))
         )(base_keys)
         if mesh is not None:
-            state = jax.device_put(state, exact_shardings(mesh))
+            state = jax.device_put(
+                state,
+                frontier_shardings(mesh) if sparse
+                else exact_shardings(mesh),
+            )
         flags: List[np.ndarray] = []
         mm: List[np.ndarray] = []
         mp: List[np.ndarray] = []
         ticks_done = 0
         chunks = 0
         while ticks_done < cfg.max_ticks:
-            if mesh is None:
-                state, (conv, m_mean, m_p99) = _packed_scan_chunk_batch(
+            if mesh is not None:
+                state, (conv, m_mean, m_p99) = chunk_fn(state, base_keys)
+            elif sparse:
+                state, (conv, m_mean, m_p99) = _frontier_scan_chunk_batch(
                     state, base_keys, cfg
                 )
             else:
-                state, (conv, m_mean, m_p99) = chunk_fn(state, base_keys)
+                state, (conv, m_mean, m_p99) = _packed_scan_chunk_batch(
+                    state, base_keys, cfg
+                )
             flags.append(np.asarray(conv).T)  # scan stacks [C, S]
             mm.append(np.asarray(m_mean).T)
             mp.append(np.asarray(m_p99).T)
@@ -898,6 +1335,7 @@ def run_exact_headline(
         "n_nodes": cfg.n_nodes,
         "n_seeds": n_seeds,
         "delivery_model": "exact",
+        "kernel": ("sharded-" if mesh is not None else "") + kernel,
         "converged_frac": converged / n_seeds,
         "ticks_p50": float(np.percentile(firsts, 50)),
         "ticks_p99": float(np.percentile(firsts, 99)),

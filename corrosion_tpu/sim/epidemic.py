@@ -83,6 +83,29 @@ class EpidemicConfig:
     # side by side in one flat index space; None = single universe
     n_universes: Optional[int] = None
 
+    # scenario families beyond uniform fanout (models/broadcast.py and
+    # the exact kernels' HeadlineExactConfig carry the same fields):
+    # - ``het_ring``: node i sits on RTT tier 1 + i*rtt_tiers//n of a
+    #   ring by id; its retransmit cadence (and first post-learn
+    #   forward) scales with the tier — the convergence tail is driven
+    #   by the slow arc of the ring;
+    # - ``wan_two_region``: node i lives in region i*wan_blocks//n;
+    #   gossip crossing regions suffers an EXTRA i.i.d. drop of
+    #   ``wan_cross_loss`` on top of ``loss``, while anti-entropy
+    #   sessions cross unharmed (QUIC streams with retries).
+    topology: str = "uniform"
+    rtt_tiers: int = 4
+    wan_blocks: int = 2
+    wan_cross_loss: float = 0.25
+
+    def __post_init__(self):
+        if self.topology not in ("uniform", "het_ring", "wan_two_region"):
+            raise ValueError(f"unknown topology {self.topology!r}")
+        if self.topology == "het_ring" and self.rtt_tiers < 1:
+            raise ValueError("het_ring needs rtt_tiers >= 1")
+        if self.topology == "wan_two_region" and self.wan_blocks < 2:
+            raise ValueError("wan_two_region needs wan_blocks >= 2")
+
     @property
     def flat_nodes(self) -> int:
         return self.n_nodes * (self.n_universes or 1)
@@ -103,6 +126,10 @@ class EpidemicConfig:
             backoff_ticks=self.backoff_ticks,
             universe=self._universe,
             oneway_blocks=self.oneway_blocks,
+            topology=self.topology,
+            rtt_tiers=self.rtt_tiers,
+            wan_blocks=self.wan_blocks,
+            wan_cross_loss=self.wan_cross_loss,
         )
 
     @property

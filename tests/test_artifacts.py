@@ -185,6 +185,70 @@ def test_perf_bench_artifact_schemas(name, value_floor):
         assert _gate_passed(doc["overhead_gate"])
 
 
+def test_frontier_bench_artifact_schema():
+    """The frontier-sparse BENCH headline (bench.py --frontier): the
+    exact sampler's p99 convergence + msgs/node swept through N=1M,
+    every point tagged with the kernel the bitmap-budget dispatch
+    selected, the dense/sparse exactness gate green, the 100k perf
+    gate green (the sparse kernel must not cost the existing scale
+    anything), and one sweep point per scenario topology beyond
+    uniform fanout."""
+    KERNELS = {"dense", "sharded-dense", "sparse", "sharded-sparse"}
+    doc = _load("BENCH_FRONTIER.json")
+    _check(doc, {
+        "metric": lambda v: v == "epidemic_exact_frontier_sweep_vs_n",
+        "value": NUM,
+        "unit": lambda v: v == "ticks",
+        "conditions": str,
+        "kernel_budget": {
+            "bitmap_budget_bytes": lambda v: isinstance(v, int) and v > 0,
+            "source": str,
+            "devices": int,
+            "backend": str,
+        },
+        "points": lambda v: isinstance(v, list) and len(v) >= 3,
+        "headline": {
+            "n": lambda v: v == 1_000_000,
+            "ticks_p99": NUM,
+            "msgs_per_node_mean": NUM,
+            "msgs_per_node_p99": NUM,
+            "converged_frac": lambda v: v == 1.0,
+            # the million-node point can only come from the sparse
+            # representation (the dense bitmap is ~125 GB there)
+            "kernel": lambda v: v in ("sparse", "sharded-sparse"),
+        },
+        "exactness_gate": {"pass": lambda v: v is True},
+        "perf_gate_100k": {
+            "dense_wall_s": NUM,
+            "sparse_wall_s": NUM,
+            "sparse_over_dense": lambda v: v <= 1.0,
+            "stats_equal": lambda v: v is True,
+            "pass": lambda v: v is True,
+        },
+        "topologies": dict,
+    })
+    assert "error" not in doc
+    # headline floors: the committed 1M point converged with the
+    # protocol's own message bound (budget*fanout broadcast + sync
+    # session accounting), in sane epidemic depth
+    hl = doc["headline"]
+    assert hl["msgs_per_node_mean"] < 64
+    assert 8 <= hl["ticks_p99"] <= 64
+    # every successful point carries a recognized kernel tag, and the
+    # sweep actually exercised more than one representation
+    tags = {p["kernel"] for p in doc["points"] if "error" not in p}
+    assert tags <= KERNELS and len(tags) >= 2, tags
+    # one committed sweep point per scenario topology, converged
+    for topo in ("het_ring", "wan_two_region"):
+        cell = doc["topologies"][topo]
+        assert "error" not in cell, cell
+        assert cell["converged_frac"] == 1.0
+        assert cell["kernel"] in KERNELS
+    # the wan family converges THROUGH sync; het_ring's slow arc may
+    # not beat uniform's depth, but both stay within protocol bounds
+    assert doc["topologies"]["het_ring"]["msgs_per_node_mean"] < 64
+
+
 def test_virtual_scenarios_n512_artifact_schema():
     """The virtual-time campaign artifact (bench.py --scenarios
     --virtual-time --n 512): the full matrix PLUS the scale-only cells

@@ -127,6 +127,54 @@ def test_oneway_partition_severs_exactly_the_listed_direction():
         assert cov["converged_frac"] == 1.0
 
 
+def test_het_ring_topology_slows_perm_kernel_tail():
+    """The heterogeneous-RTT ring in the perm-fanout kernel: matched
+    configs, convergence strictly later than uniform (the slow arc's
+    scaled retransmit cadence drives the tail)."""
+    base = dict(
+        n_nodes=1024, n_rows=4, fanout_ring0=1, fanout_global=2,
+        ring0_size=64, max_transmissions=8, loss=0.05, sync_interval=8,
+        max_ticks=96, chunk_ticks=8, track_hops=False,
+    )
+    uni = run_epidemic_seeds(EpidemicConfig(**base), n_seeds=4, seed=0)
+    het = run_epidemic_seeds(
+        EpidemicConfig(**base, topology="het_ring", rtt_tiers=6),
+        n_seeds=4, seed=0,
+    )
+    assert uni["converged_frac"] == het["converged_frac"] == 1.0
+    assert het["ticks_p50"] > uni["ticks_p50"]
+
+
+def test_wan_topology_gossip_isolation_and_sync_heal():
+    """wan_two_region in the perm-fanout kernel: at full cross-region
+    loss gossip alone never crosses; anti-entropy (QUIC streams with
+    retries — models/sync.py keeps sessions lossless) heals across,
+    so the same config with sync on converges."""
+    import jax
+
+    from corrosion_tpu.sim.epidemic import epidemic_init, epidemic_tick
+
+    base = dict(
+        n_nodes=512, n_rows=4, fanout_ring0=1, fanout_global=2,
+        ring0_size=64, max_transmissions=8, loss=0.0,
+        max_ticks=64, chunk_ticks=8, track_hops=False,
+        topology="wan_two_region", wan_cross_loss=1.0,
+    )
+    iso = EpidemicConfig(**base, sync_interval=0)
+    st = epidemic_init(iso)
+    target = np.asarray(st.rows[0])
+    key = jax.random.PRNGKey(3)
+    for t in range(16):
+        st = epidemic_tick(st, jax.random.fold_in(key, t), iso)
+    holds = (np.asarray(st.rows) == target[None, :]).all(axis=1)
+    assert holds[:256].sum() > 16
+    assert holds[256:].sum() == 0
+    healed = run_epidemic_seeds(
+        EpidemicConfig(**base, sync_interval=4), n_seeds=2, seed=0,
+    )
+    assert healed["converged_frac"] == 1.0
+
+
 def test_oneway_sync_needs_both_directions():
     """Anti-entropy sessions ride a bi-stream: ANY severed direction
     between the pair kills the session (the live open_bi semantics).

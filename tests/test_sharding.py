@@ -384,6 +384,137 @@ def test_sharded_exact_negative_control():
     assert diverged, "different keys produced identical trajectories"
 
 
+@pytest.mark.parametrize("topology", ["het_ring", "wan_two_region"])
+def test_sharded_dense_exact_topologies_match_packed(topology):
+    """The scenario topologies hold across the DENSE mesh kernel too:
+    _sharded_tick_local implements the same wan cross-drop and
+    RTT-tier backoff as the single-chip oracle (regression: the
+    sharded-dense path originally missed both, silently running
+    uniform while every other kernel ran the family)."""
+    from corrosion_tpu.sim.calibrate import (
+        HeadlineExactConfig,
+        exact_shardings,
+        packed_exact_init,
+        packed_exact_tick,
+        sharded_packed_exact_step,
+    )
+
+    cfg = HeadlineExactConfig(
+        n_nodes=4096, fanout=4, ring0_size=256, max_transmissions=8,
+        loss=0.05, sync_interval=2, backoff_ticks=0.5,
+        max_ticks=32, chunk_ticks=8, topology=topology,
+    )
+    mesh = Mesh(np.array(jax.devices()[:8]), ("nodes",))
+    kk = jax.random.PRNGKey(21)
+    ref = packed_exact_init(cfg, jax.random.fold_in(kk, 2**20))
+    batched = jax.vmap(
+        lambda k: packed_exact_init(cfg, jax.random.fold_in(k, 2**20))
+    )(jnp.stack([kk]))
+    batched = jax.device_put(batched, exact_shardings(mesh))
+    step = sharded_packed_exact_step(mesh, cfg)
+    for t in range(4):
+        ref = packed_exact_tick(ref, jax.random.fold_in(kk, t), cfg)
+        batched = step(batched, jnp.stack([jax.random.fold_in(kk, t)]))
+        for field in ("infected", "msgs", "tx", "next_send"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(batched, field)[0]),
+                np.asarray(getattr(ref, field)),
+                err_msg=f"{field} diverged at tick {t} ({topology})",
+            )
+    assert bool(np.asarray(batched.infected).any())
+
+
+def test_sharded_frontier_matches_single_chip_bitwise():
+    """The mesh-native FRONTIER kernel (rings row-sharded, dense
+    bookkeeping replicated per shard, only the per-round validity
+    delta crossing the fabric) is BITWISE the single-chip
+    ``frontier_exact_tick`` per tick — infected, msgs, tx, next_send
+    AND the ring rows — at N=4096 on the 8-device mesh, full headline
+    shape.  Through tests/test_frontier.py's oracle chain this pins
+    sharded-sparse == sparse == packed_exact_tick."""
+    from corrosion_tpu.models.sharded import sharded_frontier_exact_step
+    from corrosion_tpu.sim.calibrate import (
+        HeadlineExactConfig,
+        frontier_exact_init,
+        frontier_exact_tick,
+        frontier_shardings,
+    )
+
+    cfg = HeadlineExactConfig(
+        n_nodes=4096, fanout=4, ring0_size=256, max_transmissions=8,
+        loss=0.05, partition_blocks=2, heal_tick=3, sync_interval=2,
+        max_ticks=32, chunk_ticks=8,
+    )
+    mesh = Mesh(np.array(jax.devices()[:8]), ("nodes",))
+    n_seeds = 2
+    base = [jax.random.PRNGKey(11 + s) for s in range(n_seeds)]
+
+    refs = [
+        frontier_exact_init(cfg, jax.random.fold_in(kk, 2**20))
+        for kk in base
+    ]
+    batched = jax.vmap(
+        lambda kk: frontier_exact_init(cfg, jax.random.fold_in(kk, 2**20))
+    )(jnp.stack(base))
+    batched = jax.device_put(batched, frontier_shardings(mesh))
+    step = sharded_frontier_exact_step(mesh, cfg)
+
+    for t in range(5):
+        keys_t = jnp.stack([jax.random.fold_in(kk, t) for kk in base])
+        refs = [
+            frontier_exact_tick(r, jax.random.fold_in(kk, t), cfg)
+            for r, kk in zip(refs, base)
+        ]
+        batched = step(batched, keys_t)
+        for s in range(n_seeds):
+            for field in ("infected", "msgs", "ring", "tx", "next_send"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(batched, field)[s]),
+                    np.asarray(getattr(refs[s], field)),
+                    err_msg=f"{field} diverged at tick {t}, seed {s}",
+                )
+    assert 0.0 < float(np.asarray(batched.infected).mean()) < 1.0
+
+
+def test_sharded_frontier_negative_control():
+    """Discriminating power: the sharded frontier kernel driven by
+    different per-seed keys diverges from the single-chip reference
+    within a few ticks."""
+    from corrosion_tpu.models.sharded import sharded_frontier_exact_step
+    from corrosion_tpu.sim.calibrate import (
+        HeadlineExactConfig,
+        frontier_exact_init,
+        frontier_exact_tick,
+        frontier_shardings,
+    )
+
+    cfg = HeadlineExactConfig(
+        n_nodes=4096, fanout=4, ring0_size=0, max_transmissions=8,
+        max_ticks=32, chunk_ticks=8,
+    )
+    mesh = Mesh(np.array(jax.devices()[:8]), ("nodes",))
+    good = jax.random.PRNGKey(11)
+    evil = jax.random.PRNGKey(999)
+
+    ref = frontier_exact_init(cfg, jax.random.fold_in(good, 2**20))
+    batched = jax.vmap(
+        lambda kk: frontier_exact_init(cfg, jax.random.fold_in(kk, 2**20))
+    )(jnp.stack([good]))
+    batched = jax.device_put(batched, frontier_shardings(mesh))
+    step = sharded_frontier_exact_step(mesh, cfg)
+
+    diverged = False
+    for t in range(3):
+        ref = frontier_exact_tick(ref, jax.random.fold_in(good, t), cfg)
+        batched = step(batched, jnp.stack([jax.random.fold_in(evil, t)]))
+        if not np.array_equal(
+            np.asarray(batched.infected[0]), np.asarray(ref.infected)
+        ):
+            diverged = True
+            break
+    assert diverged, "different keys produced identical trajectories"
+
+
 def test_ring_fabric_small_cap_reports_overflow():
     """With a deliberately starved slot cap the fabric must not
     corrupt state silently: the overflow count reports the dropped
