@@ -216,6 +216,151 @@ def _apply_overhead_ab(n_changes: int, reps: int = 5,
     return gate
 
 
+_SIG_AB_SITE = b"\x52" * 16
+
+
+def _sig_build_payloads(n_changes: int):
+    """The A/B's shared corpus: complete single-version broadcast
+    changesets from one remote actor, pre-encoded BOTH ways — the
+    pre-signing traced (v1) envelope and the signed (v2) envelope.
+    Built once per A/B run: signing 2.5k payloads costs seconds of
+    big-int crypto, and doing it inside each rep would wrap every
+    timed window in a different thermal/scheduler state."""
+    from corrosion_tpu.agent.pack import pack_values
+    from corrosion_tpu.agent.runtime import sig_message
+    from corrosion_tpu.bridge import speedy
+    from corrosion_tpu.types import ActorId, ChangeV1, Changeset
+    from corrosion_tpu.types.actor import ClusterId
+    from corrosion_tpu.types.base import CrsqlDbVersion, CrsqlSeq, Version
+    from corrosion_tpu.types.change import Change
+    from corrosion_tpu.types.crypto import seed_keypair, sign
+    from corrosion_tpu.types.hlc import HLClock
+    from corrosion_tpu.types.payload import BroadcastV1, UniPayload
+
+    site = _SIG_AB_SITE
+    secret, pub = seed_keypair(b"sig-ab-origin")
+    clock = HLClock()
+    v1s, v2s = [], []
+    total = 0
+    v = 0
+    while total < n_changes:
+        v += 1
+        pk = pack_values([v])
+        changes = []
+        for seq, cid in enumerate(("a", "b", "c", "d")):
+            changes.append(Change(
+                table="bench", pk=pk, cid=cid, val=f"v-{v}-{cid}",
+                col_version=1, db_version=CrsqlDbVersion(v),
+                seq=CrsqlSeq(seq), site_id=site, cl=1,
+            ))
+            total += 1
+            if total >= n_changes:
+                break
+        last = CrsqlSeq(len(changes) - 1)
+        cs = Changeset.full(
+            Version(v), changes, (CrsqlSeq(0), last), last,
+            clock.new_timestamp(),
+        )
+        cv = ChangeV1(actor_id=ActorId(site), changeset=cs)
+        classic = speedy.encode_uni_payload(UniPayload(
+            broadcast=BroadcastV1(change=cv),
+            cluster_id=ClusterId(0),
+        ))
+        sig = sign(secret, sig_message(site, cs))
+        v1s.append(speedy.encode_traced_uni(classic, None, 0))
+        v2s.append(speedy.encode_signed_uni(classic, None, 0, sig))
+    return v1s, v2s, pub
+
+
+def _sig_ingest_run(d: str, payloads, n_changes: int, tag: str,
+                    signed_on: bool, pub: bytes) -> float:
+    """One timed arm: raw payloads through ``Agent._apply_batch`` —
+    the layer where the envelope decode, the digest+signature
+    bookkeeping and the bounded spot check actually run.  ``signed_on``
+    = signed (v2) envelopes + a populated trust directory + spot
+    checks at the campaign posture; off = the pre-signing traced
+    envelope with no keys (the default wire)."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+
+    adir = os.path.join(d, f"sig{tag}")
+    os.makedirs(adir, exist_ok=True)
+    overrides = {}
+    if signed_on:
+        overrides = dict(
+            sig_pubkeys={_SIG_AB_SITE: pub},
+            sig_spot_check_rate=0.05,  # the campaign posture
+        )
+    agent = make_offline_agent(
+        tmpdir=adir, schema=_APPLY_AB_SCHEMA, **overrides
+    )
+    try:
+        peer = ("bench-peer", 1)
+        t0 = time.perf_counter()
+        for i in range(0, len(payloads), 64):
+            agent._apply_batch([
+                ((p, peer), None) for p in payloads[i:i + 64]
+            ])
+        wall = time.perf_counter() - t0
+        # the A/B is only honest if the on-arm actually carried live
+        # signatures through the verdict machinery
+        if signed_on:
+            assert agent._equiv_sigs, "signed arm recorded no sigs"
+        return n_changes / max(wall, 1e-9)
+    finally:
+        agent.storage.close()
+
+
+def _sig_overhead_ab(n_changes: int, reps: int = 7,
+                     max_regression: float = 0.05) -> dict:
+    """Paired in-run A/B of signed attribution's ingest cost, same
+    pairing/median discipline as ``_apply_overhead_ab``: signing off
+    (the pre-PR wire + no keys) vs on (signed envelopes, signature
+    bookkeeping, spot checks at campaign posture) in temporally-
+    adjacent pairs, gated on the median per-pair ratio ≥ 0.95."""
+    import statistics
+    import tempfile
+
+    v1s, v2s, pub = _sig_build_payloads(n_changes)
+    pairs = []
+    with tempfile.TemporaryDirectory(prefix="corro-sig-ab-") as d:
+        # one unrecorded warmup per arm: first-run costs (module
+        # imports, allocator warmup) must not skew a recorded pair
+        _sig_ingest_run(d, v1s[:256], 1024, "-warm-off", False, pub)
+        _sig_ingest_run(d, v2s[:256], 1024, "-warm-on", True, pub)
+        for rep in range(reps):
+            arms = (("off", False), ("on", True))
+            if rep % 2:
+                arms = arms[::-1]
+            cps = {}
+            for arm, on in arms:
+                cps[arm] = _sig_ingest_run(
+                    d, v2s if on else v1s, n_changes,
+                    f"-{arm}{rep}", on, pub,
+                )
+            pairs.append({
+                "off_changes_per_s": round(cps["off"], 1),
+                "on_changes_per_s": round(cps["on"], 1),
+                "ratio": round(cps["on"] / max(cps["off"], 1e-9), 4),
+            })
+    ratio = statistics.median(p["ratio"] for p in pairs)
+    return {
+        "method": (
+            f"paired in-run A/B, {reps} adjacent off/on pairs of "
+            "agent-level RAW-payload ingest (_apply_batch, BROADCAST "
+            "source) at the headline change count (arm order "
+            "alternating), median per-pair ratio; on = signed v2 "
+            "envelopes + trust directory + digest/signature "
+            "bookkeeping + spot checks (rate 0.05, interval-bounded), "
+            "off = the pre-signing traced envelope with no keys"
+        ),
+        "n_changes": n_changes,
+        "pairs": pairs,
+        "ratio": round(ratio, 4),
+        "max_regression": max_regression,
+        "pass": bool(ratio >= 1.0 - max_regression),
+    }
+
+
 def run_apply_bench(sizes=(1000, 10000), out_path="APPLY_BENCH.json"):
     """Per-change vs batched CRDT apply throughput (changes/s), cold
     (fresh rows) and warm (existing rows, superseding col_versions).
@@ -335,12 +480,26 @@ def run_apply_bench(sizes=(1000, 10000), out_path="APPLY_BENCH.json"):
                 "observability overhead gate failed: plane-on ingest "
                 "throughput regressed > 5% vs plane-off in paired A/B",
             )
+        # signed-attribution overhead gate (docs/faults.md): the same
+        # paired-A/B discipline applied to the signing knob at the
+        # APPLY ingest layer
+        out["sig_overhead_gate"] = _sig_overhead_ab(
+            headline["n_changes"]
+        )
+        if out["sig_overhead_gate"]["pass"] is False:
+            out.setdefault(
+                "error",
+                "signed-attribution overhead gate failed: signing-on "
+                "ingest throughput regressed > 5% vs signing-off in "
+                "paired A/B",
+            )
     else:
         out["overhead_gate"] = {
             "pass": None,
             "skipped": "smoke scale (n_changes < 5000): plane cost "
                        "below noise floor; gated at the 10k headline",
         }
+        out["sig_overhead_gate"] = dict(out["overhead_gate"])
     if out_path:
         with open(out_path, "w") as f:
             json.dump(_sanitize(out), f, indent=2)

@@ -208,13 +208,13 @@ class DetCluster:
         the previous delivery phase) become due entries this tick."""
         for i, a in enumerate(self.agents):
             while not a._bcast_queue.empty():
-                cv, remaining, hop, tp = a._bcast_queue.get_nowait()
+                cv, remaining, hop, tp, sig = a._bcast_queue.get_nowait()
                 key = a._seen_key(cv)
                 if key in self._entries[i]:
                     continue
                 self._entries[i][key] = _Entry(
                     cv=cv,
-                    frame=a.encode_broadcast_frame(cv, hop, tp),
+                    frame=a.encode_broadcast_frame(cv, hop, tp, sig),
                     remaining=remaining,
                     next_due=self.tick_no,
                 )
@@ -270,9 +270,9 @@ class DetCluster:
             for payload in speedy.FrameReader().feed(frame):
                 decoded = a.decode_uni_frame_meta(payload)
                 if decoded is not None:
-                    cv, tp, hop = decoded
+                    cv, tp, hop, sig = decoded
                     a.handle_change(cv, ChangeSource.BROADCAST,
-                                    meta=(tp, hop))
+                                    meta=(tp, hop, sig, None))
         # anti-entropy phase on the kernel's cadence
         # (sim/epidemic.py: tick % sync_interval == sync_interval - 1),
         # after deliveries so sync sees this tick's learned state

@@ -19,6 +19,31 @@ from typing import Dict, List, Optional, Tuple
 RING0_MAX_RTT_MS = 6.0
 RTT_SAMPLES = 20
 
+# quarantine evidence ranking (docs/faults.md): transport-class
+# evidence ("breaker", "sig_failure") is recoverable and equal-rank;
+# an unsigned equivocation verdict outranks it; a PROVEN signed
+# equivocation outranks everything and is never relabeled or cleared
+# by weaker evidence
+_REASON_RANK = {
+    "": 0,
+    "breaker": 1,
+    "sig_failure": 1,
+    "equivocation": 2,
+    "signed_equivocation": 3,
+}
+# reasons that survive an address move (evidence about the ACTOR)
+_ACTOR_REASONS = ("equivocation", "signed_equivocation")
+# transport-class restores clear each other (a half-open success is
+# evidence about the same channel either way); verdict-class reasons
+# only clear on their own exact restore call
+_TRANSPORT_REASONS = ("breaker", "sig_failure")
+
+
+def _restores(current: str, reason: str) -> bool:
+    if current == reason:
+        return True
+    return current in _TRANSPORT_REASONS and reason in _TRANSPORT_REASONS
+
 
 class MemberState(enum.Enum):
     ALIVE = "alive"
@@ -41,11 +66,15 @@ class Member:
     last_seen: float = field(default_factory=time.monotonic)
     # quarantine: a peer is deprioritized in fanout sampling the way
     # high-RTT peers are.  `quarantine_reason` records the evidence
-    # class — "breaker" (transport-level: persistent send failures,
-    # restored on half-open success) or "equivocation" (protocol-level:
-    # conflicting changesets for one (actor, version); never restored
-    # by transport success — cleared only by the runtime's bounded
-    # verdict expiry or an identity renewal)
+    # class — "breaker" / "sig_failure" (transport-level: persistent
+    # send failures / a delivery whose origin signature failed to
+    # verify; restored on half-open success), "equivocation"
+    # (protocol-level: conflicting changesets for one (actor,
+    # version); never restored by transport success — cleared only by
+    # the runtime's bounded verdict expiry or an identity renewal), or
+    # "signed_equivocation" (a VERIFIED signed conflicting pair:
+    # permanent, survives address moves and restarts, outranks all
+    # other evidence)
     quarantined: bool = False
     quarantine_reason: str = ""
 
@@ -117,13 +146,14 @@ class Members:
                 return False
             self._alive_cache = None
             if tuple(addr) != tuple(m.addr) \
-                    and m.quarantine_reason != "equivocation":
+                    and m.quarantine_reason not in _ACTOR_REASONS:
                 # the peer moved (e.g. restarted on a fresh ephemeral
                 # port): transport-level quarantine was evidence about
                 # the OLD address, and the old breaker can never
                 # half-open-succeed to clear it — start the new address
-                # with a clean slate.  Equivocation evidence is about
-                # the ACTOR, not the address: it survives a move
+                # with a clean slate.  Equivocation evidence (signed or
+                # not) is about the ACTOR, not the address: it
+                # survives a move
                 m.quarantined = False
                 m.quarantine_reason = ""
             m.state = state
@@ -183,12 +213,14 @@ class Members:
     @staticmethod
     def _apply_quarantine(m: Member, flag: bool, reason: str) -> None:
         if flag:
-            # equivocation outranks breaker evidence: a hostile actor
-            # whose transport also flaps must stay marked hostile
-            if m.quarantine_reason != "equivocation":
+            # stronger evidence sticks: a hostile actor whose transport
+            # also flaps must stay marked hostile, and a PROVEN
+            # (signed) equivocator must never be relabeled by anything
+            if _REASON_RANK.get(reason, 0) \
+                    >= _REASON_RANK.get(m.quarantine_reason, 0):
                 m.quarantine_reason = reason
             m.quarantined = True
-        elif m.quarantined and m.quarantine_reason == reason:
+        elif m.quarantined and _restores(m.quarantine_reason, reason):
             m.quarantined = False
             m.quarantine_reason = ""
 

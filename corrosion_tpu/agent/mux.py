@@ -384,6 +384,12 @@ async def serve_mux(agent, reader: asyncio.StreamReader,
     from corrosion_tpu.bridge import speedy
 
     uni_frames = speedy.FrameReader()
+    # the delivering transport's address, carried with each uni
+    # payload so a failed origin signature can blame the delivery
+    # (runtime._blame_relay, docs/faults.md signed attribution)
+    mux_peer = writer.get_extra_info("peername")
+    if mux_peer is not None:
+        mux_peer = tuple(mux_peer[:2])
     wlock = asyncio.Lock()
     channels: Dict[int, asyncio.StreamReader] = {}
     tasks: Dict[int, asyncio.Task] = {}
@@ -446,7 +452,9 @@ async def serve_mux(agent, reader: asyncio.StreamReader,
                 channels, clock=getattr(agent, "_clock", None)
             )
             if cls == CLASS_UNI:
-                agent._ingest_uni_payloads(uni_frames.feed(payload))
+                agent._ingest_uni_payloads(
+                    uni_frames.feed(payload), mux_peer
+                )
                 if agent.metrics is not None:
                     agent.metrics.counter(
                         "corro_transport_frames_total", channel="uni")

@@ -29,6 +29,7 @@ import asyncio
 import hashlib
 import os
 import random
+import struct
 import threading
 import time
 from dataclasses import dataclass, field
@@ -97,6 +98,41 @@ def _changes_digest(changes) -> bytes:
             int(ch.db_version), int(ch.seq), ch.site_id, int(ch.cl),
         )).encode())
     return h.digest()
+
+
+def _sig_message_raw(actor: bytes, version: int, seq0: int, seq1: int,
+                     last_seq: int, ts, digest: bytes) -> bytes:
+    """The one place the signing-message wire layout lives: every
+    signer and every verifier — including the evidence-time rebuild of
+    a STORED half (``_stored_sig_message``) — must produce the same
+    bytes, or provable signed equivocations silently downgrade to
+    bounded-window verdicts."""
+    return (
+        b"corro-sig-v1"
+        + actor
+        + struct.pack(
+            "<QQQQQ",
+            int(version), int(seq0), int(seq1), int(last_seq),
+            int(ts) if ts is not None else 0,
+        )
+        + digest
+    )
+
+
+def sig_message(actor: bytes, cs, digest: bytes = None) -> bytes:
+    """The canonical byte string a changeset signature covers: the
+    content digest BOUND to (actor, version, seq span, ts).  Binding
+    the metadata matters — a signature over the digest alone could be
+    replayed under re-written seq claims to wedge partial buffering
+    with origin-attributed garbage.  Only FULL changesets are ever
+    signed (equivocation is a full-changeset attack; empty/empty-set
+    variants carry no content to conflict over).  ``digest`` lets hot
+    callers that already computed ``_changes_digest`` skip the
+    recompute (the sort+hash is the expensive part of this message)."""
+    return _sig_message_raw(
+        actor, cs.version, cs.seqs[0], cs.seqs[1], cs.last_seq, cs.ts,
+        digest if digest is not None else _changes_digest(cs.changes),
+    )
 
 
 class _SlowPeer(Exception):
@@ -209,14 +245,59 @@ class AgentConfig:
     # quarantine the hostile actor (Members path) — dropping its
     # further changesets so it cannot poison CRDT state
     equivocation_detection: bool = True
-    # how long an equivocation quarantine holds before the actor's
-    # traffic is admitted again (re-offense re-quarantines: the
-    # digests survive).  Actor attribution is UNSIGNED — a hostile
-    # relay can frame an honest origin by forging its actor id — so
-    # the drop-all verdict must be a bounded window, not a permanent
-    # severance a single forged message could inflict.  0 = forever
+    # how long an UNSIGNED equivocation quarantine holds before the
+    # actor's traffic is admitted again (re-offense re-quarantines:
+    # the digests survive).  The bounded window applies ONLY to
+    # conflicts whose attribution could not be cryptographically
+    # proven — a hostile relay can forge an unsigned actor id, so an
+    # unbounded drop-all would let one forged message inflict
+    # permanent divergence.  A VERIFIED signed conflicting pair (both
+    # contents signed by the origin's key, types/crypto.py) is an
+    # unframeable proof: that verdict is PERMANENT
+    # (quarantine_reason="signed_equivocation", persisted across
+    # restarts) and ignores this window.  0 = forever even unsigned
     # (only for harnesses that control every message source).
     equiv_quarantine_s: float = 300.0
+    # -- signed changeset attribution (docs/faults.md) ------------------
+    # 32-byte Ed25519 seed (types/crypto.py) signing this node's OWN
+    # full changesets on the broadcast path; None (and no key file) =
+    # unsigned, wire byte-exact vs the pre-signing envelope
+    sig_secret: Optional[bytes] = None
+    # production path: hex-encoded 32-byte seed on disk (chmod 600)
+    sig_key_file: Optional[str] = None
+    # trust directory: origin actor id -> 32-byte Ed25519 public key.
+    # Verification only ever runs for actors present here; the agent
+    # keeps a live REFERENCE (not a copy) so a harness can extend the
+    # shared directory after boot
+    sig_pubkeys: Optional[Dict[bytes, bytes]] = None
+    # verify-on-evidence posture: signatures are verified when the
+    # digest screen fires, when the span screen trips, and on a
+    # bounded random spot check — hash-sampled per (node, actor,
+    # version) at this rate (deterministic, no rng stream), and
+    # additionally spaced at least sig_spot_check_min_interval_s apart
+    # so pure-Python verification (~ms each) stays a tripwire, never
+    # an ingest tax (the APPLY_BENCH sig A/B holds the ≥0.95 gate at
+    # these defaults).  0 disables spot checks (evidence-driven
+    # verification stays on)
+    sig_spot_check_rate: float = 0.0
+    sig_spot_check_min_interval_s: float = 0.5
+    # evidence-triggered verification budget: conflicting duplicates
+    # and span-screen trips admit at most this many verifications per
+    # second (token bucket, burst 2x) — without it an attacker who
+    # mutates one byte per replayed copy manufactures a ~ms verify per
+    # message inside the apply workers.  Over budget the conflicting
+    # duplicate is DROPPED with no verdict (it was never going to
+    # apply; counted result=skipped) rather than falling back to the
+    # unsigned bounded-window path, which would let the flood frame
+    # the origin.  0 disables the budget (every evidence fires a
+    # verify)
+    sig_evidence_verify_rate: float = 64.0
+    # -- Byzantine sync-serve client hardening (docs/faults.md) ---------
+    # total wall/virtual budget for one outbound sync session: a
+    # hostile server trickling one byte per read-timeout window would
+    # otherwise hold a session (and its needs) hostage forever.
+    # 0 disables the deadline
+    sync_session_deadline_s: float = 60.0
     pg_port: Optional[int] = None  # PostgreSQL wire protocol (None = off)
     pg_host: Optional[str] = None  # PG bind host (None = api_host)
     # PG TLS client-cert verification is its OWN knob (corro-pg
@@ -382,6 +463,41 @@ class Agent:
         self._equiv_digests: Dict[tuple, bytes] = {}
         self._equiv_lock = threading.Lock()
         self._equiv_quarantined: Dict[bytes, float] = {}
+        # actors under a SIGNED (proof-backed) verdict: drives the
+        # one-time escalation relabel in _note_equivocation
+        self._equiv_proofed: set = set()
+        # signed changeset attribution (docs/faults.md): this node's
+        # Ed25519 identity (None = unsigned, wire byte-exact), the
+        # trust directory (a live REFERENCE — harnesses extend it
+        # after boot and respawns see the additions), accepted-content
+        # signatures remembered next to the digests (the raw material
+        # of a signed-equivocation proof), an own-signature cache (one
+        # sign per local version, not per retransmission), and the
+        # spot-check interval bound
+        self._sig_secret: Optional[bytes] = None
+        self._sig_pub: Optional[bytes] = None
+        secret = config.sig_secret
+        if secret is None and config.sig_key_file:
+            with open(config.sig_key_file) as f:
+                secret = bytes.fromhex(f.read().strip())
+        if secret is not None:
+            from corrosion_tpu.types import crypto
+
+            self._sig_secret = bytes(secret)
+            self._sig_pub = crypto.public_key(self._sig_secret)
+        self._sig_pubkeys: Dict[bytes, bytes] = (
+            config.sig_pubkeys if config.sig_pubkeys is not None else {}
+        )
+        self._equiv_sigs: Dict[tuple, bytes] = {}  # (actor,v) -> 64-byte sig
+        self._sig_own_cache: Dict[int, bytes] = {}
+        self._sig_last_spot = float("-inf")
+        # evidence-verification token bucket (sig_evidence_verify_rate)
+        self._sig_ev_tokens = 2.0 * config.sig_evidence_verify_rate
+        self._sig_ev_stamp = self._clock.monotonic()
+        # guards the bucket and the spot-check stamp: apply workers
+        # race their read-modify-writes, and an unsynchronized bucket
+        # admits more ~ms verifies than the rate it exists to enforce
+        self._sig_lock = threading.Lock()
         # digests survive restarts (__corro_equiv_digests): an
         # equivocator must not be able to wait out a reboot of its
         # victim — the conflicting re-send after a restart compares
@@ -561,7 +677,7 @@ class Agent:
             self.metrics.counter(
                 "corro_channel_sends_total", channel="bcast")
             self._bcast_queue.put_nowait(
-                (cv, self.config.max_transmissions, 0, tp)
+                (cv, self.config.max_transmissions, 0, tp, None)
             )
         self._sync_sem = asyncio.Semaphore(self.config.max_sync_sessions)
         self._ingest_event = asyncio.Event()
@@ -619,6 +735,22 @@ class Agent:
                 if self.config.gossip_port != 0 or attempt == 15:
                     raise
         self._load_members()
+        # persisted members loaded AFTER the proof reload in __init__:
+        # re-assert the permanent signed verdicts on the records that
+        # just appeared (the boot-time set_quarantined no-op'd on them).
+        # Keyed on the explicit proof set, NOT deadline == inf: with
+        # equiv_quarantine_s=0 UNSIGNED verdicts park at inf too, and
+        # a pre-run() unsigned verdict must never boot-relabel a
+        # possibly-framed actor as a proven signed equivocator
+        with self._equiv_lock:
+            proven = [
+                actor for actor in self._equiv_quarantined
+                if actor in self._equiv_proofed
+            ]
+        for actor in proven:
+            self.members.set_quarantined(
+                actor, True, reason="signed_equivocation"
+            )
         if self.config.subs_enabled:
             from corrosion_tpu.agent.pubsub import SubsManager
 
@@ -872,8 +1004,11 @@ class Agent:
                 ))
             extra.append((
                 "corro_transport_breakers_open",
+                # list() snapshot: apply workers insert convictions
+                # concurrently and a plain generator over .values()
+                # races the resize
                 float(sum(
-                    1 for b in self.transport.breakers.values()
+                    1 for b in list(self.transport.breakers.values())
                     if b.is_open
                 )), {},
             ))
@@ -1911,7 +2046,7 @@ class Agent:
         self.metrics.counter("corro_channel_sends_total", channel="bcast")
         loop.call_soon_threadsafe(
             self._bcast_queue.put_nowait,
-            (cv, self.config.max_transmissions, 0, traceparent),
+            (cv, self.config.max_transmissions, 0, traceparent, None),
         )
 
     def _queue_or_defer_broadcast(
@@ -2184,10 +2319,10 @@ class Agent:
             else:
                 timeout = None
             try:
-                cv, remaining, hop, tp = await self._clock.wait_for(
+                cv, remaining, hop, tp, sig = await self._clock.wait_for(
                     self._bcast_queue.get(), timeout=timeout
                 )
-                frame = self.encode_broadcast_frame(cv, hop, tp)
+                frame = self.encode_broadcast_frame(cv, hop, tp, sig)
                 buffer.append((frame, cv, remaining, set()))
                 buf_bytes += len(frame)
             except asyncio.TimeoutError:
@@ -2200,21 +2335,42 @@ class Agent:
                 await flush()
 
     def encode_broadcast_frame(self, cv: ChangeV1, hop: int = 0,
-                               traceparent: Optional[str] = None) -> bytes:
+                               traceparent: Optional[str] = None,
+                               sig: Optional[bytes] = None) -> bytes:
         """One queued broadcast → the exact on-wire frame bytes
         (speedy UniPayload + u32-BE framing; optional debug-hop prefix).
         With ``bcast_trace_propagation`` the payload rides the versioned
         traced envelope (hop + traceparent ahead of the classic bytes —
-        receivers accept both formats).  Shared by the live broadcast
-        loop and the deterministic scheduler (``agent/det.py``) so both
-        emit identical bytes."""
+        receivers accept both formats).  When signing is configured
+        (``sig_secret``/``sig_key_file``) this node's OWN full
+        changesets are signed here and ride the v2 SIGNED envelope;
+        a relayed payload's origin signature (``sig``) passes through
+        unchanged — a relay cannot re-sign what it did not author.
+        Unsigned and trace-off configurations emit the pre-signing
+        bytes exactly.  Shared by the live broadcast loop and the
+        deterministic scheduler (``agent/det.py``) so both emit
+        identical bytes."""
         payload = speedy.encode_uni_payload(
             UniPayload(
                 broadcast=BroadcastV1(change=cv),
                 cluster_id=ClusterId(self.config.cluster_id),
             )
         )
-        if self.config.bcast_trace_propagation:
+        if (sig is None and self._sig_secret is not None
+                and cv.actor_id.bytes == self.actor_id):
+            sig = self._sign_changeset(cv.changeset)
+        if sig is not None:
+            # the v2 envelope carries the trace slot structurally, but
+            # the CONTENT still honors bcast_trace_propagation — signing
+            # must not become a side channel that re-enables wire trace
+            # context the operator turned off
+            payload = speedy.encode_signed_uni(
+                payload,
+                traceparent if self.config.bcast_trace_propagation
+                else None,
+                hop, sig,
+            )
+        elif self.config.bcast_trace_propagation:
             payload = speedy.encode_traced_uni(payload, traceparent, hop)
         if self.config.debug_hops:
             payload = bytes([min(hop, 255)]) + payload
@@ -2222,15 +2378,15 @@ class Agent:
 
     def decode_uni_frame_meta(
         self, payload: bytes
-    ) -> Optional[Tuple[ChangeV1, Optional[str], int]]:
+    ) -> Optional[Tuple[ChangeV1, Optional[str], int, Optional[bytes]]]:
         """One deframed uni-stream payload → ``(ChangeV1, traceparent,
-        hop)``, or None on a decode error / foreign cluster.  Classic
-        (untraced) payloads yield ``(cv, None, 0)``."""
+        hop, sig)``, or None on a decode error / foreign cluster.
+        Classic (untraced) payloads yield ``(cv, None, 0, None)``."""
         dbg_hop = 0
         if self.config.debug_hops and payload:
             dbg_hop, payload = payload[0], payload[1:]
         try:
-            payload, tp, hop = speedy.decode_traced_uni(payload)
+            payload, tp, hop, sig = speedy.decode_uni_envelope(payload)
             up = speedy.decode_uni_payload(payload)
         except speedy.SpeedyError:
             self.metrics.counter("corro_wire_decode_errors_total")
@@ -2242,7 +2398,7 @@ class Agent:
             key = self._seen_key(cv)
             with self._seen_lock:
                 self._recv_hops.setdefault(key, dbg_hop)
-        return cv, tp, hop
+        return cv, tp, hop, sig
 
     def decode_uni_frame(self, payload: bytes) -> Optional[ChangeV1]:
         """One deframed uni-stream payload → its ChangeV1 (or None on a
@@ -2330,7 +2486,7 @@ class Agent:
                     # count is unknown pre-decode, so estimate from the
                     # payload size (speedy changes run ~100+ bytes) so
                     # apply_queue_len keeps bounding real batch work
-                    cost += max(1, len(item) >> 7)
+                    cost += max(1, len(item[0]) >> 7)
                 else:
                     cost += max(
                         1,
@@ -2372,7 +2528,8 @@ class Agent:
                 self._bcast_queue.put_nowait(
                     (cv, self.config.max_transmissions,
                      self._rebroadcast_hop(cv, meta),
-                     meta[0] if meta is not None else None)
+                     meta[0] if meta is not None else None,
+                     self._meta_sig(meta))
                 )
 
     def _apply_batch(self, batch: List[tuple]) -> List[tuple]:
@@ -2394,9 +2551,13 @@ class Agent:
             with self.metrics.timed("corro_apply_seconds"):
                 items: List[tuple] = []
                 for item, source in batch:
-                    if source is None:  # raw uni payload, decode off-loop
+                    if source is None:
+                        # raw uni payload, decode off-loop; the item
+                        # carries (payload, delivering_peer) so a
+                        # failed signature can blame the transport
+                        payload, peer = item
                         try:
-                            decoded = self.decode_uni_frame_meta(item)
+                            decoded = self.decode_uni_frame_meta(payload)
                         except Exception:
                             # decode catches SpeedyError, but a hostile
                             # frame can raise others (e.g. invalid
@@ -2406,10 +2567,11 @@ class Agent:
                                 "corro_wire_decode_errors_total")
                             decoded = None
                         if decoded is not None:
-                            cv, tp, hop = decoded
-                            items.append(
-                                (cv, ChangeSource.BROADCAST, (tp, hop))
-                            )
+                            cv, tp, hop, sig = decoded
+                            items.append((
+                                cv, ChangeSource.BROADCAST,
+                                (tp, hop, sig, peer),
+                            ))
                     else:
                         items.append((item, source, None))
                 i, n = 0, len(items)
@@ -2467,7 +2629,7 @@ class Agent:
         live_idx: List[int] = []
         dropped = [False] * len(group)
         for k, (cv, source, _meta) in enumerate(group):
-            if self._pre_change(cv, source):
+            if self._pre_change(cv, source, _meta):
                 live_idx.append(k)
             else:
                 # dedup/self-origin drop: handle_change returns without
@@ -2478,9 +2640,11 @@ class Agent:
         if live_idx:
             live = [group[k][0] for k in live_idx]
             live_sources = [group[k][1] for k in live_idx]
+            live_metas = [group[k][2] for k in live_idx]
             try:
                 news_flags = self._apply_complete_group(
-                    live[0].actor_id.bytes, live, live_sources
+                    live[0].actor_id.bytes, live, live_sources,
+                    live_metas,
                 )
             except Exception:
                 # not an apply error yet: the per-changeset retry below
@@ -2492,10 +2656,10 @@ class Agent:
                     actor=live[0].actor_id.bytes.hex(), size=len(live),
                 )
                 news_flags = []
-                for cv, src in zip(live, live_sources):
+                for cv, src, mta in zip(live, live_sources, live_metas):
                     try:
                         news_flags.append(
-                            self._process_changeset(cv, src)
+                            self._process_changeset(cv, src, mta)
                         )
                     except Exception:
                         self.metrics.counter(
@@ -2529,6 +2693,7 @@ class Agent:
     def _apply_complete_group(
         self, actor: bytes, cvs: List[ChangeV1],
         sources: Optional[List[ChangeSource]] = None,
+        metas: Optional[List] = None,
     ) -> List[bool]:
         """Merge several COMPLETE changesets from ``actor`` under one
         storage lock + one apply transaction.  The already-have gate is
@@ -2544,25 +2709,37 @@ class Agent:
         sync-like, no digest bookkeeping (harness seeding paths)."""
         if sources is None:
             sources = [ChangeSource.SYNC] * len(cvs)
+        if metas is None:
+            metas = [None] * len(cvs)
         with self.storage._lock:
             booked = self.bookie.for_actor(actor)
             flags: List[bool] = []
             to_apply: List[ChangeV1] = []
-            # version -> (cs, source) accepted within THIS batch: a
-            # back-to-back conflicting pair lands here before any
-            # digest is remembered, so the in-batch dup must compare
-            # against the batch member directly
+            # version -> (cs, source, meta) accepted within THIS
+            # batch: a back-to-back conflicting pair lands here before
+            # any digest is remembered, so the in-batch dup must
+            # compare against the batch member directly
             batch_cs: Dict[int, tuple] = {}
-            for cv, src in zip(cvs, sources):
+            for cv, src, mta in zip(cvs, sources, metas):
                 v = int(cv.changeset.version)
                 if v in batch_cs:
-                    first_cs, first_src = batch_cs[v]
+                    first_cs, first_src, first_meta = batch_cs[v]
                     if (self.config.equivocation_detection
                             and src is ChangeSource.BROADCAST
-                            and first_src is ChangeSource.BROADCAST
-                            and _changes_digest(cv.changeset.changes)
-                            != _changes_digest(first_cs.changes)):
-                        self._note_equivocation(actor, "content")
+                            and first_src is ChangeSource.BROADCAST):
+                        dup_dig = _changes_digest(cv.changeset.changes)
+                        if dup_dig != _changes_digest(first_cs.changes):
+                            # the in-batch conflicting pair runs the
+                            # same signed-attribution decision as the
+                            # dup paths — with the first member's
+                            # signature verified directly (it is in
+                            # hand, no store round-trip needed)
+                            self._equiv_verdict(
+                                actor, cv.changeset, "content", mta,
+                                first=(first_cs,
+                                       self._meta_sig(first_meta)),
+                                digest=dup_dig,
+                            )
                     flags.append(False)
                     continue
                 if booked.contains_version(v) and v not in booked.partials:
@@ -2572,11 +2749,11 @@ class Agent:
                     # see _check_content_equivocation)
                     if src is ChangeSource.BROADCAST:
                         self._check_content_equivocation(
-                            actor, cv.changeset
+                            actor, cv.changeset, mta
                         )
                     flags.append(False)
                     continue
-                batch_cs[v] = (cv.changeset, src)
+                batch_cs[v] = (cv.changeset, src, mta)
                 to_apply.append(cv)
                 flags.append(True)
             if not to_apply:
@@ -2613,11 +2790,12 @@ class Agent:
             if self.config.equivocation_detection:
                 for cv in to_apply:
                     cs = cv.changeset
-                    src = batch_cs[int(cs.version)][1]
+                    _cs, src, mta = batch_cs[int(cs.version)]
                     if src is ChangeSource.BROADCAST:
                         self._remember_digest(
                             actor, int(cs.version),
                             _changes_digest(cs.changes),
+                            sig=self._meta_sig(mta),
                         )
             return flags
 
@@ -2658,38 +2836,82 @@ class Agent:
     def _load_equiv_digests(self) -> None:
         """Boot-time reload of the accepted-content digests (newest
         ``seen_cache_size``, re-inserted oldest-first so the in-memory
-        FIFO keeps evicting in age order)."""
-        self.storage.conn.execute(
+        FIFO keeps evicting in age order), their signatures, and the
+        persisted SIGNED-equivocation proofs — a proven equivocator
+        stays permanently quarantined across its victim's reboot."""
+        conn = self.storage.conn
+        conn.execute(
             "CREATE TABLE IF NOT EXISTS __corro_equiv_digests ("
             " actor_id BLOB NOT NULL, version INTEGER NOT NULL,"
             " digest BLOB NOT NULL, PRIMARY KEY (actor_id, version))"
         )
-        rows = self.storage.conn.execute(
-            "SELECT actor_id, version, digest FROM __corro_equiv_digests"
-            " ORDER BY rowid DESC LIMIT ?",
+        # pre-signing databases hold the 3-column table: widen in place
+        cols = {r[1] for r in conn.execute(
+            "PRAGMA table_info(__corro_equiv_digests)"
+        ).fetchall()}
+        if "sig" not in cols:
+            conn.execute(
+                "ALTER TABLE __corro_equiv_digests ADD COLUMN sig BLOB"
+            )
+        conn.execute(
+            "CREATE TABLE IF NOT EXISTS __corro_equiv_proofs ("
+            " actor_id BLOB NOT NULL PRIMARY KEY,"
+            " version INTEGER NOT NULL, kind TEXT NOT NULL,"
+            " msg_a BLOB, sig_a BLOB, msg_b BLOB, sig_b BLOB)"
+        )
+        rows = conn.execute(
+            "SELECT actor_id, version, digest, sig"
+            " FROM __corro_equiv_digests ORDER BY rowid DESC LIMIT ?",
             (self.config.seen_cache_size,),
         ).fetchall()
-        for actor, v, digest in reversed(rows):
-            self._equiv_digests[(bytes(actor), int(v))] = bytes(digest)
+        for actor, v, digest, sig in reversed(rows):
+            key = (bytes(actor), int(v))
+            self._equiv_digests[key] = bytes(digest)
+            if sig is not None:
+                self._equiv_sigs[key] = bytes(sig)
+        for (actor,) in conn.execute(
+            "SELECT actor_id FROM __corro_equiv_proofs"
+        ).fetchall():
+            actor = bytes(actor)
+            self._equiv_quarantined[actor] = float("inf")
+            self._equiv_proofed.add(actor)
+            # the member record may not exist yet at boot; the
+            # _pre_change drop path re-asserts the flag when the
+            # proven actor's traffic next shows up
+            self.members.set_quarantined(
+                actor, True, reason="signed_equivocation"
+            )
 
-    def _remember_digest(self, actor: bytes, v: int, digest: bytes) -> None:
+    def _remember_digest(self, actor: bytes, v: int, digest: bytes,
+                         sig: Optional[bytes] = None) -> None:
         """Record the accepted content digest for ``(actor, v)`` —
-        in-memory FIFO + durable write-through.  Callers hold the
-        storage lock (both sites sit inside apply paths), so the
-        durable row commits on the shared write connection without a
-        re-acquire; persistence failure never blocks the apply seam."""
+        in-memory FIFO + durable write-through — plus the origin
+        signature when the delivery carried one: a later conflicting
+        SIGNED re-claim needs both halves of the pair to form a proof.
+        Only the raw signature is stored; the message it covers is
+        rebuilt at EVIDENCE time (``_stored_sig_message``) from the
+        digest and bookkeeping — a complete changeset's seq span is
+        always ``(0, last_seq)``, so the accept hot path pays no
+        message construction.  Callers hold the storage lock (both
+        sites sit inside apply paths), so the durable row commits on
+        the shared write connection without a re-acquire; persistence
+        failure never blocks the apply seam."""
         evicted = None
         with self._equiv_lock:
             dig = self._equiv_digests
             dig[(actor, v)] = digest
+            if sig is not None:
+                self._equiv_sigs[(actor, v)] = sig
             if len(dig) > self.config.seen_cache_size:
                 evicted = next(iter(dig))
                 dig.pop(evicted)
+                self._equiv_sigs.pop(evicted, None)
         try:
             self.storage.conn.execute(
                 "INSERT OR REPLACE INTO __corro_equiv_digests"
-                " (actor_id, version, digest) VALUES (?, ?, ?)",
-                (actor, v, digest),
+                " (actor_id, version, digest, sig)"
+                " VALUES (?, ?, ?, ?)",
+                (actor, v, digest, sig),
             )
             if evicted is not None:
                 self.storage.conn.execute(
@@ -2700,7 +2922,326 @@ class Agent:
         except Exception:
             logger.debug("equiv digest persist failed", exc_info=True)
 
-    def _check_content_equivocation(self, actor: bytes, cs) -> bool:
+    def _stored_sig_message(self, actor: bytes, v: int,
+                            digest: bytes) -> Optional[bytes]:
+        """Rebuild the exact ``sig_message`` the ACCEPTED content was
+        signed over, from bookkeeping + the stored digest: a complete
+        changeset's seq span is ``(0, last_seq)`` by definition, and
+        ``last_seq``/``ts`` were recorded at apply time.  Evidence-time
+        only — the accept path stores just the 64-byte signature."""
+        bv = self.bookie.for_actor(actor)
+        entry = bv.versions.get(v)
+        if entry is None:
+            return None
+        _dbv, last_seq = entry
+        ts = self.bookie.version_ts(actor, v)
+        return _sig_message_raw(
+            actor, v, 0, last_seq, last_seq, ts, digest
+        )
+
+    # -- signed attribution (docs/faults.md) ---------------------------
+
+    @staticmethod
+    def _meta_sig(meta) -> Optional[bytes]:
+        return meta[2] if meta is not None and len(meta) > 2 else None
+
+    @staticmethod
+    def _meta_peer(meta):
+        return meta[3] if meta is not None and len(meta) > 3 else None
+
+    def _sign_changeset(self, cs) -> Optional[bytes]:
+        """Sign one of OUR full changesets (cached per version: the
+        broadcast loop re-frames on retransmission, the statement
+        signed never changes)."""
+        if self._sig_secret is None or not cs.is_full:
+            return None
+        v = int(cs.version)
+        sig = self._sig_own_cache.get(v)
+        if sig is None:
+            from corrosion_tpu.types import crypto
+
+            sig = crypto.sign(
+                self._sig_secret, sig_message(self.actor_id, cs)
+            )
+            cache = self._sig_own_cache
+            cache[v] = sig
+            if len(cache) > 1024:
+                cache.pop(next(iter(cache)))
+        return sig
+
+    def _verify_changeset_sig(self, actor: bytes, cs,
+                              sig: Optional[bytes],
+                              digest: Optional[bytes] = None,
+                              ) -> Optional[bool]:
+        """Evidence-time verification: True/False when it actually ran
+        (counted under ``corro_sig_verifications_total{result=}``),
+        None when unverifiable (no signature on the delivery, or the
+        origin has no key in the trust directory).  ``digest`` skips
+        the ``_changes_digest`` recompute when the caller already paid
+        for it (every content-conflict caller has)."""
+        if sig is None:
+            return None
+        pub = self._sig_pubkeys.get(actor)
+        if pub is None:
+            return None
+        from corrosion_tpu.types import crypto
+
+        ok = crypto.verify_cached(pub, sig_message(actor, cs, digest), sig)
+        self.metrics.counter(
+            "corro_sig_verifications_total",
+            result="ok" if ok else "fail",
+        )
+        return ok
+
+    def _sig_evidence_budget(self) -> bool:
+        """Token-bucket admission for evidence-triggered verification
+        (``sig_evidence_verify_rate``/s refill, 2x burst).  The spot
+        check has its own interval bound; this one keeps the paths an
+        ATTACKER can fire at will — digest conflicts and span-screen
+        trips are both manufacturable from any accepted changeset —
+        from turning ~ms pure-Python verifies into an ingest tax."""
+        rate = self.config.sig_evidence_verify_rate
+        if rate <= 0.0:
+            return True
+        now = self._clock.monotonic()
+        with self._sig_lock:
+            self._sig_ev_tokens = min(
+                2.0 * rate,
+                self._sig_ev_tokens + (now - self._sig_ev_stamp) * rate,
+            )
+            self._sig_ev_stamp = now
+            if self._sig_ev_tokens < 1.0:
+                return False
+            self._sig_ev_tokens -= 1.0
+        return True
+
+    def _spot_check_due(self, actor: bytes, v: int) -> bool:
+        """Deterministic, bounded spot-check sampling: a pure hash of
+        (this node, actor, version) against ``sig_spot_check_rate`` —
+        no rng stream, so virtual campaigns replay identically — and a
+        minimum spacing on the injected clock so pure-Python
+        verification can never dominate ingest."""
+        rate = self.config.sig_spot_check_rate
+        # the ACTOR must be keyed before anything else: an admitted
+        # candidate claims the interval slot, and verification of an
+        # unkeyed actor returns None — in a partially-keyed cluster a
+        # chatty unkeyed actor would otherwise eat every slot and the
+        # keyed actors' tripwire would go dark
+        if rate <= 0.0 or self._sig_pubkeys.get(actor) is None:
+            return False
+        # interval bound FIRST: it rejects almost every candidate
+        # during bursts, and a float compare is ~10x cheaper than the
+        # sampling hash — the hash only runs when a verify could
+        # actually be admitted
+        now = self._clock.monotonic()
+        # check + claim under one lock hold: two apply workers racing
+        # the stamp would both admit a verify inside one interval (the
+        # hash between them costs ~µs, far under a saved ~ms verify)
+        with self._sig_lock:
+            if now - self._sig_last_spot \
+                    < self.config.sig_spot_check_min_interval_s:
+                return False
+            h = hashlib.blake2b(
+                b"sig-spot" + self.actor_id + actor + struct.pack("<Q", v),
+                digest_size=8,
+            ).digest()
+            if int.from_bytes(h, "big") / 2.0**64 >= rate:
+                return False
+            self._sig_last_spot = now
+        return True
+
+    def _get_breaker(self, addr):
+        """The per-peer transport breaker for ``addr``, created with a
+        bounded insert when absent (this path is reachable with
+        attacker-controlled ephemeral source addresses — tampered
+        deliveries from unknown hosts).  None when the transport
+        carries no breaker registry.
+
+        Delegates to ``Transport._breaker`` (same thresholds — the
+        transport is constructed from this config — same on_evict
+        restore via ``on_breaker``, and its registry lock: this runs
+        on apply-pool threads concurrently with the loop's dials).
+        The fallback covers registry-only doubles like the virtual
+        cluster's ``_TransportStub``, which are single-threaded."""
+        transport = self.transport
+        mk = getattr(transport, "_breaker", None)
+        if mk is not None:
+            return mk(addr)
+        breakers = getattr(transport, "breakers", None)
+        if breakers is None:
+            return None
+        b = breakers.get(addr)
+        if b is None:
+            from corrosion_tpu.agent.transport import (
+                CircuitBreaker, prune_breakers,
+            )
+
+            prune_breakers(
+                breakers, 4 * getattr(transport, "max_cached", 256),
+                on_evict=lambda a: self._on_breaker(a, False),
+            )
+            b = breakers[addr] = CircuitBreaker(
+                self.config.breaker_threshold,
+                self.config.breaker_cooldown,
+                now=self._clock.monotonic,
+            )
+        return b
+
+    def _trip_breaker(self, addr) -> None:
+        """Force the per-peer transport breaker OPEN (verified-hostile
+        evidence: tampered bytes or garbage serves are not ordinary
+        flakiness worth `threshold` free strikes)."""
+        addr = tuple(addr)
+        b = self._get_breaker(addr)
+        if b is not None and b.trip():
+            self.metrics.counter("corro_transport_breaker_opens_total")
+            self._on_breaker(addr, True)
+
+    def _strike_breaker(self, addr) -> None:
+        """One breaker failure strike (AMBIGUOUS evidence: a sync
+        session deadline could be an honest slow peer, so it earns
+        `threshold` free strikes before quarantine — unlike the hard
+        `_trip_breaker` reserved for verified-hostile bytes).  Keeps a
+        slow-trickle server from being re-selected round after round
+        forever: enough deadline aborts open its breaker and
+        `_choose_sync_peers` stops offering it sessions."""
+        addr = tuple(addr)
+        fail = getattr(self.transport, "_breaker_failure", None)
+        if fail is not None:
+            fail(addr)
+            return
+        b = self._get_breaker(addr)
+        if b is not None and b.record_failure():
+            self.metrics.counter("corro_transport_breaker_opens_total")
+            self._on_breaker(addr, True)
+
+    def _blame_relay(self, peer) -> None:
+        """A signature FAILURE convicts the DELIVERY, never the named
+        origin: the one thing an invalid signature proves is that the
+        (claimed origin, content, signature) triple was not produced
+        by the origin's key — the tamperer could be the delivering
+        relay or a forger upstream, but the origin cannot be framed by
+        it.  So the delivering transport eats breaker-class (bounded,
+        half-open-recoverable) quarantine, and the origin's verdict
+        state is never touched."""
+        if peer is None:
+            return
+        addr = tuple(peer)
+        if not any(tuple(m.addr) == addr for m in self.members.all()):
+            # live inbound streams carry the peer's EPHEMERAL port,
+            # not its gossip address; when exactly one member shares
+            # the host the delivery is attributable anyway (distinct
+            # hosts in real deployments — a loopback harness stays
+            # unattributed rather than blaming the wrong node).
+            # NAMED RESIDUAL (docs/faults.md): an UNREGISTERED process
+            # co-located with that one member (container/NAT sharing
+            # its host) can draw this transport-class blame onto it.
+            # Bounded by construction — it is breaker-class (sampling
+            # deprioritization, half-open-recoverable once the
+            # tampered traffic stops), never the actor-class verdict
+            # a signature proof mints — and the alternative, dropping
+            # the fallback, would leave live tampering relays entirely
+            # unattributable since exact addr matches never happen on
+            # real inbound sockets.
+            same_host = [
+                m for m in self.members.all()
+                if tuple(m.addr)[0] == addr[0]
+            ]
+            if len(same_host) == 1:
+                addr = tuple(same_host[0].addr)
+        # breaker FIRST: a newly-opened breaker's _on_breaker labels
+        # the member reason="breaker", and the equal-rank relabel rule
+        # means whichever transport-class reason lands LAST wins — the
+        # specific evidence class must be the one that sticks
+        self._trip_breaker(addr)
+        hit = self.members.quarantine_by_addr(addr, True,
+                                              reason="sig_failure")
+        if hit:
+            self.metrics.counter(
+                "corro_members_quarantine_transitions_total",
+                state="sig_failure",
+            )
+            self._flight_event(
+                "quarantine", addr=f"{addr[0]}:{addr[1]}", on=True,
+                reason="sig_failure",
+            )
+
+    def _equiv_verdict(self, actor: bytes, cs, kind: str, meta,
+                       first: Optional[tuple] = None,
+                       digest: Optional[bytes] = None) -> bool:
+        """Attribution decision once hostile evidence fired (a content
+        conflict or a span-screen trip).  Returns True when the ORIGIN
+        was convicted (quarantined), False when blame landed on the
+        delivering relay instead.
+
+        * delivery signature INVALID → the bytes were tampered in
+          transit; the relay's breaker is quarantined and the origin
+          is untouched (unframeable);
+        * delivery signature VALID → the origin really said this.  A
+          signed span-garbage claim, or a signed conflict against an
+          accepted content whose OWN stored signature also verifies,
+          is a persistable PROOF: the quarantine is permanent;
+        * unverifiable (unsigned delivery / unknown key) → the
+          pre-signing bounded-window verdict, byte-for-byte;
+        * signed but over the evidence-verification budget
+          (``sig_evidence_verify_rate``) → the conflicting message is
+          dropped with NO verdict (every caller is a drop path; the
+          content never applies).  Falling back to the unsigned
+          bounded-window verdict here would let a tampered-copy flood
+          frame the origin — the one thing the signature exists to
+          prevent.
+
+        ``first`` = ``(accepted_cs, accepted_sig)`` for the in-batch
+        conflicting-pair path, where the accepted half is in hand
+        before any digest was stored.  ``digest`` = the incoming
+        changeset's ``_changes_digest`` when the caller already
+        computed it."""
+        sig = self._meta_sig(meta)
+        if sig is not None and self._sig_pubkeys.get(actor) is not None:
+            if not self._sig_evidence_budget():
+                self.metrics.counter(
+                    "corro_sig_verifications_total", result="skipped"
+                )
+                return False
+            if digest is None:
+                digest = _changes_digest(cs.changes)
+        ver = self._verify_changeset_sig(actor, cs, sig, digest)
+        if ver is False:
+            self._blame_relay(self._meta_peer(meta))
+            return False
+        proof = None
+        if ver is True:
+            from corrosion_tpu.types import crypto
+
+            msg = sig_message(actor, cs, digest)
+            v = int(cs.version)
+            pub = self._sig_pubkeys.get(actor)
+            if kind == "span":
+                # one signed, structurally-impossible claim is its own
+                # proof: no relay could mint it without the origin key
+                proof = (v, kind, msg, sig, None, None)
+            elif first is not None:
+                first_cs, first_sig = first
+                if first_sig is not None and pub is not None:
+                    smsg = sig_message(actor, first_cs)
+                    if smsg != msg and crypto.verify_cached(
+                            pub, smsg, first_sig):
+                        proof = (v, kind, smsg, first_sig, msg, sig)
+            else:
+                with self._equiv_lock:
+                    ssig = self._equiv_sigs.get((actor, v))
+                    sdigest = self._equiv_digests.get((actor, v))
+                if ssig is not None and sdigest is not None \
+                        and pub is not None:
+                    smsg = self._stored_sig_message(actor, v, sdigest)
+                    if (smsg is not None and smsg != msg
+                            and crypto.verify_cached(pub, smsg, ssig)):
+                        proof = (v, kind, smsg, ssig, msg, sig)
+        self._note_equivocation(actor, kind, proof=proof)
+        return True
+
+    def _check_content_equivocation(self, actor: bytes, cs,
+                                    meta=None) -> bool:
         """Compare a duplicate complete changeset's content digest
         against the accepted one for its (actor, version); a mismatch
         is equivocation (returns True after counting + quarantining).
@@ -2740,55 +3281,104 @@ class Agent:
             return False
         with self._equiv_lock:
             prev = self._equiv_digests.get((actor, int(cs.version)))
-        if prev is None or prev == _changes_digest(cs.changes):
+        if prev is None:
             return False
-        self._note_equivocation(actor, "content")
-        return True
+        dig = _changes_digest(cs.changes)
+        if prev == dig:
+            return False
+        return self._equiv_verdict(actor, cs, "content", meta, digest=dig)
 
-    def _note_equivocation(self, actor: bytes, kind: str) -> None:
+    def _note_equivocation(self, actor: bytes, kind: str,
+                           proof: Optional[tuple] = None) -> None:
         """Count one hostile observation and quarantine the origin
         actor through the Members path (the breaker-quarantine shape,
         protocol-level evidence): out of ring0, deprioritized in
         sampling, reason surfaced in ``cluster_members`` — and its
-        further changesets drop at ``_pre_change`` for
-        ``equiv_quarantine_s``, so an equivocator cannot keep
-        poisoning CRDT state.  The verdict is a bounded WINDOW, not a
-        permanent severance: actor attribution is unsigned (mTLS
-        authenticates the channel, not the claimed origin of relayed
-        changesets), so a hostile relay could frame an honest actor —
-        an unbounded drop-all would let one forged message inflict
-        permanent divergence, worse than the attack it guards.  The
-        already-accepted first content stays: it is consistent
-        cluster-wide as long as it won every node's first arrival,
-        which the no-divergence checker verifies cross-node."""
+        further changesets drop at ``_pre_change``, so an equivocator
+        cannot keep poisoning CRDT state.
+
+        UNSIGNED evidence gets a bounded WINDOW
+        (``equiv_quarantine_s``), not a permanent severance: without a
+        verified signature, attribution rests on a forgeable actor id
+        (mTLS authenticates the channel, not the claimed origin of
+        relayed changesets), so a hostile relay could frame an honest
+        actor — an unbounded drop-all would let one forged message
+        inflict permanent divergence, worse than the attack it guards.
+
+        A verified signed ``proof`` (``_equiv_verdict``) removes that
+        caveat: only the origin's key could have produced the
+        conflicting pair, so the verdict becomes PERMANENT
+        (``quarantine_reason="signed_equivocation"``), persisted to
+        ``__corro_equiv_proofs`` so it survives this victim's restart.
+
+        The already-accepted first content stays either way: it is
+        consistent cluster-wide as long as it won every node's first
+        arrival, which the no-divergence checker verifies cross-node."""
         self.metrics.counter(
             "corro_sync_equivocations_total", kind=kind
         )
         hold = self.config.equiv_quarantine_s
         deadline = (self._clock.monotonic() + hold) if hold > 0 \
             else float("inf")
+        if proof is not None:
+            deadline = float("inf")
         with self._equiv_lock:
-            first = actor not in self._equiv_quarantined
-            self._equiv_quarantined[actor] = deadline
+            prev_deadline = self._equiv_quarantined.get(actor)
+            first = prev_deadline is None
+            # a signed proof escalates; a later unsigned observation
+            # must never SHORTEN a standing permanent verdict
+            if prev_deadline is None or deadline > prev_deadline \
+                    or proof is not None:
+                self._equiv_quarantined[actor] = deadline
+            # escalation = the FIRST proof over a standing unsigned
+            # verdict.  Tracked as a set, not inferred from the
+            # deadline: equiv_quarantine_s=0 gives unsigned verdicts
+            # an inf deadline too, and a proof must still relabel
+            # those to signed_equivocation
+            escalate = (proof is not None and not first
+                        and actor not in self._equiv_proofed)
+            if proof is not None:
+                self._equiv_proofed.add(actor)
+        reason = "signed_equivocation" if proof is not None \
+            else "equivocation"
         # per-VERDICT journal record (the drop-volume "quarantined"
         # kind stays counter-only: one line per dropped message would
         # flood the bounded ring during an attack)
         self._flight_event("equivocation", actor=actor.hex(), kind=kind)
-        if first:
+        if proof is not None:
+            self._persist_equiv_proof(actor, proof)
+        if first or escalate:
             logger.warning(
-                "equivocation detected (kind=%s) from %s: quarantining",
-                kind, actor.hex(),
+                "equivocation detected (kind=%s, %s) from %s: "
+                "quarantining", kind, reason, actor.hex(),
             )
-            self.members.set_quarantined(actor, True,
-                                         reason="equivocation")
+            self.members.set_quarantined(actor, True, reason=reason)
             self.metrics.counter(
                 "corro_members_quarantine_transitions_total",
-                state="equivocation",
+                state=reason,
             )
             self._flight_event(
                 "quarantine", actor=actor.hex(), on=True,
-                reason="equivocation",
+                reason=reason,
             )
+
+    def _persist_equiv_proof(self, actor: bytes, proof: tuple) -> None:
+        """Durably record a signed-equivocation proof (idempotent —
+        the first proof for an actor wins; re-offenses don't rewrite
+        it).  Best-effort like the digest write-through: persistence
+        failure must never break the verdict seam (the in-memory
+        deadline already went permanent)."""
+        v, kind, msg_a, sig_a, msg_b, sig_b = proof
+        try:
+            with self.storage._lock:
+                self.storage.conn.execute(
+                    "INSERT OR IGNORE INTO __corro_equiv_proofs"
+                    " (actor_id, version, kind, msg_a, sig_a,"
+                    "  msg_b, sig_b) VALUES (?, ?, ?, ?, ?, ?, ?)",
+                    (actor, v, kind, msg_a, sig_a, msg_b, sig_b),
+                )
+        except Exception:
+            logger.debug("equiv proof persist failed", exc_info=True)
 
     def _rebroadcast_hop(self, cv: ChangeV1, meta=None) -> int:
         """Hop count for re-gossiping a received payload: received hop
@@ -2809,19 +3399,23 @@ class Agent:
 
         ``rebroadcast=False`` when called from the change loop's worker
         thread — the loop requeues news itself on the event loop.
-        ``meta`` is the traced-envelope ``(traceparent, hop)`` receipt
-        context, when the payload carried one.  ``record_prov=False``
+        ``meta`` is the envelope receipt context ``(traceparent, hop,
+        sig, peer)``, when the payload carried one — ``sig`` is the
+        origin's Ed25519 signature from the signed envelope and
+        ``peer`` the delivering transport's address (the blame target
+        when that signature fails to verify).  ``record_prov=False``
         when the caller flushes the whole batch's provenance in one
         pass (``_record_provenance_many``).
         """
-        if not self._pre_change(cv, source):
+        if not self._pre_change(cv, source, meta):
             return False
-        news = self._process_changeset(cv, source)
+        news = self._process_changeset(cv, source, meta)
         self._post_change(cv, source, news, rebroadcast, meta=meta,
                           record_prov=record_prov)
         return news
 
-    def _pre_change(self, cv: ChangeV1, source: ChangeSource) -> bool:
+    def _pre_change(self, cv: ChangeV1, source: ChangeSource,
+                    meta=None) -> bool:
         """Hostile screen + dedup + clock ingestion ahead of applying;
         False = drop."""
         actor = cv.actor_id.bytes
@@ -2835,6 +3429,19 @@ class Agent:
                 self.metrics.counter(
                     "corro_sync_equivocations_total", kind="quarantined"
                 )
+                if actor in self._equiv_proofed:
+                    # permanent (signed-proof) verdicts re-assert the
+                    # Members flag: the record can postdate the boot
+                    # reload (e.g. a reborn node that re-learned the
+                    # proven actor through gossip).  Keyed on proof
+                    # state, not deadline==inf: equiv_quarantine_s=0
+                    # parks UNSIGNED verdicts at inf too, and those
+                    # must not masquerade as signed
+                    m = self.members.get(actor)
+                    if m is not None and not m.quarantined:
+                        self.members.set_quarantined(
+                            actor, True, reason="signed_equivocation"
+                        )
                 return False
             # verdict expired: re-admit (bounded blast radius for a
             # FRAMED honest actor — attribution is unsigned).  The
@@ -2867,7 +3474,8 @@ class Agent:
                 # conflicting re-send shares the (actor, version, seqs)
                 # key with the accepted content, so the duplicate path
                 # is exactly where conflicting contents hide
-                self._check_content_equivocation(actor, cv.changeset)
+                self._check_content_equivocation(actor, cv.changeset,
+                                                 meta)
                 return False
         # structural screen AFTER dedup: fanout duplicates drop on the
         # dict hit without paying the O(changes) span walk — a garbage
@@ -2876,7 +3484,19 @@ class Agent:
         if self.config.equivocation_detection:
             kind = self._screen_changeset(cv.changeset)
             if kind is not None:
-                self._note_equivocation(actor, kind)
+                self._equiv_verdict(actor, cv.changeset, kind, meta)
+                return False
+            # bounded random spot check (broadcast first arrivals
+            # only): the hot path stays verification-free unless the
+            # deterministic sample + minimum interval both admit it
+            sig = self._meta_sig(meta)
+            cs = cv.changeset
+            if (sig is not None and source is ChangeSource.BROADCAST
+                    and cs.is_full and cs.is_complete()
+                    and self._spot_check_due(actor, int(cs.version))
+                    and self._verify_changeset_sig(actor, cs, sig)
+                    is False):
+                self._blame_relay(self._meta_peer(meta))
                 return False
         # clock ingestion: a remote ts past max_delta_ns (the 300 ms
         # gossip clock-delta rule) is REJECTED — the merge raises and
@@ -2914,7 +3534,8 @@ class Agent:
             self._bcast_queue.put_nowait(
                 (cv, self.config.max_transmissions,
                  self._rebroadcast_hop(cv, meta),
-                 meta[0] if meta is not None else None)
+                 meta[0] if meta is not None else None,
+                 self._meta_sig(meta))
             )
         if news and self.on_change is not None:
             self.on_change(cv)
@@ -3012,16 +3633,17 @@ class Agent:
             self.metrics.counter("corro_trace_spans_total")
 
     def _process_changeset(self, cv: ChangeV1,
-                           source: ChangeSource = ChangeSource.SYNC
-                           ) -> bool:
+                           source: ChangeSource = ChangeSource.SYNC,
+                           meta=None) -> bool:
         # hold the storage lock across the have-it-already checks AND the
         # apply transaction: concurrent apply workers mutate the same
         # booked RangeSets, and those mutations are multi-step
         with self.storage._lock:
-            return self._process_changeset_locked(cv, source)
+            return self._process_changeset_locked(cv, source, meta)
 
     def _process_changeset_locked(self, cv: ChangeV1,
-                                  source: ChangeSource) -> bool:
+                                  source: ChangeSource,
+                                  meta=None) -> bool:
         actor = cv.actor_id.bytes
         cs = cv.changeset
         booked = self.bookie.for_actor(actor)
@@ -3061,7 +3683,7 @@ class Agent:
             # must be caught, byte-identical replays absorbed.
             # Broadcast scope only: see _check_content_equivocation
             if source is ChangeSource.BROADCAST:
-                self._check_content_equivocation(actor, cs)
+                self._check_content_equivocation(actor, cs, meta)
             return False
 
         if cs.is_complete():
@@ -3077,7 +3699,8 @@ class Agent:
             if (self.config.equivocation_detection
                     and source is ChangeSource.BROADCAST):
                 self._remember_digest(
-                    actor, v, _changes_digest(cs.changes)
+                    actor, v, _changes_digest(cs.changes),
+                    sig=self._meta_sig(meta),
                 )
             return True
 
@@ -3448,17 +4071,36 @@ class Agent:
                         SyncNeedV1.empty(known)
                     )
             q = deque()
+            # per-session need cap (Byzantine serve-path hardening,
+            # docs/faults.md): the 10-version chunking loop over a
+            # hostile server's lying head would otherwise allocate an
+            # unbounded queue BEFORE a single request goes out.
+            # Bounded work per session; what got cut is still in
+            # bookkeeping for future rounds against honest peers
+            capped = False
+            cap = self.SYNC_CLIENT_NEED_CAP
             for actor, actor_needs in needs.items():
                 for n in actor_needs:
                     if n.kind == "full":
                         lo, hi = n.versions
                         while lo <= hi:  # 10-version chunks (peer.rs:1285)
+                            if len(q) >= cap:
+                                capped = True
+                                break
                             q.append(
                                 (actor, SyncNeedV1.full(lo, min(lo + 9, hi)))
                             )
                             lo += 10
+                    elif len(q) >= cap:
+                        capped = True
                     else:
                         q.append((actor, n))
+                    if capped:
+                        break
+                if capped:
+                    break
+            if capped:
+                self._sync_client_reject("need_cap")
             queues.append(q)
             s["needs"] = {}
         while any(queues):
@@ -3492,6 +4134,49 @@ class Agent:
                     if out:
                         s["needs"].setdefault(actor, []).extend(out)
                         taken += 1
+
+    # -- Byzantine sync-serve client defenses (docs/faults.md) ---------
+
+    def _screen_sync_state(self, theirs: SyncStateV1) -> Optional[str]:
+        """Structural sanity screen on a sync SERVER's advertised
+        state — the serve-path mirror of ``_screen_changeset``.
+        Returns the reject reason or None.  A lying head past
+        ``SYNC_MAX_ADVERTISED_HEAD`` (no real history allocates a
+        version per nanosecond for millennia) or inverted need/seq
+        spans (the wire decoder rejects these; the in-process virtual
+        path hands the object straight over, so the screen must check
+        too) mark a hostile server whose serves cannot be trusted."""
+        for head in theirs.heads.values():
+            if int(head) >= self.SYNC_MAX_ADVERTISED_HEAD:
+                return "advertised_range"
+        for spans in theirs.need.values():
+            for s, e in spans:
+                if s < 0 or e < s:
+                    return "advertised_range"
+        for partials in theirs.partial_need.values():
+            for seq_spans in partials.values():
+                for s, e in seq_spans:
+                    if s < 0 or e < s:
+                        return "advertised_range"
+        return None
+
+    def _sync_client_reject(self, reason: str, addr=None,
+                            trip: bool = False,
+                            strike: bool = False) -> None:
+        """Count one client-side serve-path rejection
+        (``corro_sync_client_rejects_total{reason=}``); ``trip``
+        opens the peer's breaker — verified-garbage serves are
+        hostile, not flaky — while ``strike`` records one ordinary
+        breaker failure (ambiguous evidence like a session deadline:
+        `threshold` of them before quarantine)."""
+        self.metrics.counter(
+            "corro_sync_client_rejects_total", reason=reason
+        )
+        if addr is not None:
+            if trip:
+                self._trip_breaker(tuple(addr))
+            elif strike:
+                self._strike_breaker(tuple(addr))
 
     async def _sync_handshake(self, m: Member) -> Optional[dict]:
         """Open a bi-stream, send SyncStart + Clock, read the server's
@@ -3541,6 +4226,16 @@ class Agent:
                         except Exception:
                             pass
                     elif isinstance(msg, SyncStateV1):
+                        reason = self._screen_sync_state(msg)
+                        if reason is not None:
+                            # a structurally-lying advertised state is
+                            # hostile: refuse the whole session before
+                            # a single need is computed from it
+                            self._sync_client_reject(
+                                reason, tuple(m.addr), trip=True
+                            )
+                            writer.close()
+                            return None
                         # frames decoded after State in the same read
                         # (routinely the server's Clock) carry over to
                         # the session instead of being dropped
@@ -3709,13 +4404,61 @@ class Agent:
             # closes (EOF-terminated like the reference)
             if writer.can_write_eof():
                 writer.write_eof()
+            # Byzantine serve-path hardening (docs/faults.md): a
+            # whole-session deadline on the injected clock (each read
+            # has a 10 s timeout, so a slow-trickle server feeding one
+            # byte per window would otherwise hold the session — and
+            # its allocated needs — hostage forever), plus a budget of
+            # undecodable frames before the serve is judged hostile
+            # and the peer's breaker trips
+            deadline = None
+            if self.config.sync_session_deadline_s > 0:
+                deadline = (self._clock.monotonic()
+                            + self.config.sync_session_deadline_s)
+            frame_errs = 0
+            aborted = False
             while True:
-                data = await asyncio.wait_for(reader.read(65536), timeout=10.0)
+                read_timeout = 10.0
+                if deadline is not None:
+                    remaining = deadline - self._clock.monotonic()
+                    if remaining <= 0:
+                        # one STRIKE, not a hard trip: a blown session
+                        # deadline could be honest slowness, but enough
+                        # of them must stop the peer being re-selected
+                        # every round (the slow-trickle containment the
+                        # vcluster campaign seam already models)
+                        self._sync_client_reject(
+                            "deadline", tuple(m.addr), strike=True
+                        )
+                        aborted = True
+                        break
+                    read_timeout = min(read_timeout, remaining)
+                data = await asyncio.wait_for(
+                    reader.read(65536), timeout=read_timeout
+                )
                 if not data:
                     break  # server closed: session complete
                 live["bytes"] += len(data)
-                for payload in frames.feed(data):
-                    msg = speedy.decode_sync_message(payload)
+                try:
+                    payloads = frames.feed(data)
+                except speedy.SpeedyError:
+                    # oversized/corrupt framing: unrecoverable stream
+                    self._sync_client_reject(
+                        "frame_garbage", tuple(m.addr), trip=True
+                    )
+                    aborted = True
+                    break
+                for payload in payloads:
+                    try:
+                        msg = speedy.decode_sync_message(payload)
+                    except speedy.SpeedyError:
+                        frame_errs += 1
+                        self._sync_client_reject("frame_garbage")
+                        if frame_errs > self.SYNC_CLIENT_FRAME_BUDGET:
+                            self._trip_breaker(tuple(m.addr))
+                            aborted = True
+                            break
+                        continue
                     if isinstance(msg, Timestamp):
                         try:
                             self.clock.update_with_timestamp(msg)
@@ -3725,6 +4468,10 @@ class Agent:
                         await self._ingest_sync_change(msg)
                         count += 1
                         live["changes"] = count
+                if aborted:
+                    break
+            if aborted:
+                return count, False
             self.members.update_sync_ts(m.actor_id, self._clock.wall())
             self.metrics.counter("corro_sync_client_rounds_total")
             complete = True
@@ -3780,15 +4527,18 @@ class Agent:
     # ingest-queue slot (a junk burst must not evict real changesets).
     _UNI_PRELUDE = b"\x00" * 12
 
-    def enqueue_uni_payload(self, payload: bytes) -> None:
+    def enqueue_uni_payload(self, payload: bytes, peer=None) -> None:
         """Queue one RAW uni-stream payload for off-loop decoding: the
         event loop only deframes (+ a 12-byte tag sanity check); speedy
         decode happens in the apply worker pool (``_apply_batch``), so a
         burst of inbound gossip never blocks the loop on
         deserialization.  Same bounded drop-oldest policy as
-        ``enqueue_change``.  The traced envelope, if present, is walked
-        (fixed-offset arithmetic only — no string or change decode) so
-        the prelude screen applies to both wire formats."""
+        ``enqueue_change``.  The traced/signed envelope, if present, is
+        walked (fixed-offset arithmetic only — no string or change
+        decode) so the prelude screen applies to every wire format.
+        ``peer`` is the delivering transport's address, carried through
+        to the worker so a failed origin signature can blame the
+        delivery (docs/faults.md, signed attribution)."""
         off = 1 if self.config.debug_hops else 0
         try:
             start = speedy.traced_uni_payload_start(payload, off)
@@ -3798,26 +4548,29 @@ class Agent:
         if payload[start : start + 12] != self._UNI_PRELUDE:
             self.metrics.counter("corro_wire_decode_errors_total")
             return
-        self._enqueue_ingest(payload, None)
+        self._enqueue_ingest((payload, peer), None)
 
-    def _ingest_uni_payloads(self, payloads) -> None:
+    def _ingest_uni_payloads(self, payloads, peer=None) -> None:
         """Deframed uni payloads → ingest queue (shared by the
         dedicated uni stream server and the mux demux)."""
         for payload in payloads:
-            self.enqueue_uni_payload(payload)
+            self.enqueue_uni_payload(payload, peer)
 
     async def _serve_uni(self, reader, writer) -> None:
         """Long-lived inbound broadcast stream: speedy UniPayload frames
         (broadcast.rs:37-55) → ingest queue."""
         frames = speedy.FrameReader()
         ingest = self._ingest_uni_payloads
+        peer = writer.get_extra_info("peername")
+        if peer is not None:
+            peer = tuple(peer[:2])
 
         try:
             while True:
                 data = await reader.read(65536)
                 if not data:
                     return
-                ingest(frames.feed(data))
+                ingest(frames.feed(data), peer)
         except (OSError, ConnectionError, speedy.SpeedyError):
             return
         finally:
@@ -3835,6 +4588,19 @@ class Agent:
     SYNC_NEED_JOBS = 6  # concurrent need jobs per session (peer.rs:843)
     SYNC_MAX_PARTIAL_SPANS = 1024  # clamp hostile partial seqs lists
     SYNC_MAX_SESSION_NEEDS = 10_000  # total needs one session may request
+    # -- Byzantine sync-SERVE client hardening (docs/faults.md) --------
+    # the server-side caps above bound what a hostile CLIENT can cost a
+    # server; these bound what a hostile SERVER can cost a client:
+    # a head no real history could reach (one version per committed
+    # local transaction — a claim past 2^48 is a structural lie, and
+    # naively chunking it into 10-version requests would allocate
+    # ~10^13 needs)
+    SYNC_MAX_ADVERTISED_HEAD = 1 << 48
+    # max needs the client allocates toward ONE server session
+    SYNC_CLIENT_NEED_CAP = 10_000
+    # undecodable frames tolerated per session before the serve is
+    # definitively garbage and the peer's breaker trips
+    SYNC_CLIENT_FRAME_BUDGET = 3
     # batched serve pipeline (docs/sync.md): versions resolved/collected
     # per storage-lock window, and the byte budget one coalesced write
     # accumulates before draining when the session carries no adaptive

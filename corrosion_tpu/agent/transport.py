@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import random
+import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
@@ -162,12 +163,61 @@ class CircuitBreaker:
             return True
         return False
 
+    def trip(self, now: Optional[float] = None) -> bool:
+        """Force the breaker OPEN immediately (verified-hostile
+        evidence — tampered signed bytes, garbage sync serves — is not
+        ordinary flakiness worth ``threshold`` free strikes).  Returns
+        True when this call newly opened it; an already-open breaker
+        just restarts its cooldown."""
+        self.half_open_inflight = False
+        self.failures = self.threshold
+        was_closed = self.opened_at is None
+        self.opened_at = self._now() if now is None else now
+        return was_closed
+
     def state(self) -> str:
         if self.opened_at is None:
             return "closed"
         if self._now() - self.opened_at >= self.cooldown:
             return "half-open"
         return "open"
+
+
+def prune_breakers(breakers: dict, cap: int, on_evict=None) -> None:
+    """Bound a breaker registry at insert time, cheapest state first:
+    healthy entries (closed, no strikes), then closed entries with
+    partial strikes (member churn accrues these forever and losing a
+    strike count is cheap), then — because the survivors can ALL be
+    open: verified-hostile evidence (``runtime._trip_breaker``) mints
+    immediately-open breakers keyed by attacker-controlled ephemeral
+    source addresses, and ``is_open`` holds until a dial SUCCEEDS —
+    the oldest-OPENED entries go too.  A memory bound beats a perfect
+    memory of every hostile port; a live offender re-trips on its
+    next evidence.  ``on_evict(addr)`` fires for each evicted OPEN
+    entry: an open breaker carries live member-quarantine state, and
+    a fresh breaker minted later for the same address closes silently
+    (``record_success`` on a never-opened breaker reports no
+    transition), so the owner must lift the quarantine NOW or the
+    member strands deprioritized forever."""
+    if len(breakers) <= cap:
+        return
+    for a in [a for a, br in breakers.items()
+              if not br.is_open and br.failures == 0]:
+        del breakers[a]
+    if len(breakers) <= cap:
+        return
+    for a in [a for a, br in breakers.items() if not br.is_open]:
+        del breakers[a]
+        if len(breakers) <= cap:
+            return
+    by_age = sorted(
+        (a for a, br in breakers.items() if br.is_open),
+        key=lambda a: breakers[a].opened_at or 0.0,
+    )
+    for a in by_age[: len(breakers) - cap]:
+        del breakers[a]
+        if on_evict is not None:
+            on_evict(a)
 
 
 class UniConnection:
@@ -234,6 +284,11 @@ class Transport:
         self.breaker_threshold = breaker_threshold
         self.breaker_cooldown = breaker_cooldown
         self.breakers: Dict[Addr, CircuitBreaker] = {}
+        # registry mutation is NOT loop-affine: the apply workers'
+        # verified-hostile convictions (runtime._trip_breaker) insert
+        # from their pool threads while the loop's own _breaker does —
+        # prune's iteration must never race an insert
+        self.breakers_lock = threading.Lock()
         self.on_breaker = on_breaker  # callback(addr, opened: bool)
         # LRU cap on cached uni connections (the reference's QUIC conns
         # close on idle timeout; an unbounded TCP cache leaks fds in
@@ -269,19 +324,20 @@ class Transport:
     # -- degraded-mode plumbing -----------------------------------------
 
     def _breaker(self, addr: Addr) -> CircuitBreaker:
-        b = self.breakers.get(addr)
-        if b is None:
-            # bound the map like the stats cache: evict healthy
-            # (closed, no strikes) entries first — open breakers carry
-            # live quarantine state and must survive the sweep
-            if len(self.breakers) > 4 * self.max_cached:
-                for a in [a for a, br in self.breakers.items()
-                          if not br.is_open and br.failures == 0]:
-                    del self.breakers[a]
-            b = self.breakers[addr] = CircuitBreaker(
-                self.breaker_threshold, self.breaker_cooldown,
-                now=self._clock.monotonic,
-            )
+        with self.breakers_lock:
+            b = self.breakers.get(addr)
+            if b is None:
+                prune_breakers(
+                    self.breakers, 4 * self.max_cached,
+                    on_evict=(
+                        None if self.on_breaker is None
+                        else lambda a: self.on_breaker(a, False)
+                    ),
+                )
+                b = self.breakers[addr] = CircuitBreaker(
+                    self.breaker_threshold, self.breaker_cooldown,
+                    now=self._clock.monotonic,
+                )
         return b
 
     def _breaker_success(self, addr: Addr) -> None:
@@ -313,7 +369,9 @@ class Transport:
         return act
 
     def breaker_states(self) -> Dict[Addr, str]:
-        return {a: b.state() for a, b in self.breakers.items()}
+        with self.breakers_lock:
+            snapshot = list(self.breakers.items())
+        return {a: b.state() for a, b in snapshot}
 
     async def _open(self, addr: Addr, header: bytes) -> UniConnection:
         t0 = self._clock.monotonic()

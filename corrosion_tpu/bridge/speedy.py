@@ -436,6 +436,14 @@ def decode_uni_payload(data: bytes) -> UniPayload:
 # reference-byte-exact wire output (receivers accept both regardless).
 
 TRACED_UNI_VERSION = 1
+# signed attribution envelope (docs/faults.md): the traced layout plus
+# one more Option field — a raw 64-byte Ed25519 signature over the
+# changeset's canonical identity (types/crypto.py; the signing message
+# is built by agent/runtime.py sig_message).  Emitted only when the
+# origin is configured with a signing key, so an unsigned deployment's
+# wire stays byte-exact vs the v0/v1 formats.
+SIGNED_UNI_VERSION = 2
+SIG_BYTES = 64
 # traceparent is 55 chars; anything longer is junk, reject before it
 # can bloat frames or the span ring
 MAX_TRACEPARENT_LEN = 64
@@ -452,72 +460,123 @@ def encode_traced_uni(payload: bytes, traceparent: Optional[str] = None,
     return w.getvalue()
 
 
-def decode_traced_uni(data: bytes) -> Tuple[bytes, Optional[str], int]:
-    """``(classic_payload, traceparent, hop)`` from either wire format.
+def encode_signed_uni(payload: bytes, traceparent: Optional[str] = None,
+                      hop: int = 0, sig: Optional[bytes] = None) -> bytes:
+    """Wrap classic UniPayload bytes in the SIGNED envelope (v2):
+    ``u8 2 | u8 hop | Option<traceparent> | Option<[u8;64] sig> |
+    UniPayload``.  ``sig`` rides as 64 raw bytes (speedy ``[u8; N]``
+    layout, no length prefix)."""
+    if sig is not None and len(sig) != SIG_BYTES:
+        raise SpeedyError(
+            f"signature must be {SIG_BYTES} bytes, got {len(sig)}"
+        )
+    w = Writer()
+    w.u8(SIGNED_UNI_VERSION)
+    w.u8(min(max(int(hop), 0), 255))
+    w.opt(traceparent, w.s)
+    w.opt(sig, w.raw)
+    w.raw(payload)
+    return w.getvalue()
 
-    Classic payloads (first byte 0x00) pass through with no trace
-    context; unknown envelope versions raise SpeedyError."""
-    if not data:
-        raise SpeedyError("empty uni payload")
-    if data[0] == 0:
-        return data, None, 0
-    if data[0] != TRACED_UNI_VERSION:
-        raise SpeedyError(f"unknown traced-uni version {data[0]}")
-    r = Reader(data, pos=1)
-    hop = r.u8()
+
+def _read_traceparent(r: Reader) -> Optional[str]:
     # strict Option tag, matching traced_uni_payload_start: the walker
     # and the decoder must accept the SAME byte set or the live path's
     # prelude screen and the det scheduler diverge on hostile frames
     flag = r.u8()
     if flag == 0:
-        tp = None
-    elif flag == 1:
-        # bound in BYTES (the u32 length prefix), exactly like
-        # traced_uni_payload_start — bounding the decoded char count
-        # instead would let a multi-byte-UTF-8 traceparent pass here
-        # while the walker rejects the same frame, and live ingest
-        # (which screens via the walker) would diverge from the det
-        # scheduler on identical bytes
-        raw = r.lp_bytes()
-        if len(raw) > MAX_TRACEPARENT_LEN:
-            raise SpeedyError("oversized traceparent")
-        try:
-            tp = raw.decode("utf-8")
-        except UnicodeDecodeError as e:
-            # keep the SpeedyError contract: a raw UnicodeDecodeError
-            # would escape callers' `except SpeedyError` handling
-            raise SpeedyError(f"invalid traceparent utf-8: {e}") from None
-    else:
+        return None
+    if flag != 1:
         raise SpeedyError(f"bad Option tag {flag}")
-    return data[r.pos:], tp, hop
+    # bound in BYTES (the u32 length prefix), exactly like
+    # traced_uni_payload_start — bounding the decoded char count
+    # instead would let a multi-byte-UTF-8 traceparent pass here
+    # while the walker rejects the same frame, and live ingest
+    # (which screens via the walker) would diverge from the det
+    # scheduler on identical bytes
+    raw = r.lp_bytes()
+    if len(raw) > MAX_TRACEPARENT_LEN:
+        raise SpeedyError("oversized traceparent")
+    try:
+        return raw.decode("utf-8")
+    except UnicodeDecodeError as e:
+        # keep the SpeedyError contract: a raw UnicodeDecodeError
+        # would escape callers' `except SpeedyError` handling
+        raise SpeedyError(f"invalid traceparent utf-8: {e}") from None
+
+
+def decode_uni_envelope(
+    data: bytes,
+) -> Tuple[bytes, Optional[str], int, Optional[bytes]]:
+    """``(classic_payload, traceparent, hop, sig)`` from any wire
+    format: classic (0x00), traced (0x01) or signed (0x02).  Unknown
+    envelope versions raise SpeedyError."""
+    if not data:
+        raise SpeedyError("empty uni payload")
+    if data[0] == 0:
+        return data, None, 0, None
+    if data[0] not in (TRACED_UNI_VERSION, SIGNED_UNI_VERSION):
+        raise SpeedyError(f"unknown traced-uni version {data[0]}")
+    r = Reader(data, pos=1)
+    hop = r.u8()
+    tp = _read_traceparent(r)
+    sig = None
+    if data[0] == SIGNED_UNI_VERSION:
+        flag = r.u8()
+        if flag == 1:
+            sig = r.raw(SIG_BYTES)
+        elif flag != 0:
+            raise SpeedyError(f"bad Option tag {flag}")
+    return data[r.pos:], tp, hop, sig
+
+
+def decode_traced_uni(data: bytes) -> Tuple[bytes, Optional[str], int]:
+    """``(classic_payload, traceparent, hop)`` from any wire format —
+    the pre-signing surface, kept for callers that don't carry the
+    signature (the signature, if any, is dropped)."""
+    payload, tp, hop, _sig = decode_uni_envelope(data)
+    return payload, tp, hop
 
 
 def traced_uni_payload_start(data: bytes, off: int = 0) -> int:
     """Offset of the classic UniPayload bytes inside ``data`` — the
     cheap event-loop-side check (no string decode, no change decode)
     that lets the ingest queue's 12-byte tag prelude screen work on
-    both wire formats.  Raises SpeedyError on a malformed envelope."""
+    every wire format (classic/traced/signed).  Raises SpeedyError on
+    a malformed envelope."""
     if off >= len(data):
         raise SpeedyError("empty uni payload")
-    if data[off] == 0:
+    version = data[off]
+    if version == 0:
         return off
-    if data[off] != TRACED_UNI_VERSION:
-        raise SpeedyError(f"unknown traced-uni version {data[off]}")
+    if version not in (TRACED_UNI_VERSION, SIGNED_UNI_VERSION):
+        raise SpeedyError(f"unknown traced-uni version {version}")
     pos = off + 2  # version + hop
     if pos >= len(data):
         raise SpeedyError("truncated traced-uni envelope")
     flag = data[pos]
     pos += 1
-    if flag == 0:
-        return pos
-    if flag != 1:
+    if flag == 1:
+        if pos + 4 > len(data):
+            raise SpeedyError("truncated traceparent length")
+        (n,) = struct.unpack_from("<I", data, pos)
+        if n > MAX_TRACEPARENT_LEN:
+            raise SpeedyError("oversized traceparent")
+        pos += 4 + n
+    elif flag != 0:
         raise SpeedyError(f"bad Option tag {flag}")
-    if pos + 4 > len(data):
-        raise SpeedyError("truncated traceparent length")
-    (n,) = struct.unpack_from("<I", data, pos)
-    if n > MAX_TRACEPARENT_LEN:
-        raise SpeedyError("oversized traceparent")
-    return pos + 4 + n
+    if version == SIGNED_UNI_VERSION:
+        if pos >= len(data):
+            raise SpeedyError("truncated signed-uni envelope")
+        flag = data[pos]
+        pos += 1
+        if flag == 1:
+            if pos + SIG_BYTES > len(data):
+                raise SpeedyError("truncated signature")
+            pos += SIG_BYTES
+        elif flag != 0:
+            raise SpeedyError(f"bad Option tag {flag}")
+    return pos
 
 
 def encode_bi_payload(p: BiPayload, cluster_id: ClusterId = ClusterId(0)) -> bytes:

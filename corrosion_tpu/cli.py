@@ -344,12 +344,34 @@ def cmd_db_lock(args) -> int:
     return run_locked(args.db_path, args.command, timeout_s=args.timeout)
 
 
+def _tls_generate(make_pair) -> int:
+    """Run one cert-generation step with the dependency surfaced as an
+    actionable message: ``agent/tls.py`` imports ``cryptography``
+    lazily inside the generators, so on hosts without the package a
+    bare ``corrosion-tpu tls ... generate`` used to die with a raw
+    ModuleNotFoundError traceback instead of saying what to install.
+    (Only cert GENERATION needs it — serving TLS from existing PEM
+    files is pure stdlib ``ssl``.)"""
+    try:
+        cert, key = make_pair()
+    except ImportError as e:
+        print(
+            "error: TLS certificate generation requires the "
+            "'cryptography' package, which is not installed "
+            f"({e}).\nInstall it with:  pip install cryptography\n"
+            "(running an agent with EXISTING cert/key files needs "
+            "only the stdlib)",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"wrote {cert} and {key}")
+    return 0
+
+
 def cmd_tls_ca(args) -> int:
     from corrosion_tpu.agent.tls import generate_ca
 
-    cert, key = generate_ca(args.dir, days=args.days)
-    print(f"wrote {cert} and {key}")
-    return 0
+    return _tls_generate(lambda: generate_ca(args.dir, days=args.days))
 
 
 def cmd_tls_server(args) -> int:
@@ -357,14 +379,12 @@ def cmd_tls_server(args) -> int:
 
     from corrosion_tpu.agent.tls import generate_server_cert
 
-    cert, key = generate_server_cert(
+    return _tls_generate(lambda: generate_server_cert(
         args.dir,
         args.ca_cert or os.path.join(args.dir, "ca.crt"),
         args.ca_key or os.path.join(args.dir, "ca.key"),
         args.names, days=args.days,
-    )
-    print(f"wrote {cert} and {key}")
-    return 0
+    ))
 
 
 def cmd_tls_client(args) -> int:
@@ -372,14 +392,12 @@ def cmd_tls_client(args) -> int:
 
     from corrosion_tpu.agent.tls import generate_client_cert
 
-    cert, key = generate_client_cert(
+    return _tls_generate(lambda: generate_client_cert(
         args.dir,
         args.ca_cert or os.path.join(args.dir, "ca.crt"),
         args.ca_key or os.path.join(args.dir, "ca.key"),
         days=args.days,
-    )
-    print(f"wrote {cert} and {key}")
-    return 0
+    ))
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -516,7 +516,8 @@ class EquivocatingPeer:
     """
 
     def __init__(self, seed: int = 0, table: str = "tests",
-                 now_ns: Optional[Callable[[], int]] = None):
+                 now_ns: Optional[Callable[[], int]] = None,
+                 sig_secret: Optional[bytes] = None):
         self.seed = seed
         self.table = table
         # injectable craft-time clock (the Clock seam): a virtual-time
@@ -526,7 +527,54 @@ class EquivocatingPeer:
         self.actor_id = hashlib.blake2b(
             f"equivocator:{seed}".encode(), digest_size=16
         ).digest()
+        # optional Ed25519 identity (types/crypto.py): a KEYED hostile
+        # origin — the insider-gone-rogue shape — signs its crafted
+        # changesets, so its conflicting pairs become the persistable
+        # signed-equivocation proofs the permanent verdict requires
+        self.sig_secret = sig_secret
         self._version = 0
+
+    @property
+    def sig_public(self) -> Optional[bytes]:
+        if self.sig_secret is None:
+            return None
+        from corrosion_tpu.types import crypto
+
+        return crypto.public_key(self.sig_secret)
+
+    def sign_changeset(self, cv) -> Optional[bytes]:
+        """The origin signature for a crafted changeset (None when
+        this peer is unkeyed)."""
+        if self.sig_secret is None:
+            return None
+        from corrosion_tpu.agent.runtime import sig_message
+        from corrosion_tpu.types import crypto
+
+        return crypto.sign(
+            self.sig_secret,
+            sig_message(cv.actor_id.bytes, cv.changeset),
+        )
+
+    def tampered_copy(self, cv, text: str):
+        """A relay-tampered variant of ``cv``: identical claimed
+        (actor, version, seqs, last_seq, ts) — the metadata a passed-
+        through signature binds — with the cell contents rewritten.
+        The framing-relay attack: delivered with the ORIGINAL
+        signature, it must convict the delivering transport, never
+        the named origin."""
+        from dataclasses import replace
+
+        from corrosion_tpu.types import Changeset, ChangeV1
+
+        cs = cv.changeset
+        changes = tuple(
+            replace(ch, val=text) for ch in cs.changes
+        )
+        return ChangeV1(
+            cv.actor_id,
+            Changeset.full(cs.version, changes, cs.seqs, cs.last_seq,
+                           cs.ts),
+        )
 
     def _ts(self):
         from corrosion_tpu.types.hlc import Timestamp
@@ -605,3 +653,109 @@ class EquivocatingPeer:
             seqs=(CrsqlSeq(0), CrsqlSeq(2**40)),
             last_seq=CrsqlSeq(2**40),
         )
+
+
+class ByzantineSyncServer:
+    """A hostile anti-entropy SERVER: the serve-path sibling of
+    :class:`EquivocatingPeer` (which attacks with hostile changesets;
+    this attacks with hostile needs/ranges/frames on the sync session
+    itself).  One instance plays one attack ``mode``:
+
+    * ``lying_ranges``   — advertises a head past any real history
+      (``SYNC_MAX_ADVERTISED_HEAD``); a naive client would chunk it
+      into ~10^13 need requests.  Defense: the advertised-state screen
+      refuses the session outright;
+    * ``absurd_needs``   — advertises inverted need/seq spans (the
+      wire decoder rejects these; the screen covers the in-process
+      path).  Same defense;
+    * ``huge_head``      — a head that passes the structural screen
+      but is far beyond anything it can serve.  Defense: the
+      per-session need cap bounds allocation;
+    * ``garbage_frames`` — well-framed, undecodable payload bytes.
+      Defense: the frame-validation budget, then the breaker;
+    * ``oversized_frame``— a length prefix past ``MAX_FRAME_LEN``.
+      Defense: the deframer rejects the stream, breaker trips;
+    * ``slow_trickle``   — a serve that never completes (one byte per
+      read-timeout window).  Defense: the Clock-driven session
+      deadline;
+    * ``conflicting_reserve`` — unsolicited re-serves of versions the
+      clients already hold, with tampered contents.  Defense: the
+      version-ledger dedup drops them (sync re-serves are outside the
+      digest defense by design — docs/faults.md).  Fresh hostile
+      versions minted under the server's OWN id remain a named
+      residual: only signed sync frames could close it, and the
+      campaign scopes the mode to re-serves.
+
+    All crafted bytes derive from ``seed`` (+ the injectable clock for
+    timestamps), so virtual campaigns replay byte-identically.
+    """
+
+    MODES = (
+        "lying_ranges", "absurd_needs", "huge_head", "garbage_frames",
+        "oversized_frame", "slow_trickle", "conflicting_reserve",
+    )
+
+    def __init__(self, seed: int = 0, mode: str = "lying_ranges",
+                 now_ns: Optional[Callable[[], int]] = None,
+                 reserve_source: Optional[EquivocatingPeer] = None):
+        if mode not in self.MODES:
+            raise ValueError(f"unknown byzantine mode {mode!r}")
+        self.seed = seed
+        self.mode = mode
+        self.now_ns = now_ns
+        self.actor_id = hashlib.blake2b(
+            f"byzserver:{seed}:{mode}".encode(), digest_size=16
+        ).digest()
+        # the hostile actor whose accepted versions the
+        # conflicting_reserve mode re-serves tampered
+        self.reserve_source = reserve_source
+
+    def advertised_state(self):
+        """The SyncStateV1 this server hands a handshaking client."""
+        from corrosion_tpu.types.actor import ActorId
+        from corrosion_tpu.types.base import Version
+        from corrosion_tpu.types.payload import SyncStateV1
+
+        st = SyncStateV1(actor_id=ActorId(self.actor_id))
+        if self.mode == "lying_ranges":
+            st.heads[ActorId(self.actor_id)] = Version(1 << 52)
+        elif self.mode == "absurd_needs":
+            st.heads[ActorId(self.actor_id)] = Version(4)
+            st.need[ActorId(self.actor_id)] = [(9, 2)]  # inverted
+        elif self.mode == "huge_head":
+            # below the structural-lie line, far above anything real:
+            # the client's need cap must bound the allocation
+            st.heads[ActorId(self.actor_id)] = Version((1 << 48) - 1)
+        # garbage/oversized/trickle/reserve modes look innocuous at
+        # handshake time — the attack is in the serve bytes
+        return st
+
+    def serve_duration(self) -> float:
+        """Virtual seconds the serve would take to complete — the
+        slow-trickle mode never finishes inside any sane deadline."""
+        return 1e6 if self.mode == "slow_trickle" else 0.01
+
+    def serve_frames(self, needs) -> bytes:
+        """The served byte stream for the client's allocated needs."""
+        import struct as _struct
+
+        from corrosion_tpu.bridge import speedy
+
+        if self.mode == "garbage_frames":
+            junk = hashlib.blake2b(
+                f"byzjunk:{self.seed}".encode(), digest_size=32
+            ).digest()
+            return b"".join(
+                speedy.frame(junk + bytes([i])) for i in range(4)
+            )
+        if self.mode == "oversized_frame":
+            return _struct.pack(">I", speedy.MAX_FRAME_LEN + 1) + b"\x00"
+        if self.mode == "conflicting_reserve" \
+                and self.reserve_source is not None:
+            src = self.reserve_source
+            out = []
+            for v in range(1, src._version + 1):
+                cv = src._changeset(v, 9100, f"byz-reserve-{v}")
+                out.append(speedy.frame(speedy.encode_sync_message(cv)))
+            return b"".join(out)
+        return b""

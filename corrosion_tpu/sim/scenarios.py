@@ -609,24 +609,42 @@ async def run_scenarios(
 # ---------------------------------------------------------------------------
 
 #: scale-only fault families — restart storms, hostile-fraction sweeps
-#: ("Simulating BFT Protocol Implementations at Scale", PAPERS.md), and
-#: compound cells composing matrix faults with crash schedules
+#: ("Simulating BFT Protocol Implementations at Scale", PAPERS.md),
+#: compound cells composing matrix faults with crash schedules, and
+#: the signed-attribution/Byzantine-sync-serve cells (docs/faults.md):
+#: framing_relay is the headline NEGATIVE control — a tampering relay
+#: is convicted while the framed honest origin is quarantined on zero
+#: nodes — signed_equivocator proves the permanent (restart-surviving)
+#: verdict, byz_sync_server proves the serve-path client defenses, and
+#: hostile_sweep_32_signed re-runs the 32-hostile sweep with keyed
+#: hostiles so every verdict lands as a signed proof
 SCALE_FAMILIES = (
     "restart_storm",
     "hostile_sweep_8",
     "hostile_sweep_32",
     "equiv_during_heal",
     "skew_during_restart",
+    "framing_relay",
+    "signed_equivocator",
+    "byz_sync_server",
+    "hostile_sweep_32_signed",
 )
 
 VIRTUAL_FAMILIES = FAMILIES + SCALE_FAMILIES
 
+#: cells that run on a SIGNED cluster (per-node Ed25519 keypairs, one
+#: shared trust directory, spot checks on)
+SIGNED_FAMILIES = (
+    "framing_relay", "signed_equivocator", "hostile_sweep_32_signed",
+)
+
 
 def _hostile_count(family: str) -> int:
-    if family in ("equivocation", "equiv_during_heal"):
+    if family in ("equivocation", "equiv_during_heal",
+                  "signed_equivocator"):
         return 1
     if family.startswith("hostile_sweep_"):
-        return int(family.rsplit("_", 1)[1])
+        return int(family.split("_")[2])
     return 0
 
 
@@ -652,8 +670,18 @@ def build_virtual_plan(family: str, seed: int, heal_after: float,
             for j in range(k)
         )
         return FaultPlan(seed=seed, crashes=crashes)
-    if family in ("hostile_sweep_8", "hostile_sweep_32"):
+    if family in ("hostile_sweep_8", "hostile_sweep_32",
+                  "hostile_sweep_32_signed", "framing_relay",
+                  "byz_sync_server"):
         return FaultPlan(seed=seed)
+    if family == "signed_equivocator":
+        # the victim restart that proves the persisted proof re-arms:
+        # timed well past the attack script (which runs at virtual
+        # t≈0) and before the convergence check waits it out
+        return FaultPlan(
+            seed=seed,
+            crashes=(CrashEvent("n3", at=0.6, restart_at=1.2),),
+        )
     if family == "equiv_during_heal":
         return FaultPlan(
             seed=seed, partition_blocks=2, heal_after=heal_after
@@ -678,13 +706,22 @@ def build_virtual_plan(family: str, seed: int, heal_after: float,
 
 def _virtual_hostile_attack(c, seed: int, k: int,
                             mid_heal: bool = False,
-                            heal_after: float = 0.0) -> Dict:
+                            heal_after: float = 0.0,
+                            signed: bool = False) -> Dict:
     """The equivocating-peer script on virtual time, for ``k``
     simultaneous hostiles (the hostile-fraction sweep): per hostile —
     bait → conflicting re-send → replayed duplicate; one extra
     span-garbage actor covers the structural screen.  ``mid_heal``
     delays the conflicting re-sends until just before the partition
-    heals (the equivocation-during-partition-heal compound cell)."""
+    heals (the equivocation-during-partition-heal compound cell).
+
+    ``signed=True`` gives every hostile a REGISTERED Ed25519 keypair
+    (the insider-gone-rogue shape) and signs every crafted delivery:
+    the conflicting pair then verifies as a signed-equivocation PROOF
+    and the quarantine goes permanent
+    (``quarantine_reason="signed_equivocation"``).  The span-garbage
+    actor stays unkeyed either way, pinning the bounded-window verdict
+    next to the permanent one in a single cell."""
     from corrosion_tpu.faults import EquivocatingPeer
     from corrosion_tpu.types import ChangeSource
 
@@ -693,15 +730,31 @@ def _virtual_hostile_attack(c, seed: int, k: int,
     # not relay throughput: multi-hostile waves deliver point-to-point
     # (the matrix's single-equivocator family keeps relay on)
     relay = k == 1
-    hostiles = [
-        EquivocatingPeer(seed=seed + 1 + h, now_ns=c.clock.wall_ns)
-        for h in range(k)
-    ]
+    hostiles = []
+    for h in range(k):
+        sig_secret = None
+        if signed:
+            from corrosion_tpu.types.crypto import seed_keypair
+
+            sig_secret, pub = seed_keypair(
+                f"vhostile:{seed}:{h}".encode()
+            )
+        peer = EquivocatingPeer(
+            seed=seed + 1 + h, now_ns=c.clock.wall_ns,
+            sig_secret=sig_secret,
+        )
+        if signed:
+            c.register_pubkey(peer.actor_id, pub)
+        hostiles.append(peer)
     spanner = EquivocatingPeer(seed=seed + 5000, now_ns=c.clock.wall_ns)
     for a in c.agents.values():
         for h, peer in enumerate(hostiles):
             a.members.upsert(peer.actor_id, ("hostile", h))
         a.members.upsert(spanner.actor_id, ("hostile", 9999))
+
+    def _inject(cv, peer, source):
+        c.inject(all_idx, cv, source, rebroadcast=relay,
+                 sig=peer.sign_changeset(cv))
 
     def all_contain(actor, version):
         return all(
@@ -711,7 +764,7 @@ def _virtual_hostile_attack(c, seed: int, k: int,
 
     # 1. bait: a well-formed version per hostile, accepted everywhere
     for peer in hostiles:
-        c.inject(all_idx, peer.honest(9100, "bait"), ChangeSource.BROADCAST, rebroadcast=relay)
+        _inject(peer.honest(9100, "bait"), peer, ChangeSource.BROADCAST)
     assert c.run_until_true(
         lambda: all(all_contain(p.actor_id, 1) for p in hostiles),
         timeout=20,
@@ -721,8 +774,8 @@ def _virtual_hostile_attack(c, seed: int, k: int,
     #    re-claims it on the gossip path (optionally timed to land
     #    around the partition heal)
     pairs = [p.conflicting_pair(9101) for p in hostiles]
-    for a_cv, _b in pairs:
-        c.inject(all_idx, a_cv, ChangeSource.BROADCAST, rebroadcast=relay)
+    for (a_cv, _b), peer in zip(pairs, hostiles):
+        _inject(a_cv, peer, ChangeSource.BROADCAST)
     assert c.run_until_true(
         lambda: all(all_contain(p.actor_id, 2) for p in hostiles),
         timeout=20,
@@ -732,14 +785,14 @@ def _virtual_hostile_attack(c, seed: int, k: int,
         gap = heal_after - c.clock.monotonic() - 0.05
         if gap > 0:
             c.run_for(gap)
-    for _a, b_cv in pairs:
-        c.inject(all_idx, b_cv, ChangeSource.BROADCAST, rebroadcast=relay)
+    for (_a, b_cv), peer in zip(pairs, hostiles):
+        _inject(b_cv, peer, ChangeSource.BROADCAST)
     # replayed duplicates of the ACCEPTED content: absorbed, never
     # counted (split across both detection paths like the live cell)
-    for i, (a_cv, _b) in enumerate(pairs):
+    for i, ((a_cv, _b), peer) in enumerate(zip(pairs, hostiles)):
         src = (ChangeSource.BROADCAST if i % 2 == 0
                else ChangeSource.SYNC)
-        c.inject(all_idx, a_cv, src, rebroadcast=relay)
+        _inject(a_cv, peer, src)
 
     # 3. garbage seq spans (screened before any buffering)
     c.inject(all_idx, spanner.garbage_span(9102), ChangeSource.BROADCAST, rebroadcast=relay)
@@ -759,17 +812,154 @@ def _virtual_hostile_attack(c, seed: int, k: int,
 
     # 5. post-quarantine probe: fresh well-formed traffic must DROP
     posts = [p.honest(9104, "post-quarantine") for p in hostiles]
-    for post in posts:
-        c.inject(all_idx, post, ChangeSource.BROADCAST, rebroadcast=relay)
+    for post, peer in zip(posts, hostiles):
+        _inject(post, peer, ChangeSource.BROADCAST)
     c.run_for(0.2)
     return {
         "hostiles": [p.actor_id.hex() for p in hostiles],
+        "hostile_peers": hostiles,
         "span_actor": spanner.actor_id.hex(),
         "hostile_actors": actors,
+        "keyed_actors": [p.actor_id for p in hostiles] if signed else [],
         "quarantined_everywhere": quarantined_ok,
         "post_quarantine_version": int(
             posts[0].changeset.version
         ) if posts else None,
+    }
+
+
+def _virtual_framing_relay(c, seed: int, relay_idx: int = 1,
+                           waves: int = 4) -> Dict:
+    """The headline NEGATIVE control (docs/faults.md, signed
+    attribution): an honest keyed origin's signed waves converge, then
+    a tampering relay — a real cluster node's transport identity —
+    re-delivers every wave with the contents rewritten but the
+    ORIGINAL signature attached.  Every node's digest screen fires on
+    the conflict, verification fails, and blame must land on the
+    DELIVERING relay: the honest origin is quarantined on ZERO nodes."""
+    from corrosion_tpu.faults import EquivocatingPeer
+    from corrosion_tpu.types import ChangeSource
+    from corrosion_tpu.types.crypto import seed_keypair
+
+    sec, pub = seed_keypair(f"vframing-origin:{seed}".encode())
+    origin = EquivocatingPeer(
+        seed=seed + 7_000, now_ns=c.clock.wall_ns, sig_secret=sec,
+    )
+    c.register_pubkey(origin.actor_id, pub)
+    for a in c.agents.values():
+        a.members.upsert(origin.actor_id, ("honest", 7))
+    all_idx = list(range(c.n))
+    relay_addr = ("virt", relay_idx)
+    # everyone except the relay receives the tampered re-delivery (a
+    # node cannot be its own delivering transport)
+    victims = [i for i in all_idx if i != relay_idx]
+
+    def all_contain(version):
+        return all(
+            a.bookie.for_actor(origin.actor_id).contains_version(version)
+            for nm, a in c.agents.items() if nm not in c._crashed
+        )
+
+    # 1. the honest signed waves, accepted everywhere
+    cvs = []
+    for w in range(waves):
+        cv = origin.honest(9300 + w, f"honest-{w}")
+        cvs.append(cv)
+        c.inject(all_idx, cv, ChangeSource.BROADCAST,
+                 rebroadcast=False, sig=origin.sign_changeset(cv))
+    assert c.run_until_true(
+        lambda: all(all_contain(int(cv.changeset.version))
+                    for cv in cvs),
+        timeout=20,
+    ), "honest waves did not reach every node"
+
+    # 2. the tampering relay: rewritten contents, original signature,
+    #    delivery attributed to the relay node's transport address
+    for w, cv in enumerate(cvs):
+        tampered = origin.tampered_copy(cv, f"tampered-{w}")
+        c.inject(victims, tampered, ChangeSource.BROADCAST,
+                 rebroadcast=False, sig=origin.sign_changeset(cv),
+                 peer=relay_addr)
+    c.run_for(0.3)
+    return {
+        "origin": origin.actor_id.hex(),
+        "origin_actor": origin.actor_id,
+        "relay": f"n{relay_idx}",
+        "relay_addr": relay_addr,
+        "victims": victims,
+        "waves": waves,
+    }
+
+
+#: Byzantine sync-server mode → the client-reject reason its defense
+#: must produce (None = contained by dedup, no reject counter)
+BYZ_MODE_REASONS = {
+    "lying_ranges": "advertised_range",
+    "absurd_needs": "advertised_range",
+    "huge_head": "need_cap",
+    "garbage_frames": "frame_garbage",
+    "oversized_frame": "frame_garbage",
+    "slow_trickle": "deadline",
+    "conflicting_reserve": None,
+}
+
+
+def _virtual_byz_sync(c, seed: int) -> Dict:
+    """The Byzantine sync-SERVER cell script: one hostile server per
+    attack mode (real cluster nodes n1..n7 whose serve path is played
+    by ``faults.ByzantineSyncServer``), plus a phantom honest wave the
+    conflicting_reserve mode re-serves tampered.  Each mode is driven
+    against three deterministic clients explicitly (organic sync
+    rounds hit the hostile servers too, but the campaign must not
+    depend on sampling luck), and containment comes entirely from the
+    client-side defenses."""
+    from corrosion_tpu.faults import ByzantineSyncServer, EquivocatingPeer
+    from corrosion_tpu.types import ChangeSource
+
+    # the phantom wave every client holds, for tampered re-serves
+    source = EquivocatingPeer(seed=seed + 8_000, now_ns=c.clock.wall_ns)
+    for a in c.agents.values():
+        a.members.upsert(source.actor_id, ("honest", 8))
+    all_idx = list(range(c.n))
+    for w in range(2):
+        c.inject(all_idx, source.honest(9400 + w, f"reserve-src-{w}"),
+                 ChangeSource.BROADCAST, rebroadcast=False)
+    assert c.run_until_true(
+        lambda: all(
+            a.bookie.for_actor(source.actor_id).contains_version(2)
+            for nm, a in c.agents.items() if nm not in c._crashed
+        ),
+        timeout=20,
+    ), "reserve-source wave did not reach every node"
+
+    modes = list(ByzantineSyncServer.MODES)
+    servers = {}
+    for k, mode in enumerate(modes):
+        name = f"n{k + 1}"
+        servers[name] = ByzantineSyncServer(
+            seed=seed, mode=mode, now_ns=c.clock.wall_ns,
+            reserve_source=source,
+        )
+    c.byz_servers.update(servers)
+
+    # deterministic coverage: three clients per mode run one hostile
+    # session each, through the SAME seam organic rounds use
+    for k, (name, byz) in enumerate(sorted(servers.items())):
+        server_idx = int(name[1:])
+        for j in range(3):
+            client_idx = (len(modes) + 1 + 3 * k + j) % c.n
+            if client_idx == server_idx:
+                continue
+            client = c.agents[f"n{client_idx}"]
+            member = client.members.get(
+                c.agents[name].actor_id
+            )
+            if member is not None:
+                c._byz_session(client, member, byz)
+    c.run_for(0.3)
+    return {
+        "servers": {nm: b.mode for nm, b in servers.items()},
+        "reserve_actor": source.actor_id.hex(),
     }
 
 
@@ -804,21 +994,35 @@ def virtual_scenario_cell(
         # dynamics (suspicion is neutralized by suspect_timeout=10
         # exactly like the live cells)
         overrides["probe_interval"] = 1.0
+    signed = family in SIGNED_FAMILIES
+    if signed:
+        # signed cluster: per-node keypairs + spot checks live (the
+        # spot-check interval bound keeps pure-Python verification off
+        # the campaign's critical path)
+        overrides["sig_spot_check_rate"] = 0.05
     wall0 = _time.perf_counter()
     c = VirtualCluster(
-        n, seed=seed, plan=plan, base_dir=base_dir, **overrides
+        n, seed=seed, plan=plan, base_dir=base_dir, sign=signed,
+        **overrides,
     )
     try:
         if plan.partition_blocks > 1:
             c.ctrl.split()
 
         hostile = None
+        framing = None
+        byz = None
         k_hostile = _hostile_count(family)
-        if k_hostile:
+        if family == "framing_relay":
+            framing = _virtual_framing_relay(c, seed)
+        elif family == "byz_sync_server":
+            byz = _virtual_byz_sync(c, seed)
+        elif k_hostile:
             hostile = _virtual_hostile_attack(
                 c, seed, k_hostile,
                 mid_heal=(family == "equiv_during_heal"),
                 heal_after=heal_after,
+                signed=signed,
             )
 
         # write workload: one writer per partition block, else strided
@@ -860,6 +1064,23 @@ def virtual_scenario_cell(
         virt_s = c.clock.monotonic() - t0v
         # one more snapshot interval so the end state reaches the rings
         c.run_for(0.3)
+
+        restart_probe_version = None
+        if family == "signed_equivocator" and hostile is not None:
+            # the permanent verdict must survive the victim restart
+            # the plan injected: a fresh well-formed SIGNED version
+            # from the proven equivocator still drops on every node —
+            # including the reborn one, whose proof reloaded from
+            # __corro_equiv_proofs at boot
+            from corrosion_tpu.types import ChangeSource as _CS
+
+            peer = hostile["hostile_peers"][0]
+            probe_cv = peer.honest(9105, "post-restart")
+            restart_probe_version = int(probe_cv.changeset.version)
+            c.inject(list(range(n)), probe_cv, _CS.BROADCAST,
+                     rebroadcast=False,
+                     sig=peer.sign_changeset(probe_cv))
+            c.run_for(0.2)
 
         obs = c.observer()
         scrape = obs.scrape()
@@ -931,8 +1152,39 @@ def virtual_scenario_cell(
                 and not c._crashed
             )
             detail["crashes"] = len(plan.crashes)
-        if k_hostile:
+        def _count_like(a, pat):
+            _, rows = a.storage.read_query(
+                "SELECT COUNT(*) FROM tests WHERE text LIKE ?",
+                (pat,),
+            )
+            return rows[0][0]
+
+        if k_hostile and hostile is not None:
             actors = hostile["hostile_actors"]
+            keyed = set(hostile["keyed_actors"])
+            reborn_names = {
+                node for _t, ev, node in c.ctrl.crash_log
+                if ev == "restart"
+            }
+
+            def _member_verdict_ok(nm, a, actor) -> bool:
+                if actor not in keyed and nm in reborn_names:
+                    # UNSIGNED verdicts are in-memory by design (a
+                    # bounded window for forgeable attribution): a
+                    # reborn victim legitimately starts clean and
+                    # re-convicts on the next conflicting re-send.
+                    # Only SIGNED proofs must survive the restart
+                    return True
+                expected = ("signed_equivocation" if actor in keyed
+                            else "equivocation")
+                m = a.members.get(actor)
+                if m is None:
+                    # a reborn node re-learns hostile records lazily;
+                    # the verdict itself (reloaded from the proof
+                    # store) is what must hold
+                    return actor in a._equiv_quarantined
+                return m.quarantined and m.quarantine_reason == expected
+
             gates["content_detected"] = (
                 equiv.get("content", 0) >= k_hostile
             )
@@ -940,21 +1192,41 @@ def virtual_scenario_cell(
             gates["hostile_quarantined_everywhere"] = (
                 hostile["quarantined_everywhere"]
                 and all(
-                    a.members.get(actor) is not None
-                    and a.members.get(actor).quarantined
-                    and a.members.get(actor).quarantine_reason
-                    == "equivocation"
-                    for a in live_agents
+                    _member_verdict_ok(nm, a, actor)
+                    for nm, a in c.agents.items()
+                    if nm not in c._crashed
                     for actor in actors
                 )
             )
-
-            def _count_like(a, pat):
-                _, rows = a.storage.read_query(
-                    "SELECT COUNT(*) FROM tests WHERE text LIKE ?",
-                    (pat,),
+            if signed:
+                # keyed hostiles were convicted by PROOF: permanent
+                # verdicts (deadline = inf) on every live node
+                gates["signed_verdict_permanent"] = all(
+                    a._equiv_quarantined.get(actor) == float("inf")
+                    for a in live_agents
+                    for actor in keyed
                 )
-                return rows[0][0]
+            if restart_probe_version is not None:
+                reborn = [
+                    c.agents[node]
+                    for _t, ev, node in c.ctrl.crash_log
+                    if ev == "restart" and node not in c._crashed
+                ]
+                gates["proof_survived_restart"] = bool(reborn) and all(
+                    not a.bookie.for_actor(actor).contains_version(
+                        restart_probe_version
+                    )
+                    for a in live_agents
+                    for actor in keyed
+                ) and all(
+                    a._equiv_quarantined.get(actor) == float("inf")
+                    for a in reborn
+                    for actor in keyed
+                )
+                gates["zero_post_restart_rows"] = all(
+                    _count_like(a, "post-restart") == 0
+                    for a in live_agents
+                )
 
             gates["zero_divergent_rows"] = all(
                 _count_like(a, "equiv-b-%") == 0
@@ -965,6 +1237,90 @@ def virtual_scenario_cell(
             )
             detail["hostiles"] = k_hostile
             detail["equivocations"] = equiv
+
+        if framing is not None:
+            origin_actor = framing["origin_actor"]
+            # the headline negative control, in-record: the framed
+            # honest origin is quarantined on ZERO nodes — neither the
+            # verdict map nor the membership flag — while every victim
+            # observed the signature failure and convicted the relay's
+            # transport identity
+            # "never quarantined" means never CONVICTED: no node may
+            # hold an attribution-class verdict (equivocation /
+            # signed_equivocation / sig_failure) against the origin.
+            # Plain transport-breaker quarantine is excluded — the
+            # harness-crafted origin has no real socket, so nodes that
+            # sample it for fanout legitimately open its address
+            # breaker (evidence about reachability, not authorship)
+            _verdict_reasons = (
+                "equivocation", "signed_equivocation", "sig_failure",
+            )
+            origin_quarantined = [
+                nm for nm, a in c.agents.items()
+                if origin_actor in a._equiv_quarantined
+                or (a.members.get(origin_actor) is not None
+                    and a.members.get(origin_actor).quarantined
+                    and a.members.get(origin_actor).quarantine_reason
+                    in _verdict_reasons)
+            ]
+            gates["origin_never_quarantined"] = not origin_quarantined
+
+            def _victim_blamed(a) -> bool:
+                # monotone evidence (breaker flags are transient by
+                # design — half-open recovery is the point of the
+                # bounded relay verdict): the node verified at least
+                # one failing signature AND recorded the sig_failure
+                # quarantine transition for the relay's transport
+                return (
+                    a.metrics.get_counter(
+                        "corro_sig_verifications_total", result="fail"
+                    ) >= 1
+                    and a.metrics.get_counter(
+                        "corro_members_quarantine_transitions_total",
+                        state="sig_failure",
+                    ) >= 1
+                )
+
+            gates["relay_blamed_everywhere"] = all(
+                _victim_blamed(c.agents[f"n{i}"])
+                for i in framing["victims"]
+            )
+            gates["zero_tampered_rows"] = all(
+                _count_like(a, "tampered-%") == 0 for a in live_agents
+            )
+            detail["framing"] = {
+                "origin": framing["origin"],
+                "relay": framing["relay"],
+                "origin_quarantined_nodes": len(origin_quarantined),
+                "victims": len(framing["victims"]),
+                "sig_fail_verifications": sum(
+                    a.metrics.get_counter(
+                        "corro_sig_verifications_total", result="fail"
+                    )
+                    for a in live_agents
+                ),
+            }
+
+        if byz is not None:
+            rejects: Dict[str, float] = {}
+            for parsed in scrape.values():
+                fam_ = parsed.get("corro_sync_client_rejects_total")
+                if fam_ is None:
+                    continue
+                for _n2, labels, v in fam_["samples"]:
+                    r = labels.get("reason", "?")
+                    rejects[r] = rejects.get(r, 0.0) + v
+            for reason in ("advertised_range", "need_cap",
+                           "frame_garbage", "deadline"):
+                gates[f"rejected_{reason}"] = rejects.get(reason, 0) >= 1
+            gates["zero_reserve_rows"] = all(
+                _count_like(a, "byz-reserve-%") == 0
+                for a in live_agents
+            )
+            detail["byz"] = {
+                "servers": byz["servers"],
+                "client_rejects": rejects,
+            }
 
         return {
             "runtime": "virtual",

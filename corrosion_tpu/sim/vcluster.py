@@ -119,6 +119,18 @@ def vsite_id(seed: int, index: int) -> bytes:
     ).digest()
 
 
+def vsig_keypair(seed: int, index: int):
+    """Seeded Ed25519 keypair for node ``index`` of a SIGNED campaign
+    (``types/crypto.py seed_keypair``).  The KDF input includes the
+    campaign seed, which the harness holds privately — deriving the
+    secret needs more than the public actor id, so a tampering relay
+    inside the campaign cannot re-sign what it altered (the property
+    the framing_relay cell proves)."""
+    from corrosion_tpu.types.crypto import seed_keypair
+
+    return seed_keypair(f"vsig:{seed}:{index}".encode())
+
+
 class VirtualCluster:
     """N real agents under the virtual-time discrete-event scheduler."""
 
@@ -130,6 +142,7 @@ class VirtualCluster:
         base_dir: Optional[str] = None,
         clock: Optional[VirtualClock] = None,
         link_rtt_s: float = LINK_RTT_S,
+        sign: bool = False,
         **agent_overrides,
     ):
         import os
@@ -143,6 +156,22 @@ class VirtualCluster:
         self.link_rtt_s = link_rtt_s
         self.plan = plan or FaultPlan(seed=seed)
         self.ctrl = FaultController(self.plan, now=self.clock.monotonic)
+        # signed changeset attribution (docs/faults.md): every node
+        # gets a seeded Ed25519 keypair and ONE shared trust directory
+        # (the agents hold a live reference, so register_pubkey
+        # extends it after boot — e.g. for a keyed hostile actor)
+        self.sign = sign
+        self._sig_secrets: List[Optional[bytes]] = [None] * n
+        self.sig_directory: Dict[bytes, bytes] = {}
+        if sign:
+            for i in range(n):
+                sec, pub = vsig_keypair(seed, i)
+                self._sig_secrets[i] = sec
+                self.sig_directory[vsite_id(seed, i)] = pub
+        # Byzantine sync servers (faults.ByzantineSyncServer): node
+        # name -> hostile server double; a client sync round choosing
+        # one runs the hostile session instead of the real serve
+        self.byz_servers: Dict[str, object] = {}
         self._own_dir = base_dir is None
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="corro-vt-")
         os.makedirs(self.base_dir, exist_ok=True)
@@ -213,6 +242,14 @@ class VirtualCluster:
         from corrosion_tpu.agent.testing import TEST_SCHEMA
 
         offset_ns, drift = self.ctrl.clock_for(self.names[i])
+        sig_kwargs = {}
+        if self.sign:
+            sig_kwargs = dict(
+                sig_secret=self._sig_secrets[i],
+                # the SHARED directory object: late registrations
+                # (hostile keys, respawns) are visible to every agent
+                sig_pubkeys=self.sig_directory,
+            )
         return AgentConfig(
             db_path=f"{node_dir}/corrosion.db",
             schema_sql=TEST_SCHEMA,
@@ -220,8 +257,14 @@ class VirtualCluster:
             site_id=vsite_id(self.seed, i),
             clock_skew_ns=offset_ns,
             clock_drift=drift,
+            **sig_kwargs,
             **self._overrides,
         )
+
+    def register_pubkey(self, actor_id: bytes, pub: bytes) -> None:
+        """Extend the shared trust directory (e.g. a keyed hostile
+        actor whose signed conflicts the campaign must prove)."""
+        self.sig_directory[bytes(actor_id)] = bytes(pub)
 
     def _make_template(self) -> None:
         """Build the one template database every fresh node copies:
@@ -361,7 +404,8 @@ class VirtualCluster:
         return res["version"]
 
     def inject(self, targets: List[int], cv, source,
-               delay: float = 0.0, rebroadcast: bool = True) -> None:
+               delay: float = 0.0, rebroadcast: bool = True,
+               sig: Optional[bytes] = None, peer=None) -> None:
         """Schedule a crafted changeset (e.g. an ``EquivocatingPeer``
         payload) into each target's REAL ingest path at ``now+delay`` —
         the virtual form of the live harness's ``_deliver``.
@@ -371,20 +415,27 @@ class VirtualCluster:
         node, re-gossiping it adds only duplicate traffic — at N=512
         with 32 hostiles that is ~10^5 redundant decodes per wave.
         The single-equivocator matrix family keeps relay on, so the
-        rebroadcast-path defense coverage is not lost."""
+        rebroadcast-path defense coverage is not lost.
+
+        ``sig`` rides the delivery as the origin's claimed Ed25519
+        signature; ``peer`` attributes the delivery to a transport
+        address (the framing_relay cell's tampering relay) — together
+        the signed-attribution meta the live envelope would carry."""
         for j in targets:
             self.clock.schedule(
                 delay, lambda _d, _j=j, _cv=cv: self._ingest_injected(
-                    _j, _cv, source, rebroadcast
+                    _j, _cv, source, rebroadcast, sig=sig, peer=peer
                 )
             )
 
     def _ingest_injected(self, j: int, cv, source,
-                         rebroadcast: bool = True) -> None:
+                         rebroadcast: bool = True,
+                         sig: Optional[bytes] = None, peer=None) -> None:
         if j in self._crashed_idx():
             return
         a = self.agents[self.names[j]]
-        a.handle_change(cv, source, rebroadcast=rebroadcast)
+        a.handle_change(cv, source, rebroadcast=rebroadcast,
+                        meta=(None, 0, sig, peer))
         if rebroadcast:
             self._arm_flush(j)
 
@@ -427,12 +478,13 @@ class VirtualCluster:
         now = self.clock.monotonic()
         entries = self._entries[i]
         while not a._bcast_queue.empty():
-            cv, remaining, hop, tp = a._bcast_queue.get_nowait()
+            cv, remaining, hop, tp, sig = a._bcast_queue.get_nowait()
             key = a._seen_key(cv)
             if key in entries:
                 continue
             entries[key] = _Pending(
-                cv, a.encode_broadcast_frame(cv, hop, tp), remaining, now
+                cv, a.encode_broadcast_frame(cv, hop, tp, sig),
+                remaining, now,
             )
         crashed = self._crashed_idx()
         sends = 0
@@ -473,7 +525,9 @@ class VirtualCluster:
                     continue
                 self.clock.schedule(
                     self.link_rtt_s + act.delay,
-                    lambda _d, _j=j, _f=e.frame: self._deliver(_j, _f),
+                    lambda _d, _j=j, _f=e.frame, _i=i: self._deliver(
+                        _j, _f, src=_i
+                    ),
                 )
             e.remaining -= 1
             if e.remaining < 1:
@@ -492,21 +546,26 @@ class VirtualCluster:
         elif nxt is not None:
             self._arm_flush(i, at=max(nxt, now + 1e-4))
 
-    def _deliver(self, j: int, frame: bytes) -> None:
+    def _deliver(self, j: int, frame: bytes,
+                 src: Optional[int] = None) -> None:
         """Delivery phase: the real wire + ingest path (det.py's
         contract), then re-arm the receiver's flush for any
-        rebroadcast-on-learn it queued inline."""
+        rebroadcast-on-learn it queued inline.  ``src`` is the sending
+        node index — the delivering-transport identity a failed origin
+        signature blames (``runtime._blame_relay``)."""
         from corrosion_tpu.bridge import speedy
         from corrosion_tpu.types import ChangeSource
 
         if j in self._crashed_idx():
             return
         a = self.agents[self.names[j]]
+        peer = ("virt", src) if src is not None else None
         for payload in speedy.FrameReader().feed(frame):
             decoded = a.decode_uni_frame_meta(payload)
             if decoded is not None:
-                cv, tp, hop = decoded
-                a.handle_change(cv, ChangeSource.BROADCAST, meta=(tp, hop))
+                cv, tp, hop, sig = decoded
+                a.handle_change(cv, ChangeSource.BROADCAST,
+                                meta=(tp, hop, sig, peer))
         if not a._bcast_queue.empty():
             self._arm_flush(j)
 
@@ -639,6 +698,13 @@ class VirtualCluster:
             ):
                 self._breaker_failure(a, addr)
                 continue
+            byz = self.byz_servers.get(peer)
+            if byz is not None:
+                # hostile serve: the client-side defenses (state
+                # screen, need cap, frame budget, session deadline)
+                # must contain it — never this harness
+                self._byz_session(a, m, byz)
+                continue
             self._breaker_success(a, addr)
             sessions.append({
                 "member": m,
@@ -713,6 +779,57 @@ class VirtualCluster:
             "sync_server_end", peer=a.actor_id.hex(),
             needs=srv_live["needs_done"], bytes=srv_live["bytes"],
         )
+
+    def _byz_session(self, a, m, byz) -> None:
+        """One client session against a Byzantine sync server
+        (``faults.ByzantineSyncServer``): the hostile advert/serve is
+        produced by the double, and containment comes exclusively from
+        the agent's OWN client-side defenses — the advertised-state
+        screen, the per-session need cap (inside ``_allocate_needs``),
+        the frame-validation budget, and the session deadline."""
+        from corrosion_tpu.bridge import speedy
+        from corrosion_tpu.types.changeset import ChangeSource, ChangeV1
+
+        addr = tuple(m.addr)
+        theirs = byz.advertised_state()
+        reason = a._screen_sync_state(theirs)
+        if reason is not None:
+            a._sync_client_reject(reason, addr, trip=True)
+            return
+        sessions = [{"member": m, "theirs": theirs}]
+        a._allocate_needs(sessions, a.generate_sync())
+        deadline = a.config.sync_session_deadline_s
+        if deadline > 0 and byz.serve_duration() > deadline:
+            # slow trickle: the virtual serve would outlive the
+            # session deadline — the client aborts at the budget
+            a._sync_client_reject("deadline", addr)
+            self._breaker_failure(a, addr)
+            return
+        try:
+            payloads = speedy.FrameReader().feed(
+                byz.serve_frames(sessions[0]["needs"])
+            )
+        except speedy.SpeedyError:
+            # oversized/corrupt framing kills the whole stream
+            a._sync_client_reject("frame_garbage", addr, trip=True)
+            return
+        frame_errs = 0
+        for payload in payloads:
+            try:
+                msg = speedy.decode_sync_message(payload)
+            except speedy.SpeedyError:
+                frame_errs += 1
+                a._sync_client_reject("frame_garbage")
+                if frame_errs > a.SYNC_CLIENT_FRAME_BUDGET:
+                    a._trip_breaker(addr)
+                    return
+                continue
+            if isinstance(msg, ChangeV1):
+                # conflicting re-serves of held versions land in the
+                # version-ledger dedup; fresh hostile data is gated by
+                # what the advert could legitimately offer
+                a.handle_change(msg, ChangeSource.SYNC,
+                                rebroadcast=False)
 
     # -- recorder snapshots / stall beats ------------------------------
 

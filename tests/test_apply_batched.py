@@ -401,7 +401,11 @@ def test_apply_batch_decodes_raw_uni_payloads_off_loop(tmp_path):
             agent._ingest_uni_payloads(payloads)
             assert len(agent._ingest) == 1
             item, source = agent._ingest[0]
-            assert source is None and isinstance(item, (bytes, bytearray))
+            # raw items carry (payload, delivering_peer) so a failed
+            # signature can blame the transport (signed attribution)
+            assert source is None
+            payload, peer = item
+            assert isinstance(payload, (bytes, bytearray)) and peer is None
             batch = list(agent._ingest)
             agent._ingest.clear()
             out = agent._apply_batch(batch)
@@ -409,7 +413,7 @@ def test_apply_batch_decodes_raw_uni_payloads_off_loop(tmp_path):
             assert agent.storage.conn.execute(
                 "SELECT a FROM items WHERE id=21").fetchone() == ("raw",)
             # garbage payloads are dropped without poisoning the batch
-            out = agent._apply_batch([(b"\xde\xad\xbe\xef", None)])
+            out = agent._apply_batch([((b"\xde\xad\xbe\xef", None), None)])
             assert out == []
             # and rejected at ENQUEUE by the prelude check, so a junk
             # burst cannot evict real changesets from the bounded queue
@@ -430,7 +434,7 @@ def test_apply_batch_decodes_raw_uni_payloads_off_loop(tmp_path):
             hostile = w.getvalue()
             good = _complete_cv(SITES[2], 1, pk=22, val="ok")
             out = agent._apply_batch([
-                (hostile, None), (good, ChangeSource.SYNC),
+                ((hostile, None), None), (good, ChangeSource.SYNC),
             ])
             assert len(out) == 1 and out[0][2] is True
             assert agent.storage.conn.execute(

@@ -183,6 +183,12 @@ def test_perf_bench_artifact_schemas(name, value_floor):
     )
     if "overhead_gate" in doc:
         assert _gate_passed(doc["overhead_gate"])
+    if name == "APPLY_BENCH.json":
+        # signed-attribution ingest overhead: the committed paired A/B
+        # ran at the headline shape and held the ≥0.95 median ratio
+        gate = doc["sig_overhead_gate"]
+        assert gate["pass"] is True
+        assert gate["ratio"] >= 0.95
 
 
 def test_frontier_bench_artifact_schema():
@@ -270,9 +276,12 @@ def test_virtual_scenarios_n512_artifact_schema():
         "cells": dict,
     })
     assert set(doc["families"]) == set(doc["cells"])
-    # the scale-only families actually ran at scale
+    # the scale-only families actually ran at scale — including the
+    # signed-attribution and Byzantine-sync-serve cells
     for fam in ("restart_storm", "hostile_sweep_8", "hostile_sweep_32",
-                "equiv_during_heal", "skew_during_restart"):
+                "equiv_during_heal", "skew_during_restart",
+                "framing_relay", "signed_equivocator",
+                "byz_sync_server", "hostile_sweep_32_signed"):
         assert fam in doc["cells"], f"scale family {fam} missing"
     for family, cell in doc["cells"].items():
         _check(cell, {
@@ -299,6 +308,26 @@ def test_virtual_scenarios_n512_artifact_schema():
     assert asym_sim is not None
     assert asym_sim.get("oneway_blocks") == [[0, 1]]
     assert "residual" not in asym_sim
+    # the framing_relay headline NEGATIVE control, in-record: the
+    # tampering relay was blamed on every victim while the framed
+    # honest origin was quarantined on ZERO nodes
+    framing = doc["cells"]["framing_relay"]["agents"]
+    _check(framing["detail"]["framing"], {
+        "origin_quarantined_nodes": lambda v: v == 0,
+        "victims": lambda v: isinstance(v, int) and v >= 500,
+        "sig_fail_verifications": lambda v: v >= 1,
+    }, "$.cells.framing_relay.detail.framing")
+    assert framing["gates"]["origin_never_quarantined"] is True
+    assert framing["gates"]["relay_blamed_everywhere"] is True
+    # the permanent signed verdict survived its victim's restart
+    se_gates = doc["cells"]["signed_equivocator"]["agents"]["gates"]
+    assert se_gates["signed_verdict_permanent"] is True
+    assert se_gates["proof_survived_restart"] is True
+    # every Byzantine sync-serve defense actually fired
+    byz_gates = doc["cells"]["byz_sync_server"]["agents"]["gates"]
+    for reason in ("advertised_range", "need_cap", "frame_garbage",
+                   "deadline"):
+        assert byz_gates[f"rejected_{reason}"] is True, reason
     assert "error" not in doc
 
 

@@ -1,0 +1,127 @@
+"""RFC 8032 conformance for the dependency-free Ed25519
+(``types/crypto.py``) — the signed-changeset-attribution primitive.
+
+Vectors are §7.1 of RFC 8032 (TEST 1-3 + the SHA(abc) vector), byte
+for byte; plus negative cases (wrong message/key/signature, malformed
+encodings), the derivation KDF, and the process-wide verification memo
+the virtual campaigns lean on.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import pytest
+
+from corrosion_tpu.types import crypto
+
+# (secret, public, message, signature) — RFC 8032 §7.1
+RFC8032_VECTORS = [
+    (  # TEST 1: empty message
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (  # TEST 2: one byte
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (  # TEST 3: two bytes
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+    (  # TEST SHA(abc): ed25519 over a sha512 digest
+        "833fe62409237b9d62ec77587520911e9a759cec1d19755b7da901b96dca3d42",
+        "ec172b93ad5e563bf4932c70e1245034c35467ef2efd4d64ebf819683467e2bf",
+        hashlib.sha512(b"abc").hexdigest(),
+        "dc2a4459e7369633a52b1bf277839a00201009a3efbf3ecb69bea2186c26b589"
+        "09351fc9ac90b3ecfdfbc7c66431e0303dca179c138ac17ad9bef1177331a704",
+    ),
+]
+
+
+@pytest.mark.parametrize("sk,pk,msg,sig", RFC8032_VECTORS)
+def test_rfc8032_vectors(sk, pk, msg, sig):
+    sk, pk = bytes.fromhex(sk), bytes.fromhex(pk)
+    msg, sig = bytes.fromhex(msg), bytes.fromhex(sig)
+    assert crypto.public_key(sk) == pk
+    assert crypto.sign(sk, msg) == sig
+    assert crypto.verify(pk, msg, sig)
+
+
+def test_verify_rejects_wrong_message_key_and_signature():
+    sk, pk, _msg, _sig = (bytes.fromhex(v) for v in RFC8032_VECTORS[0])
+    sig = crypto.sign(sk, b"genuine")
+    assert crypto.verify(pk, b"genuine", sig)
+    assert not crypto.verify(pk, b"tampered", sig)
+    # flipped signature bits
+    for i in (0, 31, 32, 63):
+        bad = bytearray(sig)
+        bad[i] ^= 0x01
+        assert not crypto.verify(pk, b"genuine", bytes(bad))
+    # wrong key
+    sk2, pk2 = crypto.seed_keypair(b"someone else")
+    assert not crypto.verify(pk2, b"genuine", sig)
+    # a signature by the other key over the same message
+    assert not crypto.verify(pk, b"genuine", crypto.sign(sk2, b"genuine"))
+
+
+def test_verify_never_raises_on_malformed_inputs():
+    sk, pk = crypto.seed_keypair(b"malformed-suite")
+    sig = crypto.sign(sk, b"m")
+    assert not crypto.verify(pk, b"m", b"")                      # empty
+    assert not crypto.verify(pk, b"m", sig[:-1])                 # short
+    assert not crypto.verify(pk, b"m", sig + b"\x00")            # long
+    assert not crypto.verify(b"", b"m", sig)                     # no key
+    assert not crypto.verify(b"\xff" * 32, b"m", sig)            # junk key
+    # S >= L (scalar out of range) must be rejected, not reduced
+    bad = bytearray(sig)
+    bad[32:] = (crypto._L).to_bytes(32, "little")
+    assert not crypto.verify(pk, b"m", bytes(bad))
+    # non-canonical R (not a curve point)
+    bad = bytearray(sig)
+    bad[:32] = b"\x05" + b"\xff" * 31
+    assert not crypto.verify(pk, b"m", bytes(bad))
+
+
+def test_secret_length_is_enforced():
+    with pytest.raises(ValueError):
+        crypto.sign(b"short", b"m")
+    with pytest.raises(ValueError):
+        crypto.public_key(b"x" * 33)
+
+
+def test_seed_keypair_is_deterministic_and_not_identity_derived():
+    s1, p1 = crypto.seed_keypair(b"node-7")
+    s2, p2 = crypto.seed_keypair(b"node-7")
+    s3, p3 = crypto.seed_keypair(b"node-8")
+    assert (s1, p1) == (s2, p2)
+    assert p1 != p3 and s1 != s3
+    assert crypto.public_key(s1) == p1
+    # the KDF is keyed (personalized blake2b), not a plain hash of the
+    # material: knowing the derivation SHAPE plus a public id is not
+    # enough to recompute the secret
+    assert s1 != hashlib.blake2b(b"node-7", digest_size=32).digest()
+    assert crypto.verify(p1, b"m", crypto.sign(s1, b"m"))
+
+
+def test_verify_cached_matches_verify_and_caches():
+    sk, pk = crypto.seed_keypair(b"cache-suite")
+    sig = crypto.sign(sk, b"m")
+    assert crypto.verify_cached(pk, b"m", sig) is True
+    assert crypto.verify_cached(pk, b"x", sig) is False
+    # cached results are stable (pure function memo)
+    assert crypto.verify_cached(pk, b"m", sig) is True
+    assert crypto.verify_cached(pk, b"x", sig) is False
+    # distinct triples never alias in the cache key
+    sig2 = crypto.sign(sk, b"m2")
+    assert crypto.verify_cached(pk, b"m2", sig2) is True
+    assert crypto.verify_cached(pk, b"m2", sig) is False

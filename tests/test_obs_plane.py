@@ -197,18 +197,18 @@ def test_broadcast_frame_backward_compat(tmp_path):
         assert rest == b""
         got = new.decode_uni_frame_meta(payloads[0])
         assert got is not None
-        got_cv, tp, hop = got
-        assert got_cv == cv and tp is None and hop == 0
+        got_cv, tp, hop, sig = got
+        assert got_cv == cv and tp is None and hop == 0 and sig is None
         # traced frame: the envelope rides ahead of the classic bytes
         _write(new, 2)
         cv2 = _full_changeset(new, 1, 2)
         traced_frame = new.encode_broadcast_frame(cv2, hop=1, traceparent=TP)
         payloads, _ = speedy.deframe(traced_frame)
-        got_cv, tp, hop = new.decode_uni_frame_meta(payloads[0])
+        got_cv, tp, hop, _sig = new.decode_uni_frame_meta(payloads[0])
         assert got_cv == cv2 and tp == TP and hop == 1
         # ...and an old-config receiver still accepts it (decode is
         # format-agnostic; only EMISSION is gated)
-        got_cv, tp, hop = old.decode_uni_frame_meta(payloads[0])
+        got_cv, tp, hop, _sig = old.decode_uni_frame_meta(payloads[0])
         assert got_cv == cv2 and tp == TP and hop == 1
     finally:
         old.storage.close()

@@ -540,3 +540,664 @@ def test_scenario_matrix_n32(run, tmp_path):
         assert out.exists()
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# signed changeset attribution (docs/faults.md): unframeable verdicts
+# ---------------------------------------------------------------------------
+
+
+def test_signed_equivocation_is_permanent_and_survives_restart(tmp_path):
+    """A VERIFIED signed conflicting pair is a proof: the quarantine
+    ignores the bounded window (deadline = inf), persists to
+    __corro_equiv_proofs, and re-arms on reboot."""
+    import math
+
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.faults import EquivocatingPeer
+    from corrosion_tpu.types import ChangeSource
+    from corrosion_tpu.types.crypto import seed_keypair
+
+    sec, pub = seed_keypair(b"keyed-hostile")
+    peer = EquivocatingPeer(seed=3, sig_secret=sec)
+    directory = {peer.actor_id: pub}
+    a = make_offline_agent(
+        tmpdir=str(tmp_path), sig_pubkeys=directory,
+        equiv_quarantine_s=5.0,
+    )
+    try:
+        a.members.upsert(peer.actor_id, ("x", 1))
+        ca, cb = peer.conflicting_pair(1)
+        assert a.handle_change(
+            ca, ChangeSource.BROADCAST, rebroadcast=False,
+            meta=(None, 0, peer.sign_changeset(ca), None),
+        )
+        assert not a.handle_change(
+            cb, ChangeSource.BROADCAST, rebroadcast=False,
+            meta=(None, 0, peer.sign_changeset(cb), None),
+        )
+        assert a._equiv_quarantined[peer.actor_id] == math.inf
+        m = a.members.get(peer.actor_id)
+        assert m.quarantined
+        assert m.quarantine_reason == "signed_equivocation"
+        rows = a.storage.conn.execute(
+            "SELECT actor_id, kind FROM __corro_equiv_proofs"
+        ).fetchall()
+        assert len(rows) == 1 and bytes(rows[0][0]) == peer.actor_id
+        # both verifications ran and passed (the proof pair)
+        assert a.metrics.get_counter(
+            "corro_sig_verifications_total", result="ok") >= 1
+    finally:
+        a.storage.close()
+
+    # reboot: the proof reloads and the verdict still drops traffic
+    b = make_offline_agent(tmpdir=str(tmp_path), sig_pubkeys=directory)
+    try:
+        assert b._equiv_quarantined.get(peer.actor_id) == math.inf
+        assert not b.handle_change(
+            peer.honest(2, "post-reboot"), ChangeSource.BROADCAST,
+            rebroadcast=False,
+        )
+    finally:
+        b.storage.close()
+
+
+def test_sig_failure_blames_relay_never_origin(tmp_path):
+    """The unframeable property: tampered contents under the origin's
+    passed-through signature convict the DELIVERING transport; the
+    named origin keeps a clean record, and its untampered traffic
+    keeps flowing."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.faults import EquivocatingPeer
+    from corrosion_tpu.types import ChangeSource
+    from corrosion_tpu.types.crypto import seed_keypair
+
+    sec, pub = seed_keypair(b"honest-origin")
+    origin = EquivocatingPeer(seed=5, sig_secret=sec)
+    relay_actor = b"\x99" * 16
+    a = make_offline_agent(
+        tmpdir=str(tmp_path), sig_pubkeys={origin.actor_id: pub},
+    )
+    try:
+        a.members.upsert(origin.actor_id, ("honest", 1))
+        a.members.upsert(relay_actor, ("relayhost", 7))
+        hv = origin.honest(1, "honest")
+        sig = origin.sign_changeset(hv)
+        assert a.handle_change(hv, ChangeSource.BROADCAST,
+                               rebroadcast=False,
+                               meta=(None, 0, sig, None))
+        tampered = origin.tampered_copy(hv, "tampered")
+        assert not a.handle_change(
+            tampered, ChangeSource.BROADCAST, rebroadcast=False,
+            meta=(None, 0, sig, ("relayhost", 7)),
+        )
+        # origin: no verdict, member record clean
+        assert origin.actor_id not in a._equiv_quarantined
+        assert not a.members.get(origin.actor_id).quarantined
+        # relay: transport-class quarantine + tripped breaker
+        mr = a.members.get(relay_actor)
+        assert mr.quarantined and mr.quarantine_reason == "sig_failure"
+        b = a.transport.breakers.get(("relayhost", 7)) \
+            if a.transport else None
+        assert b is None or b.is_open  # offline agent has no transport
+        assert a.metrics.get_counter(
+            "corro_sig_verifications_total", result="fail") >= 1
+        # the origin's NEXT honest signed version still applies
+        nxt = origin.honest(2, "still-flowing")
+        assert a.handle_change(
+            nxt, ChangeSource.BROADCAST, rebroadcast=False,
+            meta=(None, 0, origin.sign_changeset(nxt), None),
+        )
+    finally:
+        a.storage.close()
+
+
+def test_evidence_verify_budget_bounds_flood(tmp_path):
+    """A tampered-copy flood (one byte flipped per replay, so every
+    copy is a fresh digest conflict) cannot buy a ~ms verify per
+    message: past the token bucket the conflicting duplicate drops
+    with NO verdict (result=skipped) — the origin stays clean and
+    nothing applies, but the apply workers stop paying for Ed25519."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.faults import EquivocatingPeer
+    from corrosion_tpu.types import ChangeSource
+    from corrosion_tpu.types.crypto import seed_keypair
+
+    sec, pub = seed_keypair(b"flooded-origin")
+    origin = EquivocatingPeer(seed=11, sig_secret=sec)
+    a = make_offline_agent(
+        tmpdir=str(tmp_path), sig_pubkeys={origin.actor_id: pub},
+        sig_evidence_verify_rate=4.0,  # burst 8
+    )
+    try:
+        a.members.upsert(origin.actor_id, ("honest", 1))
+        hv = origin.honest(1, "honest")
+        sig = origin.sign_changeset(hv)
+        assert a.handle_change(hv, ChangeSource.BROADCAST,
+                               rebroadcast=False,
+                               meta=(None, 0, sig, None))
+        for i in range(50):
+            assert not a.handle_change(
+                origin.tampered_copy(hv, f"tamper-{i}"),
+                ChangeSource.BROADCAST, rebroadcast=False,
+                meta=(None, 0, sig, ("flood-host", 1000 + i)),
+            )
+        ran = a.metrics.get_counter(
+            "corro_sig_verifications_total", result="fail")
+        skipped = a.metrics.get_counter(
+            "corro_sig_verifications_total", result="skipped")
+        # burst 8 plus whatever refilled during the loop's few ms
+        assert 0 < ran <= 12
+        assert skipped >= 50 - 12
+        # no verdict of ANY kind landed on the origin
+        assert origin.actor_id not in a._equiv_quarantined
+        assert not a.members.get(origin.actor_id).quarantined
+        # and none of the tampered contents reached the tables
+        _cols, rows = a.storage.read_query(
+            "SELECT text FROM tests WHERE id = 1")
+        assert rows == [("honest",)]
+    finally:
+        a.storage.close()
+
+    # rate=0 opts out: every conflict verifies (pre-budget behavior)
+    (tmp_path / "unbounded").mkdir()
+    b = make_offline_agent(
+        tmpdir=str(tmp_path / "unbounded"),
+        sig_pubkeys={origin.actor_id: pub},
+        sig_evidence_verify_rate=0.0,
+    )
+    try:
+        b.members.upsert(origin.actor_id, ("honest", 1))
+        assert b.handle_change(hv, ChangeSource.BROADCAST,
+                               rebroadcast=False,
+                               meta=(None, 0, sig, None))
+        for i in range(10):
+            assert not b.handle_change(
+                origin.tampered_copy(hv, f"t{i}"),
+                ChangeSource.BROADCAST, rebroadcast=False,
+                meta=(None, 0, sig, ("flood-host", 2000 + i)),
+            )
+        assert b.metrics.get_counter(
+            "corro_sig_verifications_total", result="fail") == 10
+        assert b.metrics.get_counter(
+            "corro_sig_verifications_total", result="skipped") == 0
+    finally:
+        b.storage.close()
+
+
+def test_trip_breaker_bounded_under_rotating_addrs(tmp_path):
+    """Verified-hostile evidence keyed by attacker-controlled
+    ephemeral source addresses must not grow the breaker registry
+    without bound: past the cap the oldest-opened entries are evicted
+    (transport.prune_breakers), and a real Transport's insert path
+    shares the same sweep."""
+    from types import SimpleNamespace
+
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.agent.transport import (
+        CircuitBreaker, prune_breakers,
+    )
+
+    a = make_offline_agent(tmpdir=str(tmp_path))
+    try:
+        a.transport = SimpleNamespace(breakers={}, max_cached=4)
+        for port in range(200):
+            a._trip_breaker(("hostile", port))
+        cap = 4 * 4
+        assert len(a.transport.breakers) <= cap + 1
+        # the survivors are the most recently tripped (a live offender
+        # re-trips on its next evidence, so old ports are safe to drop)
+        assert ("hostile", 199) in a.transport.breakers
+        assert ("hostile", 0) not in a.transport.breakers
+    finally:
+        a.storage.close()
+
+    # unit shape: healthy entries evict first, open ones only past cap
+    breakers = {}
+    for i in range(10):
+        breakers[("h", i)] = CircuitBreaker(1, 1.0)
+    breakers[("open", 0)] = CircuitBreaker(1, 1.0)
+    breakers[("open", 0)].trip()
+    prune_breakers(breakers, 4)
+    assert ("open", 0) in breakers  # open survives while healthy go
+    assert len(breakers) <= 4
+
+    # closed-with-strikes entries (member churn accrues them forever)
+    # must not dodge the bound: they evict after healthy, before open
+    breakers = {}
+    for i in range(10):
+        b = CircuitBreaker(5, 1.0)
+        b.record_failure()  # 0 < failures < threshold, not open
+        breakers[("striked", i)] = b
+    breakers[("open", 0)] = CircuitBreaker(1, 1.0)
+    breakers[("open", 0)].trip()
+    prune_breakers(breakers, 4)
+    assert ("open", 0) in breakers
+    assert len(breakers) <= 4
+
+    # evicting an OPEN breaker fires on_evict so the owner can lift
+    # the member quarantine it carried (a fresh breaker for the same
+    # address closes silently — no transition event would ever fire)
+    breakers = {}
+    for i in range(10):
+        b = CircuitBreaker(1, 1.0)
+        b.trip()
+        breakers[("o", i)] = b
+    lifted = []
+    prune_breakers(breakers, 4, on_evict=lifted.append)
+    assert len(breakers) <= 4
+    assert len(lifted) == 10 - len(breakers)
+    assert all(a not in breakers for a in lifted)
+
+
+def test_sig_failure_label_survives_breaker_transition(tmp_path):
+    """The evidence-class label must be what sticks: _blame_relay
+    trips the breaker FIRST (whose _on_breaker labels the member
+    reason="breaker") and applies reason="sig_failure" after, so the
+    equal-rank last-writer-wins relabel leaves the SPECIFIC evidence
+    class visible in cluster_members."""
+    from types import SimpleNamespace
+
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.faults import EquivocatingPeer
+    from corrosion_tpu.types import ChangeSource
+    from corrosion_tpu.types.crypto import seed_keypair
+
+    sec, pub = seed_keypair(b"labeled-origin")
+    origin = EquivocatingPeer(seed=21, sig_secret=sec)
+    relay_actor = b"\x77" * 16
+    a = make_offline_agent(
+        tmpdir=str(tmp_path), sig_pubkeys={origin.actor_id: pub},
+    )
+    try:
+        # a real breaker registry so _trip_breaker's _on_breaker
+        # member-labeling path actually fires
+        a.transport = SimpleNamespace(breakers={}, max_cached=16)
+        a.members.upsert(origin.actor_id, ("honest", 1))
+        a.members.upsert(relay_actor, ("relayhost", 7))
+        hv = origin.honest(1, "honest")
+        sig = origin.sign_changeset(hv)
+        assert a.handle_change(hv, ChangeSource.BROADCAST,
+                               rebroadcast=False,
+                               meta=(None, 0, sig, None))
+        assert not a.handle_change(
+            origin.tampered_copy(hv, "tampered"),
+            ChangeSource.BROADCAST, rebroadcast=False,
+            meta=(None, 0, sig, ("relayhost", 7)),
+        )
+        mr = a.members.get(relay_actor)
+        assert mr.quarantined
+        assert mr.quarantine_reason == "sig_failure"
+        assert a.transport.breakers[("relayhost", 7)].is_open
+    finally:
+        a.storage.close()
+
+
+def test_spot_check_slot_not_consumed_by_unkeyed_actor(tmp_path):
+    """In a partially-keyed cluster the interval slot belongs to
+    actors that can actually be verified: an unkeyed actor's traffic
+    must never claim it (verification would return None), or a chatty
+    unkeyed actor starves the keyed actors' tripwire."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.types.crypto import seed_keypair
+
+    _sec, pub = seed_keypair(b"keyed-one")
+    keyed = b"\x01" * 16
+    unkeyed = b"\x02" * 16
+    a = make_offline_agent(
+        tmpdir=str(tmp_path), sig_pubkeys={keyed: pub},
+        sig_spot_check_rate=1.0, sig_spot_check_min_interval_s=3600.0,
+    )
+    try:
+        # a flood from the unkeyed actor admits nothing and, crucially,
+        # leaves the interval slot unclaimed
+        assert not any(a._spot_check_due(unkeyed, v) for v in range(50))
+        assert a._spot_check_due(keyed, 1)   # slot still available
+        assert not a._spot_check_due(keyed, 2)  # now interval-bound
+    finally:
+        a.storage.close()
+
+
+def test_signed_proof_escalates_inf_unsigned_verdict(tmp_path):
+    """equiv_quarantine_s=0 gives UNSIGNED verdicts an inf deadline
+    too — a later signed proof must still relabel the standing verdict
+    to signed_equivocation (the escalation is tracked by proof state,
+    not inferred from the deadline), and the _pre_change drop path's
+    Members re-assert must key on proof state the same way."""
+    import math
+
+    from corrosion_tpu.agent.testing import make_offline_agent
+
+    actor = b"\x31" * 16
+    a = make_offline_agent(tmpdir=str(tmp_path), equiv_quarantine_s=0.0)
+    try:
+        a.members.upsert(actor, ("x", 1))
+        # unsigned verdict: inf deadline (hold=0) but unsigned reason
+        a._note_equivocation(actor, "content")
+        assert a._equiv_quarantined[actor] == math.inf
+        assert a.members.get(actor).quarantine_reason == "equivocation"
+        # an unsigned inf verdict must NOT masquerade as signed on the
+        # drop path's re-assert (keyed on _equiv_proofed, not the
+        # deadline)
+        assert actor not in a._equiv_proofed
+        # the signed proof (in-batch conflicting pairs reach the
+        # verdict seam before the drop path arms) escalates in place
+        a._note_equivocation(
+            actor, "content",
+            proof=(1, "content", b"msg-a", b"s" * 64, b"msg-b",
+                   b"t" * 64),
+        )
+        assert a.members.get(actor).quarantine_reason \
+            == "signed_equivocation"
+        assert actor in a._equiv_proofed
+        assert a.storage.conn.execute(
+            "SELECT COUNT(*) FROM __corro_equiv_proofs"
+        ).fetchone()[0] == 1
+        # a REPEAT proof does not re-fire the escalation transition
+        before = a.metrics.get_counter(
+            "corro_members_quarantine_transitions_total",
+            state="signed_equivocation")
+        a._note_equivocation(
+            actor, "content",
+            proof=(1, "content", b"msg-a", b"s" * 64, b"msg-b",
+                   b"t" * 64),
+        )
+        assert a.metrics.get_counter(
+            "corro_members_quarantine_transitions_total",
+            state="signed_equivocation") == before
+    finally:
+        a.storage.close()
+
+
+def test_sync_deadline_strikes_breaker(tmp_path):
+    """A blown session deadline records one ordinary breaker failure
+    (ambiguous evidence: threshold strikes before quarantine), so a
+    slow-trickle server stops being re-selected round after round
+    forever — the containment the vcluster campaign seam models."""
+    from types import SimpleNamespace
+
+    from corrosion_tpu.agent.testing import make_offline_agent
+
+    a = make_offline_agent(tmpdir=str(tmp_path), breaker_threshold=3)
+    try:
+        a.transport = SimpleNamespace(breakers={}, max_cached=16)
+        addr = ("trickler", 9)
+        for _ in range(2):
+            a._sync_client_reject("deadline", addr, strike=True)
+        b = a.transport.breakers[addr]
+        assert not b.is_open and b.failures == 2
+        a._sync_client_reject("deadline", addr, strike=True)
+        assert b.is_open  # threshold strikes opened it
+        assert a.metrics.get_counter(
+            "corro_sync_client_rejects_total", reason="deadline") == 3
+    finally:
+        a.storage.close()
+
+
+def test_unsigned_conflict_keeps_bounded_window(tmp_path):
+    """Without verifiable signatures the pre-signing behavior holds
+    byte for byte: bounded-window quarantine, reason=equivocation."""
+    import math
+
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.faults import EquivocatingPeer
+    from corrosion_tpu.types import ChangeSource
+
+    peer = EquivocatingPeer(seed=9)
+    a = make_offline_agent(tmpdir=str(tmp_path), equiv_quarantine_s=60.0)
+    try:
+        a.members.upsert(peer.actor_id, ("x", 1))
+        ca, cb = peer.conflicting_pair(1)
+        assert a.handle_change(ca, ChangeSource.BROADCAST,
+                               rebroadcast=False)
+        assert not a.handle_change(cb, ChangeSource.BROADCAST,
+                                   rebroadcast=False)
+        deadline = a._equiv_quarantined[peer.actor_id]
+        assert deadline != math.inf
+        assert a.members.get(peer.actor_id).quarantine_reason \
+            == "equivocation"
+        assert a.storage.conn.execute(
+            "SELECT COUNT(*) FROM __corro_equiv_proofs"
+        ).fetchone()[0] == 0
+    finally:
+        a.storage.close()
+
+
+def test_wire_byte_exact_with_signing_disabled(tmp_path):
+    """The acceptance criterion's wire half: with no keys configured
+    the emitted frames are byte-identical to the pre-signing envelope
+    (traced v1 with propagation on, classic v0 with it off)."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.bridge import speedy
+    from corrosion_tpu.faults import EquivocatingPeer
+    from corrosion_tpu.types.actor import ClusterId
+    from corrosion_tpu.types.payload import BroadcastV1, UniPayload
+
+    peer = EquivocatingPeer(seed=1)
+    cv = peer.honest(1, "x")
+    (tmp_path / "on").mkdir()
+    (tmp_path / "off").mkdir()
+    a = make_offline_agent(tmpdir=str(tmp_path / "on"))
+    b = make_offline_agent(
+        tmpdir=str(tmp_path / "off"), bcast_trace_propagation=False,
+    )
+    try:
+        classic = speedy.encode_uni_payload(UniPayload(
+            broadcast=BroadcastV1(change=cv),
+            cluster_id=ClusterId(0),
+        ))
+        assert a.encode_broadcast_frame(cv) == speedy.frame(
+            speedy.encode_traced_uni(classic, None, 0)
+        )
+        assert b.encode_broadcast_frame(cv) == speedy.frame(classic)
+    finally:
+        a.storage.close()
+        b.storage.close()
+
+
+def test_signed_envelope_honors_trace_propagation_off(tmp_path):
+    """The v2 envelope carries a structural trace slot, but signing
+    must not become a side channel that re-enables wire trace context
+    the operator turned off: with ``bcast_trace_propagation=False`` a
+    signed frame keeps the signature and drops the traceparent."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.faults import EquivocatingPeer
+
+    TP = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    cv = EquivocatingPeer(seed=1).honest(1, "x")
+    sig = bytes(range(64))  # relayed pass-through; content is opaque here
+    (tmp_path / "on").mkdir()
+    (tmp_path / "off").mkdir()
+    a = make_offline_agent(tmpdir=str(tmp_path / "on"))
+    b = make_offline_agent(
+        tmpdir=str(tmp_path / "off"), bcast_trace_propagation=False,
+    )
+    try:
+        _, tp, hop, gsig = a.decode_uni_frame_meta(
+            a.encode_broadcast_frame(cv, traceparent=TP, hop=1, sig=sig)[4:]
+        )
+        assert (tp, hop, gsig) == (TP, 1, sig)
+        _, tp, hop, gsig = b.decode_uni_frame_meta(
+            b.encode_broadcast_frame(cv, traceparent=TP, hop=1, sig=sig)[4:]
+        )
+        assert (tp, hop, gsig) == (None, 1, sig)
+    finally:
+        a.storage.close()
+        b.storage.close()
+
+
+def test_boot_reassert_skips_unsigned_inf_verdicts(run, tmp_path):
+    """run()'s boot re-assert of permanent verdicts is keyed on the
+    explicit proof set, not ``deadline == inf``: with
+    ``equiv_quarantine_s=0`` an UNSIGNED verdict parks at inf too, and
+    a pre-start verdict on a possibly-framed actor must never be
+    boot-relabeled as a proven signed equivocator."""
+    from corrosion_tpu.agent.runtime import Agent, AgentConfig
+    from corrosion_tpu.agent.testing import TEST_SCHEMA
+    from corrosion_tpu.faults import EquivocatingPeer
+    from corrosion_tpu.types import ChangeSource
+
+    async def main():
+        import math
+
+        peer = EquivocatingPeer(seed=9)
+        a = Agent(AgentConfig(
+            db_path=str(tmp_path / "corrosion.db"),
+            schema_sql=TEST_SCHEMA, api_port=None,
+            equiv_quarantine_s=0.0,
+        ))
+        try:
+            # a real loopback addr: start() boots the SWIM loops and a
+            # non-IP member host breaks announce-target parsing
+            a.members.upsert(peer.actor_id, ("127.0.0.1", 1))
+            ca, cb = peer.conflicting_pair(1)
+            assert a.handle_change(ca, ChangeSource.BROADCAST,
+                                   rebroadcast=False)
+            assert not a.handle_change(cb, ChangeSource.BROADCAST,
+                                       rebroadcast=False)
+            # unsigned verdict, parked at inf by the zero window
+            assert a._equiv_quarantined[peer.actor_id] == math.inf
+            assert peer.actor_id not in a._equiv_proofed
+            assert a.members.get(peer.actor_id).quarantine_reason \
+                == "equivocation"
+            await a.start()
+            assert a.members.get(peer.actor_id).quarantine_reason \
+                == "equivocation"
+        finally:
+            await a.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# Byzantine sync-serve client defenses (docs/faults.md)
+# ---------------------------------------------------------------------------
+
+
+def test_screen_sync_state_rejects_structural_liars(tmp_path):
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.faults import ByzantineSyncServer
+    from corrosion_tpu.types.actor import ActorId
+    from corrosion_tpu.types.base import Version
+    from corrosion_tpu.types.payload import SyncStateV1
+
+    a = make_offline_agent(tmpdir=str(tmp_path))
+    try:
+        for mode in ("lying_ranges", "absurd_needs"):
+            byz = ByzantineSyncServer(seed=0, mode=mode)
+            assert a._screen_sync_state(byz.advertised_state()) \
+                == "advertised_range", mode
+        # huge-but-sub-structural head passes the screen (the need cap
+        # is its bound) and an honest state passes clean
+        assert a._screen_sync_state(
+            ByzantineSyncServer(seed=0, mode="huge_head")
+            .advertised_state()
+        ) is None
+        honest = SyncStateV1(actor_id=ActorId(b"\x01" * 16))
+        honest.heads[ActorId(b"\x02" * 16)] = Version(41)
+        honest.need[ActorId(b"\x02" * 16)] = [(3, 9)]
+        assert a._screen_sync_state(honest) is None
+        # inverted partial seq spans are structural lies too
+        hostile = SyncStateV1(actor_id=ActorId(b"\x01" * 16))
+        hostile.heads[ActorId(b"\x02" * 16)] = Version(5)
+        hostile.partial_need[ActorId(b"\x02" * 16)] = {
+            Version(3): [(7, 2)]
+        }
+        assert a._screen_sync_state(hostile) == "advertised_range"
+    finally:
+        a.storage.close()
+
+
+def test_allocate_needs_caps_hostile_head(tmp_path):
+    """A head just under the structural-lie line must not allocate an
+    unbounded need queue: the per-session cap bounds the round and
+    counts the rejection."""
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.faults import ByzantineSyncServer
+
+    a = make_offline_agent(tmpdir=str(tmp_path))
+    try:
+        byz = ByzantineSyncServer(seed=0, mode="huge_head")
+        sessions = [{"member": None, "theirs": byz.advertised_state()}]
+        a._allocate_needs(sessions, a.generate_sync())
+        allocated = sum(
+            len(v) for v in sessions[0]["needs"].values()
+        )
+        assert 0 < allocated <= a.SYNC_CLIENT_NEED_CAP
+        assert a.metrics.get_counter(
+            "corro_sync_client_rejects_total", reason="need_cap") >= 1
+    finally:
+        a.storage.close()
+
+
+def test_byz_frame_garbage_and_oversize_are_contained(tmp_path):
+    from corrosion_tpu.agent.testing import make_offline_agent
+    from corrosion_tpu.bridge import speedy
+    from corrosion_tpu.faults import ByzantineSyncServer
+
+    a = make_offline_agent(tmpdir=str(tmp_path))
+    try:
+        garbage = ByzantineSyncServer(seed=0, mode="garbage_frames")
+        payloads = speedy.FrameReader().feed(garbage.serve_frames({}))
+        assert payloads  # frames deframe fine; the CONTENT is junk
+        for p in payloads:
+            import pytest as _pytest
+
+            with _pytest.raises(speedy.SpeedyError):
+                speedy.decode_sync_message(p)
+        oversized = ByzantineSyncServer(seed=0, mode="oversized_frame")
+        import pytest as _pytest
+
+        with _pytest.raises(speedy.SpeedyError):
+            speedy.FrameReader().feed(oversized.serve_frames({}))
+        # slow-trickle never completes inside any sane deadline
+        trickle = ByzantineSyncServer(seed=0, mode="slow_trickle")
+        assert trickle.serve_duration() \
+            > a.config.sync_session_deadline_s
+    finally:
+        a.storage.close()
+
+
+def test_quarantine_reason_ranking():
+    """Evidence ranking (docs/faults.md): transport-class reasons
+    (breaker/sig_failure) clear each other on restore; an unsigned
+    equivocation verdict outranks them; a signed proof outranks
+    everything, survives address moves, and is never relabeled."""
+    from corrosion_tpu.agent.members import Members
+
+    ms = Members(b"\x01" * 16)
+    actor = b"\x02" * 16
+    ms.upsert(actor, ("h", 1))
+
+    # transport class: sig_failure set, breaker restore clears it
+    ms.set_quarantined(actor, True, reason="sig_failure")
+    assert ms.get(actor).quarantine_reason == "sig_failure"
+    ms.set_quarantined(actor, False, reason="breaker")
+    assert not ms.get(actor).quarantined
+
+    # unsigned verdict outranks breaker and survives its restore
+    ms.set_quarantined(actor, True, reason="equivocation")
+    ms.set_quarantined(actor, True, reason="breaker")
+    assert ms.get(actor).quarantine_reason == "equivocation"
+    ms.set_quarantined(actor, False, reason="breaker")
+    assert ms.get(actor).quarantined
+
+    # signed proof outranks the unsigned verdict and every later
+    # weaker observation
+    ms.set_quarantined(actor, True, reason="signed_equivocation")
+    for weaker in ("breaker", "sig_failure", "equivocation"):
+        ms.set_quarantined(actor, True, reason=weaker)
+        assert ms.get(actor).quarantine_reason == "signed_equivocation"
+        ms.set_quarantined(actor, False, reason=weaker)
+        assert ms.get(actor).quarantined
+
+    # an address move clears transport evidence but never a verdict
+    ms.upsert(actor, ("h", 2), incarnation=1)
+    m = ms.get(actor)
+    assert m.quarantined
+    assert m.quarantine_reason == "signed_equivocation"
+    other = b"\x03" * 16
+    ms.upsert(other, ("h", 3))
+    ms.set_quarantined(other, True, reason="sig_failure")
+    ms.upsert(other, ("h", 4), incarnation=1)
+    assert not ms.get(other).quarantined

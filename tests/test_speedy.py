@@ -361,3 +361,150 @@ def test_traced_uni_hostile_inputs():
     assert speedy.traced_uni_payload_start(bad_utf8) == 9
     with pytest.raises(speedy.SpeedyError):
         speedy.decode_traced_uni(bad_utf8)
+
+
+# ---------------------------------------------------------------------------
+# signed uni envelope (signed changeset attribution, docs/faults.md)
+# ---------------------------------------------------------------------------
+
+SIG = bytes(range(64))
+
+
+def test_signed_uni_roundtrip():
+    classic = _classic_uni_bytes()
+    for tp, hop, sig in (
+        (TP, 3, SIG), (None, 0, SIG), (TP, 1, None), (None, 0, None),
+    ):
+        wrapped = speedy.encode_signed_uni(classic, tp, hop, sig)
+        payload, got_tp, got_hop, got_sig = speedy.decode_uni_envelope(
+            wrapped
+        )
+        assert payload == classic
+        assert (got_tp, got_hop, got_sig) == (tp, hop, sig)
+        # the walker lands exactly where the decoder says the classic
+        # bytes start, on every field combination
+        start = speedy.traced_uni_payload_start(wrapped)
+        assert wrapped[start:] == classic
+
+
+def test_signed_uni_golden_bytes():
+    """The v2 layout, byte for byte: u8 2 | u8 hop | Option<tp> |
+    Option<[u8;64] sig raw, no length prefix> | classic payload."""
+    classic = _classic_uni_bytes()
+    wrapped = speedy.encode_signed_uni(classic, None, 2, SIG)
+    assert wrapped == b"\x02\x02\x00\x01" + SIG + classic
+    no_sig = speedy.encode_signed_uni(classic, None, 2, None)
+    assert no_sig == b"\x02\x02\x00\x00" + classic
+    with_tp = speedy.encode_signed_uni(classic, TP, 0, SIG)
+    tp_bytes = TP.encode()
+    assert with_tp == (
+        b"\x02\x00\x01" + struct.pack("<I", len(tp_bytes)) + tp_bytes
+        + b"\x01" + SIG + classic
+    )
+
+
+def test_signed_uni_envelope_versions_interoperate():
+    """decode_uni_envelope accepts all three wire formats; the legacy
+    decode_traced_uni surface keeps working on v2 frames (dropping the
+    signature), so pre-signing consumers never break."""
+    classic = _classic_uni_bytes()
+    v1 = speedy.encode_traced_uni(classic, TP, 1)
+    v2 = speedy.encode_signed_uni(classic, TP, 1, SIG)
+    assert speedy.decode_uni_envelope(classic) == (classic, None, 0, None)
+    assert speedy.decode_uni_envelope(v1) == (classic, TP, 1, None)
+    assert speedy.decode_uni_envelope(v2) == (classic, TP, 1, SIG)
+    assert speedy.decode_traced_uni(v2) == (classic, TP, 1)
+
+
+def test_signed_uni_hostile_inputs():
+    classic = _classic_uni_bytes()
+    wrapped = speedy.encode_signed_uni(classic, TP, 1, SIG)
+    # wrong sig length at ENCODE time
+    with pytest.raises(speedy.SpeedyError):
+        speedy.encode_signed_uni(classic, None, 0, b"short")
+    with pytest.raises(speedy.SpeedyError):
+        speedy.encode_signed_uni(classic, None, 0, SIG + b"x")
+    # flipped version byte: unknown envelope on BOTH sides
+    flipped = b"\x07" + wrapped[1:]
+    with pytest.raises(speedy.SpeedyError):
+        speedy.decode_uni_envelope(flipped)
+    with pytest.raises(speedy.SpeedyError):
+        speedy.traced_uni_payload_start(flipped)
+    # truncated signature: structural, rejected by BOTH sides
+    trunc = speedy.encode_signed_uni(classic, None, 0, SIG)[: 4 + 40]
+    with pytest.raises(speedy.SpeedyError):
+        speedy.decode_uni_envelope(trunc)
+    with pytest.raises(speedy.SpeedyError):
+        speedy.traced_uni_payload_start(trunc)
+    # bad sig Option tag
+    bad_tag = b"\x02\x00\x00\x07" + classic
+    with pytest.raises(speedy.SpeedyError):
+        speedy.decode_uni_envelope(bad_tag)
+    with pytest.raises(speedy.SpeedyError):
+        speedy.traced_uni_payload_start(bad_tag)
+    # truncated right after the header
+    for cut in (b"\x02", b"\x02\x00", b"\x02\x00\x00"):
+        with pytest.raises(speedy.SpeedyError):
+            speedy.traced_uni_payload_start(cut)
+    # oversized traceparent still rejected under v2
+    big = (b"\x02\x00\x01" + struct.pack("<I", 4096) + b"x" * 4096
+           + b"\x00" + classic)
+    with pytest.raises(speedy.SpeedyError):
+        speedy.traced_uni_payload_start(big)
+    with pytest.raises(speedy.SpeedyError):
+        speedy.decode_uni_envelope(big)
+
+
+def test_signed_uni_walker_decoder_parity_fuzz():
+    """Mutation corpus over all three envelope versions: whenever the
+    offset walker (live ingest's prelude screen) REJECTS a frame, the
+    full decoder must reject it too — and whenever both accept, they
+    must agree on where the classic payload starts.  (The walker may
+    be more permissive only about CONTENT it never inspects, e.g.
+    traceparent UTF-8 — the PR 6 precedent.)"""
+    import random
+
+    classic = _classic_uni_bytes()
+    corpus = [
+        classic,
+        speedy.encode_traced_uni(classic, TP, 1),
+        speedy.encode_traced_uni(classic, None, 0),
+        speedy.encode_signed_uni(classic, TP, 1, SIG),
+        speedy.encode_signed_uni(classic, None, 2, SIG),
+        speedy.encode_signed_uni(classic, TP, 0, None),
+    ]
+    rng = random.Random(0xC0FFEE)
+    cases = list(corpus)
+    for base in corpus:
+        for _ in range(80):
+            mutated = bytearray(base)
+            op = rng.randrange(3)
+            if op == 0 and mutated:  # flip a byte
+                i = rng.randrange(len(mutated))
+                mutated[i] ^= 1 << rng.randrange(8)
+            elif op == 1:            # truncate
+                mutated = mutated[: rng.randrange(len(mutated) + 1)]
+            else:                    # append junk
+                mutated += bytes(
+                    rng.randrange(256) for _ in range(rng.randrange(8))
+                )
+            cases.append(bytes(mutated))
+    for data in cases:
+        try:
+            start = speedy.traced_uni_payload_start(data)
+            walker_ok = True
+        except speedy.SpeedyError:
+            walker_ok = False
+        try:
+            payload, _tp, _hop, _sig = speedy.decode_uni_envelope(data)
+            decoder_ok = True
+        except speedy.SpeedyError:
+            decoder_ok = False
+        if not walker_ok:
+            assert not decoder_ok, (
+                f"walker rejected but decoder accepted: {data!r}"
+            )
+        if walker_ok and decoder_ok:
+            assert data[start:] == payload, (
+                f"walker/decoder disagree on payload start: {data!r}"
+            )

@@ -27,6 +27,11 @@ def run():
 def certs(tmp_path):
     """CA + server cert (valid for 127.0.0.1) + client cert via the
     same code paths the CLI uses."""
+    pytest.importorskip(
+        "cryptography",
+        reason="cert GENERATION needs the cryptography package "
+               "(serving existing PEM files is stdlib-only)",
+    )
     from corrosion_tpu.agent.tls import (
         generate_ca, generate_client_cert, generate_server_cert,
     )
@@ -44,7 +49,48 @@ def certs(tmp_path):
     }
 
 
+def test_cli_tls_generate_without_cryptography_is_actionable(
+    tmp_path, capsys, monkeypatch,
+):
+    """Satellite regression: on hosts without the ``cryptography``
+    package (this container, deliberately), every ``tls ... generate``
+    command must exit 1 with an actionable install hint — never a raw
+    ModuleNotFoundError traceback from deep inside ``agent/tls.py``."""
+    import builtins
+    import sys as _sys
+
+    from corrosion_tpu.cli import main
+
+    real_import = builtins.__import__
+
+    def no_crypto(name, *a, **kw):
+        if name == "cryptography" or name.startswith("cryptography."):
+            raise ModuleNotFoundError(
+                "No module named 'cryptography'", name="cryptography"
+            )
+        return real_import(name, *a, **kw)
+
+    # simulate absence even where the package IS installed (and drop
+    # any cached modules so the block actually bites)
+    for mod in [m for m in _sys.modules if m.startswith("cryptography")]:
+        monkeypatch.delitem(_sys.modules, mod)
+    monkeypatch.setattr(builtins, "__import__", no_crypto)
+
+    d = str(tmp_path)
+    for argv in (
+        ["tls", "ca", "generate", "--dir", d],
+        ["tls", "server", "generate", "127.0.0.1", "--dir", d],
+        ["tls", "client", "generate", "--dir", d],
+    ):
+        assert main(argv) == 1
+        err = capsys.readouterr().err
+        assert "cryptography" in err
+        assert "pip install cryptography" in err
+        assert "Traceback" not in err
+
+
 def test_cli_tls_generate(tmp_path):
+    pytest.importorskip("cryptography")
     from corrosion_tpu.cli import main
 
     d = str(tmp_path)
