@@ -737,6 +737,167 @@ def run_sync_bench(n_versions: int = 10_000,
     return out
 
 
+# -- bootstrap recovery benchmark (bench.py --boot) --------------------
+
+
+async def _boot_arm(seed_dir: str, origin: bytes, n_versions: int,
+                    snapshot: bool, timeout: float = 300.0) -> dict:
+    """One bootstrap arm: a server over the seeded history and a FRESH
+    node recovering from zero.  ``snapshot=False`` is the oracle arm —
+    floors never advance (snapshot_serve off, retain disabled), every
+    version crosses change-by-change; ``snapshot=True`` compacts the
+    server's floor over the whole history first, so the client's only
+    below-floor path is snapshot install + tail sync.  Recovery wall
+    runs from client construction to full containment, and the
+    client's own flight recorder journals the trajectory."""
+    import tempfile as _tempfile
+
+    from corrosion_tpu.agent.runtime import Agent, AgentConfig
+    from corrosion_tpu.agent.testing import TEST_SCHEMA, wait_for
+
+    server = Agent(AgentConfig(
+        db_path=os.path.join(seed_dir, "corrosion.db"),
+        snapshot_serve=snapshot,
+        snapshot_retain_versions=0 if snapshot else -1,
+    ))
+    await server.start()
+    if snapshot:
+        # maintenance-driven history compaction, run eagerly: the
+        # origin's whole ledger drops below the snapshot floor
+        await asyncio.get_running_loop().run_in_executor(
+            None, server._compaction_pass
+        )
+        floor = server.bookie.for_actor(origin).snap_floor
+        assert floor >= n_versions, floor
+    import shutil as _shutil
+
+    client_dir = _tempfile.mkdtemp(prefix="corro-boot-client-")
+    client = Agent(AgentConfig(
+        db_path=os.path.join(client_dir, "corrosion.db"),
+        bootstrap=[f"127.0.0.1:{server.gossip_addr[1]}"],
+        schema_sql=TEST_SCHEMA,
+        sync_interval_min=0.1, sync_interval_max=0.3,
+        snapshot_install=snapshot,
+        flight_interval_s=0.25,
+    ))
+    t0 = time.perf_counter()
+    converged = True
+    try:
+        await client.start()
+
+        def _contained() -> bool:
+            # re-fetch per check: a snapshot install REBUILDS the
+            # bookie's per-actor ledgers in place, so a captured
+            # BookedVersions reference would go stale at the swap
+            bv = client.bookie.for_actor(origin)
+            return (bv.last() >= n_versions
+                    and bv.contains_range(1, n_versions))
+
+        try:
+            await wait_for(_contained, timeout=timeout, interval=0.05)
+        except TimeoutError:
+            converged = False
+        wall = time.perf_counter() - t0
+        installs = client.metrics.get_counter(
+            "corro_snapshot_installs_total", result="ok"
+        )
+        # the flight-recorder trajectory: the client's own journal of
+        # the recovery, offsets relative to the measured t0 — the
+        # artifact gate reads the install event out of THIS record
+        wall0 = time.time() - (time.perf_counter() - t0)
+        events = []
+        if client.flight is not None:
+            for e in client.flight.entries(kind="event"):
+                if e["kind"].startswith(("snap_", "sync_client")):
+                    events.append({
+                        "kind": e["kind"],
+                        "t_s": round(e["wall"] - wall0, 3),
+                        "attrs": e.get("attrs", {}),
+                    })
+        served_bytes = server.metrics.get_counter(
+            "corro_snapshot_bytes_total", dir="served"
+        )
+    finally:
+        await client.stop()
+        await server.stop()
+        _shutil.rmtree(client_dir, ignore_errors=True)
+    return {
+        "mode": "snapshot" if snapshot else "changes",
+        "recovery_s": round(wall, 3),
+        "converged": converged,
+        "versions_per_s": round(n_versions / max(wall, 1e-9), 1),
+        "snapshot_installs": installs,
+        "snapshot_served_bytes": served_bytes,
+        "trajectory": events[:50],
+    }
+
+
+def run_boot_bench(n_versions: int = 10_000,
+                   out_path: str = "BOOT_BENCH.json") -> dict:
+    """Recovery-time benchmark (docs/sync.md, docs/ops.md): a fresh
+    node bootstrapping a ``n_versions`` foreign history change-by-
+    change (the pre-snapshot oracle) vs via snapshot install + tail
+    sync.  Headline: the snapshot path's recovery speedup, gated >=5x
+    at the 10k shape with the recovery-time budget in-record; the
+    trajectory (the client's own flight-recorder journal) must show
+    the install completing the recovery."""
+    import tempfile
+
+    points: dict = {}
+    with tempfile.TemporaryDirectory(prefix="corro-boot-bench-") as d:
+        origin = _sync_seed_server(d, n_versions)
+        # oracle arm FIRST: it needs the uncompacted ledger
+        points["changes"] = asyncio.run(
+            _boot_arm(d, origin, n_versions, snapshot=False)
+        )
+        points["snapshot"] = asyncio.run(
+            _boot_arm(d, origin, n_versions, snapshot=True)
+        )
+    ch, sn = points["changes"], points["snapshot"]
+    speedup = round(
+        ch["recovery_s"] / max(sn["recovery_s"], 1e-9), 2
+    )
+    ok = ch["converged"] and sn["converged"] \
+        and sn["snapshot_installs"] >= 1
+    install_events = [
+        e for e in sn["trajectory"] if e["kind"] == "snap_install"
+    ]
+    # the budget the artifact lint asserts in-record: the snapshot
+    # recovery must beat HALF the oracle's wall outright (the >=5x
+    # headline floor is separately asserted at the 10k shape)
+    budget_s = round(max(5.0, ch["recovery_s"] / 2.0), 3)
+    out = {
+        "metric": "boot_recovery_speedup",
+        "value": speedup if ok else None,
+        "unit": "x",
+        "conditions": (
+            f"fresh-node recovery of a {n_versions}-version foreign "
+            "history (2 cells/version): change-by-change anti-entropy "
+            "(uncompacted server, snapshot off) vs snapshot install + "
+            "tail sync (server floor compacted over the whole "
+            "history); wall from client construction to full "
+            "containment of versions 1..n, one live server per arm on "
+            "loopback"
+        ),
+        "n_versions": n_versions,
+        "recovery_budget_s": budget_s,
+        "points": points,
+        "gates": {
+            "both_converged": ch["converged"] and sn["converged"],
+            "installed_via_snapshot": sn["snapshot_installs"] >= 1,
+            "trajectory_has_install": len(install_events) >= 1,
+            "within_budget": sn["recovery_s"] <= budget_s,
+        },
+    }
+    if not ok:
+        out["error"] = "bootstrap arm failed to converge or install"
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(_sanitize(out), f, indent=2)
+            f.write("\n")
+    return out
+
+
 # -- write-path microbenchmark (bench.py --write) ----------------------
 
 
@@ -1783,6 +1944,15 @@ def main() -> None:
                          "SYNC_BENCH.json, and exit")
     ap.add_argument("--sync-versions", type=int, default=10_000,
                     help="backfill size for --sync")
+    ap.add_argument("--boot", action="store_true",
+                    help="run the bootstrap-recovery benchmark (fresh "
+                         "node catching up a 10k-version foreign "
+                         "history change-by-change vs snapshot "
+                         "install + tail sync, recovery wall + "
+                         "flight-recorder trajectory), write "
+                         "BOOT_BENCH.json, and exit")
+    ap.add_argument("--boot-versions", type=int, default=10_000,
+                    help="history size for --boot")
     ap.add_argument("--write", action="store_true",
                     help="run the per-tx vs group-commit WRITE "
                          "microbenchmark (1k/10k transactions, 1/8/32 "
@@ -1810,6 +1980,13 @@ def main() -> None:
             os.path.dirname(os.path.abspath(__file__)), "SYNC_BENCH.json"
         )
         _emit(run_sync_bench(n_versions=args.sync_versions,
+                             out_path=out_path))
+        return
+    if args.boot:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BOOT_BENCH.json"
+        )
+        _emit(run_boot_bench(n_versions=args.boot_versions,
                              out_path=out_path))
         return
     if args.write:

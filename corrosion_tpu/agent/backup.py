@@ -21,11 +21,9 @@ from typing import Optional
 def _connect(path: str) -> sqlite3.Connection:
     """Open an agent database with the CRR layer's SQL functions
     registered (expression indexes reference them)."""
-    from corrosion_tpu.agent.storage import register_udfs
+    from corrosion_tpu.agent.snapshot import _connect as _snap_connect
 
-    conn = sqlite3.connect(path)
-    register_udfs(conn)
-    return conn
+    return _snap_connect(path)
 
 
 def backup(db_path: str, out_path: str) -> None:
@@ -39,16 +37,18 @@ def backup(db_path: str, out_path: str) -> None:
         src.close()
     snap = _connect(out_path)
     try:
-        # scrub node-local state: membership and gossip runtime tables are
-        # not part of the data being backed up
-        tables = {
-            r[0]
-            for r in snap.execute(
-                "SELECT name FROM sqlite_master WHERE type='table'"
-            )
-        }
-        if "__corro_members" in tables:
-            snap.execute("DELETE FROM __corro_members")
+        # scrub node-local state through the SHARED decision registry
+        # (snapshot.SNAP_SCRUB/SNAP_KEEP): membership, the compaction
+        # work list and the node-local equivocation digest FIFO go;
+        # signed equivocation proofs (portable cluster evidence) and
+        # the pending as_crr backfill queue (its rows travel
+        # unversioned — the restored node's boot re-registration needs
+        # the entry) stay.  An internal table with no registered
+        # decision raises — a future bookkeeping table cannot silently
+        # leak into backups
+        from corrosion_tpu.agent.snapshot import scrub_snapshot
+
+        scrub_snapshot(snap)
         snap.commit()
         snap.execute("VACUUM")
     finally:
@@ -72,45 +72,26 @@ def restore(backup_path: str, db_path: str,
 
     src = _connect(backup_path)
     dst = _connect(db_path)
+    has_sites = False
     try:
         src.backup(dst)
-        new_site = site_id or uuid.uuid4().bytes
-        row = dst.execute(
-            "SELECT site_id FROM __corro_sites WHERE ordinal=1"
-        ).fetchone()
-        if row is not None and bytes(row[0]) != new_site:
-            old_site = bytes(row[0])
-            # move the origin identity to a fresh ordinal...
-            (max_ord,) = dst.execute(
-                "SELECT COALESCE(MAX(ordinal), 1) FROM __corro_sites"
-            ).fetchone()
-            new_ord = max_ord + 1
-            dst.execute(
-                "UPDATE __corro_sites SET ordinal=? WHERE ordinal=1", (new_ord,)
-            )
-            # ...rewriting its attribution in every clock table...
-            tables = [
-                r[0]
-                for r in dst.execute(
-                    "SELECT name FROM __corro_crr_tables"
-                ).fetchall()
-            ]
-            for t in tables:
-                for suffix in ("__corro_clock", "__corro_cl"):
-                    dst.execute(
-                        f'UPDATE "{t}{suffix}" SET site_ordinal=? '
-                        "WHERE site_ordinal=1",
-                        (new_ord,),
-                    )
-            # ...and installing the restored node's own identity at slot 1
-            dst.execute(
-                "INSERT INTO __corro_sites (ordinal, site_id) VALUES (1, ?)",
-                (new_site,),
-            )
         dst.commit()
+        has_sites = dst.execute(
+            "SELECT 1 FROM __corro_sites WHERE ordinal=1"
+        ).fetchone() is not None
     finally:
         src.close()
         dst.close()
+    new_site = site_id or uuid.uuid4().bytes
+    if has_sites:
+        # ONE identity-rewrite implementation, shared with the
+        # snapshot install path (snapshot.prepare_staged): the origin
+        # moves to a fresh ordinal with its clock attribution intact,
+        # ordinal 1 becomes the restored node's own site id — reusing
+        # an existing ordinal when the backup already knew this id
+        from corrosion_tpu.agent.snapshot import prepare_staged
+
+        prepare_staged(db_path, new_site)
     for ext in ("-wal", "-shm"):
         p = db_path + ext
         if os.path.exists(p):

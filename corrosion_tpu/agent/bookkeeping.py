@@ -55,6 +55,13 @@ class BookedVersions:
         self.versions: Dict[int, Tuple[int, int]] = {}
         self.max_version: int = 0
         self.last_cleared_ts: Optional[Timestamp] = None
+        # snapshot floor (docs/sync.md): versions 1..=snap_floor are
+        # fully reflected in current table state, and their per-version
+        # bookkeeping has been COMPACTED away — they can only be
+        # obtained from this node via snapshot install, never
+        # change-by-change.  Advanced by maintenance-driven history
+        # compaction (runtime._advance_snapshot_floors)
+        self.snap_floor: int = 0
         # dirty-flag hook (Bookie.gen): every mutation that can change a
         # generate_sync snapshot reports upward so the runtime can cache
         # the snapshot between bookkeeping changes
@@ -70,9 +77,14 @@ class BookedVersions:
         return self.max_version
 
     def contains_version(self, v: int) -> bool:
-        """Do we fully have v (applied or known-cleared)?"""
+        """Do we fully have v (applied, known-cleared, or below the
+        compacted snapshot floor)?"""
         if v > self.max_version:
             return False
+        if v <= self.snap_floor:
+            # the floor only ever advances over a fully-contained
+            # prefix, so everything at or below it is held by contract
+            return True
         if self.needed.contains(v):
             return False
         if v in self.partials:
@@ -160,6 +172,37 @@ class BookedVersions:
         self._touch()
         return partial
 
+    def contained_prefix(self) -> int:
+        """The largest F with versions 1..=F all fully held (applied or
+        cleared; no gaps, no partials) — the ceiling a snapshot floor
+        may advance to."""
+        hi = self.max_version
+        spans = self.needed.spans()
+        if spans:
+            hi = min(hi, spans[0][0] - 1)
+        for v in self.partials:
+            if v <= hi:
+                hi = v - 1
+        return max(hi, 0)
+
+    def set_snap_floor(self, floor: int) -> None:
+        """Advance the snapshot floor, dropping the per-version
+        in-memory ledger it compacts (the persisted rows go in the
+        same transaction via ``Bookie.compact_below_floor``)."""
+        if floor <= self.snap_floor:
+            return
+        self.snap_floor = floor
+        if floor > self.max_version:
+            self.max_version = floor
+        for v in [v for v in self.versions if v <= floor]:
+            del self.versions[v]
+        for v in [v for v in self.partials if v <= floor]:
+            del self.partials[v]
+        # the floor only advances over a contained prefix, so this is
+        # belt-and-braces against a reloaded inconsistent ledger
+        self.needed.remove(1, floor)
+        self._touch()
+
     # -- sync handshake feed ---------------------------------------------
 
     def needed_spans(self) -> List[Tuple[int, int]]:
@@ -209,6 +252,11 @@ CREATE TABLE IF NOT EXISTS __corro_bookkeeping_gaps (
 CREATE TABLE IF NOT EXISTS __corro_sync_state (
   actor_id BLOB PRIMARY KEY NOT NULL,
   last_cleared_ts INTEGER
+);
+CREATE TABLE IF NOT EXISTS __corro_snap_floors (
+  actor_id BLOB PRIMARY KEY NOT NULL,
+  floor INTEGER NOT NULL,
+  ts INTEGER
 );
 """
 
@@ -270,6 +318,13 @@ CREATE TABLE IF NOT EXISTS __corro_sync_state (
                     self.for_actor(bytes(actor)).update_cleared_ts(
                         Timestamp(ts)
                     )
+            for actor, floor in self.conn.execute(
+                "SELECT actor_id, floor FROM __corro_snap_floors"
+            ):
+                # the floor record re-extends max_version: the concrete
+                # rows below it were compacted away, so without it a
+                # reloaded ledger would under-report the actor's head
+                self.for_actor(bytes(actor)).set_snap_floor(int(floor))
 
     def backfill_own_sync_state(self, actor_id: bytes) -> None:
         """Restore OUR OWN cleared watermark from cleared-row timestamps
@@ -430,6 +485,53 @@ CREATE TABLE IF NOT EXISTS __corro_sync_state (
             " excluded.last_cleared_ts)",
             (actor_id, int(ts)),
         )
+
+    def persist_floor(self, actor_id: bytes, floor: int,
+                      ts: Optional[int] = None) -> None:
+        """Write-through for a snapshot-floor advance (call inside the
+        same transaction as :meth:`compact_below_floor`)."""
+        self.conn.execute(
+            "INSERT INTO __corro_snap_floors (actor_id, floor, ts) "
+            "VALUES (?, ?, ?) ON CONFLICT (actor_id) DO UPDATE SET "
+            "floor = MAX(floor, excluded.floor), ts = excluded.ts",
+            (actor_id, int(floor), ts),
+        )
+
+    def compact_below_floor(self, actor_id: bytes, floor: int) -> int:
+        """History compaction: delete the per-version bookkeeping this
+        floor advance subsumes — concrete applied rows, partial seq
+        rows, and buffered chunks at or below ``floor``.  Cleared-range
+        rows are KEPT (they are already compact spans, and the
+        EmptySet/watermark serving path still reads them).  Returns
+        rows deleted; call inside the floor-advance transaction."""
+        deleted = 0
+        cur = self.conn.execute(
+            "DELETE FROM __corro_bookkeeping WHERE actor_id=? "
+            "AND end_version IS NULL AND start_version <= ?",
+            (actor_id, int(floor)),
+        )
+        deleted += cur.rowcount
+        for table in ("__corro_seq_bookkeeping", "__corro_buffered_changes"):
+            cur = self.conn.execute(
+                f"DELETE FROM {table} WHERE actor_id=? AND version <= ?",
+                (actor_id, int(floor)),
+            )
+            deleted += cur.rowcount
+        return deleted
+
+    def reload(self, conn) -> None:
+        """Rebuild the whole in-memory ledger from ``conn`` — the
+        post-snapshot-install path: the database file was atomically
+        swapped, so every actor's state re-derives from the installed
+        tables.  The Bookie OBJECT survives (everything holding a
+        reference keeps working); only its contents change."""
+        self.conn = conn
+        with self._lock:
+            conn.executescript(self.TABLES)
+            self._actors.clear()
+            self._persisted_gaps.clear()
+            self._bump_gen()
+            self._load()
 
     def version_ts(self, actor_id: bytes, version: int) -> Optional[int]:
         """The HLC ts recorded when ``version`` was applied (the sync

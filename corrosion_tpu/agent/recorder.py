@@ -68,6 +68,14 @@ EVENT_KINDS: Dict[str, str] = {
                             " (reason=stmt|abort)",
     "equivocation": "hostile-changeset verdict (actor, kind=content|"
                     "span|quarantined)",
+    "snap_serve": "served one whole-database snapshot to a"
+                  " bootstrapping peer (peer, bytes)",
+    "snap_install": "installed a served snapshot: digest verified,"
+                    " identity rewritten, file atomically swapped in"
+                    " (peer, bytes)",
+    "snap_abort": "discarded a staged snapshot cleanly (reason="
+                  "snap_digest|snap_stream|snap_offer|snap_prepare|"
+                  "snap_stale); the previous database is untouched",
     "crash": "non-graceful stop injected by devcluster.run_crash_schedule",
     "restart": "respawn from the same node directory after an injected"
                " crash",

@@ -29,6 +29,7 @@ import asyncio
 import hashlib
 import os
 import random
+import sqlite3
 import struct
 import threading
 import time
@@ -298,6 +299,37 @@ class AgentConfig:
     # otherwise hold a session (and its needs) hostage forever.
     # 0 disables the deadline
     sync_session_deadline_s: float = 60.0
+    # -- snapshot bootstrap (docs/sync.md, agent/snapshot.py) -----------
+    # serve side: answer snap_request sessions with a consistent
+    # VACUUM-INTO copy (scrubbed via the shared snapshot registry) and
+    # advertise per-actor snapshot floors in the sync handshake
+    snapshot_serve: bool = True
+    # client side: dispatch to snapshot install when a server's floors
+    # cover needs it can no longer serve change-by-change; off = this
+    # node only ever bootstraps change-by-change
+    snapshot_install: bool = True
+    # snap_chunk payload size on the serve stream
+    snapshot_chunk_bytes: int = 256 * 1024
+    # client-side offer screen: an advertised snapshot larger than this
+    # is rejected before a byte is staged (reason=snap_offer)
+    snapshot_max_bytes: int = 1 << 30
+    # serve-side build cache: a restart storm re-serves ONE snapshot
+    # file for this long instead of re-vacuuming per reborn client
+    snapshot_cache_s: float = 5.0
+    # history compaction: the snapshot floor advances to the contained
+    # prefix minus this retain window — the newest `retain` versions
+    # stay servable change-by-change (cheap incremental catch-up);
+    # everything below the floor compacts its per-version bookkeeping
+    # and is only obtainable via snapshot.  Negative disables floor
+    # advancement entirely
+    snapshot_retain_versions: int = 2000
+    # maintenance-driven compaction cadence (docs/sync.md): the sweep
+    # that finds overwritten versions AND advances snapshot floors runs
+    # on its own loop at this interval, so an idle-but-serving node's
+    # cleared spans and floor keep moving without a local write to
+    # trigger the post-commit sweep.  0 disables the dedicated loop
+    # (the slower maintenance_interval pass still runs it)
+    compaction_interval: float = 30.0
     pg_port: Optional[int] = None  # PostgreSQL wire protocol (None = off)
     pg_host: Optional[str] = None  # PG bind host (None = api_host)
     # PG TLS client-cert verification is its OWN knob (corro-pg
@@ -399,6 +431,16 @@ class Agent:
         # lock tracking costs a few ops per acquisition on the hottest
         # lock; only pay for it when the admin surface can read it
         self.lock_registry = LockRegistry()
+        # crash-safe snapshot install (agent/snapshot.py): a node
+        # killed at ANY install point classifies here BEFORE storage
+        # opens — either the swap completed (boot into the installed
+        # snapshot + tail sync) or the sidecar/journal are discarded
+        # (boot into the untouched previous database + clean retry)
+        from corrosion_tpu.agent import snapshot as snaplib
+
+        self._snap_recovered = snaplib.recover_pending_install(
+            config.db_path
+        )
         self.storage = CrConn(
             config.db_path,
             site_id=config.site_id,
@@ -421,6 +463,15 @@ class Agent:
         from corrosion_tpu.agent.metrics import Metrics
 
         self.metrics = Metrics()
+        if self._snap_recovered is not None:
+            self.metrics.counter(
+                "corro_snapshot_recoveries_total",
+                stage=self._snap_recovered,
+            )
+        # snapshot serve cache + build serialization (one VACUUM at a
+        # time; a restart storm's clients share the cached file)
+        self._snap_cache: Optional[tuple] = None
+        self._snap_build_lock = threading.Lock()
         self._members_table()
         # incarnation survives restarts one-higher: a gracefully-left
         # node re-announces ALIVE above the DOWN record peers hold for
@@ -765,6 +816,10 @@ class Agent:
             self._spawn_task(self._sync_loop(), "sync"),
             self._spawn_task(self._maintenance_loop(), "maintenance"),
         ]
+        if self.config.compaction_interval > 0:
+            self._tasks.append(
+                self._spawn_task(self._compaction_loop(), "compaction")
+            )
         if self.config.stall_probe_interval > 0:
             from corrosion_tpu.agent.health import LoopHealthProbe
 
@@ -2035,6 +2090,85 @@ class Agent:
             self._find_and_clear_overwritten()
         except Exception:
             self.metrics.counter("corro_compaction_sweep_errors_total")
+
+    async def _compaction_loop(self) -> None:
+        """Maintenance-driven compaction on its own cadence
+        (``AgentConfig.compaction_interval``): an idle-but-serving node
+        has no post-commit sweep to piggyback on, so without this loop
+        its cleared spans and snapshot floor would only move on the
+        (much slower) maintenance tick.  The SQL body runs on the apply
+        pool like the maintenance pass."""
+        while True:
+            await self._clock.sleep(self.config.compaction_interval)
+            try:
+                await self._loop.run_in_executor(
+                    self._apply_pool, self._compaction_pass
+                )
+            except Exception:
+                pass
+
+    def _compaction_pass(self) -> int:
+        """One maintenance-driven compaction sweep (worker thread):
+        clear overwritten versions, then advance snapshot floors over
+        the freshly-extended contained prefixes.  Returns the versions
+        cleared + ledger rows compacted, counted under
+        ``corro_compaction_maintenance_clears_total``."""
+        work = 0
+        try:
+            cleared = self._find_and_clear_overwritten()
+            work += sum(e - s + 1 for s, e in cleared)
+        except Exception:
+            self.metrics.counter("corro_compaction_sweep_errors_total")
+        try:
+            work += self._advance_snapshot_floors()
+        except Exception:
+            self.metrics.counter("corro_compaction_sweep_errors_total")
+        if work:
+            self.metrics.counter(
+                "corro_compaction_maintenance_clears_total", work
+            )
+        return work
+
+    def _advance_snapshot_floors(self) -> int:
+        """Background history compaction (docs/sync.md): per actor,
+        advance the snapshot floor to the contained prefix minus the
+        retain window, deleting the per-version bookkeeping it subsumes
+        — after which those versions are only obtainable from this
+        node via snapshot install (the serve path's plan walk simply
+        no longer resolves them, and the advertised floor tells
+        clients why).  Returns ledger rows compacted."""
+        if not self.config.snapshot_serve:
+            return 0
+        retain = self.config.snapshot_retain_versions
+        if retain < 0:
+            return 0
+        compacted = 0
+        advanced = False
+        with self.storage._lock.prio(PRIO_LOW, "snap-floor"):
+            for actor, bv in list(self.bookie.actors().items()):
+                target = bv.contained_prefix() - retain
+                if target <= bv.snap_floor or target <= 0:
+                    continue
+                ts = int(self.clock.new_timestamp())
+                self.storage.conn.execute("BEGIN IMMEDIATE")
+                try:
+                    compacted += self.bookie.compact_below_floor(
+                        actor, target
+                    )
+                    self.bookie.persist_floor(actor, target, ts)
+                except BaseException:
+                    self.storage.conn.execute("ROLLBACK")
+                    raise
+                self.storage.conn.execute("COMMIT")
+                bv.set_snap_floor(target)
+                advanced = True
+        if advanced:
+            self.metrics.counter("corro_snapshot_floor_advances_total")
+            self.metrics.gauge(
+                "corro_snapshot_floor",
+                self.bookie.for_actor(self.actor_id).snap_floor,
+            )
+        return compacted
 
     def _queue_or_defer_cv(self, cv: ChangeV1,
                            traceparent: Optional[str] = None) -> None:
@@ -3783,6 +3917,11 @@ class Agent:
                 state.partial_need[aid] = {
                     Version(v): gaps for v, gaps in partials.items()
                 }
+            if self.config.snapshot_serve and bv.snap_floor > 0:
+                # advertised floors drive the client-side snapshot
+                # dispatch: needs at-or-below a floor cannot be served
+                # change-by-change from this node (docs/sync.md)
+                state.snap_floors[aid] = bv.snap_floor
             if actor == self.actor_id:
                 state.last_cleared_ts = bv.last_cleared_ts
         return state
@@ -3846,8 +3985,10 @@ class Agent:
     def _maintenance_pass(self) -> None:
         """One blocking maintenance sweep (worker thread)."""
         try:
-            # crash-leftover impacted versions from before a restart
-            self._find_and_clear_overwritten()
+            # crash-leftover impacted versions from before a restart +
+            # snapshot-floor advancement (the dedicated compaction loop
+            # normally runs this faster; this is the backstop cadence)
+            self._compaction_pass()
             self._clear_buffered_meta()
         except Exception:
             pass
@@ -3987,7 +4128,16 @@ class Agent:
             if not sessions:
                 self.metrics.counter("corro_sync_empty_rounds_total")
                 return 0
+            snap_sess = None
             try:
+                # snapshot-or-changes dispatch (docs/sync.md): a server
+                # whose advertised floors cover needs it can no longer
+                # serve change-by-change gets a snap_request instead of
+                # need allocation (its needs are satisfied wholesale by
+                # the install + tail round)
+                snap_sess, sessions = self._pick_snapshot_session(
+                    sessions, ours
+                )
                 self._allocate_needs(sessions, ours)
                 kind_counts: Dict[str, int] = {}
                 for sess in sessions:
@@ -3997,6 +4147,8 @@ class Agent:
                                 "full", "partial", "empty"
                             ) else "other"
                             kind_counts[k] = kind_counts.get(k, 0) + 1
+                if snap_sess is not None:
+                    kind_counts["snapshot"] = 1
                 for k, c in kind_counts.items():
                     self.metrics.counter(
                         "corro_sync_needs_requested_total", c, kind=k
@@ -4005,9 +4157,17 @@ class Agent:
                 # one malformed peer state must not leak the other sessions
                 for s in sessions:
                     s["writer"].close()
+                if snap_sess is not None:
+                    snap_sess["writer"].close()
                 raise
+            session_tasks = [self._sync_session(s) for s in sessions]
+            if snap_sess is not None:
+                session_tasks.append(
+                    self._snapshot_client_session(snap_sess)
+                )
+                sessions = sessions + [snap_sess]
             results = await asyncio.gather(
-                *(self._sync_session(s) for s in sessions),
+                *session_tasks,
                 return_exceptions=True,
             )
             total = 0
@@ -4640,6 +4800,12 @@ class Agent:
                     if live is not None:
                         live["needs_done"] += 1
 
+            async def run_snapshot() -> None:
+                async with job_sem:
+                    await self._serve_snapshot(writer, sess)
+                    if live is not None:
+                        live["needs_done"] += 1
+
             try:
                 frames = speedy.FrameReader()
                 payloads: List[bytes] = []
@@ -4702,6 +4868,22 @@ class Agent:
                                 self.clock.update_with_timestamp(msg)
                             except Exception:
                                 pass
+                        elif isinstance(msg, tuple) \
+                                and msg[0] == "snap_request":
+                            # snapshot serve (docs/sync.md): one job
+                            # through the same semaphore/abort budgets
+                            # as changeset needs.  With serving off the
+                            # request is ignored — the client times out
+                            # of the session and falls back
+                            if not self.config.snapshot_serve:
+                                continue
+                            total_needs += 1
+                            t = asyncio.ensure_future(
+                                run_snapshot()
+                            )
+                            jobs.add(t)
+                            if live is not None:
+                                live["needs_total"] = total_needs
                         elif isinstance(msg, tuple) and msg[0] == "request":
                             # needs run as concurrent jobs, up to
                             # SYNC_NEED_JOBS at once (peer.rs:836-844);
@@ -5141,6 +5323,502 @@ class Agent:
                     )
                 sess["chunk"] //= 2
                 self.metrics.counter("corro_sync_chunk_halvings_total")
+
+    # -- snapshot bootstrap (docs/sync.md, agent/snapshot.py) ----------
+    #
+    # The serve half answers a snap_request session with a consistent,
+    # scrubbed VACUUM-INTO copy streamed as snap_chunk frames over the
+    # coalesced sync framing (the adaptive drain/slow-peer budgets
+    # apply to snapshot blocks exactly as to changeset blocks); the
+    # client half stages the stream into a sidecar, verifies the
+    # whole-snapshot digest, and atomically swaps it in under the
+    # storage lock behind a journal marker so a crash at ANY point
+    # boots into a clean retry (snapshot.recover_pending_install).
+    # Dispatch is the pure function pair snapshot.covered_below_floor
+    # / snapshot.client_behind over (client needs, server floors).
+
+    def _snapshot_wanted(self, ours: SyncStateV1,
+                         theirs: SyncStateV1) -> bool:
+        """Should this client request a snapshot from this server
+        instead of change-by-change needs?  True exactly when the
+        server advertises snapshot floors covering at least one needed
+        version (it compacted that history — changes can no longer
+        deliver it) and the client is strictly behind the server on
+        every actor it tracks (the install-safety gate)."""
+        from corrosion_tpu.agent import snapshot as snaplib
+
+        if not self.config.snapshot_install:
+            return False
+        floors = theirs.snap_floors
+        if not floors:
+            return False
+        if not snaplib.client_behind(ours.heads, theirs.heads):
+            return False
+        needs = ours.compute_available_needs(theirs)
+        return snaplib.covered_below_floor(needs, floors) >= 1
+
+    def _pick_snapshot_session(self, sessions: List[dict],
+                               ours: SyncStateV1):
+        """Snapshot-or-changes dispatch over one round's handshaken
+        sessions: at most ONE session installs — the first whose
+        server can no longer serve the client's below-floor needs as
+        changes.  Returns ``(snap_session_or_None, remaining)``.
+        Shared with the virtual cluster's sync round so the campaign
+        exercises the REAL selection policy."""
+        if self.config.snapshot_install:
+            for s in sessions:
+                if self._snapshot_wanted(ours, s["theirs"]):
+                    return s, [x for x in sessions if x is not s]
+        return None, sessions
+
+    def _snapshot_build(self) -> Tuple[str, bytes, int]:
+        """Build (or reuse) the serve-side snapshot file; returns
+        ``(path, digest, size)``.  Worker-thread body — one VACUUM at
+        a time, and a restart storm's reborn clients share the cached
+        file for ``snapshot_cache_s`` instead of re-vacuuming per
+        serve."""
+        with self._snap_build_lock:
+            return self._snapshot_build_locked()
+
+    def _snapshot_build_locked(self) -> Tuple[str, bytes, int]:
+        from corrosion_tpu.agent import snapshot as snaplib
+
+        now = self._clock.monotonic()
+        cached = self._snap_cache
+        if (
+            cached is not None
+            and now - cached[0] <= self.config.snapshot_cache_s
+            and os.path.exists(cached[1])
+        ):
+            return cached[1], cached[2], cached[3]
+        cache = self.config.db_path + ".snap-serve"
+        tmp = cache + ".tmp"
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        snaplib.build_snapshot(self.config.db_path, tmp)
+        os.replace(tmp, cache)
+        digest = snaplib.file_digest(cache)
+        size = os.path.getsize(cache)
+        self._snap_cache = (now, cache, digest, size)
+        self.metrics.counter("corro_snapshot_builds_total")
+        return cache, digest, size
+
+    def _snapshot_build_open(self):
+        """``(open file, digest, size)`` with the handle opened UNDER
+        the build lock: a slow serve that out-lives ``snapshot_cache_s``
+        must keep streaming the inode its offer advertised — a
+        concurrent rebuild ``os.replace``s the cache path, and bytes
+        from the NEW inode would fail the client's digest gate and
+        breaker-trip an honest server."""
+        with self._snap_build_lock:
+            path, digest, size = self._snapshot_build_locked()
+            return open(path, "rb"), digest, size
+
+    def _snapshot_serve_record(self, peer, size: int) -> None:
+        """Serve-side accounting, shared by the live stream path and
+        the virtual cluster's in-memory seam."""
+        self.metrics.counter("corro_snapshot_serves_total")
+        self.metrics.counter(
+            "corro_snapshot_bytes_total", size, dir="served"
+        )
+        self._flight_event("snap_serve", peer=peer, bytes=size)
+
+    async def _serve_snapshot(self, writer, sess: dict) -> None:
+        """Serve one snapshot session: offer (digest + size), chunked
+        file stream, done — every block through ``_drain_sync_block``
+        so the slow-reader halving/abort budgets bound a stalled
+        client exactly as on a changeset serve."""
+        loop = asyncio.get_running_loop()
+        pool = self._serve_executor()
+        # the handle opens under the build lock (POSIX: os.replace of
+        # the cache path cannot retarget an open fd), so the streamed
+        # bytes always hash to the digest this offer advertises
+        f, digest, size = await loop.run_in_executor(
+            pool, self._snapshot_build_open
+        )
+        try:
+            await self._drain_sync_block(
+                writer,
+                speedy.frame(
+                    speedy.encode_sync_message(
+                        ("snap_offer", digest, size)
+                    )
+                ),
+                sess,
+            )
+            chunk = max(1, self.config.snapshot_chunk_bytes)
+            sent = 0
+            while True:
+                data = await loop.run_in_executor(pool, f.read, chunk)
+                if not data:
+                    break
+                sent += len(data)
+                await self._drain_sync_block(
+                    writer,
+                    speedy.frame(
+                        speedy.encode_sync_message(("snap_chunk", data))
+                    ),
+                    sess,
+                )
+        finally:
+            f.close()
+        await self._drain_sync_block(
+            writer,
+            speedy.frame(speedy.encode_sync_message(("snap_done",))),
+            sess,
+        )
+        live = sess.get("live") if sess else None
+        self._snapshot_serve_record(
+            live["peer"] if live else None, sent
+        )
+
+    # -- client-side staging + crash-safe install ----------------------
+
+    def _snapshot_stage_begin(self, peer, digest: bytes, size: int,
+                              their_heads,
+                              crash_at: Optional[str] = None) -> dict:
+        """Open the staging sidecar + journal marker for an offered
+        snapshot.  ``their_heads`` is the server's advertised per-actor
+        head map at dispatch time — the install-safety gate
+        (``snapshot.client_behind``) re-runs over it under the storage
+        lock before the swap, so ANY change applied mid-transfer beyond
+        what the snapshot holds (a local write, or another actor's
+        broadcast this client may be the only holder of) aborts the
+        install instead of being rolled back.  ``crash_at`` is the
+        fault harness's injected death stage (faults.SnapFault); never
+        set on a production path."""
+        from corrosion_tpu.agent import snapshot as snaplib
+
+        db = self.config.db_path
+        sp = snaplib.staged_path(db)
+        if os.path.exists(sp):
+            os.unlink(sp)
+        snaplib.write_marker(db, "staging", digest, size)
+        f = open(sp, "wb")
+        return {
+            "f": f, "path": sp, "digest": bytes(digest),
+            "size": int(size), "n": 0, "peer": peer,
+            "their_heads": {
+                (a.bytes if isinstance(a, ActorId) else bytes(a)): int(h)
+                for a, h in dict(their_heads).items()
+            },
+            "t0": self._clock.monotonic(), "crash_at": crash_at,
+        }
+
+    def _snapshot_stage_feed(self, st: dict, data: bytes) -> None:
+        from corrosion_tpu.agent import snapshot as snaplib
+
+        st["f"].write(data)
+        st["n"] += len(data)
+        if st["n"] > st["size"]:
+            raise snaplib.SnapshotError(
+                "snapshot stream exceeds the offered size"
+            )
+        self.metrics.counter(
+            "corro_snapshot_bytes_total", len(data), dir="received"
+        )
+
+    def _snapshot_abort(self, st: dict, reason: str, addr=None,
+                        trip: bool = False) -> None:
+        """Discard a staged snapshot cleanly: sidecar + marker go, the
+        previous database is untouched, the rejection is counted
+        (``corro_sync_client_rejects_total{reason=}``) and — for
+        verified-hostile serves like a digest mismatch — the peer's
+        breaker trips so the retry round falls back to change-by-change
+        via another peer."""
+        from corrosion_tpu.agent import snapshot as snaplib
+
+        f = st.pop("f", None)
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+        try:
+            if os.path.exists(st["path"]):
+                os.unlink(st["path"])
+        except OSError:
+            pass
+        snaplib.clear_marker(self.config.db_path)
+        self.metrics.counter(
+            "corro_snapshot_installs_total",
+            result=reason[5:] if reason.startswith("snap_") else reason,
+        )
+        self._sync_client_reject(reason, addr, trip=trip)
+        self._flight_event(
+            "snap_abort", peer=st.get("peer"), reason=reason
+        )
+
+    def _snapshot_install_staged(self, st: dict, addr=None) -> bool:
+        """Verify, prepare, and atomically install a fully-staged
+        snapshot (worker-thread body; the virtual cluster calls it
+        inline).  Returns True on success; False after a clean abort —
+        the caller's needs stay in bookkeeping, so the partial-round
+        retry falls back to change-by-change via another peer."""
+        from corrosion_tpu.agent import snapshot as snaplib
+
+        db = self.config.db_path
+        f = st.pop("f")
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        if st["n"] != st["size"] \
+                or snaplib.file_digest(st["path"]) != st["digest"]:
+            # the containment gate: a truncated, corrupted, or
+            # divergent-minted snapshot (a hostile server advertising
+            # the honest digest over tampered bytes) dies here — trip
+            # the breaker, never install
+            self._snapshot_abort(st, "snap_digest", addr, trip=True)
+            return False
+        try:
+            snaplib.prepare_staged(
+                st["path"], self.actor_id, self.incarnation
+            )
+        except Exception:
+            self._snapshot_abort(st, "snap_prepare", addr, trip=True)
+            return False
+        t_swap = self._clock.monotonic()
+        with self.storage._lock:
+            # the install-safety gate, re-run over EVERY tracked actor
+            # at the last possible moment: a change applied
+            # mid-transfer beyond the server's recorded heads — our
+            # own write, or another actor's broadcast this client may
+            # be the only remaining holder of — must abort the swap,
+            # not be rolled back by it
+            ours = {}
+            for actor, bv in self.bookie.actors().items():
+                head = bv.last()
+                if head:
+                    ours[bytes(actor)] = head
+            if not snaplib.client_behind(ours, st["their_heads"]):
+                self._snapshot_abort(st, "snap_stale", addr)
+                return False
+            snaplib.write_marker(db, "installing", st["digest"],
+                                 st["size"])
+            if st.get("crash_at") == "installing":
+                raise snaplib.SnapshotCrash("installing")
+            try:
+                self.storage.install_snapshot(st["path"])
+                if st.get("crash_at") == "swapped":
+                    raise snaplib.SnapshotCrash("swapped")
+                snaplib.clear_marker(db)
+                self._post_install_reload()
+            except snaplib.SnapshotCrash:
+                # injected death: leave marker/sidecar exactly as the
+                # crash found them (the boot recovery contract under
+                # test); the harness closes the agent
+                raise
+            except BaseException:
+                # a FAILED swap: storage came back up on whatever file
+                # survived (install_snapshot's recovery), so every
+                # in-memory view must follow its connection — without
+                # this the Bookie would keep writing into the closed
+                # pre-swap handle
+                try:
+                    self._post_install_reload()
+                except Exception:
+                    logger.exception(
+                        "post-failure snapshot reload failed"
+                    )
+                raise
+        self.metrics.counter(
+            "corro_snapshot_installs_total", result="ok"
+        )
+        self.metrics.histogram(
+            "corro_snapshot_install_seconds",
+            self._clock.monotonic() - st["t0"],
+        )
+        self.metrics.gauge(
+            "corro_snapshot_swap_seconds",
+            round(self._clock.monotonic() - t_swap, 6),
+        )
+        self._flight_event(
+            "snap_install", peer=st.get("peer"), bytes=st["n"]
+        )
+        return True
+
+    def _post_install_reload(self) -> None:
+        """Rebuild every in-memory view of storage after the swap
+        (caller holds the storage lock).  Object identities survive —
+        the Bookie and CrConn rebuild IN PLACE so every component
+        holding a reference keeps working against the installed
+        database."""
+        self.bookie.reload(self.storage.conn)
+        self.bookie.backfill_own_sync_state(self.actor_id)
+        self._sync_gen_cache = None
+        self._snap_cache = None
+        # node-local planes the snapshot scrubbed: membership table and
+        # incarnation re-persist from the live in-memory state
+        self._members_table()
+        self._persist_members()
+        self._persist_incarnation()
+        # the digest FIFO is node-local (scrubbed); signed proofs are
+        # portable and rode the snapshot — reload re-creates the
+        # tables and re-asserts the proof-backed permanent verdicts
+        with self._equiv_lock:
+            self._equiv_digests.clear()
+            self._equiv_sigs.clear()
+        if self.config.equivocation_detection:
+            self._load_equiv_digests()
+        self._register_backfills()
+
+    async def _snapshot_client_session(self, s: dict) -> Tuple[int, bool]:
+        """One outbound snapshot session (the dispatch chose install
+        over change-by-change): request, stage the chunk stream,
+        verify, install, then rely on the next anti-entropy round for
+        the tail delta.  The PR 13 serve-path client defenses apply
+        symmetrically — whole-session deadline on the injected clock,
+        frame-validation budget, offer screen — and every failure is a
+        clean abort that keeps the needs in bookkeeping for the
+        partial-round retry."""
+        from corrosion_tpu.agent import snapshot as snaplib
+
+        m, reader, writer = s["member"], s["reader"], s["writer"]
+        frames = s["frames"]
+        addr = tuple(m.addr)
+        peer_hex = m.actor_id.hex()
+        live = self._sync_session_begin("client", peer_hex, 1)
+        self._flight_event(
+            "sync_client_start", peer=peer_hex, needs=1
+        )
+        their_heads = s["theirs"].heads
+        st: Optional[dict] = None
+        installed = False
+        try:
+            writer.write(
+                speedy.frame(
+                    speedy.encode_sync_message(("snap_request",))
+                )
+            )
+            await writer.drain()
+            if writer.can_write_eof():
+                writer.write_eof()
+            deadline = None
+            if self.config.sync_session_deadline_s > 0:
+                deadline = (self._clock.monotonic()
+                            + self.config.sync_session_deadline_s)
+            frame_errs = 0
+            done = False
+            eof = False
+            while not (done or eof):
+                read_timeout = 10.0
+                if deadline is not None:
+                    remaining = deadline - self._clock.monotonic()
+                    if remaining <= 0:
+                        self._sync_client_reject(
+                            "deadline", addr, strike=True
+                        )
+                        break
+                    read_timeout = min(read_timeout, remaining)
+                data = await asyncio.wait_for(
+                    reader.read(65536), timeout=read_timeout
+                )
+                if not data:
+                    eof = True
+                    break
+                live["bytes"] += len(data)
+                try:
+                    payloads = frames.feed(data)
+                except speedy.SpeedyError:
+                    self._sync_client_reject(
+                        "frame_garbage", addr, trip=True
+                    )
+                    break
+                for payload in payloads:
+                    try:
+                        msg = speedy.decode_sync_message(payload)
+                    except speedy.SpeedyError:
+                        frame_errs += 1
+                        self._sync_client_reject("frame_garbage")
+                        if frame_errs > self.SYNC_CLIENT_FRAME_BUDGET:
+                            self._trip_breaker(addr)
+                            done = True
+                        continue
+                    if isinstance(msg, Timestamp):
+                        try:
+                            self.clock.update_with_timestamp(msg)
+                        except Exception:
+                            pass
+                    elif isinstance(msg, tuple) and msg[0] == "snap_offer":
+                        _tag, digest, size = msg
+                        if st is not None or size <= 0 \
+                                or size > self.config.snapshot_max_bytes:
+                            self._sync_client_reject(
+                                "snap_offer", addr, trip=True
+                            )
+                            done = True
+                            continue
+                        st = await asyncio.to_thread(
+                            self._snapshot_stage_begin, peer_hex,
+                            digest, size, their_heads,
+                        )
+                    elif isinstance(msg, tuple) and msg[0] == "snap_chunk":
+                        if st is None:
+                            # chunks with no prior offer: the same
+                            # frame-validation budget as undecodable
+                            # frames — an endless offer-less chunk
+                            # stream must trip the breaker, not burn
+                            # the whole session deadline every round
+                            frame_errs += 1
+                            self._sync_client_reject("snap_offer")
+                            if frame_errs > self.SYNC_CLIENT_FRAME_BUDGET:
+                                self._trip_breaker(addr)
+                                done = True
+                            continue
+                        try:
+                            await asyncio.to_thread(
+                                self._snapshot_stage_feed, st, msg[1]
+                            )
+                        except snaplib.SnapshotError:
+                            self._snapshot_abort(
+                                st, "snap_stream", addr, trip=True
+                            )
+                            st = None
+                            done = True
+                    elif isinstance(msg, tuple) and msg[0] == "snap_done":
+                        if st is None:
+                            break
+                        installed = await asyncio.to_thread(
+                            self._snapshot_install_staged, st, addr
+                        )
+                        st = None
+                        done = True
+            if st is not None:
+                # stream ended without snap_done — a truncated serve,
+                # a blown session deadline (already a breaker STRIKE
+                # above), or an honest server crash.  None of these is
+                # VERIFIED hostility, so no breaker trip: tripping here
+                # would let a slow link cycle a bootstrapping client
+                # through honest peers' breakers forever.  Tampered
+                # bytes still die on the digest gate (trip=True there)
+                self._snapshot_abort(st, "snap_stream", addr)
+                st = None
+            if installed:
+                self.members.update_sync_ts(
+                    m.actor_id, self._clock.wall()
+                )
+            return (1 if installed else 0), installed
+        except (asyncio.TimeoutError, OSError, ConnectionError,
+                speedy.SpeedyError, snaplib.SnapshotError,
+                sqlite3.Error) as e:
+            # sqlite3.Error covers a storage-level install failure
+            # (disk full mid-swap): install_snapshot restores a
+            # working connection on whatever file survives, and the
+            # abort here cleans the sidecar/marker + counts the
+            # failure instead of gather() swallowing it silently
+            if isinstance(e, sqlite3.Error):
+                logger.error("snapshot install failed: %s", e)
+            if st is not None:
+                self._snapshot_abort(st, "snap_stream", addr)
+                st = None
+            return 0, False
+        finally:
+            writer.close()
+            self._sync_session_end(live, "client", "received")
+            self._flight_event(
+                "sync_client_end", peer=peer_hex,
+                changes=0, bytes=live["bytes"], complete=installed,
+            )
 
 
 # ---------------------------------------------------------------------------

@@ -113,21 +113,12 @@ class CrConn:
         from corrosion_tpu.agent.locks import PriorityLock
 
         self.path = path
-        self.conn = sqlite3.connect(path, check_same_thread=False)
-        self.conn.isolation_level = None  # manual transactions
-        self.conn.execute("PRAGMA journal_mode=WAL")
-        self.conn.execute("PRAGMA synchronous=NORMAL")
-        self.conn.execute("PRAGMA foreign_keys=OFF")
-        # transient SQLITE_BUSY (e.g. a checkpoint of a large WAL racing
-        # a snapshot open) should wait, not raise: a raise on the
-        # subscription delta path degrades it to a full re-evaluation
-        self.conn.execute("PRAGMA busy_timeout=5000")
+        self.conn = self._connect_rw()
         # single RW connection behind a 3-tier priority mutex: applies
         # of replicated changes go first, API writes next, maintenance
         # last (the scheduling the reference gets from its split write
         # pools, agent.rs:614-765)
         self._lock = PriorityLock(lock_registry, "storage")
-        register_udfs(self.conn)
         self._init_meta(site_id)
         self._tables: Dict[str, TableInfo] = {}
         self._load_crr_tables()
@@ -137,6 +128,10 @@ class CrConn:
         self._ro_all: List[sqlite3.Connection] = []
         self._ro_cv = threading.Condition()
         self._ro_closed = False
+        # readers checked out across a snapshot install keep serving
+        # their (pre-swap) WAL snapshot, then close on return instead
+        # of re-pooling — the pool refills lazily against the new file
+        self._ro_stale: set = set()
         # slow-disk fault seam (faults.FaultController.io_hook_for):
         # callable(op: "write"|"read") -> delay seconds, consulted once
         # per write batch and per change collection.  The sleep runs on
@@ -144,6 +139,23 @@ class CrConn:
         # disk stretches lock holds and serve windows, it does not
         # block the event loop directly.  None in production.
         self.io_fault = None
+
+    def _connect_rw(self) -> sqlite3.Connection:
+        """The ONE RW-connection recipe, shared by construction and the
+        post-snapshot-install reopen — a pragma added here applies to
+        both, so a node that installed a snapshot never runs a
+        differently-configured connection until restart."""
+        conn = sqlite3.connect(self.path, check_same_thread=False)
+        conn.isolation_level = None  # manual transactions
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("PRAGMA synchronous=NORMAL")
+        conn.execute("PRAGMA foreign_keys=OFF")
+        # transient SQLITE_BUSY (e.g. a checkpoint of a large WAL racing
+        # a snapshot open) should wait, not raise: a raise on the
+        # subscription delta path degrades it to a full re-evaluation
+        conn.execute("PRAGMA busy_timeout=5000")
+        register_udfs(conn)
+        return conn
 
     def _io_delay(self, op: str) -> None:
         hook = self.io_fault
@@ -204,10 +216,12 @@ class CrConn:
             yield conn
         finally:
             with self._ro_cv:
-                if self._ro_closed:
+                if self._ro_closed or conn in self._ro_stale:
                     conn.close()
+                    self._ro_stale.discard(conn)
                     if conn in self._ro_all:
                         self._ro_all.remove(conn)
+                    self._ro_cv.notify()
                 else:
                     self._ro_free.append(conn)
                     self._ro_cv.notify()
@@ -1483,6 +1497,65 @@ END;
             with guard:
                 state["armed"] = False
             timer.cancel()
+
+    def install_snapshot(self, staged: str) -> None:
+        """Atomically swap the database file for a fully-prepared
+        staged snapshot (docs/sync.md, install state machine).
+
+        Caller contract: holds ``self._lock``, has verified the staged
+        content digest, run ``snapshot.prepare_staged`` (identity
+        rewrite) on it, and written the ``installing`` journal marker —
+        so a crash anywhere in here classifies at boot
+        (``snapshot.recover_pending_install``).
+
+        The RW connection closes first; FREE pool readers close; a
+        reader checked out mid-query keeps its fd to the pre-swap
+        inode (POSIX ``os.replace`` semantics), finishes its stale
+        read, and closes on return instead of re-pooling.  The pool
+        condvar is HELD across the swap itself: a ``reader()``
+        checkout slipping between the drain and ``os.replace`` would
+        open the pre-swap inode and be re-pooled — serving stale data
+        forever — so checkouts block for the (brief) swap instead.
+        Stale ``-wal``/``-shm`` files are removed AFTER the swap —
+        they belong to the replaced inode, and the prepared snapshot
+        is a single self-contained file."""
+        import os
+
+        from corrosion_tpu.agent.snapshot import fsync_dir
+
+        self.conn.close()
+        try:
+            with self._ro_cv:
+                for conn in self._ro_free:
+                    conn.close()
+                    if conn in self._ro_all:
+                        self._ro_all.remove(conn)
+                self._ro_free.clear()
+                self._ro_stale.update(self._ro_all)
+                self._ro_all = []
+                os.replace(staged, self.path)
+                fsync_dir(self.path)
+                for ext in ("-wal", "-shm"):
+                    p = self.path + ext
+                    if os.path.exists(p):
+                        os.unlink(p)
+        finally:
+            # ALWAYS come back up on whatever file now lives at
+            # self.path — the previous database if the swap raised, the
+            # installed snapshot if it completed.  Without this a
+            # failed os.replace (disk full, EXDEV) would leave a LIVE
+            # agent holding a closed RW connection, bricking every
+            # subsequent write until restart.  (If connecting itself
+            # fails the error propagates with the connection closed —
+            # there is no file to come up on.)
+            self.conn = self._connect_rw()
+            # re-derive every cached view of the schema + identity; on
+            # the success path the staged prep installed OUR site id at
+            # ordinal 1, so _init_meta reads it back unchanged
+            self._apply_sql_cache = {}
+            self._init_meta(None)
+            self._tables = {}
+            self._load_crr_tables()
 
     def close(self) -> None:
         with self._ro_cv:

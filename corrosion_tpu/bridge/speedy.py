@@ -680,6 +680,15 @@ def _w_sync_state(w: Writer, st: SyncStateV1) -> None:
             for s, e in spans:
                 w.u64(s).u64(e)
     w.opt(st.last_cleared_ts, lambda ts: _w_ts(w, ts))
+    # snapshot-serve extension (docs/sync.md): trailing floors map,
+    # written ONLY when non-empty — a floor-less state emits the
+    # pre-snapshot bytes exactly (same default_on_eof discipline as
+    # last_cleared_ts before it)
+    if st.snap_floors:
+        w.u32(len(st.snap_floors))
+        for actor, floor in st.snap_floors.items():
+            _w_actor(w, actor)
+            w.u64(int(floor))
 
 
 def _r_sync_state(r: Reader) -> SyncStateV1:
@@ -701,17 +710,33 @@ def _r_sync_state(r: Reader) -> SyncStateV1:
             partials[v] = [_span(r) for _ in range(r.u32())]
         partial_need[a] = partials
     last_cleared_ts = None if r.eof else r.opt(lambda: _r_ts(r))
+    snap_floors: Dict[ActorId, int] = {}
+    if not r.eof:
+        for _ in range(r.u32()):
+            a = _r_actor(r)
+            snap_floors[a] = r.u64()
     return SyncStateV1(
         actor_id=actor,
         heads=heads,
         need=need,
         partial_need=partial_need,
         last_cleared_ts=last_cleared_ts,
+        snap_floors=snap_floors,
     )
 
 
 # SyncMessageV1 variant indices (sync.rs:23-30)
 _SM_STATE, _SM_CHANGESET, _SM_CLOCK, _SM_REJECTION, _SM_REQUEST = range(5)
+
+# snapshot-serve extension variants (docs/sync.md): a client whose
+# needs fall below the server's advertised snapshot floors requests a
+# whole-database snapshot instead of change-by-change serving.  The
+# variants extend the enum PAST the reference's tags, so a session
+# that never dispatches snapshot emits the reference's exact bytes.
+_SM_SNAP_REQUEST, _SM_SNAP_OFFER, _SM_SNAP_CHUNK, _SM_SNAP_DONE = range(5, 9)
+
+#: whole-snapshot content digest length (blake2b-32) carried by offers
+SNAP_DIGEST_LEN = 32
 
 # SyncRejectionV1 variant indices (sync.rs:251-257)
 REJECTION_MAX_CONCURRENCY = 0
@@ -722,7 +747,9 @@ SyncRequest = List[Tuple[ActorId, List[SyncNeedV1]]]
 
 def encode_sync_message(msg) -> bytes:
     """msg is one of: SyncStateV1 | ChangeV1 | Timestamp |
-    ("rejection", int) | ("request", SyncRequest)."""
+    ("rejection", int) | ("request", SyncRequest) |
+    ("snap_request",) | ("snap_offer", digest32, size) |
+    ("snap_chunk", bytes) | ("snap_done",)."""
     w = Writer()
     w.tag(0)  # SyncMessage::V1
     if isinstance(msg, SyncStateV1):
@@ -745,6 +772,22 @@ def encode_sync_message(msg) -> bytes:
             w.u32(len(needs))
             for n in needs:
                 _w_need(w, n)
+    elif isinstance(msg, tuple) and msg[0] == "snap_request":
+        w.tag(_SM_SNAP_REQUEST)
+    elif isinstance(msg, tuple) and msg[0] == "snap_offer":
+        digest, size = msg[1], msg[2]
+        if len(digest) != SNAP_DIGEST_LEN:
+            raise SpeedyError(
+                f"snapshot digest must be {SNAP_DIGEST_LEN} bytes"
+            )
+        w.tag(_SM_SNAP_OFFER)
+        w.raw(bytes(digest))
+        w.u64(int(size))
+    elif isinstance(msg, tuple) and msg[0] == "snap_chunk":
+        w.tag(_SM_SNAP_CHUNK)
+        w.lp_bytes(bytes(msg[1]))
+    elif isinstance(msg, tuple) and msg[0] == "snap_done":
+        w.tag(_SM_SNAP_DONE)
     else:
         raise SpeedyError(f"cannot encode sync message {type(msg)!r}")
     return w.getvalue()
@@ -769,6 +812,14 @@ def decode_sync_message(data: bytes):
             actor = _r_actor(r)
             req.append((actor, [_r_need(r) for _ in range(r.u32())]))
         out = ("request", req)
+    elif t == _SM_SNAP_REQUEST:
+        out = ("snap_request",)
+    elif t == _SM_SNAP_OFFER:
+        out = ("snap_offer", r.raw(SNAP_DIGEST_LEN), r.u64())
+    elif t == _SM_SNAP_CHUNK:
+        out = ("snap_chunk", r.lp_bytes())
+    elif t == _SM_SNAP_DONE:
+        out = ("snap_done",)
     else:
         raise SpeedyError(f"unknown SyncMessageV1 variant {t}")
     r.expect_end()

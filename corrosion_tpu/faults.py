@@ -88,6 +88,23 @@ class LoopStall:
 
 
 @dataclass(frozen=True)
+class SnapFault:
+    """One snapshot-install fault on ``node`` (the INSTALLING client):
+    kill it at a named install stage — ``"crash_staging"`` (mid chunk
+    stream, sidecar partially written), ``"crash_installing"`` (the
+    ``installing`` journal marker is durable but the swap has not
+    happened), or ``"crash_swapped"`` (``os.replace`` completed, the
+    marker not yet cleared) — then restart it ``restart_delay``
+    seconds later.  Consumed ONCE: the reborn node's retry runs clean,
+    which is exactly the crash-recovery contract under test
+    (``snapshot.recover_pending_install``)."""
+
+    node: str
+    mode: str  # crash_staging | crash_installing | crash_swapped
+    restart_delay: float = 0.5
+
+
+@dataclass(frozen=True)
 class FaultPlan:
     """Seeded, replayable fault regime — the live-cluster analogue of
     ``EpidemicConfig``'s ``loss``/``partition_blocks``/``heal_tick``
@@ -131,6 +148,10 @@ class FaultPlan:
     disk_read_delay: float = 0.0
     disk_read_jitter: float = 0.0
     loop_stalls: Tuple[LoopStall, ...] = ()
+    # snapshot-install fault knobs (docs/faults.md): per-client crash
+    # stages injected at the install seams; truncated/corrupted/
+    # divergent snapshot SERVES are modeled by ByzantineSnapshotServer
+    snap_faults: Tuple[SnapFault, ...] = ()
 
     def link_decision(self, src: str, dst: str, channel: str,
                       n: int) -> FaultAction:
@@ -234,6 +255,11 @@ class FaultPlan:
                 LoopStall(s["node"], s["at"], s["duration_ms"])
                 for s in d.get("loop_stalls", ())
             ),
+            snap_faults=tuple(
+                SnapFault(s["node"], s["mode"],
+                          s.get("restart_delay", 0.5))
+                for s in d.get("snap_faults", ())
+            ),
         )
 
 
@@ -271,7 +297,10 @@ class FaultController:
         self.decision_log = bytearray()
         self.injected: Dict[str, int] = {"drop": 0, "partition": 0,
                                          "delay": 0, "disk": 0,
-                                         "stall": 0}
+                                         "stall": 0, "snap_crash": 0}
+        # snapshot-install faults are ONE-SHOT per (node, mode): the
+        # reborn node's retry must run clean (the recovery contract)
+        self._snap_consumed: set = set()
         # crash orchestration bookkeeping (devcluster.run_inprocess)
         self.agents: Optional[Dict[str, object]] = None
         self.respawn: Dict[str, Callable] = {}
@@ -443,6 +472,19 @@ class FaultController:
         a respawned node gets its identical skew back."""
         return self.plan.node_clock(name)
 
+    def snap_decision(self, name: str) -> Optional[SnapFault]:
+        """The pending snapshot-install fault for ``name``'s NEXT
+        install attempt, consumed on return (one-shot: the reborn
+        node's retry runs clean).  None = install normally."""
+        with self._io_lock:
+            for f in self.plan.snap_faults:
+                key = (f.node, f.mode)
+                if f.node == name and key not in self._snap_consumed:
+                    self._snap_consumed.add(key)
+                    self.injected["snap_crash"] += 1
+                    return f
+        return None
+
     # -- introspection (admin `faults` command) -------------------------
 
     def as_dict(self) -> dict:
@@ -471,6 +513,11 @@ class FaultController:
             "loop_stalls": [
                 {"node": s.node, "at": s.at, "duration_ms": s.duration_ms}
                 for s in p.loop_stalls
+            ],
+            "snap_faults": [
+                {"node": s.node, "mode": s.mode,
+                 "restart_delay": s.restart_delay}
+                for s in p.snap_faults
             ],
             "nodes": len(self._node_idx),
             "injected": dict(self.injected),
@@ -759,3 +806,85 @@ class ByzantineSyncServer:
                 out.append(speedy.frame(speedy.encode_sync_message(cv)))
             return b"".join(out)
         return b""
+
+
+class ByzantineSnapshotServer:
+    """A hostile snapshot SERVER: the snapshot-path sibling of
+    :class:`ByzantineSyncServer` — a new, high-leverage Byzantine
+    surface (PAPERS.md, "Simulating BFT Protocol Implementations at
+    Scale"): a server the dispatch trusts to ship a whole database
+    must not be able to install garbage.  One instance plays one
+    attack ``mode`` from a REAL cluster node's transport identity:
+
+    * ``truncate``       — advertises the honest digest/size, then the
+      stream ends halfway.  Defense: the size/digest check over the
+      staged bytes fails, clean abort;
+    * ``corrupt_chunk``  — honest advert, one chunk's bytes flipped
+      (the staged file is structural garbage).  Same defense;
+    * ``divergent_mint`` — a same-length snapshot whose row CONTENTS
+      were rewritten, served under the HONEST digest (the server wants
+      the tampered state installed as if it were the real one).  The
+      whole-snapshot content digest is exactly the gate that kills it.
+
+    All three end in ``corro_sync_client_rejects_total{reason=
+    snap_digest}`` + a breaker trip, zero bytes installed, and the
+    client's needs falling back to change-by-change via another peer.
+    (A hostile server advertising a digest OF its divergent snapshot
+    is the unsigned-serve-path residual docs/faults.md names: only
+    signed serve attestations close it; the campaign scopes the mode
+    to digest-covered tampering.)
+
+    The hostile floors it advertises mirror the honest node's heads,
+    so the client-side dispatch genuinely chooses snapshot — the
+    containment must come from the install gates, never the harness.
+    """
+
+    MODES = ("truncate", "corrupt_chunk", "divergent_mint")
+
+    def __init__(self, seed: int = 0, mode: str = "truncate"):
+        if mode not in self.MODES:
+            raise ValueError(f"unknown snapshot-byz mode {mode!r}")
+        self.seed = seed
+        self.mode = mode
+
+    def advertised_state(self, server_agent):
+        """The honest node's handshake state with hostile floors
+        grafted on: every advertised head becomes a floor, so a behind
+        client's dispatch picks snapshot install."""
+        import copy
+
+        st = copy.copy(server_agent.generate_sync())
+        st.snap_floors = {
+            actor: int(head) for actor, head in st.heads.items()
+        }
+        return st
+
+    def tampered_serve(self, server_agent,
+                       chunk_bytes: int) -> Tuple[bytes, int, list]:
+        """(advertised_digest, advertised_size, chunks) for one hostile
+        serve: the HONEST snapshot's digest/size with tampered chunk
+        bytes per the mode.  Deterministic in (seed, db content)."""
+        path, digest, size = server_agent._snapshot_build()
+        with open(path, "rb") as f:
+            blob = f.read()
+        if self.mode == "truncate":
+            blob = blob[: max(1, len(blob) // 2)]
+        elif self.mode == "corrupt_chunk":
+            h = hashlib.blake2b(
+                f"snapbyz:{self.seed}".encode(), digest_size=8
+            ).digest()
+            off = int.from_bytes(h, "big") % max(1, len(blob))
+            blob = blob[:off] + bytes([blob[off] ^ 0xFF]) + blob[off + 1:]
+        else:  # divergent_mint: same length, rewritten row contents
+            marker = b"storm-"
+            if marker in blob:
+                blob = blob.replace(marker, b"evil!!")
+            else:
+                mid = len(blob) // 2
+                blob = blob[:mid] + bytes([blob[mid] ^ 0x5A]) \
+                    + blob[mid + 1:]
+        chunks = [
+            blob[i : i + chunk_bytes]
+            for i in range(0, len(blob), max(1, chunk_bytes))
+        ]
+        return digest, size, chunks

@@ -628,6 +628,22 @@ SCALE_FAMILIES = (
     "signed_equivocator",
     "byz_sync_server",
     "hostile_sweep_32_signed",
+    "restart_storm_snapshot",
+    "byz_snapshot_server",
+    "crash_mid_install",
+)
+
+#: snapshot-bootstrap cells (docs/sync.md): a compacted history makes
+#: snapshot install the only below-floor catch-up path —
+#: restart_storm_snapshot wipes a storm's victims so every reborn node
+#: bootstraps via snapshot install + tail sync; byz_snapshot_server
+#: proves the install gates contain a hostile snapshot server (digest
+#: mismatch → breaker trip → change-by-change fallback via honest
+#: peers, zero divergent rows); crash_mid_install kills installing
+#: clients at every journal stage and proves the boot recovery
+#: contract re-converges them
+SNAP_FAMILIES = (
+    "restart_storm_snapshot", "byz_snapshot_server", "crash_mid_install",
 )
 
 VIRTUAL_FAMILIES = FAMILIES + SCALE_FAMILIES
@@ -685,6 +701,41 @@ def build_virtual_plan(family: str, seed: int, heal_after: float,
     if family == "equiv_during_heal":
         return FaultPlan(
             seed=seed, partition_blocks=2, heal_after=heal_after
+        )
+    if family in ("restart_storm_snapshot", "byz_snapshot_server"):
+        # victims crash early and restart later; the cell script wipes
+        # their directories in between (VirtualCluster.schedule_wipe),
+        # so the reborn nodes are FRESH bootstraps
+        k = max(2, n // 16) if family == "restart_storm_snapshot" else 3
+        stride = max(1, (n - 8) // k)
+        crashes = tuple(
+            CrashEvent(
+                f"n{8 + (j * stride) % max(1, n - 8)}",
+                at=0.3 + j * 0.02,
+                restart_at=1.3 + j * 0.02,
+            )
+            for j in range(k)
+        )
+        return FaultPlan(seed=seed, crashes=crashes)
+    if family == "crash_mid_install":
+        from corrosion_tpu.faults import SnapFault
+
+        # three wiped victims, one injected death per install stage;
+        # each reborn node's retry must run clean (the faults are
+        # one-shot) and re-converge the cluster
+        victims = [f"n{8 + j * max(1, (n - 8) // 3)}" for j in range(3)]
+        crashes = tuple(
+            CrashEvent(v, at=0.3 + j * 0.02, restart_at=0.8 + j * 0.02)
+            for j, v in enumerate(victims)
+        )
+        stages = ("crash_staging", "crash_installing", "crash_swapped")
+        return FaultPlan(
+            seed=seed,
+            crashes=crashes,
+            snap_faults=tuple(
+                SnapFault(v, stage, restart_delay=0.4)
+                for v, stage in zip(victims, stages)
+            ),
         )
     if family == "skew_during_restart":
         k = max(2, n // 64)
@@ -963,6 +1014,102 @@ def _virtual_byz_sync(c, seed: int) -> Dict:
     }
 
 
+def _virtual_snapshot_setup(c, family: str, seed: int) -> Dict:
+    """The snapshot-cell pre-phase, run before the measured write
+    workload: (1) a multi-writer HISTORY that converges everywhere;
+    (2) maintenance-driven history compaction on every honest node
+    (``_compaction_pass`` with the cell's retain-0 override), so every
+    advertised floor covers the whole history and below-floor catch-up
+    is snapshot-only; (3) wipes scheduled between each victim's crash
+    and restart, turning the reborn nodes into FRESH bootstraps; and
+    (4) for ``byz_snapshot_server``, the hostile doubles registered on
+    real nodes n1..n3 plus deterministically-scheduled attack sessions
+    against each reborn victim (organic rounds hit the hostiles too,
+    but the campaign must not depend on sampling luck).
+
+    The storm itself is DEFERRED (``VirtualCluster(defer_crashes=
+    True)``): the plan's crash/restart times are offsets applied to
+    the virtual clock AFTER this setup returns — the history must be
+    converged and compacted below every floor before the first victim
+    dies, and the setup's own convergence wait has no fixed duration.
+    Writers stay on n0/n4/n6: victims are strided from n8 up and the
+    byz doubles sit on n1..n3, so no writer is ever crashed, wiped,
+    or hostile."""
+    from corrosion_tpu.faults import ByzantineSnapshotServer
+
+    writers = [0, min(4, c.n - 1), min(6, c.n - 1)]
+    versions = []
+    hist = 12
+    for w in range(hist):
+        origin = writers[w % len(writers)]
+        v = c.write(
+            origin,
+            "INSERT INTO tests (id, text) VALUES (?, ?)",
+            (7000 + w, f"storm-{w}"),
+        )
+        versions.append((c.agents[f"n{origin}"].actor_id, v))
+        c.run_for(0.02)
+    assert c.run_until_true(
+        lambda: c.converged(versions), timeout=30
+    ), "snapshot-cell history did not converge"
+
+    servers = {}
+    if family == "byz_snapshot_server":
+        # honest nodes keep floor 0 here: containment must fall back
+        # to CHANGE-BY-CHANGE via honest peers, so only the hostile
+        # doubles advertise (fabricated) floors
+        for k, mode in enumerate(ByzantineSnapshotServer.MODES):
+            servers[f"n{k + 1}"] = ByzantineSnapshotServer(
+                seed=seed, mode=mode
+            )
+        c.snap_byz.update(servers)
+    else:
+        for a in c.agents.values():
+            a._compaction_pass()
+
+    # the deferred storm: crash/restart offsets anchor at NOW (the
+    # compacted, converged pre-state), wipes between each death and
+    # rebirth turn the victims into fresh bootstraps
+    t0 = c.clock.monotonic()
+    c.schedule_plan_crashes(t0)
+    for ev in c.plan.crashes:
+        if ev.restart_at is not None:
+            c.schedule_wipe(
+                ev.node, t0 + (ev.at + ev.restart_at) / 2.0
+            )
+
+    if servers:
+        # one scripted hostile session per (reborn victim, mode),
+        # timed just after each rebirth while the victim is still
+        # behind (dispatch otherwise declines: nothing to cover)
+        ordered = sorted(servers.items())
+
+        def _attack(victim: str, sname: str, double) -> None:
+            if victim in c._crashed or sname in c._crashed:
+                return
+            client = c.agents[victim]
+            hostile = c.agents[sname]
+            member = client.members.get(hostile.actor_id)
+            if member is not None:
+                c._vsnap_byz(client, member, double, int(sname[1:]))
+
+        for ev in c.plan.crashes:
+            if ev.restart_at is None:
+                continue
+            for k, (sname, double) in enumerate(ordered):
+                c.clock.schedule_at(
+                    t0 + ev.restart_at + 0.05 + k * 0.01,
+                    lambda _d, v=ev.node, s=sname, b=double:
+                        _attack(v, s, b),
+                )
+    return {
+        "history": hist,
+        "history_versions": versions,
+        "servers": {nm: b.mode for nm, b in servers.items()},
+        "victims": [ev.node for ev in c.plan.crashes],
+    }
+
+
 def virtual_scenario_cell(
     family: str,
     n: int = 64,
@@ -1000,9 +1147,15 @@ def virtual_scenario_cell(
         # spot-check interval bound keeps pure-Python verification off
         # the campaign's critical path)
         overrides["sig_spot_check_rate"] = 0.05
+    if family in SNAP_FAMILIES:
+        # retain-0: every contained version is compactable, so the
+        # 12-version cell history sits entirely below the floors the
+        # setup phase advances — dispatch genuinely chooses snapshot
+        overrides["snapshot_retain_versions"] = 0
     wall0 = _time.perf_counter()
     c = VirtualCluster(
         n, seed=seed, plan=plan, base_dir=base_dir, sign=signed,
+        defer_crashes=family in SNAP_FAMILIES,
         **overrides,
     )
     try:
@@ -1012,11 +1165,14 @@ def virtual_scenario_cell(
         hostile = None
         framing = None
         byz = None
+        snap = None
         k_hostile = _hostile_count(family)
         if family == "framing_relay":
             framing = _virtual_framing_relay(c, seed)
         elif family == "byz_sync_server":
             byz = _virtual_byz_sync(c, seed)
+        elif family in SNAP_FAMILIES:
+            snap = _virtual_snapshot_setup(c, family, seed)
         elif k_hostile:
             hostile = _virtual_hostile_attack(
                 c, seed, k_hostile,
@@ -1032,6 +1188,11 @@ def virtual_scenario_cell(
                 if plan.block_of(i, n) != plan.block_of(0, n)
             )
             writers = [0, other]
+        elif family in SNAP_FAMILIES:
+            # setup deferred the storm to fire right after this
+            # workload: keep the writers clear of the strided victims
+            # (n8 up) and the byz doubles (n1..n3)
+            writers = sorted({0, min(4, n - 1), min(6, n - 1)})
         else:
             writers = list(range(0, n, max(1, n // 3)))[:3] or [0]
         t0v = c.clock.monotonic()
@@ -1049,6 +1210,9 @@ def virtual_scenario_cell(
         want_crash_events = len(plan.crashes) + sum(
             1 for ev in plan.crashes if ev.restart_at is not None
         )
+        # every snapshot-install fault is one EXTRA death + rebirth on
+        # top of the scheduled storm (faults.SnapFault is one-shot)
+        want_crash_events += 2 * len(plan.snap_faults)
 
         def settled() -> bool:
             if plan.crashes:
@@ -1320,6 +1484,98 @@ def virtual_scenario_cell(
             detail["byz"] = {
                 "servers": byz["servers"],
                 "client_rejects": rejects,
+            }
+
+        if snap is not None:
+            reborn_nodes = sorted({
+                node for _t, ev2, node in c.ctrl.crash_log
+                if ev2 == "restart" and node not in c._crashed
+            })
+            installs_ok = {
+                nm: c.agents[nm].metrics.get_counter(
+                    "corro_snapshot_installs_total", result="ok"
+                )
+                for nm in reborn_nodes
+            }
+            serves = sum(
+                a.metrics.get_counter("corro_snapshot_serves_total")
+                for a in live_agents
+            )
+            snap_rejects = sum(
+                a.metrics.get_counter(
+                    "corro_sync_client_rejects_total",
+                    reason="snap_digest",
+                )
+                for a in live_agents
+            )
+            recoveries = {}
+            for a in live_agents:
+                for stage in ("retry", "finalized"):
+                    n_rec = a.metrics.get_counter(
+                        "corro_snapshot_recoveries_total", stage=stage
+                    )
+                    if n_rec:
+                        recoveries[stage] = (
+                            recoveries.get(stage, 0) + n_rec
+                        )
+            # the pre-storm history must be contained everywhere too —
+            # on reborn nodes it can ONLY have arrived via the
+            # snapshot path (honest floors cover it) or the
+            # change-by-change fallback (the byz cell's honest peers)
+            gates["history_converged"] = c.converged(
+                snap["history_versions"]
+            )
+            if family == "restart_storm_snapshot":
+                gates["reborn_installed_via_snapshot"] = bool(
+                    reborn_nodes
+                ) and all(v >= 1 for v in installs_ok.values())
+                gates["snapshots_served"] = serves >= len(reborn_nodes)
+            if family == "byz_snapshot_server":
+                # containment: every victim rejected hostile serves on
+                # the digest gate, NOTHING installed cluster-wide (the
+                # honest peers advertise no floors — fallback is
+                # genuinely change-by-change), no tampered row exists
+                gates["rejected_snap_digest"] = (
+                    snap_rejects >= len(snap["victims"])
+                )
+                gates["hostile_never_installed"] = all(
+                    v == 0 for v in installs_ok.values()
+                ) and sum(
+                    a.metrics.get_counter(
+                        "corro_snapshot_installs_total", result="ok"
+                    )
+                    for a in live_agents
+                ) == 0
+                gates["zero_tampered_rows"] = all(
+                    _count_like(a, "evil%") == 0 for a in live_agents
+                )
+            if family == "crash_mid_install":
+                # every injected stage fired, both recovery outcomes
+                # were exercised (mid-stage crashes → clean retry; a
+                # post-swap crash → finalized boot), and the retries
+                # completed real installs
+                gates["snap_crashes_fired"] = (
+                    c.ctrl.injected["snap_crash"]
+                    == len(plan.snap_faults)
+                )
+                gates["recovery_retry_seen"] = (
+                    recoveries.get("retry", 0) >= 2
+                )
+                gates["recovery_finalized_seen"] = (
+                    recoveries.get("finalized", 0) >= 1
+                )
+                gates["retries_installed"] = sum(
+                    installs_ok.values()
+                ) >= len(plan.snap_faults) - 1
+            detail["snapshot"] = {
+                "history": snap["history"],
+                "victims": snap["victims"],
+                "servers": snap["servers"],
+                "reborn": len(reborn_nodes),
+                "installs_ok": sum(installs_ok.values()),
+                "snapshots_served": serves,
+                "snap_digest_rejects": snap_rejects,
+                "recoveries": recoveries,
             }
 
         return {

@@ -143,6 +143,7 @@ class VirtualCluster:
         clock: Optional[VirtualClock] = None,
         link_rtt_s: float = LINK_RTT_S,
         sign: bool = False,
+        defer_crashes: bool = False,
         **agent_overrides,
     ):
         import os
@@ -172,6 +173,11 @@ class VirtualCluster:
         # name -> hostile server double; a client sync round choosing
         # one runs the hostile session instead of the real serve
         self.byz_servers: Dict[str, object] = {}
+        # Byzantine snapshot servers (faults.ByzantineSnapshotServer):
+        # node name -> double serving tampered snapshot streams; the
+        # client's own install gates (digest/size verify) must contain
+        # them — never this harness
+        self.snap_byz: Dict[str, object] = {}
         self._own_dir = base_dir is None
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="corro-vt-")
         os.makedirs(self.base_dir, exist_ok=True)
@@ -226,13 +232,24 @@ class VirtualCluster:
         self.clock.schedule(STALL_BEAT_S, self._stall_beat)
         for ev in self.plan.loop_stalls:
             self.clock.schedule_at(ev.at, self._make_stall(ev))
+        if not defer_crashes:
+            self.schedule_plan_crashes(0.0)
+
+    def schedule_plan_crashes(self, offset: float) -> None:
+        """Schedule the plan's crash/restart events at ``offset +
+        ev.at`` / ``offset + ev.restart_at``.  Runs at boot with
+        offset 0 unless ``defer_crashes=True`` — the snapshot cells
+        (docs/sync.md) defer so their setup phase (history
+        convergence + floor compaction, variable virtual duration)
+        completes BEFORE the storm's victims start dying."""
         for ev in self.plan.crashes:
             self.clock.schedule_at(
-                ev.at, lambda _d, nm=ev.node: self._crash(nm)
+                offset + ev.at, lambda _d, nm=ev.node: self._crash(nm)
             )
             if ev.restart_at is not None:
                 self.clock.schedule_at(
-                    ev.restart_at, lambda _d, nm=ev.node: self._restart(nm)
+                    offset + ev.restart_at,
+                    lambda _d, nm=ev.node: self._restart(nm),
                 )
 
     # -- construction ---------------------------------------------------
@@ -705,6 +722,13 @@ class VirtualCluster:
                 # must contain it — never this harness
                 self._byz_session(a, m, byz)
                 continue
+            sbyz = self.snap_byz.get(peer)
+            if sbyz is not None:
+                # hostile SNAPSHOT serve: the install gates (digest +
+                # size verification over the staged bytes) must
+                # contain it — never this harness
+                self._vsnap_byz(a, m, sbyz, j)
+                continue
             self._breaker_success(a, addr)
             sessions.append({
                 "member": m,
@@ -713,7 +737,17 @@ class VirtualCluster:
             })
         if not sessions:
             return
+        # snapshot-or-changes dispatch: the REAL agent selection policy
+        # (runtime._pick_snapshot_session) — at most one session per
+        # round installs; the rest allocate needs as usual
+        snap_sess, sessions = a._pick_snapshot_session(sessions, ours)
         a._allocate_needs(sessions, ours)
+        if snap_sess is not None:
+            self._vsnap_session(i, a, snap_sess)
+            if name in self._crashed:
+                # a SnapFault killed the client mid-install: the rest
+                # of its round dies with it
+                return
         for s in sessions:
             self._sync_session(a, s)
 
@@ -830,6 +864,113 @@ class VirtualCluster:
                 # what the advert could legitimately offer
                 a.handle_change(msg, ChangeSource.SYNC,
                                 rebroadcast=False)
+
+    def _vsnap_session(self, i: int, a, s: dict) -> None:
+        """One snapshot install session on the virtual heap: the live
+        wire replaced by in-memory chunk handoff, every install gate
+        REAL — whole-snapshot digest verify, identity rewrite on the
+        staged sidecar, journal marker, atomic swap, in-place state
+        reload — plus the ``SnapFault`` crash stages, which kill the
+        client exactly where the knob says and prove the boot-time
+        recovery contract (``snapshot.recover_pending_install``)."""
+        from corrosion_tpu.agent.snapshot import SnapshotCrash
+
+        name = self.names[i]
+        m = s["member"]
+        server = self.agents[self.names[s["j"]]]
+        fault = self.ctrl.snap_decision(name)
+        crash_at = None
+        if fault is not None and fault.mode in (
+            "crash_installing", "crash_swapped"
+        ):
+            crash_at = fault.mode[len("crash_"):]
+        path, digest, size = server._snapshot_build()
+        with open(path, "rb") as f:
+            blob = f.read()
+        server._snapshot_serve_record(a.actor_id.hex(), len(blob))
+        st = a._snapshot_stage_begin(
+            m.actor_id.hex(), digest, size, s["theirs"].heads,
+            crash_at=crash_at,
+        )
+        cb = max(1, a.config.snapshot_chunk_bytes)
+        chunks = [blob[k : k + cb] for k in range(0, len(blob), cb)]
+        try:
+            for idx, chunk in enumerate(chunks):
+                if fault is not None and fault.mode == "crash_staging" \
+                        and idx == len(chunks) // 2:
+                    raise SnapshotCrash("staging")
+                a._snapshot_stage_feed(st, chunk)
+            ok = a._snapshot_install_staged(st, addr=tuple(m.addr))
+        except SnapshotCrash:
+            # leave the sidecar/marker exactly as the crash found them
+            # (a real death flushes nothing further); the reborn node's
+            # boot recovery classifies the window
+            f = st.pop("f", None)
+            if f is not None:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+            self._crash(name)
+            if fault is not None:
+                self.clock.schedule(
+                    fault.restart_delay,
+                    lambda _d, nm=name: self._restart(nm),
+                )
+            return
+        if ok:
+            a.members.update_sync_ts(m.actor_id, self.clock.wall())
+
+    def _vsnap_byz(self, a, m, byz, j: int) -> None:
+        """One client session against a Byzantine snapshot server
+        (``faults.ByzantineSnapshotServer``): the hostile advert +
+        tampered stream come from the double, and containment comes
+        exclusively from the client's OWN install gates — the offer
+        screen and the whole-snapshot digest/size verification.  A
+        contained serve trips the hostile peer's breaker, so the
+        client's next rounds fall back to change-by-change via honest
+        peers."""
+        server = self.agents[self.names[j]]
+        theirs = byz.advertised_state(server)
+        ours = a.generate_sync()
+        if not a._snapshot_wanted(ours, theirs):
+            return
+        addr = tuple(m.addr)
+        digest, size, chunks = byz.tampered_serve(
+            server, a.config.snapshot_chunk_bytes
+        )
+        st = a._snapshot_stage_begin(
+            m.actor_id.hex(), digest, size, theirs.heads
+        )
+        try:
+            for chunk in chunks:
+                a._snapshot_stage_feed(st, chunk)
+        except Exception:
+            a._snapshot_abort(st, "snap_stream", addr, trip=True)
+            return
+        # truncated/corrupted/divergent bytes all die on the digest
+        # gate inside the install (reason=snap_digest, breaker trip)
+        a._snapshot_install_staged(st, addr=addr)
+
+    def schedule_wipe(self, name: str, at: float) -> None:
+        """Schedule deletion of ``name``'s database (+ snapshot
+        sidecars) — between a crash and its restart this turns the
+        reborn node into a FRESH bootstrap (the long-dead/new-node
+        shape whose catch-up the snapshot path exists for)."""
+        import os
+
+        path = self._configs[self._idx[name]].db_path
+
+        def wipe(_due: float) -> None:
+            for p in (
+                path, path + "-wal", path + "-shm",
+                path + ".snap-staged", path + ".snap-state",
+                path + ".snap-serve",
+            ):
+                if os.path.exists(p):
+                    os.unlink(p)
+
+        self.clock.schedule_at(at, wipe)
 
     # -- recorder snapshots / stall beats ------------------------------
 

@@ -93,6 +93,11 @@ class SyncStateV1:
     need: Dict[ActorId, List[Span]] = field(default_factory=dict)
     partial_need: Dict[ActorId, Dict[Version, List[Span]]] = field(default_factory=dict)
     last_cleared_ts: Optional[Timestamp] = None
+    # snapshot-serve extension (docs/sync.md): per-actor snapshot
+    # floors — versions 1..=floor are only obtainable from this node
+    # via snapshot install (their per-version bookkeeping is
+    # compacted).  Empty = the pre-snapshot wire bytes, exactly.
+    snap_floors: Dict[ActorId, int] = field(default_factory=dict)
 
     def need_len(self) -> int:
         full = sum(e - s + 1 for spans in self.need.values() for s, e in spans)

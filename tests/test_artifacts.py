@@ -255,6 +255,59 @@ def test_frontier_bench_artifact_schema():
     assert doc["topologies"]["het_ring"]["msgs_per_node_mean"] < 64
 
 
+def test_boot_bench_artifact_schema():
+    """The bootstrap-recovery artifact (bench.py --boot): a fresh
+    node's change-by-change catch-up vs snapshot install + tail sync
+    over a 10k-version history — the committed headline must clear the
+    >=5x floor, the snapshot arm must have genuinely installed, and
+    the flight-recorder trajectory must carry the install event within
+    the in-record recovery budget."""
+    doc = _load("BOOT_BENCH.json")
+    _check(doc, {
+        "metric": lambda v: v == "boot_recovery_speedup",
+        "value": NUM,
+        "unit": lambda v: v == "x",
+        "conditions": str,
+        "n_versions": lambda v: v >= 10_000,
+        "recovery_budget_s": NUM,
+        "points": {
+            "changes": {
+                "recovery_s": NUM,
+                "converged": lambda v: v is True,
+                # the oracle arm must never have taken the shortcut
+                "snapshot_installs": lambda v: v == 0,
+            },
+            "snapshot": {
+                "recovery_s": NUM,
+                "converged": lambda v: v is True,
+                "snapshot_installs": lambda v: v >= 1,
+                "snapshot_served_bytes": lambda v: v > 0,
+                "trajectory": lambda v: isinstance(v, list) and any(
+                    e["kind"] == "snap_install" for e in v
+                ),
+            },
+        },
+        "gates": {
+            "both_converged": lambda v: v is True,
+            "installed_via_snapshot": lambda v: v is True,
+            "trajectory_has_install": lambda v: v is True,
+            "within_budget": lambda v: v is True,
+        },
+    })
+    assert "error" not in doc
+    assert doc["value"] >= 5.0, (
+        f"committed boot headline {doc['value']} under its 5x gate"
+    )
+    assert (doc["points"]["snapshot"]["recovery_s"]
+            <= doc["recovery_budget_s"])
+    # the trajectory's install event lands inside the measured wall
+    install = [
+        e for e in doc["points"]["snapshot"]["trajectory"]
+        if e["kind"] == "snap_install"
+    ][0]
+    assert 0 <= install["t_s"] <= doc["points"]["snapshot"]["recovery_s"]
+
+
 def test_virtual_scenarios_n512_artifact_schema():
     """The virtual-time campaign artifact (bench.py --scenarios
     --virtual-time --n 512): the full matrix PLUS the scale-only cells
@@ -281,7 +334,9 @@ def test_virtual_scenarios_n512_artifact_schema():
     for fam in ("restart_storm", "hostile_sweep_8", "hostile_sweep_32",
                 "equiv_during_heal", "skew_during_restart",
                 "framing_relay", "signed_equivocator",
-                "byz_sync_server", "hostile_sweep_32_signed"):
+                "byz_sync_server", "hostile_sweep_32_signed",
+                "restart_storm_snapshot", "byz_snapshot_server",
+                "crash_mid_install"):
         assert fam in doc["cells"], f"scale family {fam} missing"
     for family, cell in doc["cells"].items():
         _check(cell, {
@@ -328,6 +383,23 @@ def test_virtual_scenarios_n512_artifact_schema():
     for reason in ("advertised_range", "need_cap", "frame_garbage",
                    "deadline"):
         assert byz_gates[f"rejected_{reason}"] is True, reason
+    # snapshot-bootstrap cells (docs/sync.md): reborn nodes installed
+    # via snapshot; a hostile snapshot server was contained on the
+    # digest gate with ZERO installs and zero tampered rows
+    # cluster-wide; every mid-install death recovered to convergence
+    storm = doc["cells"]["restart_storm_snapshot"]["agents"]
+    assert storm["gates"]["reborn_installed_via_snapshot"] is True
+    assert storm["gates"]["snapshots_served"] is True
+    assert storm["detail"]["snapshot"]["installs_ok"] >= 1
+    sbyz = doc["cells"]["byz_snapshot_server"]["agents"]
+    assert sbyz["gates"]["rejected_snap_digest"] is True
+    assert sbyz["gates"]["hostile_never_installed"] is True
+    assert sbyz["gates"]["zero_tampered_rows"] is True
+    cmi = doc["cells"]["crash_mid_install"]["agents"]
+    assert cmi["gates"]["snap_crashes_fired"] is True
+    assert cmi["gates"]["recovery_retry_seen"] is True
+    assert cmi["gates"]["recovery_finalized_seen"] is True
+    assert cmi["gates"]["retries_installed"] is True
     assert "error" not in doc
 
 

@@ -1,0 +1,866 @@
+"""Snapshot bootstrap: build/stage/install units, scrub-registry
+coverage, crash-recovery windows, maintenance-driven compaction, the
+bootstrap-equivalence parity suite, and the live two-node wire path.
+
+The parity discipline mirrors PRs 3-5: the change-by-change path is
+the oracle — a node bootstrapped via snapshot install + tail sync must
+converge to canonically-equal table state, row clocks, and contained
+bookkeeping against always-alive nodes that applied every change
+individually (docs/sync.md, "Snapshot serve + install").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sqlite3
+
+import pytest
+
+from corrosion_tpu.agent import snapshot as snaplib
+from corrosion_tpu.agent.runtime import Agent, AgentConfig
+from corrosion_tpu.agent.testing import (
+    TEST_SCHEMA,
+    launch_test_agent,
+    wait_for,
+)
+
+
+def _offline_agent(tmp_path, name, **kw) -> Agent:
+    return Agent(AgentConfig(
+        db_path=str(tmp_path / f"{name}.db"), schema_sql=TEST_SCHEMA,
+        **kw,
+    ))
+
+
+def _tables(conn) -> set:
+    return {
+        r[0]
+        for r in conn.execute(
+            "SELECT name FROM sqlite_master WHERE type='table'"
+        )
+    }
+
+
+# ---------------------------------------------------------------------------
+# scrub registry: every live __corro_* table must have a decision
+# ---------------------------------------------------------------------------
+
+
+def test_scrub_registry_covers_live_schema(tmp_path):
+    """The regression the shared registry exists for: every internal
+    table in a LIVE agent database classifies keep-or-scrub — a future
+    bookkeeping table with no decision fails here instead of silently
+    leaking into (or vanishing from) snapshots and backups."""
+    a = _offline_agent(tmp_path, "a")
+    internal = [
+        t for t in _tables(a.storage.conn) if t.startswith("__corro_")
+    ]
+    assert internal, "expected internal tables in a live schema"
+    for t in internal:
+        assert snaplib.classify_table(t) in ("keep", "scrub"), t
+    # the decisions the satellite names: the digest FIFO is node-local
+    # cache (scrub); signed proofs are portable cluster evidence (keep)
+    assert snaplib.classify_table("__corro_equiv_digests") == "scrub"
+    assert snaplib.classify_table("__corro_equiv_proofs") == "keep"
+    assert snaplib.classify_table("__corro_members") == "scrub"
+    assert snaplib.classify_table("__corro_bookkeeping") == "keep"
+    # the backfill queue is PORTABLE: its table rows travel unversioned
+    # in the copy, so without the entry the receiver's boot-time
+    # _register_backfills would never version them
+    assert snaplib.classify_table("__corro_backfills") == "keep"
+    assert snaplib.classify_table("tests__corro_clock") == "keep"
+    assert snaplib.classify_table("tests") is None
+    with pytest.raises(snaplib.SnapshotError):
+        snaplib.classify_table("__corro_未registered")
+    a.storage.close()
+
+
+def test_backup_scrubs_through_registry(tmp_path):
+    """backup.py predated the PR 7/13 bookkeeping tables; it now
+    shares the snapshot registry: digests scrub, proofs survive."""
+    from corrosion_tpu.agent.backup import backup
+
+    a = _offline_agent(tmp_path, "a")
+    a.execute_transaction(
+        [("INSERT INTO tests (id, text) VALUES (1, 'kept-row')",)]
+    )
+    a.storage.conn.execute(
+        "INSERT INTO __corro_equiv_digests "
+        "(actor_id, version, digest) VALUES (x'01', 1, x'aa')"
+    )
+    a.storage.conn.execute(
+        "INSERT INTO __corro_equiv_proofs "
+        "(actor_id, version, kind, msg_a, sig_a, msg_b, sig_b) "
+        "VALUES (x'01', 1, 'content', x'bb', x'bb', x'cc', x'cc')"
+    )
+    out = str(tmp_path / "backup.db")
+    backup(a.config.db_path, out)
+    c = sqlite3.connect(out)
+    assert c.execute("SELECT count(*) FROM tests").fetchone()[0] == 1
+    assert c.execute(
+        "SELECT count(*) FROM __corro_equiv_digests"
+    ).fetchone()[0] == 0
+    assert c.execute(
+        "SELECT count(*) FROM __corro_equiv_proofs"
+    ).fetchone()[0] == 1
+    assert c.execute(
+        "SELECT count(*) FROM __corro_members"
+    ).fetchone()[0] == 0
+    c.close()
+    a.storage.close()
+
+
+# ---------------------------------------------------------------------------
+# build / digest / crash-recovery windows
+# ---------------------------------------------------------------------------
+
+
+def test_build_snapshot_scrubs_and_single_file(tmp_path):
+    a = _offline_agent(tmp_path, "a")
+    a.execute_transaction(
+        [("INSERT INTO tests (id, text) VALUES (1, 'snap-me')",)]
+    )
+    out = str(tmp_path / "snap.db")
+    snaplib.build_snapshot(a.config.db_path, out)
+    # single-file artifact: DELETE journal mode, no -wal sidecar
+    assert not os.path.exists(out + "-wal")
+    c = sqlite3.connect(out)
+    assert c.execute(
+        "PRAGMA journal_mode"
+    ).fetchone()[0].lower() == "delete"
+    assert c.execute("SELECT count(*) FROM tests").fetchone()[0] == 1
+    assert c.execute(
+        "SELECT count(*) FROM __corro_members"
+    ).fetchone()[0] == 0
+    assert c.execute(
+        "SELECT count(*) FROM __corro_state WHERE key='incarnation'"
+    ).fetchone()[0] == 0
+    c.close()
+    # target-exists refuses (the serve cache swaps via a tmp name)
+    with pytest.raises(snaplib.SnapshotError):
+        snaplib.build_snapshot(a.config.db_path, out)
+    digest = snaplib.file_digest(out)
+    assert len(digest) == snaplib.DIGEST_LEN
+    assert digest == snaplib.file_digest(out)
+    a.storage.close()
+
+
+def test_recovery_windows_classify(tmp_path):
+    """Every crash window of the install state machine boots into
+    exactly one of two outcomes (docs/sync.md, crash-recovery
+    contract): retry-from-scratch or finalized."""
+    db = str(tmp_path / "node.db")
+    with open(db, "w") as f:
+        f.write("previous database")
+
+    # no marker, no sidecar: nothing pending
+    assert snaplib.recover_pending_install(db) is None
+
+    # orphan sidecar, no marker: crash before the first marker write
+    with open(snaplib.staged_path(db), "w") as f:
+        f.write("partial stream")
+    assert snaplib.recover_pending_install(db) == "retry"
+    assert not os.path.exists(snaplib.staged_path(db))
+
+    # staging marker + sidecar present: mid-stream or verified-but-
+    # unswapped — discard both, previous database untouched
+    snaplib.write_marker(db, "staging", b"\x00" * 32, 123)
+    with open(snaplib.staged_path(db), "w") as f:
+        f.write("partial stream")
+    assert snaplib.recover_pending_install(db) == "retry"
+    assert not os.path.exists(snaplib.staged_path(db))
+    assert snaplib.read_marker(db) is None
+    with open(db) as f:
+        assert f.read() == "previous database"
+
+    # installing marker + sidecar STILL present: the swap never ran
+    snaplib.write_marker(db, "installing", b"\x00" * 32, 123)
+    with open(snaplib.staged_path(db), "w") as f:
+        f.write("prepared but unswapped")
+    assert snaplib.recover_pending_install(db) == "retry"
+
+    # installing marker + sidecar gone: os.replace completed — the DB
+    # IS the snapshot; stale -wal/-shm of the REPLACED inode removed
+    snaplib.write_marker(db, "installing", b"\x00" * 32, 123)
+    with open(db + "-wal", "w") as f:
+        f.write("stale wal of the replaced inode")
+    assert snaplib.recover_pending_install(db) == "finalized"
+    assert not os.path.exists(db + "-wal")
+    assert snaplib.read_marker(db) is None
+
+
+# ---------------------------------------------------------------------------
+# offline stage + install end-to-end (the runtime helpers, no wire)
+# ---------------------------------------------------------------------------
+
+
+def _serve_blob(server):
+    path, digest, size = server._snapshot_build()
+    with open(path, "rb") as f:
+        return f.read(), digest, size
+
+
+def test_offline_install_end_to_end(tmp_path):
+    """Stage + verify + identity rewrite + atomic swap + in-place
+    reload: the installing node ends with the server's data, its OWN
+    site id at ordinal 1, and a working write path."""
+    a1 = _offline_agent(tmp_path, "a1")
+    for i in range(5):
+        a1.execute_transaction(
+            [("INSERT OR REPLACE INTO tests (id, text) VALUES (?, ?)",
+              (i % 2, f"v{i}"))]
+        )
+    a2 = _offline_agent(tmp_path, "a2")
+    blob, digest, size = _serve_blob(a1)
+
+    st = a2._snapshot_stage_begin("peer", digest, size, {})
+    a2._snapshot_stage_feed(st, blob)
+    assert a2._snapshot_install_staged(st) is True
+    assert snaplib.read_marker(a2.config.db_path) is None
+    assert not os.path.exists(snaplib.staged_path(a2.config.db_path))
+
+    _, rows = a2.storage.read_query(
+        "SELECT id, text FROM tests ORDER BY id"
+    )
+    assert rows == [(0, "v4"), (1, "v3")]
+    # identity: ordinal 1 is the INSTALLING node, the origin keeps its
+    # clock attribution under a fresh ordinal
+    sites = dict(a2.storage.conn.execute(
+        "SELECT ordinal, site_id FROM __corro_sites"
+    ))
+    assert bytes(sites[1]) == a2.actor_id
+    assert any(
+        bytes(s) == a1.actor_id for o, s in sites.items() if o != 1
+    )
+    # bookkeeping rode the snapshot: a2 holds a1's ledger
+    bv = a2.bookie.for_actor(a1.actor_id)
+    assert bv.last() == 5
+    assert all(bv.contains_version(v) for v in range(1, 6))
+    # the write path works against the installed file (triggers +
+    # version cursor intact)
+    r = a2.execute_transaction(
+        [("INSERT INTO tests (id, text) VALUES (77, 'post-install')",)]
+    )
+    assert r["version"] == 1
+    assert a2.metrics.get_counter(
+        "corro_snapshot_installs_total", result="ok"
+    ) == 1
+    a1.storage.close()
+    a2.storage.close()
+
+
+def test_install_rejects_digest_mismatch(tmp_path):
+    """The containment gate: truncated, corrupted, or divergent-minted
+    bytes die on the whole-snapshot digest with a clean abort — the
+    previous database untouched, marker gone, breaker-visible
+    reason=snap_digest counted."""
+    a1 = _offline_agent(tmp_path, "a1")
+    a1.execute_transaction(
+        [("INSERT INTO tests (id, text) VALUES (1, 'truth')",)]
+    )
+    a2 = _offline_agent(tmp_path, "a2")
+    a2.execute_transaction(
+        [("INSERT INTO tests (id, text) VALUES (2, 'mine')",)]
+    )
+    blob, digest, size = _serve_blob(a1)
+    heads = {a2.actor_id: 1}  # the server's recorded view of a2
+
+    # truncate
+    st = a2._snapshot_stage_begin("peer", digest, size, heads)
+    a2._snapshot_stage_feed(st, blob[: len(blob) // 2])
+    assert a2._snapshot_install_staged(st) is False
+    # corrupt one byte (same size, honest digest advertised)
+    st = a2._snapshot_stage_begin("peer", digest, size, heads)
+    a2._snapshot_stage_feed(
+        st, blob[:100] + bytes([blob[100] ^ 0xFF]) + blob[101:]
+    )
+    assert a2._snapshot_install_staged(st) is False
+    # oversized stream dies while staging
+    st = a2._snapshot_stage_begin("peer", digest, size, heads)
+    with pytest.raises(snaplib.SnapshotError):
+        a2._snapshot_stage_feed(st, blob + b"x")
+    a2._snapshot_abort(st, "snap_stream")
+
+    assert a2.metrics.get_counter(
+        "corro_sync_client_rejects_total", reason="snap_digest"
+    ) == 2
+    assert snaplib.read_marker(a2.config.db_path) is None
+    _, rows = a2.storage.read_query("SELECT id, text FROM tests")
+    assert rows == [(2, "mine")]  # previous database untouched
+    r = a2.execute_transaction(
+        [("INSERT INTO tests (id, text) VALUES (3, 'still-alive')",)]
+    )
+    assert r["version"] == 2
+    a1.storage.close()
+    a2.storage.close()
+
+
+def test_install_aborts_on_local_write_races(tmp_path):
+    """The install-safety re-check under the storage lock: a local
+    write committed after dispatch (own head beyond the server's
+    recorded limit) aborts the swap instead of being silently lost."""
+    a1 = _offline_agent(tmp_path, "a1")
+    a1.execute_transaction(
+        [("INSERT INTO tests (id, text) VALUES (1, 'server')",)]
+    )
+    a2 = _offline_agent(tmp_path, "a2")
+    blob, digest, size = _serve_blob(a1)
+    st = a2._snapshot_stage_begin("peer", digest, size, {})
+    a2._snapshot_stage_feed(st, blob)
+    # the race: a local write lands mid-transfer
+    a2.execute_transaction(
+        [("INSERT INTO tests (id, text) VALUES (9, 'local-only')",)]
+    )
+    assert a2._snapshot_install_staged(st) is False
+    assert a2.metrics.get_counter(
+        "corro_sync_client_rejects_total", reason="snap_stale"
+    ) == 1
+    _, rows = a2.storage.read_query("SELECT id, text FROM tests")
+    assert rows == [(9, "local-only")]
+    a1.storage.close()
+    a2.storage.close()
+
+
+def test_failed_swap_restores_a_working_connection(tmp_path, monkeypatch):
+    """A swap that raises (the disk-full / EXDEV shape, injected at
+    ``os.replace``) must never leave a LIVE agent bricked: storage
+    comes back up on the previous database and the runtime re-points
+    every in-memory view at the restored connection — reads AND
+    writes work afterwards."""
+    a1 = _offline_agent(tmp_path, "a1")
+    a1.execute_transaction(
+        [("INSERT INTO tests (id, text) VALUES (1, 'server')",)]
+    )
+    a2 = _offline_agent(tmp_path, "a2")
+    a2.execute_transaction(
+        [("INSERT INTO tests (id, text) VALUES (2, 'before')",)]
+    )
+    blob, digest, size = _serve_blob(a1)
+    st = a2._snapshot_stage_begin(
+        "peer", digest, size, {a2.actor_id: 1}
+    )
+    a2._snapshot_stage_feed(st, blob)
+
+    real_replace = os.replace
+
+    def failing_replace(src, dst):
+        if dst == a2.config.db_path:
+            raise OSError(28, "No space left on device")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", failing_replace)
+    with pytest.raises(OSError):
+        a2._snapshot_install_staged(st)
+    monkeypatch.setattr(os, "replace", real_replace)
+    # the previous database is live again — reads AND writes (which
+    # go through the Bookie's connection) both work
+    _, rows = a2.storage.read_query("SELECT id, text FROM tests")
+    assert rows == [(2, "before")]
+    r = a2.execute_transaction(
+        [("INSERT INTO tests (id, text) VALUES (3, 'after')",)]
+    )
+    assert r["version"] == 2
+    a1.storage.close()
+    a2.storage.close()
+
+
+def test_serve_handle_survives_cache_rebuild(tmp_path):
+    """The offer/stream TOCTOU: a serve slower than ``snapshot_cache_s``
+    must keep streaming the inode its offer advertised — the handle
+    opens under the build lock, so a concurrent rebuild replacing the
+    cache path cannot divert the stream onto bytes that fail the
+    client's digest gate."""
+    import hashlib
+
+    a = _offline_agent(tmp_path, "a", snapshot_cache_s=0.0)
+    a.execute_transaction(
+        [("INSERT INTO tests (id, text) VALUES (1, 'gen-1')",)]
+    )
+    f, digest, size = a._snapshot_build_open()
+    # a newer build replaces the cache file before the slow serve
+    # reads a single byte
+    a.execute_transaction(
+        [("INSERT INTO tests (id, text) VALUES (2, 'gen-2')",)]
+    )
+    f2, digest2, _ = a._snapshot_build_open()
+    f2.close()
+    assert digest2 != digest  # the cache genuinely moved on
+    blob = f.read()
+    f.close()
+    assert len(blob) == size
+    assert hashlib.blake2b(
+        blob, digest_size=snaplib.DIGEST_LEN
+    ).digest() == digest
+    a.storage.close()
+
+
+# ---------------------------------------------------------------------------
+# history compaction: floors, contained prefix, idle-node maintenance
+# ---------------------------------------------------------------------------
+
+
+def test_contained_prefix_bounds():
+    from corrosion_tpu.agent.bookkeeping import BookedVersions
+
+    bv = BookedVersions(b'\x01' * 16)
+    bv.max_version = 10
+    assert bv.contained_prefix() == 10
+    bv.needed.insert(4, 6)
+    assert bv.contained_prefix() == 3
+    bv.needed.remove(4, 6)
+    # a partial at v=2 caps the prefix below it
+    bv.partials = {2: None}
+    assert bv.contained_prefix() == 1
+
+
+def test_set_snap_floor_compacts_ledger_and_extends_head():
+    from corrosion_tpu.agent.bookkeeping import BookedVersions
+
+    bv = BookedVersions(b'\x01' * 16)
+    bv.versions = {1: (1, 1), 2: (2, 2), 5: (5, 5)}
+    bv.max_version = 5
+    bv.set_snap_floor(3)
+    assert bv.snap_floor == 3
+    assert set(bv.versions) == {5}
+    assert bv.contains_version(1) and bv.contains_version(3)
+    # a floor record ABOVE max_version re-extends the head (the reload
+    # path: concrete rows below the floor were compacted away)
+    bv2 = BookedVersions(b'\x02' * 16)
+    bv2.set_snap_floor(7)
+    assert bv2.last() == 7
+    assert bv2.contains_version(7)
+    assert not bv2.contains_version(8)
+
+
+def test_idle_node_floor_advances_and_persists(tmp_path):
+    """The satellite regression: an idle-but-serving node's sweep is
+    maintenance-driven (``_compaction_pass``), not post-commit — the
+    floor advances with NO write in flight, persists, compacts the
+    per-version rows, and reloads across restart."""
+    a = _offline_agent(
+        tmp_path, "a", snapshot_retain_versions=0,
+    )
+    for i in range(8):
+        a.execute_transaction(
+            [("INSERT INTO tests (id, text) VALUES (?, 'h')", (i,))]
+        )
+    rows_before = a.storage.conn.execute(
+        "SELECT count(*) FROM __corro_bookkeeping WHERE actor_id=?",
+        (a.actor_id,),
+    ).fetchone()[0]
+    assert rows_before >= 1
+    # idle: no write between the history and the sweep
+    cleared = a._compaction_pass()
+    assert cleared >= 1
+    bv = a.bookie.for_actor(a.actor_id)
+    assert bv.snap_floor == 8
+    assert a.metrics.get_counter_sum(
+        "corro_compaction_maintenance_clears_total"
+    ) >= 1
+    assert a.storage.conn.execute(
+        "SELECT count(*) FROM __corro_bookkeeping WHERE actor_id=? "
+        "AND end_version IS NULL",
+        (a.actor_id,),
+    ).fetchone()[0] == 0
+    assert a.storage.conn.execute(
+        "SELECT floor FROM __corro_snap_floors WHERE actor_id=?",
+        (a.actor_id,),
+    ).fetchone()[0] == 8
+    # advertised in the handshake
+    st = a.generate_sync()
+    from corrosion_tpu.types import ActorId
+
+    assert st.snap_floors.get(ActorId(a.actor_id)) == 8
+    # second sweep with nothing new: no further advance
+    assert a._advance_snapshot_floors() == 0
+    a.storage.close()
+
+    # restart: the floor reloads and the head survives compaction
+    b = _offline_agent(tmp_path, "a", snapshot_retain_versions=0)
+    bv = b.bookie.for_actor(b.actor_id)
+    assert bv.snap_floor == 8
+    assert bv.last() == 8
+    r = b.execute_transaction(
+        [("INSERT INTO tests (id, text) VALUES (100, 'next')",)]
+    )
+    assert r["version"] == 9
+    b.storage.close()
+
+
+def test_retain_window_holds_floor_back(tmp_path):
+    a = _offline_agent(tmp_path, "a", snapshot_retain_versions=5)
+    for i in range(8):
+        a.execute_transaction(
+            [("INSERT INTO tests (id, text) VALUES (?, 'h')", (i,))]
+        )
+    a._compaction_pass()
+    assert a.bookie.for_actor(a.actor_id).snap_floor == 3
+    # negative disables advancement entirely
+    a.config.snapshot_retain_versions = -1
+    assert a._advance_snapshot_floors() == 0
+    a.storage.close()
+
+
+# ---------------------------------------------------------------------------
+# snapshot-or-changes dispatch: pure functions
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_pure_functions():
+    from corrosion_tpu.types.payload import SyncNeedV1
+
+    needs = {
+        "a": [SyncNeedV1.full(1, 10)],
+        "b": [SyncNeedV1.partial(3, [(0, 4)])],
+    }
+    # floors cover: actor a compacted through 6 -> 6 versions of the
+    # full span plus the partial below b's floor
+    assert snaplib.covered_below_floor(
+        needs, {"a": 6, "b": 4}
+    ) == 7
+    assert snaplib.covered_below_floor(needs, {"a": 0}) == 0
+    assert snaplib.covered_below_floor({}, {"a": 6}) == 0
+    # needs strictly above the floor: changes can still deliver them
+    assert snaplib.covered_below_floor(
+        {"a": [SyncNeedV1.full(7, 10)]}, {"a": 6}
+    ) == 0
+
+    assert snaplib.client_behind({"x": 3}, {"x": 3, "y": 9})
+    assert snaplib.client_behind({}, {"x": 1})
+    # a local-only write makes the install unsound
+    assert not snaplib.client_behind({"x": 4}, {"x": 3})
+
+
+# ---------------------------------------------------------------------------
+# wire: snap message variants + the sync-state floor extension
+# ---------------------------------------------------------------------------
+
+
+def test_snap_wire_roundtrip():
+    from corrosion_tpu.bridge import speedy
+
+    for msg in (
+        ("snap_request",),
+        ("snap_offer", bytes(range(32)), 123456),
+        ("snap_chunk", b"some snapshot bytes"),
+        ("snap_done",),
+    ):
+        enc = speedy.encode_sync_message(msg)
+        out = speedy.decode_sync_message(enc)
+        assert out[0] == msg[0]
+        if msg[0] == "snap_offer":
+            assert bytes(out[1]) == msg[1] and out[2] == msg[2]
+        if msg[0] == "snap_chunk":
+            assert bytes(out[1]) == msg[1]
+    with pytest.raises(speedy.SpeedyError):
+        speedy.encode_sync_message(("snap_offer", b"\x00" * 31, 1))
+    # truncated offer rejects instead of mis-decoding
+    enc = speedy.encode_sync_message(
+        ("snap_offer", bytes(32), 7)
+    )
+    with pytest.raises(speedy.SpeedyError):
+        speedy.decode_sync_message(enc[: len(enc) - 2])
+
+
+def test_sync_state_floor_extension_bytes():
+    """Floor-less states emit the pre-extension bytes exactly (the
+    trailing-map discipline of last_cleared_ts before it); states with
+    floors round-trip them."""
+    from corrosion_tpu.bridge import speedy
+    from corrosion_tpu.types import ActorId
+    from corrosion_tpu.types.payload import SyncStateV1
+
+    actor = ActorId(bytes(range(16)))
+    peer = ActorId(bytes(range(16, 32)))
+    base = SyncStateV1(actor_id=actor, heads={peer: 9})
+    enc_plain = speedy.encode_sync_message(base)
+    st = speedy.decode_sync_message(enc_plain)
+    assert st.snap_floors == {}
+
+    floored = SyncStateV1(
+        actor_id=actor, heads={peer: 9}, snap_floors={peer: 7}
+    )
+    enc_floor = speedy.encode_sync_message(floored)
+    assert enc_floor[: len(enc_plain)] == enc_plain  # pure suffix
+    assert len(enc_floor) > len(enc_plain)
+    st2 = speedy.decode_sync_message(enc_floor)
+    assert st2.snap_floors == {peer: 7}
+
+
+# ---------------------------------------------------------------------------
+# bootstrap-equivalence parity: snapshot+tail vs change-by-change
+# ---------------------------------------------------------------------------
+
+
+def _canonical_state(a) -> dict:
+    """Site-ordinal-independent dump of every CRR table + its clock/cl
+    tables: ordinals map through __corro_sites to site ids, so two
+    nodes with different site directories compare bytewise."""
+    sites = {
+        o: bytes(s).hex()
+        for o, s in a.storage.conn.execute(
+            "SELECT ordinal, site_id FROM __corro_sites"
+        )
+    }
+    out = {}
+    for t in sorted(a.storage.tables):
+        q = t.replace('"', '""')
+        rows = a.storage.conn.execute(f'SELECT * FROM "{q}"').fetchall()
+        out[t] = sorted(repr(r) for r in rows)
+        for sfx in ("__corro_clock", "__corro_cl"):
+            ct = t + sfx
+            cols = [
+                r[1]
+                for r in a.storage.conn.execute(
+                    f'PRAGMA table_info("{ct}")'
+                )
+            ]
+            if not cols:
+                continue
+            si = cols.index("site_ordinal") if "site_ordinal" in cols \
+                else None
+            canon = []
+            for r in a.storage.conn.execute(
+                f'SELECT * FROM "{ct}"'
+            ):
+                r = list(r)
+                if si is not None:
+                    r[si] = sites[r[si]]
+                canon.append(repr(r))
+            out[ct] = sorted(canon)
+    return out
+
+
+def _contained_ledgers(a) -> dict:
+    """Per-actor contained view: head + the exact contained set +
+    unresolved partials (the applied/cleared/floored split is a
+    per-node compaction detail and deliberately NOT compared)."""
+    out = {}
+    for actor, bv in a.bookie.actors().items():
+        head = bv.last()
+        if head == 0 and not bv.partials:
+            continue
+        out[actor.hex()] = (
+            head,
+            tuple(
+                v for v in range(1, head + 1)
+                if bv.contains_version(v)
+            ),
+            tuple(sorted(
+                int(v) for v, p in bv.partials.items()
+                if p is not None and not p.is_complete()
+            )),
+        )
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_bootstrap_equivalence_parity(tmp_path, seed):
+    """One cluster, randomized history (overwrites -> cleared spans
+    crossing the floor, an unresolved foreign partial riding the
+    ledger), floors compacted everywhere, a victim wiped to a fresh
+    bootstrap.  Seeds 1-3 kill the installer at each journal stage
+    (faults.SnapFault) so the retry path is part of the parity claim.
+    End state: the snapshot+tail node is canonically EQUAL to the
+    always-alive change-by-change nodes — tables, row clocks, and
+    contained ledgers."""
+    import random
+
+    from corrosion_tpu.faults import (
+        CrashEvent,
+        EquivocatingPeer,
+        FaultPlan,
+        SnapFault,
+    )
+    from corrosion_tpu.sim.vcluster import VirtualCluster
+    from corrosion_tpu.types import ChangeSource
+    from corrosion_tpu.types.base import CrsqlSeq
+
+    stage = [None, "crash_staging", "crash_installing",
+             "crash_swapped"][seed]
+    victim = "n5"
+    plan = FaultPlan(
+        seed=seed,
+        crashes=(CrashEvent(victim, at=0.1, restart_at=0.6),),
+        snap_faults=() if stage is None else (
+            SnapFault(victim, stage, restart_delay=0.3),
+        ),
+    )
+    rng = random.Random(seed)
+    c = VirtualCluster(
+        6, seed=seed, plan=plan, base_dir=str(tmp_path),
+        defer_crashes=True, snapshot_retain_versions=0,
+    )
+    try:
+        versions = []
+        for w in range(10):
+            origin = rng.choice([0, 1, 2])
+            # overwrites: a few distinct ids rewritten repeatedly so
+            # the originating ledgers grow cleared spans
+            row = rng.choice([1, 2, 3, 50 + w])
+            v = c.write(
+                origin,
+                "INSERT OR REPLACE INTO tests (id, text) VALUES (?, ?)",
+                (row, f"par-{seed}-{w}"),
+            )
+            versions.append((c.agents[f"n{origin}"].actor_id, v))
+            c.run_for(0.03)
+        # an unresolved PARTIAL from a foreign actor, buffered on every
+        # node: first seq half of a two-seq version
+        peer = EquivocatingPeer(
+            seed=900 + seed, now_ns=c.clock.wall_ns
+        )
+        half = peer._changeset(
+            1, 7001, f"partial-{seed}",
+            seqs=(CrsqlSeq(0), CrsqlSeq(0)), last_seq=CrsqlSeq(1),
+        )
+        c.inject(list(range(6)), half, ChangeSource.BROADCAST,
+                 rebroadcast=False)
+        assert c.run_until_true(
+            lambda: c.converged(versions), timeout=30
+        )
+        # floors advance over the full contained history on every node
+        # (cleared spans from the overwrites sit BELOW the floor)
+        for a in c.agents.values():
+            a._compaction_pass()
+        own = c.agents["n0"].bookie.for_actor(
+            c.agents["n0"].actor_id
+        )
+        assert own.snap_floor > 0
+        assert own.cleared.spans(), "history must hold cleared spans"
+
+        t0 = c.clock.monotonic()
+        c.schedule_plan_crashes(t0)
+        c.schedule_wipe(victim, t0 + 0.35)
+        # tail writes: committed while the victim is dead, so they sit
+        # ABOVE the server floors — only the tail sync can deliver them
+        tail = []
+        for w in range(3):
+            v = c.write(
+                0, "INSERT INTO tests (id, text) VALUES (?, ?)",
+                (9100 + w, f"tail-{seed}-{w}"),
+            )
+            tail.append((c.agents["n0"].actor_id, v))
+            c.run_for(0.05)
+
+        want_events = 2 + (2 if stage is not None else 0)
+        assert c.run_until_true(
+            lambda: len(c.ctrl.crash_log) >= want_events
+            and not c._crashed
+            and c.converged(versions + tail),
+            timeout=40,
+        ), (c.ctrl.crash_log, c._crashed)
+        c.run_for(0.3)
+
+        reborn = c.agents[victim]
+        if stage in (None, "crash_staging", "crash_installing"):
+            # these windows recover by RETRYING the install
+            assert reborn.metrics.get_counter(
+                "corro_snapshot_installs_total", result="ok"
+            ) >= 1
+        if stage is not None:
+            assert c.ctrl.injected["snap_crash"] == 1
+
+        ref = _canonical_state(c.agents["n0"])
+        led_ref = _contained_ledgers(c.agents["n0"])
+        assert _canonical_state(reborn) == ref
+        assert _contained_ledgers(reborn) == led_ref
+        # the foreign partial survived the bootstrap on BOTH paths
+        assert 1 in reborn.bookie.for_actor(peer.actor_id).partials
+
+        # completing the partial later applies identically everywhere
+        from dataclasses import replace
+
+        from corrosion_tpu.types import ChangeV1
+
+        other = peer._changeset(
+            1, 7002, f"partial-{seed}-tail",
+            seqs=(CrsqlSeq(1), CrsqlSeq(1)), last_seq=CrsqlSeq(1),
+            seq=1,
+        )
+        # one version = one commit ts: both halves share the stamp
+        other = ChangeV1(
+            other.actor_id,
+            replace(other.changeset, ts=half.changeset.ts),
+        )
+        c.inject(list(range(6)), other, ChangeSource.BROADCAST,
+                 rebroadcast=False)
+        c.run_for(0.5)
+        assert _canonical_state(reborn) == _canonical_state(
+            c.agents["n0"]
+        )
+        assert c.observer().no_divergence()["ok"]
+    finally:
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# live wire: the real serve/install path over sockets
+# ---------------------------------------------------------------------------
+
+
+def test_live_snapshot_bootstrap(tmp_path):
+    """Two REAL agents: the server's floor covers its whole history,
+    a fresh node bootstraps — the sync round dispatches snap_request,
+    the serve streams chunked frames through the coalesced sync
+    framing, the client stages + verifies + swaps, and the tail write
+    arrives via normal anti-entropy afterwards."""
+    async def main():
+        (tmp_path / "n1").mkdir()
+        (tmp_path / "n2").mkdir()
+        a1 = await launch_test_agent(
+            tmpdir=str(tmp_path / "n1"),
+            snapshot_retain_versions=0,
+        )
+        for i in range(10):
+            a1.execute_transaction(
+                [("INSERT OR REPLACE INTO tests (id, text) VALUES (?, ?)",
+                  (i % 3, f"live-{i}"))]
+            )
+        a1._compaction_pass()
+        floor = a1.bookie.for_actor(a1.actor_id).snap_floor
+        assert floor == 10
+        # drain the broadcast retransmission tail: a node joining
+        # IMMEDIATELY after the writes would catch the recent payloads
+        # via gossip and never need the snapshot — the scenario under
+        # test is the long-dead/new node whose history is floor-only
+        await asyncio.sleep(2.0)
+        a2 = await launch_test_agent(
+            bootstrap=[f"{a1.gossip_addr[0]}:{a1.gossip_addr[1]}"],
+            tmpdir=str(tmp_path / "n2"),
+            snapshot_retain_versions=0,
+        )
+        await wait_for(
+            lambda: a2.metrics.get_counter(
+                "corro_snapshot_installs_total", result="ok"
+            ) >= 1,
+            timeout=20,
+        )
+        assert a1.metrics.get_counter(
+            "corro_snapshot_serves_total"
+        ) >= 1
+
+        def table_equal():
+            q = "SELECT id, text FROM tests ORDER BY id"
+            return (a2.storage.read_query(q)[1]
+                    == a1.storage.read_query(q)[1])
+
+        await wait_for(table_equal, timeout=20)
+        # bookkeeping rode along: a2 holds a1's contained history
+        bv = a2.bookie.for_actor(a1.actor_id)
+        assert all(bv.contains_version(v) for v in range(1, 11))
+        # tail: a post-install write reaches a2 via normal gossip/sync
+        a1.execute_transaction(
+            [("INSERT INTO tests (id, text) VALUES (500, 'tail')",)]
+        )
+        await wait_for(
+            lambda: a2.storage.read_query(
+                "SELECT text FROM tests WHERE id=500"
+            )[1] == [("tail",)],
+            timeout=20,
+        )
+        await a1.stop()
+        await a2.stop()
+
+    asyncio.run(main())

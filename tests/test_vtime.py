@@ -532,3 +532,116 @@ def test_signed_campaigns_are_byte_deterministic(tmp_path, family):
     # the different-seed negative control
     j3, cs3, _log3 = _signed_campaign(tmp_path, "run3", family, seed=12)
     assert j3 != j1 or cs3 != cs1
+
+
+# ---------------------------------------------------------------------------
+# snapshot-bootstrap cells (docs/sync.md): restart storms install via
+# snapshot, hostile snapshot servers are contained, mid-install deaths
+# recover — plus byte-determinism over the new snap fault knobs
+# ---------------------------------------------------------------------------
+
+
+def test_vcell_restart_storm_snapshot(tmp_path):
+    r = _vcell(tmp_path, "restart_storm_snapshot")
+    assert r["gates"]["reborn_installed_via_snapshot"]
+    assert r["gates"]["snapshots_served"]
+    assert r["detail"]["snapshot"]["installs_ok"] >= 1
+    assert r["timeline"]["event_counts"].get("snap_install", 0) >= 1
+    assert r["timeline"]["event_counts"].get("snap_serve", 0) >= 1
+
+
+def test_vcell_byz_snapshot_server(tmp_path):
+    """Containment: every tampered serve dies on the whole-snapshot
+    digest gate, NOTHING installs cluster-wide, zero tampered rows,
+    and the victims still converge — change-by-change via honest
+    peers (which advertise no floors in this cell)."""
+    r = _vcell(tmp_path, "byz_snapshot_server")
+    assert r["gates"]["rejected_snap_digest"]
+    assert r["gates"]["hostile_never_installed"]
+    assert r["gates"]["zero_tampered_rows"]
+    assert r["detail"]["snapshot"]["snap_digest_rejects"] >= 3
+    assert r["timeline"]["event_counts"].get("snap_abort", 0) >= 1
+
+
+def test_vcell_crash_mid_install(tmp_path):
+    """A node killed at EVERY journal stage (mid-stream, marker-
+    durable, post-swap) boots into the classified recovery outcome
+    and re-converges."""
+    r = _vcell(tmp_path, "crash_mid_install")
+    assert r["gates"]["snap_crashes_fired"]
+    assert r["gates"]["recovery_retry_seen"]
+    assert r["gates"]["recovery_finalized_seen"]
+    assert r["gates"]["retries_installed"]
+
+
+def _snap_campaign(tmp_path, tag, seed):
+    """A fault-dense snapshot campaign at N=12: compacted floors,
+    a wiped victim killed mid-install (``faults.SnapFault``), clean
+    retry, full convergence — the determinism surface for the new
+    snapshot fault knobs."""
+    from corrosion_tpu.faults import CrashEvent, FaultPlan, SnapFault
+    from corrosion_tpu.sim.vcluster import VirtualCluster
+
+    victim = "n9"
+    plan = FaultPlan(
+        seed=seed,
+        crashes=(CrashEvent(victim, at=0.1, restart_at=0.7),),
+        snap_faults=(
+            SnapFault(victim, "crash_installing", restart_delay=0.3),
+        ),
+    )
+    c = VirtualCluster(
+        12, seed=seed, plan=plan, base_dir=str(tmp_path / tag),
+        defer_crashes=True, snapshot_retain_versions=0,
+    )
+    try:
+        versions = []
+        for w in range(6):
+            origin = [0, 4][w % 2]
+            v = c.write(
+                origin, "INSERT INTO tests (id, text) VALUES (?, ?)",
+                (700 + w, f"sn-{w}"),
+            )
+            versions.append((c.agents[f"n{origin}"].actor_id, v))
+            c.run_for(0.04)
+        assert c.run_until_true(
+            lambda: c.converged(versions), timeout=30
+        )
+        for a in c.agents.values():
+            a._compaction_pass()
+        t0 = c.clock.monotonic()
+        c.schedule_plan_crashes(t0)
+        c.schedule_wipe(victim, t0 + 0.4)
+        assert c.run_until_true(
+            lambda: len(c.ctrl.crash_log) >= 4 and not c._crashed
+            and c.converged(versions),
+            timeout=40,
+        ), (c.ctrl.crash_log, c._crashed)
+        c.run_for(0.5)
+        return (
+            c.journal_bytes(),
+            c.state_checksum(),
+            bytes(c.ctrl.decision_log),
+            dict(c.ctrl.injected),
+        )
+    finally:
+        c.close()
+
+
+def test_snapshot_campaign_is_byte_deterministic(tmp_path):
+    """Campaign byte-determinism extends to the snapshot fault knobs:
+    identical journals, state checksums, decision logs and injected
+    counts across two runs; different seed diverges."""
+    import json as _json
+
+    j1, cs1, log1, inj1 = _snap_campaign(tmp_path, "run1", seed=21)
+    j2, cs2, log2, inj2 = _snap_campaign(tmp_path, "run2", seed=21)
+    assert j1 == j2
+    assert cs1 == cs2
+    assert log1 == log2
+    assert inj1 == inj2
+    assert inj1["snap_crash"] == 1
+    kinds = {e["kind"] for e in _json.loads(j1)}
+    assert {"crash", "restart", "snap_serve"} <= kinds
+    j3, cs3, _log3, _inj3 = _snap_campaign(tmp_path, "run3", seed=22)
+    assert j3 != j1 or cs3 != cs1
