@@ -361,13 +361,186 @@ def _sig_overhead_ab(n_changes: int, reps: int = 7,
     }
 
 
+def _apply_state_digest(db) -> str:
+    """Order-normalized digest of every piece of observable CRDT state
+    — the in-bench parity witness between the apply arms."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    for t in sorted(db.tables):
+        q = t.replace('"', '""')
+        h.update(repr(sorted(
+            db.conn.execute(f'SELECT * FROM "{q}"').fetchall(),
+            key=repr,
+        )).encode())
+        h.update(repr(sorted(db.conn.execute(
+            f'SELECT pk, cid, col_version, db_version, seq, '
+            f'site_ordinal FROM "{q}__corro_clock"').fetchall())).encode())
+        h.update(repr(sorted(db.conn.execute(
+            f'SELECT pk, cl, db_version, seq, site_ordinal, sentinel '
+            f'FROM "{q}__corro_cl"').fetchall())).encode())
+    # ordinal 1 is the node's OWN random id — only the interned remote
+    # sites are part of the applied-state contract
+    h.update(repr(db.conn.execute(
+        "SELECT ordinal, site_id FROM __corro_sites WHERE ordinal > 1 "
+        "ORDER BY ordinal"
+    ).fetchall()).encode())
+    return h.hexdigest()
+
+
+def _apply_kernel_ab(n_changes: int, reps: int = 7,
+                     max_regression: float = 0.10) -> dict:
+    """Paired in-run A/B of the columnar merge kernel at the STORAGE
+    layer (the PR 6 pairing/median discipline): dict-replay (kernel
+    off) vs columnar (kernel on) batched applies of the same cold
+    stream in temporally-adjacent pairs, arm order alternating, gated
+    on the median per-pair ratio — plus a per-pair state-digest parity
+    assert, so a speedup over divergent semantics can never read as a
+    win.  The floor is 0.90 (not the observability planes' 0.95): the
+    two arms are alternative merge IMPLEMENTATIONS whose product gate
+    is the batched-vs-per-change headline, and on a CPU host the
+    columnar path's encode cost sits within host noise of the dict
+    replay — the kernel buys the shared sim/live winner-selection core
+    and the accelerator-resident reduction form, and this gate proves
+    it never costs more than 10% of the oracle's merge wall."""
+    import statistics
+    import tempfile
+
+    from corrosion_tpu.agent.storage import CrConn
+
+    site = b"\x42" * 16
+    changes = _apply_bench_changes(n_changes, site, col_version=1)
+    pairs = []
+    parity = True
+
+    def _arm_once(d, tag, columnar):
+        db = CrConn(os.path.join(d, f"kab-{tag}.db"))
+        try:
+            db.columnar_merge = columnar
+            db.columnar_merge_min = 0
+            db.conn.execute(
+                "CREATE TABLE IF NOT EXISTS bench ("
+                " id INTEGER PRIMARY KEY NOT NULL, a, b, c, d)"
+            )
+            db.as_crr("bench")
+            t0 = time.perf_counter()
+            db.apply_changes_batched(changes)
+            wall = time.perf_counter() - t0
+            return wall, _apply_state_digest(db)
+        finally:
+            db.close()
+
+    with tempfile.TemporaryDirectory(prefix="corro-apply-kab-") as d:
+        # one unrecorded warmup per arm: first-use costs (numpy/ops
+        # imports, allocator growth) must not skew a recorded pair
+        _arm_once(d, "warm-off", False)
+        _arm_once(d, "warm-on", True)
+        for rep in range(reps):
+            arms = (("off", False), ("on", True))
+            if rep % 2:
+                arms = arms[::-1]
+            cps = {}
+            digests = {}
+            for arm, columnar in arms:
+                # best-of-2 per arm: a scheduler preemption inside one
+                # 70 ms apply would otherwise dominate the pair ratio;
+                # symmetric across arms, so no directional bias
+                w1, dig = _arm_once(d, f"{arm}{rep}a", columnar)
+                w2, _ = _arm_once(d, f"{arm}{rep}b", columnar)
+                cps[arm] = n_changes / max(min(w1, w2), 1e-9)
+                digests[arm] = dig
+            if digests["off"] != digests["on"]:
+                parity = False
+            pairs.append({
+                "off_changes_per_s": round(cps["off"], 1),
+                "on_changes_per_s": round(cps["on"], 1),
+                "ratio": round(cps["on"] / max(cps["off"], 1e-9), 4),
+            })
+    ratio = statistics.median(p["ratio"] for p in pairs)
+    return {
+        "method": (
+            f"paired in-run A/B, {reps} adjacent off/on pairs of "
+            "storage-level batched apply at the headline change count "
+            "(arm order alternating, one unrecorded warmup per arm, "
+            "best-of-2 applies per recorded arm), median per-pair "
+            "ratio; on = the columnar merge kernel (ops/merge.py "
+            "segment reductions), off = the per-change dict-replay "
+            "oracle; per-pair state digests asserted equal; floor "
+            "0.90 — the arms are alternative merge implementations "
+            "(the product gate is the batched-vs-per-change "
+            "headline), and the kernel must never cost more than 10% "
+            "of the oracle's apply wall"
+        ),
+        "n_changes": n_changes,
+        "pairs": pairs,
+        "ratio": round(ratio, 4),
+        "parity": parity,
+        "max_regression": max_regression,
+        "pass": bool(parity and ratio >= 1.0 - max_regression),
+    }
+
+
+def _apply_stall_gate(n_changes: int, budget_ms: float = 50.0) -> dict:
+    """Event-loop stall gate for the batched apply: the full stream
+    applies in runtime-shaped chunks on executor threads (exactly how
+    the apply workers hold the storage path) under a concurrent stall
+    probe; the loop's worst scheduling gap must stay within budget."""
+    import asyncio as _asyncio
+    import tempfile
+
+    from corrosion_tpu.agent.storage import CrConn
+
+    site = b"\x42" * 16
+    changes = _apply_bench_changes(n_changes, site, col_version=1)
+
+    async def run(db):
+        stats = {"max_stall_ms": 0.0}
+        probe = _asyncio.ensure_future(_stall_probe(stats))
+        loop = _asyncio.get_running_loop()
+        try:
+            for i in range(0, len(changes), 2048):
+                await loop.run_in_executor(
+                    None, db.apply_changes_batched,
+                    changes[i : i + 2048],
+                )
+        finally:
+            await _asyncio.sleep(0.02)  # let the probe sample the tail
+            probe.cancel()
+        return stats["max_stall_ms"]
+
+    with tempfile.TemporaryDirectory(prefix="corro-apply-stall-") as d:
+        db = CrConn(os.path.join(d, "stall.db"))
+        try:
+            db.conn.execute(
+                "CREATE TABLE IF NOT EXISTS bench ("
+                " id INTEGER PRIMARY KEY NOT NULL, a, b, c, d)"
+            )
+            db.as_crr("bench")
+            max_stall = _asyncio.run(run(db))
+        finally:
+            db.close()
+    return {
+        "method": (
+            "full cold stream applied in 2048-change chunks on "
+            "executor threads (the apply-worker shape) under a "
+            "concurrent 5 ms event-loop stall probe"
+        ),
+        "n_changes": n_changes,
+        "max_stall_ms": round(max_stall, 2),
+        "budget_ms": budget_ms,
+        "pass": bool(max_stall <= budget_ms),
+    }
+
+
 def run_apply_bench(sizes=(1000, 10000), out_path="APPLY_BENCH.json"):
     """Per-change vs batched CRDT apply throughput (changes/s), cold
     (fresh rows) and warm (existing rows, superseding col_versions).
-    Each measurement gets its own database; the two paths are also
-    cross-checked to impact the same number of rows."""
+    Each measurement gets its own database; the paths are cross-checked
+    to impact the same number of rows AND to leave byte-identical CRDT
+    state (in-bench parity)."""
     import tempfile
 
+    from corrosion_tpu.agent.metrics import Metrics
     from corrosion_tpu.agent.storage import CrConn
 
     site = b"\x42" * 16
@@ -392,12 +565,26 @@ def run_apply_bench(sizes=(1000, 10000), out_path="APPLY_BENCH.json"):
         return time.perf_counter() - t0, impacted
 
     with tempfile.TemporaryDirectory(prefix="corro-apply-bench-") as d:
+        # one unrecorded warmup apply per path: first-use costs (the
+        # ops/numpy import in the columnar kernel, allocator growth)
+        # must not land inside the first timed point
+        wdb = _mk_db(d, "warmup")
+        try:
+            wchanges = _apply_bench_changes(512, site, col_version=1)
+            wdb.apply_changes_batched(wchanges)
+            with wdb.apply_tx():
+                wdb.apply_changes_sequential_in_tx(
+                    _apply_bench_changes(64, site, col_version=2)
+                )
+        finally:
+            wdb.close()
         for n in sizes:
             cold = _apply_bench_changes(n, site, col_version=1)
             warm = _apply_bench_changes(n, site, col_version=2)
             for mode in ("cold", "warm"):
                 row = {"n_changes": n, "mode": mode}
                 impacts = {}
+                digests = {}
                 for batched in (False, True):
                     key = "batched" if batched else "per_change"
                     db = _mk_db(d, f"{n}-{mode}-{key}")
@@ -406,9 +593,25 @@ def run_apply_bench(sizes=(1000, 10000), out_path="APPLY_BENCH.json"):
                             # pre-populate rows, then time the
                             # superseding second pass
                             db.apply_changes_batched(cold)
+                        if batched:
+                            # record which merge kernel the production
+                            # dispatch selects at this batch size
+                            # (fresh sink: exclude any warm prefill)
+                            db.metrics = Metrics()
                         wall, impacted = _measure(
                             db, warm if mode == "warm" else cold, batched
                         )
+                        if batched:
+                            kernels = sorted({
+                                dict(k).get("kernel") for k in
+                                db.metrics.histogram_samples(
+                                    "corro_apply_merge_seconds")
+                            })
+                            row["kernel"] = (
+                                kernels[0] if len(kernels) == 1
+                                else kernels
+                            )
+                        digests[key] = _apply_state_digest(db)
                     finally:
                         db.close()
                     impacts[key] = impacted
@@ -422,6 +625,14 @@ def run_apply_bench(sizes=(1000, 10000), out_path="APPLY_BENCH.json"):
                         "impact mismatch: per_change="
                         f"{impacts['per_change']} "
                         f"batched={impacts['batched']}"
+                    )
+                row["parity"] = (
+                    digests["per_change"] == digests["batched"]
+                )
+                if not row["parity"]:
+                    row["error"] = (
+                        "state divergence: per-change and batched "
+                        "applies left different CRDT state"
                     )
                 row["speedup"] = round(
                     row["batched"]["changes_per_s"]
@@ -469,6 +680,25 @@ def run_apply_bench(sizes=(1000, 10000), out_path="APPLY_BENCH.json"):
             None,
         )
     if headline["n_changes"] >= 5000:
+        # columnar-kernel off/on paired A/B + state parity at the
+        # headline shape (docs/crdts.md "Columnar merge kernel")
+        out["kernel_ab"] = _apply_kernel_ab(headline["n_changes"])
+        if out["kernel_ab"]["pass"] is False:
+            out.setdefault(
+                "error",
+                "columnar kernel A/B failed: kernel-on apply "
+                "regressed > 10% vs the dict oracle (or diverged) in "
+                "paired A/B",
+            )
+        # event-loop stall gate: batched applies ride executor
+        # threads; the loop must stay schedulable throughout
+        out["stall_gate"] = _apply_stall_gate(headline["n_changes"])
+        if out["stall_gate"]["pass"] is False:
+            out.setdefault(
+                "error",
+                "apply stall gate failed: event-loop max stall over "
+                "the 50 ms budget during batched applies",
+            )
         out["overhead_gate"] = _apply_overhead_ab(
             headline["n_changes"],
             committed=committed_hl,
@@ -500,6 +730,8 @@ def run_apply_bench(sizes=(1000, 10000), out_path="APPLY_BENCH.json"):
                        "below noise floor; gated at the 10k headline",
         }
         out["sig_overhead_gate"] = dict(out["overhead_gate"])
+        out["kernel_ab"] = dict(out["overhead_gate"])
+        out["stall_gate"] = dict(out["overhead_gate"])
     if out_path:
         with open(out_path, "w") as f:
             json.dump(_sanitize(out), f, indent=2)

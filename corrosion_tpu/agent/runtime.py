@@ -186,6 +186,15 @@ class AgentConfig:
     apply_queue_len: int = 50           # cost-based batch target
     apply_queue_timeout: float = 0.01   # batching tick
     max_concurrent_applies: int = 5     # apply worker threads
+    # columnar CRDT merge kernel (docs/crdts.md "Columnar merge
+    # kernel"): batched applies encode to flat arrays and resolve
+    # causal/LWW winners via ops/merge.py segment reductions, sharing
+    # ONE winner-selection core with the simulator's representation-
+    # independence check.  Below the threshold (changes per table
+    # batch), or when a hostile batch cannot encode, the per-change
+    # dict replay — the parity oracle — runs instead.
+    columnar_merge: bool = True
+    columnar_merge_min: int = 256
     # broadcast buffering + governor (broadcast/mod.rs:399-458,745-801)
     bcast_buffer_cutoff: int = 64 * 1024
     bcast_flush_interval: float = 0.5
@@ -463,6 +472,11 @@ class Agent:
         from corrosion_tpu.agent.metrics import Metrics
 
         self.metrics = Metrics()
+        # columnar merge dispatch + merge-phase timing sink
+        # (corro_apply_merge_seconds{kernel=}) for the storage layer
+        self.storage.metrics = self.metrics
+        self.storage.columnar_merge = config.columnar_merge
+        self.storage.columnar_merge_min = config.columnar_merge_min
         if self._snap_recovered is not None:
             self.metrics.counter(
                 "corro_snapshot_recoveries_total",

@@ -261,7 +261,14 @@ class ClusterObserver:
         3. **one content per (actor, version)** — the accepted-content
            digests pooled across nodes never show two digests for one
            version (the equivocation invariant, checked cross-node
-           where a single agent cannot see it).
+           where a single agent cannot see it);
+        4. **representation independence** — the columnar merge kernel
+           (:func:`corrosion_tpu.ops.merge.select_winners`, the SAME
+           winner-selection core the live batched apply dispatches to)
+           re-derives every table's data-row state from the clock-table
+           representation (:meth:`kernel_state_check`), so "all nodes
+           bytewise equal" can never silently mean "all nodes equally
+           wrong about the merge rule".
 
         Returns ``{"ok": bool, "violations": [...]}`` with enough
         detail to name the diverging nodes."""
@@ -340,6 +347,135 @@ class ClusterObserver:
                         "nodes": [prev[0], name],
                     })
 
+        kern = self.kernel_state_check()
+        violations.extend(kern["violations"])
+
+        return {"ok": not violations, "violations": violations}
+
+    def kernel_state_check(self) -> dict:
+        """Representation-independence gate: re-derive data-row state
+        from the clock representation through the SHARED columnar merge
+        kernel and compare against the stored rows.
+
+        One node's net change streams (``collect_changes`` for every
+        interned origin — the same representation anti-entropy serves)
+        run through :func:`corrosion_tpu.ops.merge.select_winners` with
+        empty seeds; the decision must reproduce EVERY node's data
+        tables: row liveness from causal-length parity, cell values
+        from the surviving LWW winners.  Liveness and structure are
+        independently derivable on the stream's own node (clock tables
+        vs data rows); cell VALUES reconstruct from the data row at
+        collect time, so value tampering on the streaming node is only
+        visible against the other nodes' rows — which is why the
+        prediction is compared cluster-wide, not just locally.  This is
+        the sim-side graft of the live apply path's kernel ("CRDT
+        Emulation, Simulation, and Representation Independence"): one
+        merge implementation serves both worlds, and
+        ``tests/test_merge_columnar.py`` proves the checker bites on
+        seeded corruption."""
+        from corrosion_tpu.ops import merge as mergeops
+        from corrosion_tpu.types.change import SENTINEL_CID
+
+        violations: list = []
+        names = sorted(self.agents)
+        if not names:
+            return {"ok": True, "violations": violations}
+        st = self.agents[names[0]].storage
+        with st._lock:
+            sites = [
+                bytes(r[0]) for r in st.conn.execute(
+                    "SELECT site_id FROM __corro_sites ORDER BY ordinal"
+                )
+            ]
+        by_table: Dict[str, list] = {}
+        for site in sites:
+            for ch in st.collect_changes(
+                (1, 1 << 60),
+                None if site == st.site_id else site,
+            ):
+                by_table.setdefault(ch.table, []).append(ch)
+        for t, info in sorted(st.tables.items()):
+            t_changes = by_table.get(t, [])
+            if not t_changes:
+                continue
+            plan = mergeops.encode_change_batch(t_changes, SENTINEL_CID)
+            if plan is None:
+                violations.append({"kind": "kernel_encode", "table": t})
+                continue
+            dec = mergeops.select_winners(plan)
+            predicted: Dict[bytes, dict] = {}
+            for p, pk in enumerate(plan.pk_values):
+                if not bool(dec.alive[p]):
+                    continue
+                cells = {}
+                base = p * plan.n_cid
+                for c, cid in enumerate(plan.cid_values):
+                    w = int(dec.winner_idx[base + c])
+                    if w >= 0:
+                        cells[cid] = plan.vals[w]
+                predicted[pk] = cells
+            pk_expr = "corro_pack(" + ", ".join(
+                f'"{p}"' for p in info.pk_cols
+            ) + ")"
+            sel = "".join(f', "{c}"' for c in info.data_cols)
+            # columns with NO predicted winner were wiped by the last
+            # generation change (or never written): they must hold the
+            # column DEFAULT.  Checkable when that default is NULL —
+            # default-bearing columns are skipped (parsing arbitrary
+            # DEFAULT expressions is not worth the coverage).
+            q = t.replace('"', '""')
+            _, ti_rows = st.read_query(f'PRAGMA table_info("{q}")')
+            null_default = {
+                r[1] for r in ti_rows if not r[5] and r[4] is None
+            }
+            for name in names:
+                node_st = self.agents[name].storage
+                if t not in node_st.tables:
+                    continue
+                _, rows = node_st.read_query(
+                    f'SELECT {pk_expr}{sel} FROM "{t}"'
+                )
+                actual = {
+                    bytes(r[0]): dict(zip(info.data_cols, r[1:]))
+                    for r in rows
+                }
+                if set(actual) != set(predicted):
+                    violations.append({
+                        "kind": "kernel_liveness",
+                        "table": t,
+                        "node": name,
+                        "extra_rows": len(
+                            set(actual) - set(predicted)
+                        ),
+                        "missing_rows": len(
+                            set(predicted) - set(actual)
+                        ),
+                    })
+                    continue
+                bad_cells = 0
+                bad_residual = 0
+                for pk, cells in predicted.items():
+                    row = actual[pk]
+                    for cid, val in cells.items():
+                        if row.get(cid) != val:
+                            bad_cells += 1
+                    for cid in null_default:
+                        if cid not in cells and row.get(cid) is not None:
+                            bad_residual += 1
+                if bad_cells:
+                    violations.append({
+                        "kind": "kernel_cells",
+                        "table": t,
+                        "node": name,
+                        "cells": bad_cells,
+                    })
+                if bad_residual:
+                    violations.append({
+                        "kind": "kernel_residual",
+                        "table": t,
+                        "node": name,
+                        "cells": bad_residual,
+                    })
         return {"ok": not violations, "violations": violations}
 
     def equivocations(self, scrape: Optional[Dict[str, dict]] = None

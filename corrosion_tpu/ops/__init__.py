@@ -3,19 +3,27 @@
 These are the hot ops behind the simulator — pure, shape-static, fusible
 jnp/lax code (pallas variants can slot in underneath without changing the
 API).
+
+Exports resolve lazily (PEP 562): the live agent imports
+:mod:`corrosion_tpu.ops.merge` for the columnar batched-apply kernel's
+NumPy twin, and must not pay the JAX import (hundreds of ms, inside an
+apply transaction) — or require JAX at all — unless a jax-backed kernel
+is actually dispatched.
 """
 
-from corrosion_tpu.ops.keys import KeyCodec, DEFAULT_CODEC
-from corrosion_tpu.ops.merge import (
-    merge_cells,
-    merge_keys,
-    scatter_merge,
-)
+_KEYS = ("KeyCodec", "DEFAULT_CODEC")
+_MERGE = ("merge_keys", "scatter_merge", "merge_cells")
 
-__all__ = [
-    "KeyCodec",
-    "DEFAULT_CODEC",
-    "merge_keys",
-    "scatter_merge",
-    "merge_cells",
-]
+__all__ = list(_KEYS + _MERGE)
+
+
+def __getattr__(name):
+    if name in _KEYS:
+        from corrosion_tpu.ops import keys
+
+        return getattr(keys, name)
+    if name in _MERGE:
+        from corrosion_tpu.ops import merge
+
+        return getattr(merge, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -7,22 +7,54 @@ elementwise work and keep everything HBM-resident — this is the pjit'd
 per-row reduction that replaces cr-sqlite's C merge
 (``crates/corro-types/src/sqlite.rs:103-121`` loads the extension;
 ``doc/crdts.md:13-16`` defines the rule).
+
+The second half of this module is the COLUMNAR BATCHED-APPLY kernel
+(docs/crdts.md "Columnar merge kernel"): the live agent's batched change
+application and the simulator's representation-independence check both
+resolve causal-length / LWW winners through ONE winner-selection core,
+``select_winners``, instead of re-deriving the merge rule in per-change
+Python.  A batch of changes encodes to flat arrays (interned pk/cid
+ordinals, causal lengths, packed ``(cl, col_version, value_rank)`` LWW
+keys); winners resolve via segmented prefix-max scans + segment-max
+reductions.  Two backends produce bit-identical integer results:
+
+* a pure-NumPy twin (the no-JAX fallback and the CPU-host default), and
+* a jit-compiled JAX path, shape-bucketed to powers of two like
+  ``exact_seed_batch``'s HBM policy so a stream of varying batch sizes
+  compiles O(log) kernels, not O(batches).
+
+The per-change dict loop in ``agent/storage.py`` stays verbatim as the
+parity oracle (PR 3–5 discipline); ``tests/test_apply_batched.py`` pins
+three-way equivalence and ``tests/test_merge_columnar.py`` pins the
+numpy/jax twins against each other.
 """
 
 from __future__ import annotations
 
-import jax.numpy as jnp
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _jnp():
+    """jax.numpy, imported on first jax-backed call — the live agent's
+    NumPy-twin path must never trigger (or require) the JAX import."""
+    import jax.numpy as jnp
+
+    return jnp
 
 
 def merge_keys(a, b):
     """Merge two equally-shaped packed-key arrays (commutative, idempotent,
     associative — the CRDT join)."""
-    return jnp.maximum(a, b)
+    return _jnp().maximum(a, b)
 
 
 def merge_cells(states):
     """Merge replica states along the leading axis: [R, ...] -> [...]."""
-    return jnp.max(states, axis=0)
+    return _jnp().max(states, axis=0)
 
 
 def scatter_merge(state, targets, msg_keys):
@@ -37,3 +69,676 @@ def scatter_merge(state, targets, msg_keys):
     them, which the sim uses for loss/partition masking.
     """
     return state.at[targets].max(msg_keys, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# Columnar batched-apply winner selection
+# ---------------------------------------------------------------------------
+
+#: "no value" for packed LWW keys and segment seeds — far below any
+#: packable key (which are non-negative) yet safe to add/compare in int64
+NEG_KEY = -(1 << 62)
+_BIG = 1 << 62
+
+#: dense per-(pk, cid) seed matrices beyond this many cells fall back to
+#: the dict oracle rather than allocating a hostile-batch-shaped array
+MAX_SEED_CELLS = 4_000_000
+
+
+@dataclass(frozen=True)
+class MergePlan:
+    """One table batch, encoded to flat arrays in stream order.
+
+    ``pk``/``cid`` are first-appearance-interned ordinals (cid ``-1`` =
+    row-level sentinel change); ``key`` packs ``(cl, col_version,
+    value_rank)`` so that int64 order == the merge rule's lexicographic
+    order (``NEG_KEY`` on sentinels).  ``seed_cl``/``seed_key`` carry the
+    database's pre-batch view: the row causal length per pk (``-1`` = no
+    row entry) and the packed clock/value per (pk, cid) cell (``NEG_KEY``
+    = no clock row).
+    """
+
+    n: int
+    n_pk: int
+    n_cid: int
+    pk: np.ndarray
+    cid: np.ndarray
+    sent: np.ndarray
+    cl: np.ndarray
+    key: np.ndarray
+    seed_cl: np.ndarray
+    seed_key: np.ndarray  # flat [n_pk * n_cid]
+    pk_values: List
+    cid_values: List
+    # the extracted per-change value / col_version columns, stream
+    # order — decoders index these instead of re-walking the changes
+    vals: Tuple = ()
+    vers: Tuple = ()
+
+
+@dataclass(frozen=True)
+class MergeDecision:
+    """``select_winners`` output, mirroring the dict loop's per-pk state.
+
+    Per pk ordinal: ``final_cl`` (max causal length incl. the seed),
+    ``gen`` (the row generation changed), ``alive`` (final cl odd),
+    ``ensure`` (an equal-generation live cell change touched the row),
+    ``sent_flag`` (some generation raise was a sentinel change) and
+    ``clrow_idx`` (stream index of the change whose ``(db_version, seq,
+    site)`` stamp the row-CL record takes; ``-1`` = no raise).  Per
+    (pk, cid) cell: ``winner_idx`` — stream index of the surviving LWW
+    winner (``-1`` = none: beaten by the DB view, or wiped by a later
+    generation).  ``impacted`` counts accept events exactly like the
+    sequential replay (rows-impacted parity).
+    """
+
+    final_cl: np.ndarray
+    gen: np.ndarray
+    alive: np.ndarray
+    ensure: np.ndarray
+    sent_flag: np.ndarray
+    clrow_idx: np.ndarray
+    winner_idx: np.ndarray  # flat [n_pk * n_cid]
+    impacted: int
+
+
+_TYPE_BUCKET = {type(None): 0, bytes: 1, str: 2, float: 3, int: 4,
+                bool: 4}
+
+
+def value_ranks(values: Sequence) -> np.ndarray:
+    """Dense ranks (position -> rank) under the cr-sqlite value order
+    (:func:`corrosion_tpu.agent.pack.value_cmp`): type-enum bucket
+    first -- ``NULL < BLOB < TEXT < REAL < INTEGER`` -- then the
+    in-type order (str order == UTF-8 byte order; bool binds as
+    INTEGER).  Equal-comparing values share a rank, bigger values get
+    bigger ranks.  Bucketed so each type sorts with native C compares;
+    no per-value comparator calls.  Raises TypeError on unsupported
+    types, ValueError on NaN (value_cmp "ties" NaN against everything,
+    which is not a total order) -- callers fall back to the per-change
+    oracle."""
+    n = len(values)
+    ranks = np.zeros(n, np.int64)
+    if not n:
+        return ranks
+    buckets = list(map(_TYPE_BUCKET.get, map(type, values)))
+    if None in buckets:
+        # exotic types: normalize bytes-likes / int subclasses, reject
+        # the rest (rare path -- wire decode produces exact types)
+        values = list(values)
+        for i, v in enumerate(values):
+            if buckets[i] is not None:
+                continue
+            if isinstance(v, (bytearray, memoryview)):
+                values[i] = bytes(v)
+                buckets[i] = 1
+            elif isinstance(v, bool):
+                values[i] = bool(v)
+                buckets[i] = 4
+            elif isinstance(v, int):
+                values[i] = int(v)
+                buckets[i] = 4
+            elif isinstance(v, float):
+                values[i] = float(v)
+                buckets[i] = 3
+            elif isinstance(v, str):
+                values[i] = str(v)
+                buckets[i] = 2
+            else:
+                raise TypeError(f"unsupported SQL value: {type(v)!r}")
+    b0 = buckets[0]
+    if b0 is not None and b0 != 0 and buckets.count(b0) == n:
+        # homogeneous batch (the common wire shape): no bucket gather
+        if b0 == 3 and any(v != v for v in values):
+            raise ValueError("NaN value")
+        rank_of = {v: r for r, v in enumerate(sorted(set(values)))}
+        return np.fromiter(
+            map(rank_of.__getitem__, values), np.int64, count=n
+        )
+    barr = np.fromiter(buckets, np.int8, count=n)
+    offset = 0
+    for b in range(5):
+        ix = np.flatnonzero(barr == b)
+        if not len(ix):
+            continue
+        if b == 0:  # every NULL is one rank
+            offset += 1
+            continue
+        vals = [values[i] for i in ix.tolist()]
+        if b == 3 and any(v != v for v in vals):
+            raise ValueError("NaN value")
+        distinct = sorted(set(vals))
+        rank_of = {v: r for r, v in enumerate(distinct)}
+        ranks[ix] = np.fromiter(
+            map(rank_of.__getitem__, vals), np.int64, count=len(vals)
+        )
+        ranks[ix] += offset
+        offset += len(distinct)
+    return ranks
+
+
+def encode_changes(
+    records: Sequence[Tuple],
+    seed_cls: Optional[Dict] = None,
+    seed_cells: Optional[Dict] = None,
+) -> Optional[MergePlan]:
+    """Encode one table batch for :func:`select_winners`.
+
+    ``records``: stream-ordered ``(pk, cid_or_None, cl, col_version,
+    value)`` tuples (cid ``None`` = row-level sentinel).  ``seed_cls``:
+    pk -> pre-batch row causal length.  ``seed_cells``: (pk, cid) ->
+    ``(col_version, current_value)`` pre-batch clock view.
+
+    Returns ``None`` when the batch cannot be packed into 62-bit keys
+    (hostile out-of-range fields) or the dense seed matrix would be
+    unreasonably large -- callers fall back to the per-change oracle.
+    """
+    if not records:
+        return None
+    pk_raw, cid_raw, cl_raw, ver_raw, val_raw = zip(*records)
+    seed_cols = None
+    if seed_cells:
+        s_pk, s_cid = zip(*seed_cells)
+        s_ver, s_val = zip(*seed_cells.values())
+        seed_cols = (s_pk, s_cid, s_ver, s_val)
+    return _encode_cols(
+        len(records), pk_raw, cid_raw, cl_raw, ver_raw, val_raw,
+        None, seed_cls or {}, seed_cols,
+    )
+
+
+def encode_change_batch(
+    changes: Sequence,
+    sentinel_cid,
+    seed_cls: Optional[Dict] = None,
+    seed_cell_cols: Optional[Tuple] = None,
+) -> Optional[MergePlan]:
+    """:func:`encode_changes` straight off ``Change`` objects -- column
+    extraction via C-level ``attrgetter`` maps, no per-change tuple
+    build.  ``sentinel_cid`` is the row-level sentinel marker
+    (``types.change.SENTINEL_CID``); ``seed_cell_cols`` carries the
+    DB clock view as parallel ``(pks, cids, col_versions, values)``
+    sequences."""
+    import operator
+
+    if not changes:
+        return None
+    return _encode_cols(
+        len(changes),
+        tuple(map(operator.attrgetter("pk"), changes)),
+        tuple(map(operator.attrgetter("cid"), changes)),
+        tuple(map(operator.attrgetter("cl"), changes)),
+        tuple(map(operator.attrgetter("col_version"), changes)),
+        tuple(map(operator.attrgetter("val"), changes)),
+        sentinel_cid, seed_cls or {}, seed_cell_cols,
+    )
+
+
+def _encode_cols(
+    n: int, pk_raw, cid_raw, cl_raw, ver_raw, val_raw,
+    sentinel, seed_cls: Dict, seed_cell_cols: Optional[Tuple],
+) -> Optional[MergePlan]:
+    from itertools import repeat
+
+    # version/causal-length fields must be real ints (the dict oracle
+    # compares whatever arrives; the kernel only handles the conforming
+    # stream and falls back otherwise) -- C-level isinstance map
+    if not all(map(isinstance, cl_raw, repeat(int))):
+        return None
+    if not all(map(isinstance, ver_raw, repeat(int))):
+        return None
+
+    pk_ord: Dict = {}
+    for pk in pk_raw:
+        if pk not in pk_ord:
+            pk_ord[pk] = len(pk_ord)
+    cid_ord: Dict = {sentinel: -1}
+    for c in cid_raw:
+        if c not in cid_ord:
+            cid_ord[c] = len(cid_ord) - 1
+    try:
+        pk_col = np.fromiter(
+            map(pk_ord.__getitem__, pk_raw), np.int64, count=n)
+        cid_col = np.fromiter(
+            map(cid_ord.__getitem__, cid_raw), np.int64, count=n)
+        cl_col = np.fromiter(cl_raw, np.int64, count=n)
+        ver_col = np.fromiter(ver_raw, np.int64, count=n)
+    except OverflowError:  # hostile out-of-int64 fields
+        return None
+    del cid_ord[sentinel]
+    if int(cl_col.min()) < 0 or int(ver_col.min()) < 0:
+        return None
+    n_pk, n_cid = len(pk_ord), max(1, len(cid_ord))
+    if n_pk * n_cid > MAX_SEED_CELLS:
+        return None
+
+    # the row-CL seeds first: per-pk pre-batch causal length (-1 = no
+    # row entry), needed below to filter which clock seeds participate
+    for v in seed_cls.values():
+        if not isinstance(v, int) or not 0 <= v <= _BIG:
+            return None
+    seed_cl = np.full(n_pk, -1, np.int64)
+    if seed_cls:
+        for pk, cl in seed_cls.items():
+            o = pk_ord.get(pk)
+            if o is not None:
+                seed_cl[o] = cl
+
+    # pool the DB-view cell values with the batch values so one ranking
+    # covers every comparison the LWW tie-break can make.  Seed cells
+    # only matter for pks holding a row-CL entry (with no entry the
+    # first cell change adopts a fresh generation and the clock view
+    # never participates) and for cids the batch references.
+    sp = sc = sv = None
+    if seed_cell_cols is not None:
+        s_pk_raw, s_cid_raw, s_ver_raw, s_val_raw = seed_cell_cols
+        if not (all(map(pk_ord.__contains__, s_pk_raw))
+                and all(map(cid_ord.__contains__, s_cid_raw))):
+            f = ([], [], [], [])
+            for pk, cid, sver, sval in zip(
+                s_pk_raw, s_cid_raw, s_ver_raw, s_val_raw
+            ):
+                if pk in pk_ord and cid in cid_ord:
+                    f[0].append(pk)
+                    f[1].append(cid)
+                    f[2].append(sver)
+                    f[3].append(sval)
+            s_pk_raw, s_cid_raw, s_ver_raw, s_val_raw = f
+        m = len(s_pk_raw)
+        if m:
+            if not all(map(isinstance, s_ver_raw, repeat(int))):
+                return None
+            try:
+                sp = np.fromiter(
+                    map(pk_ord.__getitem__, s_pk_raw), np.int64,
+                    count=m)
+                sc = np.fromiter(
+                    map(cid_ord.__getitem__, s_cid_raw), np.int64,
+                    count=m)
+                sv = np.fromiter(s_ver_raw, np.int64, count=m)
+            except OverflowError:
+                return None
+            if int(sv.min()) < 0:
+                return None
+            keep = np.flatnonzero(seed_cl[sp] >= 0)
+            if len(keep) < m:
+                sp, sc, sv = sp[keep], sc[keep], sv[keep]
+                s_val_raw = [s_val_raw[i] for i in keep.tolist()]
+    # A VALUE is only ever compared on an exact (pk, cid, cl,
+    # col_version) tie -- between two batch candidates for the same
+    # cell, or a candidate and the cell's DB clock seed.  Everything
+    # else is decided by the (cl, ver) bits alone, so only
+    # tie-implicated values get ranked (rank 0 otherwise): the common
+    # backfill batch skips value ranking entirely, exactly like the
+    # dict replay's lazily-called value_cmp.  Tag-hash membership is
+    # conservative under collisions (a collision only ranks a value
+    # needlessly).
+    sent_col = cid_col < 0
+    M = np.int64(1_000_003)
+    tags = ((pk_col * M + cid_col) * M + cl_col) * M + ver_col
+    cells_pos = np.flatnonzero(~sent_col)
+    ctags = tags[cells_pos]
+    if len(ctags) > 1:
+        ss = np.sort(ctags)
+        dup_tags = np.unique(ss[1:][ss[1:] == ss[:-1]])
+    else:
+        dup_tags = np.empty(0, np.int64)
+    seed_rank = None
+    six = None
+    if sp is not None and len(sp):
+        seed_tags = ((sp * M + sc) * M + seed_cl[sp]) * M + sv
+        seed_tied = np.isin(seed_tags, ctags)
+        six = np.flatnonzero(seed_tied)
+        tie_tags = np.union1d(dup_tags, seed_tags[six])
+        seed_rank = np.zeros(len(sp), np.int64)
+    else:
+        tie_tags = dup_tags
+    rank_col = np.zeros(n, np.int64)
+    max_rank = 0
+    if len(tie_tags):
+        cix = cells_pos[np.isin(ctags, tie_tags)]
+        pool = [val_raw[i] for i in cix.tolist()]
+        n_cell_pool = len(pool)
+        if six is not None and len(six):
+            pool.extend(s_val_raw[i] for i in six.tolist())
+        try:
+            ranks = value_ranks(pool)
+        except (TypeError, ValueError):
+            return None
+        rank_col[cix] = ranks[:n_cell_pool]
+        if six is not None and len(six):
+            seed_rank[six] = ranks[n_cell_pool:]
+        if len(ranks):
+            max_rank = int(ranks.max())
+
+    max_cl = int(cl_col.max())
+    if seed_cls:
+        max_cl = max(max_cl, max(seed_cls.values()))
+    max_ver = int(ver_col.max())
+    if sv is not None and len(sv):
+        max_ver = max(max_ver, int(sv.max()))
+    cl_bits = max(1, max_cl.bit_length())
+    ver_bits = max(1, max_ver.bit_length())
+    val_bits = max(1, max_rank.bit_length())
+    if cl_bits + ver_bits + val_bits > 62:
+        return None
+    cl_shift = ver_bits + val_bits
+
+    key_col = np.where(
+        sent_col, NEG_KEY,
+        (cl_col << cl_shift) | (ver_col << val_bits) | rank_col,
+    )
+
+    seed_key = np.full(n_pk * n_cid, NEG_KEY, np.int64)
+    if sp is not None and len(sp):
+        seed_key[sp * n_cid + sc] = (
+            (seed_cl[sp] << cl_shift) | (sv << val_bits) | seed_rank
+        )
+
+    return MergePlan(
+        n=n, n_pk=n_pk, n_cid=n_cid,
+        pk=pk_col, cid=cid_col, sent=sent_col, cl=cl_col, key=key_col,
+        seed_cl=seed_cl, seed_key=seed_key,
+        pk_values=list(pk_ord), cid_values=list(cid_ord),
+        vals=val_raw, vers=ver_raw,
+    )
+
+
+def _seg_cummax_np(x: np.ndarray, seg: np.ndarray) -> np.ndarray:
+    """Segmented inclusive prefix max over contiguous segments
+    (Hillis–Steele doubling: O(n log n) vector passes, no Python loop
+    over segments)."""
+    out = x.copy()
+    shift = 1
+    n = len(x)
+    while shift < n:
+        same = seg[shift:] == seg[:-shift]
+        np.maximum(
+            out[shift:], np.where(same, out[:-shift], NEG_KEY),
+            out=out[shift:],
+        )
+        shift <<= 1
+    return out
+
+
+def _winners_np(plan: MergePlan) -> MergeDecision:
+    """The NumPy twin of the winner-selection core.
+
+    Reduction semantics (mirrors the sequential replay exactly):
+
+    1. per pk, a segmented prefix max of causal length (seeded with the
+       DB row CL) classifies every change as stale (cl < running max),
+       equal-generation (cl == running max) or a generation RAISE
+       (cl > running max);
+    2. per (pk, cid), live-generation cell changes compete through a
+       segmented prefix max over packed ``(cl, col_version,
+       value_rank)`` keys seeded with the DB clock view — a strict
+       improvement is an accept event (rows-impacted parity), and the
+       last accept is the surviving winner;
+    3. winners from generations below the pk's final causal length are
+       discarded (a later raise wiped them), matching the dict loop's
+       cell reset.
+    """
+    n, n_pk, n_cid = plan.n, plan.n_pk, plan.n_cid
+    idx = np.arange(n, dtype=np.int64)
+    pk, cid, sent, cl, key = plan.pk, plan.cid, plan.sent, plan.cl, plan.key
+
+    # -- domain A: stream order within each pk ------------------------
+    # real batches usually arrive pk-grouped (collect_changes emits
+    # (db_version, seq) order, cells of one row adjacent): a sorted
+    # input makes the stable sort the identity permutation, so skip it
+    if np.all(pk[1:] >= pk[:-1]):
+        oA = idx
+        pkA, clA, sentA = pk, cl, sent
+    else:
+        oA = np.argsort(pk, kind="stable")
+        pkA, clA, sentA = pk[oA], cl[oA], sent[oA]
+    startsA = np.empty(n, bool)
+    startsA[0] = True
+    startsA[1:] = pkA[1:] != pkA[:-1]
+    segA = np.cumsum(startsA) - 1
+    cmaxA = _seg_cummax_np(clA, segA)
+    prevA = np.empty(n, np.int64)
+    prevA[0] = NEG_KEY
+    prevA[1:] = cmaxA[:-1]
+    seedA = plan.seed_cl[pkA]
+    beforeA = np.where(startsA, seedA, np.maximum(seedA, prevA))
+    raiseA = clA > beforeA
+    oddA = (clA & 1) == 1
+    cellA = ~sentA
+
+    final_cl = plan.seed_cl.copy()
+    np.maximum.at(final_cl, pk, cl)
+    gen = final_cl > plan.seed_cl
+    alive = (final_cl & 1) == 1
+    sent_flag = np.zeros(n_pk, bool)
+    np.logical_or.at(sent_flag, pkA, raiseA & sentA)
+    ensure = np.zeros(n_pk, bool)
+    np.logical_or.at(ensure, pkA, cellA & oddA & (clA == beforeA))
+
+    # the row-CL stamp comes from the FIRST change attaining the final
+    # causal length (the last raise of the sequential replay)
+    cand = np.where(cl == final_cl[pk], idx, _BIG)
+    clrow = np.full(n_pk, _BIG, np.int64)
+    np.minimum.at(clrow, pk, cand)
+    clrow_idx = np.where(gen, clrow, -1)
+
+    n_sent_raise = int(np.count_nonzero(raiseA & sentA))
+    n_even_raise = int(np.count_nonzero(raiseA & cellA & ~oddA))
+
+    # LWW participants: live-generation cell changes only
+    partA = cellA & oddA & (clA >= beforeA)
+    part = np.zeros(n, bool)
+    part[oA] = partA
+
+    # -- domain B: stream order within each (pk, cid) cell ------------
+    compB = pk * (n_cid + 2) + (cid + 1)
+    if np.all(compB[1:] >= compB[:-1]):
+        oB = idx
+        pkB, cidB = pk, cid
+        partB = part
+        keyB = np.where(partB, key, NEG_KEY)
+    else:
+        oB = np.lexsort((idx, cid, pk))
+        pkB, cidB = pk[oB], cid[oB]
+        partB = part[oB]
+        keyB = np.where(partB, key[oB], NEG_KEY)
+    startsB = np.empty(n, bool)
+    startsB[0] = True
+    startsB[1:] = (pkB[1:] != pkB[:-1]) | (cidB[1:] != cidB[:-1])
+    segB = np.cumsum(startsB) - 1
+    cmaxB = _seg_cummax_np(keyB, segB)
+    prevB = np.empty(n, np.int64)
+    prevB[0] = NEG_KEY
+    prevB[1:] = cmaxB[:-1]
+    cell_ix = pkB * n_cid + np.maximum(cidB, 0)
+    seedB = np.where(cidB >= 0, plan.seed_key[cell_ix], NEG_KEY)
+    beforeB = np.where(startsB, seedB, np.maximum(seedB, prevB))
+    acceptB = partB & (keyB > beforeB)
+    n_accept = int(np.count_nonzero(acceptB))
+
+    winner = np.full(n_pk * n_cid, -1, np.int64)
+    np.maximum.at(winner, cell_ix[acceptB], oB[acceptB])
+    wcl = np.where(winner >= 0, cl[np.maximum(winner, 0)], -1)
+    wpk = np.arange(n_pk * n_cid, dtype=np.int64) // n_cid
+    winner = np.where(
+        (winner >= 0) & (wcl == final_cl[wpk]), winner, -1
+    )
+
+    return MergeDecision(
+        final_cl=final_cl, gen=gen, alive=alive, ensure=ensure,
+        sent_flag=sent_flag, clrow_idx=clrow_idx, winner_idx=winner,
+        impacted=n_sent_raise + n_even_raise + n_accept,
+    )
+
+
+# -- JAX twin ----------------------------------------------------------
+
+#: smallest jitted bucket; batches pad up to the next power of two so a
+#: stream of varying sizes compiles O(log) kernel shapes (the
+#: exact_seed_batch bucketing discipline)
+MIN_BUCKET = 256
+#: below this many changes the jit dispatch overhead dwarfs the scan;
+#: ``backend="auto"`` keeps such batches on the NumPy twin
+JAX_AUTO_MIN = 65536
+
+
+def _bucket(n: int) -> int:
+    b = MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _seg_cummax_jnp(x, seg, n: int):
+    jnp = _jnp()
+    shift = 1
+    while shift < n:
+        same = seg[shift:] == seg[:-shift]
+        x = x.at[shift:].max(jnp.where(same, x[:-shift], NEG_KEY))
+        shift <<= 1
+    return x
+
+
+def _winners_jax_core(pk, cid, sent, cl, key, seed_cl, seed_key,
+                      n_cid: int, n: int):
+    """Shape-static core (n = padded bucket size; pads carry pk ==
+    n_pk, sent True, cl -1, key NEG_KEY so they never raise, never
+    participate and never win)."""
+    jnp = _jnp()
+    idx = jnp.arange(n, dtype=jnp.int64)
+    n_pk1 = seed_cl.shape[0]  # n_pk + 1 (pad segment)
+
+    oA = jnp.lexsort((idx, pk))
+    pkA, clA, sentA = pk[oA], cl[oA], sent[oA]
+    startsA = jnp.concatenate(
+        [jnp.ones(1, bool), pkA[1:] != pkA[:-1]]
+    )
+    segA = jnp.cumsum(startsA) - 1
+    cmaxA = _seg_cummax_jnp(clA, segA, n)
+    prevA = jnp.concatenate(
+        [jnp.full(1, NEG_KEY, jnp.int64), cmaxA[:-1]]
+    )
+    seedA = seed_cl[pkA]
+    beforeA = jnp.where(startsA, seedA, jnp.maximum(seedA, prevA))
+    raiseA = clA > beforeA
+    oddA = (clA & 1) == 1
+    cellA = ~sentA
+
+    final_cl = seed_cl.at[pk].max(cl)
+    gen = final_cl > seed_cl
+    alive = (final_cl & 1) == 1
+    sent_flag = (
+        jnp.zeros(n_pk1, jnp.int32).at[pkA].max(
+            (raiseA & sentA).astype(jnp.int32)
+        ) > 0
+    )
+    ensure = (
+        jnp.zeros(n_pk1, jnp.int32).at[pkA].max(
+            (cellA & oddA & (clA == beforeA)).astype(jnp.int32)
+        ) > 0
+    )
+    cand = jnp.where(cl == final_cl[pk], idx, _BIG)
+    clrow = jnp.full(n_pk1, _BIG, jnp.int64).at[pk].min(cand)
+    clrow_idx = jnp.where(gen, clrow, -1)
+
+    n_sent_raise = jnp.sum(raiseA & sentA)
+    n_even_raise = jnp.sum(raiseA & cellA & ~oddA)
+
+    partA = cellA & oddA & (clA >= beforeA)
+    part = jnp.zeros(n, bool).at[oA].set(partA)
+
+    oB = jnp.lexsort((idx, cid, pk))
+    pkB, cidB = pk[oB], cid[oB]
+    partB = part[oB]
+    keyB = jnp.where(partB, key[oB], NEG_KEY)
+    startsB = jnp.concatenate([
+        jnp.ones(1, bool),
+        (pkB[1:] != pkB[:-1]) | (cidB[1:] != cidB[:-1]),
+    ])
+    segB = jnp.cumsum(startsB) - 1
+    cmaxB = _seg_cummax_jnp(keyB, segB, n)
+    prevB = jnp.concatenate(
+        [jnp.full(1, NEG_KEY, jnp.int64), cmaxB[:-1]]
+    )
+    cell_ix = pkB * n_cid + jnp.maximum(cidB, 0)
+    seedB = jnp.where(cidB >= 0, seed_key[cell_ix], NEG_KEY)
+    beforeB = jnp.where(startsB, seedB, jnp.maximum(seedB, prevB))
+    acceptB = partB & (keyB > beforeB)
+    n_accept = jnp.sum(acceptB)
+
+    winner = jnp.full(n_pk1 * n_cid, -1, jnp.int64).at[
+        jnp.where(acceptB, cell_ix, n_pk1 * n_cid - 1)
+    ].max(jnp.where(acceptB, oB, -1))
+    wcl = jnp.where(winner >= 0, cl[jnp.maximum(winner, 0)], -1)
+    wpk = jnp.arange(n_pk1 * n_cid, dtype=jnp.int64) // n_cid
+    winner = jnp.where(
+        (winner >= 0) & (wcl == final_cl[wpk]), winner, -1
+    )
+    return (final_cl, gen, alive, ensure, sent_flag, clrow_idx, winner,
+            n_sent_raise + n_even_raise + n_accept)
+
+
+_JAX_CORE_CACHE: Dict[Tuple[int, int], object] = {}
+
+
+def _winners_jax(plan: MergePlan) -> MergeDecision:
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        # 62-bit packed keys need int64 lanes; without x64 the numpy
+        # twin is the correct backend
+        raise RuntimeError("columnar merge on jax needs jax_enable_x64")
+    n = _bucket(plan.n)
+    pad = n - plan.n
+    n_pk1 = plan.n_pk + 1
+    pk = np.concatenate([plan.pk, np.full(pad, plan.n_pk, np.int64)])
+    cid = np.concatenate([plan.cid, np.full(pad, -1, np.int64)])
+    sent = np.concatenate([plan.sent, np.ones(pad, bool)])
+    cl = np.concatenate([plan.cl, np.full(pad, -1, np.int64)])
+    key = np.concatenate([plan.key, np.full(pad, NEG_KEY, np.int64)])
+    seed_cl = np.concatenate([plan.seed_cl, np.full(1, -1, np.int64)])
+    # one pad row of cells; the pad winner slot (last cell) absorbs
+    # masked scatter writes
+    seed_key = np.concatenate([
+        plan.seed_key, np.full(plan.n_cid, NEG_KEY, np.int64)
+    ])
+
+    core = _JAX_CORE_CACHE.get((n, plan.n_cid))
+    if core is None:
+        core = jax.jit(
+            _winners_jax_core, static_argnames=("n_cid", "n")
+        )
+        _JAX_CORE_CACHE[(n, plan.n_cid)] = core
+    out = core(pk, cid, sent, cl, key, seed_cl, seed_key,
+               n_cid=plan.n_cid, n=n)
+    (final_cl, gen, alive, ensure, sent_flag, clrow_idx, winner,
+     impacted) = (np.asarray(x) for x in out)
+    np_pk = plan.n_pk
+    return MergeDecision(
+        final_cl=final_cl[:np_pk], gen=gen[:np_pk], alive=alive[:np_pk],
+        ensure=ensure[:np_pk], sent_flag=sent_flag[:np_pk],
+        clrow_idx=clrow_idx[:np_pk],
+        winner_idx=winner[: np_pk * plan.n_cid],
+        impacted=int(impacted),
+    )
+
+
+def select_winners(plan: MergePlan, backend: str = "auto") -> MergeDecision:
+    """Resolve one encoded table batch to its net merge decision.
+
+    ``backend``: ``"numpy"`` (the twin), ``"jax"`` (jit, bucketed), or
+    ``"auto"`` — jax only when it is importable, x64 is live and the
+    batch is big enough to amortize dispatch (``JAX_AUTO_MIN``).  Both
+    backends return bit-identical decisions (pinned by
+    tests/test_merge_columnar.py)."""
+    if backend == "numpy":
+        return _winners_np(plan)
+    if backend == "jax":
+        return _winners_jax(plan)
+    if "jax" in sys.modules and plan.n >= JAX_AUTO_MIN:
+        try:
+            return _winners_jax(plan)
+        except Exception:
+            return _winners_np(plan)
+    return _winners_np(plan)

@@ -43,8 +43,15 @@ CREATE TABLE IF NOT EXISTS pkonly (k INTEGER PRIMARY KEY NOT NULL);
 SITES = [bytes([i]) * 16 for i in range(1, 4)]
 
 
-def _mk(tmp_path, name):
+def _mk(tmp_path, name, columnar=None):
+    """A CRR database; ``columnar`` pins the batched merge backend:
+    True forces the columnar kernel for EVERY batch size, False forces
+    the dict-replay oracle, None keeps the production dispatch."""
     conn = CrConn(str(tmp_path / f"{name}.db"), site_id=b"\x77" * 16)
+    if columnar is True:
+        conn.columnar_merge_min = 0
+    elif columnar is False:
+        conn.columnar_merge = False
     conn.conn.executescript(SCHEMA)
     for t in ("items", "typed", "pkonly"):
         conn.as_crr(t)
@@ -145,27 +152,92 @@ def _assert_state_equal(seq_db, bat_db):
         assert s == b, f"collect_changes diverged for site {site[:1].hex()}"
 
 
-@pytest.mark.parametrize("seed", range(8))
-def test_batched_apply_parity_randomized(tmp_path, seed):
-    rng = random.Random(seed)
-    a = _mk(tmp_path, f"seq{seed}")
-    b = _mk(tmp_path, f"bat{seed}")
-    # identical local writes first, so remote applies can overwrite
-    # local change rows and exercise the compaction impact triggers
-    for c in (a, b):
+def _three_way_round(rng, dbs, n=40):
+    """One hostile stream through all arms: the `_apply_one` sequential
+    oracle, the dict-replay batched path, and the columnar kernel.
+    Asserts rows-impacted and full observable state agree."""
+    a, dict_db, col_db = dbs
+    batch = _stream(rng, n)
+    with a.apply_tx():
+        n_seq = a.apply_changes_sequential_in_tx(list(batch))
+    n_dict = dict_db.apply_changes_batched(list(batch))
+    n_col = col_db.apply_changes_batched(list(batch))
+    assert n_seq == n_dict == n_col, "rows-impacted diverged"
+    _assert_state_equal(a, dict_db)
+    _assert_state_equal(a, col_db)
+
+
+def _mk_three(tmp_path, tag):
+    """The three arms with identical local writes first, so remote
+    applies can overwrite local change rows and exercise the compaction
+    impact triggers."""
+    dbs = (
+        _mk(tmp_path, f"seq{tag}"),
+        _mk(tmp_path, f"dict{tag}", columnar=False),
+        _mk(tmp_path, f"col{tag}", columnar=True),
+    )
+    for c in dbs:
         c.execute(
             "INSERT INTO items (id, a, b) VALUES (1, 'local', 0)")
         c.execute("INSERT INTO typed (id, name, n) VALUES (2, 'loc', 7)")
         c.execute("INSERT INTO pkonly (k) VALUES (3)")
+    return dbs
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_batched_apply_parity_randomized(tmp_path, seed):
+    """Three-way equivalence — columnar kernel vs dict replay vs the
+    `_apply_one` sequential oracle — over shuffled, duplicated and
+    superseded streams with sentinel/delete generations."""
+    rng = random.Random(seed)
+    dbs = _mk_three(tmp_path, seed)
     for _round in range(3):
-        batch = _stream(rng, 40)
-        with a.apply_tx():
-            n_seq = a.apply_changes_sequential_in_tx(list(batch))
-        n_bat = b.apply_changes_batched(list(batch))
-        assert n_seq == n_bat, "rows-impacted diverged"
-        _assert_state_equal(a, b)
-    a.close()
-    b.close()
+        _three_way_round(rng, dbs)
+    for c in dbs:
+        c.close()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("block", range(10))
+def test_batched_apply_parity_fuzz_200(tmp_path, block):
+    """The offline fuzz tier: 200 seeds (20 per block) of the same
+    three-way equivalence, disjoint from the tier-1 seed range."""
+    for seed in range(100 + block * 20, 120 + block * 20):
+        rng = random.Random(seed)
+        dbs = _mk_three(tmp_path, seed)
+        for _round in range(2):
+            _three_way_round(rng, dbs)
+        for c in dbs:
+            c.close()
+
+
+def test_columnar_corruption_is_caught(tmp_path, monkeypatch):
+    """Seeded-corruption negative control: a columnar decision with one
+    winner dropped MUST trip the parity checker — proving the
+    three-way suite actually bites on kernel divergence."""
+    import dataclasses
+
+    import numpy as np
+
+    from corrosion_tpu.ops import merge as mergeops
+
+    real = mergeops.select_winners
+
+    def corrupt(plan, backend="auto"):
+        dec = real(plan, backend=backend)
+        w = dec.winner_idx.copy()
+        nz = np.flatnonzero(w >= 0)
+        assert len(nz), "corruption control needs at least one winner"
+        w[nz[0]] = -1
+        return dataclasses.replace(dec, winner_idx=w)
+
+    monkeypatch.setattr(mergeops, "select_winners", corrupt)
+    rng = random.Random(5)
+    dbs = _mk_three(tmp_path, "corrupt")
+    with pytest.raises(AssertionError):
+        _three_way_round(rng, dbs)
+    for c in dbs:
+        c.close()
 
 
 def test_batched_apply_parity_interleaves_with_local_writes(tmp_path):
@@ -569,6 +641,10 @@ def test_apply_bench_smoke_500():
         assert "error" not in p, p
         assert p["per_change"]["rows_impacted"] == \
             p["batched"]["rows_impacted"]
+        # in-bench parity: byte-identical CRDT state per point, with
+        # the columnar kernel on the batched arm (500 >= threshold)
+        assert p["parity"] is True
+        assert p["kernel"] == "columnar"
 
 
 @pytest.mark.slow
@@ -578,8 +654,12 @@ def test_apply_bench_10k_speedup():
     out = run_apply_bench(sizes=(1000, 10000), out_path=None)
     for p in out["points"]:
         assert "error" not in p, p
+        assert p["parity"] is True, p
     headline = next(
         p for p in out["points"]
         if p["n_changes"] == 10000 and p["mode"] == "cold"
     )
-    assert headline["speedup"] >= 3.0, headline
+    assert headline["speedup"] >= 4.0, headline
+    assert headline["kernel"] == "columnar"
+    assert out["kernel_ab"]["pass"] is True
+    assert out["stall_gate"]["pass"] is True

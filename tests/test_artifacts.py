@@ -161,7 +161,7 @@ def test_timeline_artifact_schema():
 
 
 @pytest.mark.parametrize("name,value_floor", [
-    ("APPLY_BENCH.json", 3.0),
+    ("APPLY_BENCH.json", 4.0),
     ("SYNC_BENCH.json", 3.0),
     ("WRITE_BENCH.json", 2.5),
 ])
@@ -189,6 +189,28 @@ def test_perf_bench_artifact_schemas(name, value_floor):
         gate = doc["sig_overhead_gate"]
         assert gate["pass"] is True
         assert gate["ratio"] >= 0.95
+        # columnar merge kernel (docs/crdts.md): the committed off/on
+        # paired A/B held its ≥0.90 floor WITH in-bench state parity,
+        # the event-loop stall gate held its 50 ms budget, and every
+        # point's per-change/batched state digests matched
+        kab = doc["kernel_ab"]
+        assert kab["pass"] is True
+        assert kab["parity"] is True
+        assert kab["ratio"] >= 0.90
+        sg = doc["stall_gate"]
+        assert sg["pass"] is True
+        assert sg["max_stall_ms"] <= sg["budget_ms"]
+        for p in doc["points"]:
+            assert p["parity"] is True, p
+        # the headline batched arm actually ran the columnar kernel
+        headline = next(
+            p for p in doc["points"]
+            if p["mode"] == "cold"
+            and p["n_changes"] == max(
+                q["n_changes"] for q in doc["points"]
+            )
+        )
+        assert headline["kernel"] == "columnar"
 
 
 def test_frontier_bench_artifact_schema():

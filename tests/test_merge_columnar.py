@@ -1,0 +1,304 @@
+"""Columnar CRDT merge kernel (ops/merge.py, docs/crdts.md).
+
+Pins the winner-selection core shared by the live batched apply and the
+simulator's representation-independence check: encode semantics, the
+merge rule on handcrafted streams, bit-equality of the NumPy twin and
+the jit-compiled (shape-bucketed) JAX path, the hostile-field encode
+fallback, and the sim-side ``ClusterObserver.kernel_state_check`` graft
+with its seeded-corruption negative control.  Runs under
+``JAX_PLATFORMS=cpu`` in tier-1 (the verify command's environment); the
+JAX twin enables x64 explicitly since packed keys need int64 lanes.
+"""
+
+import random
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from corrosion_tpu.ops import merge as mergeops
+
+
+def _rand_batch(rng, n_pk=8, n_cid=4, n=None):
+    n = n if n is not None else rng.randrange(1, 300)
+    records = []
+    for _ in range(n):
+        pk = rng.randrange(n_pk)
+        if rng.random() < 0.25:
+            records.append((pk, None, rng.randrange(1, 6), 0, None))
+        else:
+            records.append((
+                pk, f"c{rng.randrange(n_cid)}", rng.randrange(1, 6),
+                rng.randrange(1, 5),
+                rng.choice([None, 1, -4, 2.5, "x", b"\x01", "yy", ""]),
+            ))
+    seed_cls = {
+        pk: rng.randrange(1, 5) for pk in range(n_pk)
+        if rng.random() < 0.5
+    }
+    seed_cells = {}
+    for pk in seed_cls:
+        for c in range(n_cid):
+            if rng.random() < 0.4:
+                seed_cells[(pk, f"c{c}")] = (
+                    rng.randrange(1, 5), rng.choice([None, 1, "z"]),
+                )
+    return records, seed_cls, seed_cells
+
+
+def _decision_fields(dec):
+    return {
+        f: np.asarray(getattr(dec, f))
+        for f in ("final_cl", "gen", "alive", "ensure", "sent_flag",
+                  "clrow_idx", "winner_idx")
+    }
+
+
+def test_kernel_lww_and_generation_semantics():
+    """Handcrafted stream against the merge rule (docs/crdts.md):
+    higher cl wins the row (even = delete wipes cells), equal cl goes
+    to col_version then the value order, and a later generation raise
+    discards earlier in-batch winners."""
+    records = [
+        ("p1", "a", 1, 2, "v1"),     # accept (fresh cell)
+        ("p1", "a", 1, 1, "stale"),  # lower col_version: reject
+        ("p1", "a", 1, 2, "v2"),     # tie -> bigger value: accept
+        ("p1", None, 2, 0, None),    # delete sentinel: wipes the cell
+        ("p2", "a", 1, 1, "x"),      # accept
+        ("p2", None, 3, 0, None),    # resurrect (odd): new generation
+        ("p2", "a", 3, 1, "y"),      # accept in the new generation
+        ("p3", "a", 1, 1, None),     # accept (NULL is a value)
+        ("p3", "a", 1, 1, 5),        # tie -> INTEGER > NULL: accept
+    ]
+    plan = mergeops.encode_changes(records)
+    dec = mergeops.select_winners(plan, backend="numpy")
+    pk_ix = {pk: i for i, pk in enumerate(plan.pk_values)}
+    cid_ix = {c: i for i, c in enumerate(plan.cid_values)}
+
+    def winner(pk, cid):
+        w = int(dec.winner_idx[pk_ix[pk] * plan.n_cid + cid_ix[cid]])
+        return None if w < 0 else records[w][4]
+
+    assert int(dec.final_cl[pk_ix["p1"]]) == 2
+    assert not bool(dec.alive[pk_ix["p1"]])
+    assert winner("p1", "a") is None  # wiped by the delete
+    assert bool(dec.alive[pk_ix["p2"]])
+    assert winner("p2", "a") == "y"
+    assert winner("p3", "a") == 5
+    # accept events: p1 a(x2), p1 delete, p2 a, p2 resurrect, p2 a,
+    # p3 a(x2)
+    assert dec.impacted == 8
+
+
+def test_kernel_db_seed_participates_in_lww():
+    """The prefetched DB view loses to a bigger in-batch write and
+    beats a smaller one — and a fresh generation ignores it."""
+    records = [
+        ("p", "a", 1, 2, "small"),   # DB holds col_version 3: reject
+        ("p", "b", 1, 3, "bigger"),  # beats the DB's col_version 2
+        ("q", "a", 3, 1, "fresh"),   # generation above the DB's cl 1
+    ]
+    plan = mergeops.encode_changes(
+        records,
+        seed_cls={"p": 1, "q": 1},
+        seed_cells={("p", "a"): (3, "db"), ("p", "b"): (2, "db"),
+                    ("q", "a"): (9, "db")},
+    )
+    dec = mergeops.select_winners(plan, backend="numpy")
+    pk_ix = {pk: i for i, pk in enumerate(plan.pk_values)}
+    cid_ix = {c: i for i, c in enumerate(plan.cid_values)}
+    assert int(
+        dec.winner_idx[pk_ix["p"] * plan.n_cid + cid_ix["a"]]
+    ) == -1
+    assert int(
+        dec.winner_idx[pk_ix["p"] * plan.n_cid + cid_ix["b"]]
+    ) == 1
+    # q's new generation wins despite the DB's huge col_version
+    assert int(
+        dec.winner_idx[pk_ix["q"] * plan.n_cid + cid_ix["a"]]
+    ) == 2
+    assert not bool(dec.ensure[pk_ix["q"]])
+    assert bool(dec.gen[pk_ix["q"]])
+
+
+def test_encode_fallback_on_hostile_fields():
+    assert mergeops.encode_changes([]) is None
+    # negative causal length cannot encode
+    assert mergeops.encode_changes([("p", "a", -1, 1, "v")]) is None
+    # a causal length beyond the 62-bit key budget cannot encode
+    assert mergeops.encode_changes(
+        [("p", "a", 1 << 63, 1, "v")]
+    ) is None
+    # an unsupported value type forces fallback when it is
+    # tie-implicated (two candidates with the same (pk, cid, cl, ver)
+    # would compare it); an untied value is never inspected — exactly
+    # like the dict replay's lazily-called value_cmp
+    assert mergeops.encode_changes(
+        [("p", "a", 1, 1, object()), ("p", "a", 1, 1, object())]
+    ) is None
+    assert mergeops.encode_changes(
+        [("p", "a", 1, 1, object())]
+    ) is not None
+    # NaN defeats the value total order: tie-implicated NaN falls back
+    assert mergeops.encode_changes(
+        [("p", "a", 1, 1, float("nan")), ("p", "a", 1, 1, 0.5)]
+    ) is None
+    # in-range batches do encode
+    assert mergeops.encode_changes([("p", "a", 1, 1, "v")]) is not None
+
+
+@pytest.mark.parametrize("trial", range(12))
+def test_numpy_and_jax_twins_agree(trial):
+    """The jitted, shape-bucketed JAX path returns bit-identical
+    decisions to the NumPy twin on randomized batches."""
+    from jax.experimental import enable_x64
+
+    rng = random.Random(1000 + trial)
+    records, seed_cls, seed_cells = _rand_batch(rng)
+    plan = mergeops.encode_changes(records, seed_cls, seed_cells)
+    assert plan is not None
+    d_np = mergeops.select_winners(plan, backend="numpy")
+    with enable_x64():
+        d_jx = mergeops.select_winners(plan, backend="jax")
+    f_np, f_jx = _decision_fields(d_np), _decision_fields(d_jx)
+    for f in f_np:
+        assert np.array_equal(f_np[f], f_jx[f]), f
+    assert d_np.impacted == d_jx.impacted
+
+
+def test_auto_backend_without_x64_uses_numpy():
+    """backend="auto" must never require x64: big batches fall back to
+    the NumPy twin when the jax path raises."""
+    rng = random.Random(3)
+    records, seed_cls, seed_cells = _rand_batch(rng, n=512)
+    plan = mergeops.encode_changes(records, seed_cls, seed_cells)
+    dec = mergeops.select_winners(plan, backend="auto")
+    ref = mergeops.select_winners(plan, backend="numpy")
+    assert dec.impacted == ref.impacted
+
+
+# ---------------------------------------------------------------------------
+# sim-side graft: ClusterObserver.kernel_state_check
+# ---------------------------------------------------------------------------
+
+
+def _mini_cluster(tmp_path):
+    """Two converged CrConn 'nodes': node B applies node A's collected
+    stream (writes, an overwrite, a delete, a resurrect)."""
+    from corrosion_tpu.agent.storage import CrConn
+
+    a = CrConn(str(tmp_path / "a.db"), site_id=b"\xaa" * 16)
+    a.conn.executescript(
+        "CREATE TABLE t (id INTEGER PRIMARY KEY NOT NULL, x, y);"
+        "CREATE TABLE pko (k INTEGER PRIMARY KEY NOT NULL);"
+    )
+    a.as_crr("t")
+    a.as_crr("pko")
+    a.execute("INSERT INTO t (id, x, y) VALUES (1, 'one', 10)")
+    a.execute("INSERT INTO t (id, x) VALUES (2, 'two')")
+    a.execute("UPDATE t SET x = 'one-v2' WHERE id = 1")
+    a.execute("DELETE FROM t WHERE id = 2")
+    a.execute("INSERT INTO t (id, x) VALUES (2, 'reborn')")
+    a.execute("INSERT INTO pko (k) VALUES (7)")
+    b = CrConn(str(tmp_path / "b.db"), site_id=b"\xbb" * 16)
+    b.conn.executescript(
+        "CREATE TABLE t (id INTEGER PRIMARY KEY NOT NULL, x, y);"
+        "CREATE TABLE pko (k INTEGER PRIMARY KEY NOT NULL);"
+    )
+    b.as_crr("t")
+    b.as_crr("pko")
+    b.apply_changes(a.collect_changes((1, a.db_version())))
+    return a, b
+
+
+def _observer(*conns):
+    from corrosion_tpu.devcluster import ClusterObserver
+
+    agents = {
+        f"n{i}": SimpleNamespace(storage=c) for i, c in enumerate(conns)
+    }
+    return ClusterObserver(agents)
+
+
+def test_kernel_state_check_passes_on_converged_cluster(tmp_path):
+    a, b = _mini_cluster(tmp_path)
+    try:
+        res = _observer(a, b).kernel_state_check()
+        assert res["ok"], res["violations"]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_kernel_state_check_bites_on_corruption(tmp_path):
+    """Negative control: a data row silently edited UNDER the clock
+    representation (triggers suppressed) must trip the kernel check —
+    bytewise node equality alone would never see it."""
+    a, b = _mini_cluster(tmp_path)
+    try:
+        with a._lock:
+            a.conn.execute("BEGIN IMMEDIATE")
+            a._set_state("apply_mode", 1)  # suppress CRR triggers
+            a.conn.execute(
+                "UPDATE t SET x = 'tampered' WHERE id = 1"
+            )
+            a._set_state("apply_mode", 0)
+            a.conn.execute("COMMIT")
+        res = _observer(a, b).kernel_state_check()
+        assert not res["ok"]
+        assert any(
+            v["kind"] == "kernel_cells" for v in res["violations"]
+        )
+        # a stray value in a column the kernel predicts NO winner for
+        # is caught by the residual check — the "all nodes equally
+        # wrong" direction bytewise equality and winner comparison
+        # both miss.  A remote pk-only sentinel generation (bare
+        # resurrect marker) creates a live row with every column at
+        # its NULL default and no cell winners; then both nodes store
+        # the same bogus value.
+        from corrosion_tpu.agent.pack import pack_values
+        from corrosion_tpu.types.base import CrsqlDbVersion, CrsqlSeq
+        from corrosion_tpu.types.change import Change, SENTINEL_CID
+
+        with a._lock:  # undo the phase-1 tamper: clean slate
+            a.conn.execute("BEGIN IMMEDIATE")
+            a._set_state("apply_mode", 1)
+            a.conn.execute("UPDATE t SET x = 'one-v2' WHERE id = 1")
+            a._set_state("apply_mode", 0)
+            a.conn.execute("COMMIT")
+        bare = Change(
+            table="t", pk=pack_values([5]), cid=SENTINEL_CID, val=None,
+            col_version=1, db_version=CrsqlDbVersion(1),
+            seq=CrsqlSeq(0), site_id=b"\xcc" * 16, cl=1,
+        )
+        for db in (a, b):
+            db.apply_changes([bare])
+        res = _observer(a, b).kernel_state_check()
+        assert res["ok"], res["violations"]
+        for db in (a, b):
+            with db._lock:
+                db.conn.execute("BEGIN IMMEDIATE")
+                db._set_state("apply_mode", 1)
+                db.conn.execute(
+                    "UPDATE t SET y = 99 WHERE id = 5"
+                )
+                db._set_state("apply_mode", 0)
+                db.conn.execute("COMMIT")
+        res = _observer(a, b).kernel_state_check()
+        assert any(
+            v["kind"] == "kernel_residual" for v in res["violations"]
+        )
+        # a vanished row (liveness corruption) is also caught
+        with a._lock:
+            a.conn.execute("BEGIN IMMEDIATE")
+            a._set_state("apply_mode", 1)
+            a.conn.execute("DELETE FROM t WHERE id = 1")
+            a._set_state("apply_mode", 0)
+            a.conn.execute("COMMIT")
+        res = _observer(a, b).kernel_state_check()
+        assert any(
+            v["kind"] == "kernel_liveness" for v in res["violations"]
+        )
+    finally:
+        a.close()
+        b.close()
