@@ -177,6 +177,10 @@ def load_config(path: Optional[str] = None, **overrides) -> AgentConfig:
         "flight_ring_max",
         # equivocation defense (docs/faults.md)
         "equivocation_detection",
+        # subscription matcher plane (docs/pubsub.md)
+        "subs_shards",
+        "subs_columnar",
+        "subs_shard_max_pending",
     ):
         if key in perf:
             kwargs[key] = perf[key]

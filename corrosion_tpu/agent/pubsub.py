@@ -53,6 +53,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 logger = logging.getLogger("corrosion_tpu.agent.pubsub")
 
+from corrosion_tpu.agent import submatch
 from corrosion_tpu.agent.pack import jsonable_row, pack_values, unpack_values
 from corrosion_tpu.types.changeset import ChangeV1
 
@@ -60,6 +61,9 @@ DEBOUNCE_S = 0.05
 MAX_CHANGE_LOG = 100_000
 # more candidate pks than this per round -> full refresh is cheaper
 DELTA_MAX_PKS = 2048
+# row-fetch VALUES chunking: stay well under sqlite's host-parameter
+# ceiling even for wide composite pks
+FETCH_PARAM_BUDGET = 900
 # words whose presence means a row's content or membership can depend on
 # OTHER rows: pk-scoped delta evaluation would be wrong, so such queries
 # use full refresh.  Deliberately over-broad (a column merely NAMED
@@ -281,6 +285,20 @@ def splice_pk_cols(nsql: str, items: List[Tuple[str, str, bool]],
     )
 
 
+def plan_mentions(plan_text: str, op: str, name: str) -> bool:
+    """Does an EXPLAIN QUERY PLAN transcript apply ``op`` (SEARCH/SCAN)
+    to the from-item ``name``?  Handles both plan formats: sqlite >=
+    3.36 prints ``SEARCH t``, older builds print ``SEARCH TABLE tests
+    AS t`` (or ``SEARCH TABLE tests`` when unaliased).  Word-boundary
+    matching, and a bare table-name hit directly followed by ``AS`` is
+    rejected — there it is the TABLE of some other alias, not the
+    from-item asked about."""
+    return re.search(
+        rf"{op} (?:TABLE )?(?:\w+ AS )?{re.escape(name)}\b(?!\s+AS\b)",
+        plan_text,
+    ) is not None
+
+
 def normalize_sql(sql: str) -> str:
     """Collapse whitespace OUTSIDE string literals only."""
     out = []
@@ -372,6 +390,24 @@ class SubscriptionHandle:
         # aliases whose scoped delta cannot reach an index: a change on
         # their table falls back to one full refresh for the round
         self.full_refresh_aliases: Set[str] = set()
+        # bounded re-evaluation mode (ORDER BY + LIMIT over an
+        # index-served ordering): a change wave re-runs the whole query
+        # but the index bounds the cost to O(limit), so it counts as a
+        # delta round, not a full refresh
+        self.bounded = False
+        # COUNT(*)-only mode: the single count row is maintained
+        # incrementally from per-pk membership transitions (the
+        # pk_groups side table records which pks are currently counted)
+        self.count_only = False
+        self.count_full_probe: Optional[str] = None
+        self.count_has_where = False
+        self.count_pk_cols_sql = ""
+        # columnar matcher spec (submatch.SubSpec) when the shape is
+        # decidable from (pk, liveness, current row); None = this sub
+        # stays on the per-sub oracle path
+        self.columnar_spec = None
+        # matcher shard this sub's candidate work routes to
+        self.shard = 0
         # single-table GROUP BY aggregate mode: the group-key tuple is
         # the row identity; a delta probes the changed pks' CURRENT
         # groups (no user WHERE — it can hide a membership change),
@@ -629,8 +665,25 @@ CREATE TABLE IF NOT EXISTS pk_groups (
         # above propagates to the drain round's failure counter)
         self.last_ok_at = time.time()
 
-    def _refresh_inner(self, initial: bool = False) -> None:
-        self.manager.agent.metrics.counter("corro_subs_refresh_total")
+    def _refresh_inner(self, initial: bool = False,
+                       count: bool = True) -> None:
+        # bounded (ORDER BY + LIMIT) re-evals run through here too but
+        # count as delta rounds, not refreshes — the index bounds their
+        # cost to O(limit)
+        if count:
+            self.manager.agent.metrics.counter("corro_subs_refresh_total")
+        if self.incremental and self.count_only:
+            cols, rows = self.manager.agent.storage.read_query(self.sql)
+            with self._lock:
+                self.columns = cols
+                cells = jsonable_row(rows[0]) if rows else [0]
+                self._apply_diff(
+                    {"__corro_count:0": cells}, {"__corro_count:0": {}},
+                    dict(self.rows), initial,
+                )
+                self._rebuild_count_members()
+                self._db.commit()
+            return
         if self.incremental and self.agg:
             cols, rows = self.manager.agent.storage.read_query(
                 self.exec_sql
@@ -674,6 +727,23 @@ CREATE TABLE IF NOT EXISTS pk_groups (
         re-evaluation.  A change on a NULLABLE (left-joined) alias
         re-scopes through the anchor instead (``_delta_nullable``)."""
         self.manager.agent.metrics.counter("corro_subs_delta_rounds_total")
+        if self.bounded:
+            # ORDER BY + LIMIT: membership depends on OTHER rows (a new
+            # row can push one out of the top-N), so the candidate pks
+            # are irrelevant — re-run the bounded query whole.  The
+            # ordering index caps the cost at O(limit).
+            self.manager.agent.metrics.counter(
+                "corro_subs_bounded_refresh_total"
+            )
+            self._refresh_inner(count=False)
+            self.last_ok_at = time.time()
+            return
+        if self.count_only:
+            pks = table_pks.get(self.pk_items[0][0])
+            if pks:
+                self._delta_count(pks)
+            self.last_ok_at = time.time()
+            return
         if self.agg:
             pks = table_pks.get(self.pk_items[0][0])
             if pks:
@@ -681,6 +751,7 @@ CREATE TABLE IF NOT EXISTS pk_groups (
             self.last_ok_at = time.time()
             return
         work = []
+        need_refresh = False
         anchor_alias = self.pk_items[0][1] if self.pk_items else None
         for table, pks in table_pks.items():
             if not pks:
@@ -693,16 +764,20 @@ CREATE TABLE IF NOT EXISTS pk_groups (
                     # a degraded anchor degrades it too
                     nullable and anchor_alias in self.full_refresh_aliases
                 ):
-                    # the scoped plan cannot reach an index: one full
-                    # refresh covers the whole round
-                    self.refresh()
-                    return
+                    # only the DEGRADED alias routes through refresh
+                    # (one per round, at the end); sibling aliases keep
+                    # their scoped deltas below, so their events emit
+                    # without waiting on the full re-evaluation
+                    need_refresh = True
+                    continue
                 work.append((alias, nullable, pks))
         for alias, nullable, pks in work:
             if nullable:
                 self._delta_nullable(alias, pks)
             else:
                 self._delta_scoped(alias, pks)
+        if need_refresh:
+            self.refresh()
         self.last_ok_at = time.time()
 
     def _scope_rows(self, alias: str, pk_values: List[tuple]):
@@ -859,13 +934,120 @@ CREATE TABLE IF NOT EXISTS pk_groups (
                 cand_keys=cand_keys,
             )
 
+    def _rebuild_count_members(self) -> None:
+        """Recompute the counted-pk membership side table wholesale
+        (boot/refresh).  Caller holds ``self._lock``; caller commits."""
+        _, rows = self.manager.agent.storage.read_query(
+            self.count_full_probe
+        )
+        self._db.execute("DELETE FROM pk_groups")
+        self._db.executemany(
+            "INSERT OR REPLACE INTO pk_groups VALUES (?, '1')",
+            [(pack_values(list(r)).hex(),) for r in rows],
+        )
+
+    def _delta_count(self, pks: Set[bytes]) -> None:
+        """Incremental COUNT(*) maintenance: probe the changed pks'
+        CURRENT membership (the count query's own WHERE, scoped on the
+        pk index), diff against each pk's recorded membership
+        (``pk_groups``), and move the single count row by the net
+        transition — no re-aggregation, no table scan."""
+        pk_values = [tuple(unpack_values(p)) for p in pks]
+        row_ph = "(" + ", ".join("?" for _ in pk_values[0]) + ")"
+        values = ", ".join(row_ph for _ in pk_values)
+        sep = " AND " if self.count_has_where else " WHERE "
+        _, rows = self.manager.agent.storage.read_query(
+            f"{self.count_full_probe}{sep}"
+            f"(({self.count_pk_cols_sql}) IN (VALUES {values}))",
+            [v for vals in pk_values for v in vals],
+        )
+        current = {pack_values(list(r)).hex() for r in rows}
+        with self._lock:
+            moved = 0
+            for pk in pks:
+                ph = pk.hex()
+                was = self._db.execute(
+                    "SELECT 1 FROM pk_groups WHERE pk = ?", (ph,)
+                ).fetchone()
+                if ph in current and was is None:
+                    moved += 1
+                    self._db.execute(
+                        "INSERT OR REPLACE INTO pk_groups VALUES (?, '1')",
+                        (ph,),
+                    )
+                elif ph not in current and was is not None:
+                    moved -= 1
+                    self._db.execute(
+                        "DELETE FROM pk_groups WHERE pk = ?", (ph,)
+                    )
+            if not moved:
+                self._db.commit()
+                return
+            identity = "__corro_count:0"
+            old = self.rows.get(identity)
+            old_n = old[1][0] if old else 0
+            self._apply_diff(
+                {identity: [old_n + moved]}, {identity: {}},
+                dict(self.rows), initial=False, cand_keys=frozenset(),
+            )
+
+    def apply_columnar(self, verdicts: Dict[bytes, Optional[tuple]]) -> None:
+        """Apply one shard wave's resolved verdicts (the columnar fast
+        path): ``verdicts[pk]`` is the current row in declared column
+        order (upsert) or None (delete).  Produces the exact rows,
+        identities and events the per-sub oracle (``_delta_scoped``)
+        would — pinned by tests/test_subs_parity.py."""
+        alias = self.pk_items[0][1]
+        spec = self.columnar_spec
+        new_ids: Dict[str, list] = {}
+        pks_of: Dict[str, Dict[str, str]] = {}
+        cand_keys = set()
+        for pk, row in verdicts.items():
+            h = pk.hex()
+            cand_keys.add((alias, h))
+            if row is None:
+                continue
+            identity = f"{h}:0"
+            new_ids[identity] = jsonable_row(
+                [row[i] for i in spec.proj_idx]
+            )
+            pks_of[identity] = {alias: h}
+        with self._lock:
+            scope_old = {
+                i: self.rows[i]
+                for k in cand_keys
+                for i in self.by_pk.get(k, [])
+                if i in self.rows
+            }
+            self._apply_diff(
+                new_ids, pks_of, scope_old, initial=False,
+                cand_keys=cand_keys,
+            )
+        self.last_ok_at = time.time()
+
     def _fanout(self, event: dict) -> None:
         self.manager.agent.metrics.counter("corro_subs_events_total")
         for q in list(self._streams):
             try:
                 q.put_nowait(event)
+                continue
             except queue.Full:
                 pass
+            # bounded buffer, drop-OLDEST: a slow consumer loses its
+            # oldest events (it must resubscribe from a snapshot anyway
+            # once it notices the change-id gap) instead of silently
+            # losing the newest — and the drop is counted, per sub
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
+            try:
+                q.put_nowait(event)
+            except queue.Full:
+                pass
+            self.manager.agent.metrics.counter(
+                "corro_subs_events_dropped_total", sub_id=self.id
+            )
 
     # -- streaming -------------------------------------------------------
 
@@ -927,6 +1109,107 @@ CREATE TABLE IF NOT EXISTS pk_groups (
         self._db.close()
 
 
+class _MatcherShard:
+    """One matcher worker shard: its own pending sets, columnar wave
+    buffers, predicate index, and drain thread.
+
+    Subscriptions hash onto shards by sub_id (``submatch.shard_of``);
+    ``SubsManager.on_change`` — called from the group-commit broadcast
+    collector (the corro-wbcast worker) and the remote apply path —
+    only ROUTES: per-table change waves to the shards holding columnar
+    subs on that table, per-sub candidate pks to the owning shard's
+    queues.  All matching (SQL or columnar) runs on shard threads, off
+    the event loop and off the collector."""
+
+    def __init__(self, mgr: "SubsManager", idx: int):
+        self.mgr = mgr
+        self.idx = idx
+        self.index = submatch.ShardIndex()
+        self.pending: Set[str] = set()
+        self.pending_pks: Dict[str, Dict[str, Set[bytes]]] = {}
+        self.waves: Dict[str, List] = {}
+        self.draining = False
+        self.wake = threading.Event()
+        self.thread = threading.Thread(
+            target=self._run, name=f"corro-subs-{idx}", daemon=True
+        )
+
+    def depth(self) -> int:
+        """Queued candidate work (caller holds the manager lock)."""
+        return (
+            len(self.pending)
+            + sum(
+                len(p)
+                for per in self.pending_pks.values()
+                for p in per.values()
+            )
+            + sum(len(chs) for chs in self.waves.values())
+        )
+
+    def overflow(self) -> None:
+        """Bounded-depth enforcement (caller holds the manager lock):
+        past the cap, queued precision work converts to full-refresh
+        candidates — a refresh covers any candidate set, so nothing is
+        lost, and the queue depth collapses to O(subs)."""
+        self.mgr.agent.metrics.counter(
+            "corro_subs_shard_overflow_total", shard=str(self.idx)
+        )
+        for sub_id in self.pending_pks:
+            self.pending.add(sub_id)
+        self.pending_pks = {}
+        for table in self.waves:
+            self.pending |= self.index.subs_on(table)
+        self.waves = {}
+
+    def _run(self) -> None:
+        try:
+            self._run_inner()
+        except BaseException:
+            # a dead worker must fail idle() loudly, not hang it
+            # (draining stuck) or lie (popped batch never processed)
+            self.mgr._worker_died = True
+            raise
+
+    def _run_inner(self) -> None:
+        mgr = self.mgr
+        last_gc = time.monotonic()
+        while not mgr._closed:
+            woke = self.wake.wait(timeout=mgr.GC_SWEEP_S)
+            if mgr._closed:
+                return
+            # sweep on a deadline, NOT only when idle: a node with
+            # steady write traffic never times the wait out.  Shard 0
+            # carries the GC duty.
+            if (
+                self.idx == 0
+                and time.monotonic() - last_gc >= mgr.GC_SWEEP_S
+            ):
+                mgr._gc_idle_subs()
+                last_gc = time.monotonic()
+            if not woke:
+                continue
+            time.sleep(DEBOUNCE_S)  # batch candidates
+            self.wake.clear()
+            with mgr._lock:
+                pending, self.pending = self.pending, set()
+                pending_pks, self.pending_pks = self.pending_pks, {}
+                waves, self.waves = self.waves, {}
+                # popped-but-unprocessed work keeps idle() false: the
+                # sets alone go empty the instant a round is claimed,
+                # long before its refresh/delta SQL has finished
+                self.draining = bool(pending or pending_pks or waves)
+            try:
+                if waves:
+                    # columnar waves first: a sub they degrade (fetch
+                    # error, missing projection) lands in `pending` and
+                    # is covered by the round's refresh pass below
+                    mgr._drain_waves(self, waves, pending)
+                mgr._drain_round(pending, pending_pks)
+            finally:
+                with mgr._lock:
+                    self.draining = False
+
+
 class SubsManager:
     """Owns all subscriptions + the table-update notify streams."""
 
@@ -939,15 +1222,19 @@ class SubsManager:
         self._subs: Dict[str, SubscriptionHandle] = {}
         self._by_sql: Dict[str, str] = {}
         self._lock = threading.RLock()
-        self._pending: Set[str] = set()
-        self._pending_pks: Dict[str, Dict[str, Set[bytes]]] = {}
-        self._draining = False
         self._worker_died = False
         self._update_streams: Dict[str, List[queue.Queue]] = {}
-        self._wake = threading.Event()
         self._closed = False
-        self._worker = threading.Thread(target=self._run, daemon=True)
-        self._worker.start()
+        self._columnar = bool(
+            getattr(agent.config, "subs_columnar", True)
+        )
+        self._shard_max = int(
+            getattr(agent.config, "subs_shard_max_pending", 50_000)
+        )
+        n_shards = max(1, int(getattr(agent.config, "subs_shards", 4)))
+        self._shards = [_MatcherShard(self, i) for i in range(n_shards)]
+        for s in self._shards:
+            s.thread.start()
         agent.on_change = self.on_change
         self._restore()
 
@@ -979,8 +1266,10 @@ class SubsManager:
 
     def close(self) -> None:
         self._closed = True
-        self._wake.set()
-        self._worker.join(timeout=2)
+        for s in self._shards:
+            s.wake.set()
+        for s in self._shards:
+            s.thread.join(timeout=2)
         with self._lock:
             for h in self._subs.values():
                 h.close()
@@ -1023,10 +1312,15 @@ class SubsManager:
             self, sub_id, nsql, [], tables,
             os.path.join(self.subs_path, f"{sub_id}.db"),
         )
+        handle.shard = submatch.shard_of(sub_id, len(self._shards))
         self._detect_incremental(handle, nsql, tables, raw_tables)
+        if self._columnar:
+            self._detect_columnar(handle, nsql)
         with self._lock:
             self._subs[sub_id] = handle
             self._by_sql[nsql] = sub_id
+            if handle.columnar_spec is not None:
+                self._shards[handle.shard].index.add(handle.columnar_spec)
         return handle
 
     def _detect_incremental(self, handle: SubscriptionHandle, nsql: str,
@@ -1054,9 +1348,25 @@ class SubsManager:
         words = re.findall(r"[A-Za-z_]+", up)
         if words.count("SELECT") != 1:
             return
-        if any(w in _GLOBAL_WORDS for w in words):
-            # one escape hatch: single-table GROUP BY aggregates get
-            # scoped re-aggregation instead of full refresh
+        hit = {w for w in words if w in _GLOBAL_WORDS}
+        if hit:
+            # escape hatches, so full refresh stays the exception:
+            # ORDER BY + LIMIT over an index-served ordering gets
+            # bounded re-evaluation, COUNT(*)-only gets incremental
+            # membership counting, single-table GROUP BY aggregates get
+            # scoped re-aggregation
+            if hit == {"LIMIT"}:
+                self._detect_incremental_bounded(
+                    handle, nsql, tables, raw_tables
+                )
+                if handle.bounded:
+                    return
+            if hit == {"COUNT"}:
+                self._detect_incremental_count(
+                    handle, nsql, tables, raw_tables
+                )
+                if handle.count_only:
+                    return
             self._detect_incremental_agg(handle, nsql, tables,
                                          raw_tables, words)
             return
@@ -1140,9 +1450,7 @@ class SubsManager:
         def in_plan(plan_text, op, name):
             # word-boundary matching: table "item" must not match the
             # plan line of its sibling "items" in the same join plan
-            return re.search(
-                rf"{op} {re.escape(name)}\b", plan_text
-            ) is not None
+            return plan_mentions(plan_text, op, name)
 
         for t, a, nullable in items:
             idx = pk_idx[a]
@@ -1274,9 +1582,8 @@ class SubsManager:
         except sqlite3.Error:
             return
         plan_text = " ".join(str(c) for row in plan for c in row)
-        if not re.search(
-            rf"SEARCH {re.escape(alias)}\b", plan_text
-        ) or re.search(rf"SCAN {re.escape(alias)}\b", plan_text):
+        if not plan_mentions(plan_text, "SEARCH", alias) or \
+                plan_mentions(plan_text, "SCAN", alias):
             return
         handle.agg = True
         handle.exec_sql = exec_sql
@@ -1286,6 +1593,299 @@ class SubsManager:
         handle.agg_scope_parts = (prefix, suffix, conj)
         handle.pk_items = [items[0]]
         handle.pk_idx = {}
+
+    def _detect_incremental_bounded(self, handle: SubscriptionHandle,
+                                    nsql: str, tables: Set[str],
+                                    raw_tables: Set[str]) -> None:
+        """Qualify ORDER BY + LIMIT over an index-served ordering for
+        bounded re-evaluation: membership depends on other rows (a new
+        row can evict one from the top-N), so a change wave re-runs the
+        WHOLE query — but only when EXPLAIN proves the ordering comes
+        straight off an index (no ``USE TEMP B-TREE FOR ORDER BY``),
+        which caps the cost at O(limit) regardless of table size.
+        Counted as delta rounds (``corro_subs_bounded_refresh_total``),
+        not full refreshes."""
+        masked = _mask_strings(nsql).upper()
+        if not re.search(r"\bLIMIT\s+\d+\s*$", masked):
+            return  # OFFSET / expression limits keep full refresh
+        if _top_level_word(nsql, "ORDER") < 0:
+            return  # LIMIT without ORDER BY is nondeterministic
+        items, _spans = from_items_ex(nsql)
+        if not items or len(items) != 1 or items[0][2]:
+            return
+        table, alias, _n = items[0]
+        if alias.startswith("__corro_"):
+            return
+        if {table} != raw_tables or table not in tables:
+            return
+        info = self.agent.storage._tables.get(table)
+        if info is None:
+            return
+        try:
+            exec_sql, n_hidden = splice_pk_cols(
+                nsql, items, {table: list(info.pk_cols)}
+            )
+            cols, _ = self.agent.storage.read_query(
+                f"SELECT * FROM ({exec_sql}) LIMIT 0"
+            )
+            _, plan = self.agent.storage.read_query(
+                f"EXPLAIN QUERY PLAN {exec_sql}"
+            )
+        except (sqlite3.Error, ValueError):
+            return
+        plan_text = " ".join(
+            str(c) for row in plan for c in row
+        ).upper()
+        if "TEMP B-TREE" in plan_text:
+            # un-indexed sort: the re-eval would pay O(n log n) per
+            # change wave — worse than the refresh path it replaces
+            return
+        handle.bounded = True
+        handle.exec_sql = exec_sql
+        handle.n_hidden = n_hidden
+        handle.pk_items = items
+        handle.pk_idx = {
+            alias: list(range(len(cols) - n_hidden, len(cols)))
+        }
+
+    def _detect_incremental_count(self, handle: SubscriptionHandle,
+                                  nsql: str, tables: Set[str],
+                                  raw_tables: Set[str]) -> None:
+        """Qualify ``SELECT COUNT(*) FROM t [WHERE …]`` for incremental
+        membership counting (``_delta_count``): the single count row
+        moves by the changed pks' net membership transitions, probed
+        with the query's own WHERE scoped onto the pk index — never a
+        re-aggregation.  Requirements: exactly that projection, one
+        replicated from-item, and the scoped membership probe provably
+        SEARCHes (never SCANs) the table."""
+        if not re.match(r"SELECT\s+COUNT\(\s*\*\s*\)\s+FROM\b", nsql,
+                        flags=re.IGNORECASE):
+            return
+        for stop in ("ORDER", "GROUP", "HAVING", "WINDOW"):
+            if _top_level_word(nsql, stop) >= 0:
+                return
+        items, _spans = from_items_ex(nsql)
+        if not items or len(items) != 1 or items[0][2]:
+            return
+        table, alias, _n = items[0]
+        if alias.startswith("__corro_"):
+            return
+        if {table} != raw_tables or table not in tables:
+            return
+        info = self.agent.storage._tables.get(table)
+        if info is None:
+            return
+        pk_cols_sql = ", ".join(
+            f'"{alias}"."{c}"' for c in info.pk_cols
+        )
+        wi = _top_level_word(nsql, "WHERE")
+        probe = (
+            f"SELECT {pk_cols_sql} FROM {from_clause_text(nsql)}"
+        )
+        has_where = wi >= 0
+        if has_where:
+            # parenthesized so a top-level OR cannot out-bind the
+            # scoping conjunction appended by _delta_count
+            probe += f" WHERE ({nsql[wi + 5:].strip()})"
+        row_ph = "(" + ", ".join("?" for _ in info.pk_cols) + ")"
+        sep = " AND " if has_where else " WHERE "
+        try:
+            self.agent.storage.read_query(f"{probe} LIMIT 0")
+            _, plan = self.agent.storage.read_query(
+                f"EXPLAIN QUERY PLAN {probe}{sep}"
+                f"(({pk_cols_sql}) IN (VALUES {row_ph}))",
+                [None] * len(info.pk_cols),
+            )
+        except sqlite3.Error:
+            return
+        plan_text = " ".join(str(c) for row in plan for c in row)
+        if not plan_mentions(plan_text, "SEARCH", alias) or \
+                plan_mentions(plan_text, "SCAN", alias):
+            return
+        handle.count_only = True
+        handle.count_full_probe = probe
+        handle.count_has_where = has_where
+        handle.count_pk_cols_sql = pk_cols_sql
+        handle.pk_items = [items[0]]
+        handle.pk_idx = {}
+
+    def _detect_columnar(self, handle: SubscriptionHandle,
+                         nsql: str) -> None:
+        """Qualify an incremental single-table subscription for the
+        shard matcher's columnar fast path: the verdict must be fully
+        decidable from (pk, liveness, current row), i.e. a bare-column
+        projection and either no WHERE or a pk IN-list predicate (the
+        per-user subscription-list shape, single- or multi-column pk).
+        Anything else keeps the per-sub oracle path."""
+        if (
+            not handle.incremental or handle.agg or handle.bounded
+            or handle.count_only or handle.full_refresh_aliases
+            or handle.pk_items is None or len(handle.pk_items) != 1
+            or handle.pk_items[0][2]
+        ):
+            return
+        table, alias, _n = handle.pk_items[0]
+        info = self.agent.storage._tables.get(table)
+        if info is None:
+            return
+        m = re.match(r"SELECT\s+", nsql, flags=re.IGNORECASE)
+        fi = _top_level_word(nsql, "FROM")
+        if not m or fi < 0:
+            return
+        for stop in ("ORDER", "GROUP", "LIMIT", "HAVING", "WINDOW"):
+            if _top_level_word(nsql, stop) >= 0:
+                return
+        proj = self._parse_bare_projection(
+            nsql[m.end():fi].strip(), alias, info.all_cols
+        )
+        if proj is None:
+            return
+        pk_filter = None
+        wi = _top_level_word(nsql, "WHERE")
+        if wi >= 0:
+            pk_filter = self._parse_pk_in_list(
+                nsql[wi + 5:].strip(), alias, table, list(info.pk_cols)
+            )
+            if pk_filter is None:
+                return
+        handle.columnar_spec = submatch.SubSpec(
+            handle.id, table, tuple(proj), pk_filter
+        )
+
+    @staticmethod
+    def _parse_bare_projection(sel: str, alias: str,
+                               all_cols) -> Optional[List[int]]:
+        """Map a select list of bare (optionally alias-qualified,
+        optionally AS-renamed) column references onto declared-order
+        column indices; None when any item is an expression."""
+        col_pos = {c.lower(): i for i, c in enumerate(all_cols)}
+        if sel == "*":
+            return list(range(len(all_cols)))
+        proj: List[int] = []
+        # depth-0 comma split (an expression projection with a comma
+        # inside parens never splits here — it just fails the regex)
+        pieces, prev = [], 0
+        for i, ch, depth in _scan_top_level(sel):
+            if ch == "," and depth == 0:
+                pieces.append(sel[prev:i])
+                prev = i + 1
+        pieces.append(sel[prev:])
+        for piece in pieces:
+            m = re.fullmatch(
+                r'(?:(\w+)\.)?"?(\w+)"?(?:\s+AS\s+"?\w+"?)?',
+                piece.strip(), flags=re.IGNORECASE,
+            )
+            if not m:
+                return None
+            qual, col = m.group(1), m.group(2)
+            if qual is not None and qual != alias:
+                return None
+            pos = col_pos.get(col.lower())
+            if pos is None:
+                return None
+            proj.append(pos)
+        return proj
+
+    def _parse_pk_in_list(self, where: str, alias: str, table: str,
+                          pk_cols: List[str]):
+        """Parse ``WHERE <pk> IN (…)`` / ``WHERE (<pk…>) IN (VALUES …)``
+        into a packed-pk membership set, or None when the predicate is
+        anything else.  Literal typing is affinity-checked against the
+        declared pk column types: a quoted literal against an INTEGER
+        pk (or vice versa) would rely on sqlite's affinity coercion,
+        which Python-side packed-bytes equality cannot reproduce — such
+        predicates stay on the oracle path."""
+        try:
+            _, tinfo = self.agent.storage.read_query(
+                f'PRAGMA table_info("{table}")'
+            )
+        except sqlite3.Error:
+            return None
+        decl = {str(r[1]).lower(): str(r[2] or "").upper() for r in tinfo}
+
+        def affinity(col: str) -> str:
+            d = decl.get(col.lower(), "")
+            if "INT" in d:
+                return "int"
+            if "CHAR" in d or "CLOB" in d or "TEXT" in d:
+                return "text"
+            return "other"
+
+        def parse_lit(text: str, col: str):
+            text = text.strip()
+            aff = affinity(col)
+            if re.fullmatch(r"-?\d+", text):
+                return int(text) if aff == "int" else None
+            m = re.fullmatch(r"'([^']*)'", text)
+            if m is not None and aff == "text":
+                return m.group(1)
+            return None
+
+        def col_ref(text: str) -> Optional[str]:
+            m = re.fullmatch(
+                r'(?:(\w+)\.)?"?(\w+)"?', text.strip()
+            )
+            if not m or (m.group(1) is not None and m.group(1) != alias):
+                return None
+            return m.group(2)
+
+        pk_lower = [c.lower() for c in pk_cols]
+        m = re.fullmatch(
+            r"(.+?)\s+IN\s*\((.+)\)", where, flags=re.IGNORECASE | re.S
+        )
+        if not m:
+            return None
+        lhs, rhs = m.group(1).strip(), m.group(2).strip()
+        if len(pk_cols) == 1 and not lhs.startswith("("):
+            col = col_ref(lhs)
+            if col is None or col.lower() != pk_lower[0]:
+                return None
+            vals = []
+            for part in rhs.split(","):
+                v = parse_lit(part, pk_cols[0])
+                if v is None:
+                    return None
+                vals.append((v,))
+            order = [0]
+        else:
+            mc = re.fullmatch(r"\((.+)\)", lhs, flags=re.S)
+            if not mc:
+                return None
+            listed = []
+            for part in mc.group(1).split(","):
+                col = col_ref(part)
+                if col is None:
+                    return None
+                listed.append(col.lower())
+            # the listed columns must be exactly the pk, any order —
+            # tuples are re-ordered into pk declaration order so the
+            # packed bytes match the change stream's packed pks
+            if sorted(listed) != sorted(pk_lower):
+                return None
+            order = [listed.index(c) for c in pk_lower]
+            mv = re.match(r"VALUES\s*(.+)$", rhs,
+                          flags=re.IGNORECASE | re.S)
+            if not mv:
+                return None
+            tuples = re.findall(r"\(([^()]*)\)", mv.group(1))
+            if not tuples:
+                return None
+            vals = []
+            for tup in tuples:
+                parts = tup.split(",")
+                if len(parts) != len(pk_cols):
+                    return None
+                row = []
+                for pos, col in zip(order, pk_cols):
+                    v = parse_lit(parts[pos], col)
+                    if v is None:
+                        return None
+                    row.append(v)
+                vals.append(tuple(row))
+        try:
+            return frozenset(pack_values(list(v)) for v in vals)
+        except Exception:
+            return None
 
     def get(self, sub_id: str) -> Optional[SubscriptionHandle]:
         with self._lock:
@@ -1314,34 +1914,37 @@ class SubsManager:
         incremental-subs observability feed), emitted next to
         ``corro_subs_refresh_failures_total``:
 
-        * ``corro_subs_pending_depth`` — queued candidate work
-          (full-refresh candidates + pk candidates), the pre-existing
-          gauge, now computed here;
-        * ``corro_subs_matcher_queue_depth`` — the matcher worker's
-          whole backlog: queued candidates plus the round currently
-          draining (a long-running refresh is load even after its
-          candidates left the queue);
+        * ``corro_subs_pending_depth`` — queued candidate work summed
+          across all matcher shards (full-refresh candidates + pk
+          candidates + buffered wave changes), the pre-existing gauge;
+        * ``corro_subs_matcher_queue_depth{shard=…}`` — one shard
+          worker's whole backlog: queued candidates plus the round
+          currently draining (a long-running refresh is load even
+          after its candidates left the queue); a single hot shard is
+          a routing skew, all shards hot is plane overload;
         * ``corro_subs_staleness_seconds{id=…}`` — seconds since each
           subscription's last SUCCESSFUL refresh/delta round; a rising
           series is a sub silently serving stale rows (its failures
           count in the refresh-failures counter)."""
         now = time.time()
         with self._lock:
-            pending = len(self._pending) + sum(
-                len(p)
-                for per in self._pending_pks.values()
-                for p in per.values()
-            )
-            draining = 1 if self._draining else 0
+            depths = [
+                (s.idx, s.depth(), 1 if s.draining else 0)
+                for s in self._shards
+            ]
             stale = [
                 (h.id, max(0.0, now - h.last_ok_at))
                 for h in self._subs.values()
             ]
         out = [
-            ("corro_subs_pending_depth", float(pending), {}),
-            ("corro_subs_matcher_queue_depth",
-             float(pending + draining), {}),
+            ("corro_subs_pending_depth",
+             float(sum(d for _i, d, _dr in depths)), {}),
         ]
+        out.extend(
+            ("corro_subs_matcher_queue_depth", float(d + dr),
+             {"shard": str(i)})
+            for i, d, dr in depths
+        )
         out.extend(
             ("corro_subs_staleness_seconds", round(age, 3), {"id": sid})
             for sid, age in stale
@@ -1352,67 +1955,147 @@ class SubsManager:
 
     def on_change(self, cv: ChangeV1) -> None:
         """Called by the agent for every local commit + applied remote
-        changeset (``match_changes`` parity)."""
+        changeset (``match_changes`` parity) — from the group-commit
+        broadcast collector (corro-wbcast) for local writes and the
+        apply path for remote ones.  This method only ROUTES: per-table
+        change waves to the shards indexing columnar subs on the table,
+        per-sub pk candidates to the owning shard's queues.  No SQL, no
+        matching — those run on the shard threads."""
         cs = cv.changeset
         touched: Dict[str, List] = {}
         for ch in cs.changes:
             touched.setdefault(ch.table, []).append(ch)
+        woken: Set[int] = set()
         with self._lock:
             for h in self._subs.values():
+                if h.columnar_spec is not None:
+                    continue  # covered by the shard's wave buffer
+                shard = self._shards[h.shard]
                 if h.incremental:
                     hit = {t for t, _a, _n in h.pk_items if t in touched}
                     if hit:
-                        per = self._pending_pks.setdefault(h.id, {})
+                        per = shard.pending_pks.setdefault(h.id, {})
                         for t in hit:
                             per.setdefault(t, set()).update(
                                 ch.pk for ch in touched[t]
                             )
+                        woken.add(h.shard)
                 elif any(t in h.tables for t in touched):
-                    self._pending.add(h.id)
+                    shard.pending.add(h.id)
+                    woken.add(h.shard)
+            for table, chs in touched.items():
+                for shard in self._shards:
+                    if shard.index.has(table):
+                        shard.waves.setdefault(table, []).extend(chs)
+                        woken.add(shard.idx)
+            for i in woken:
+                if self._shards[i].depth() > self._shard_max:
+                    self._shards[i].overflow()
         for table, chs in touched.items():
             self._notify_updates(table, chs)
-        if touched:
-            self._wake.set()
+        for i in woken:
+            self._shards[i].wake.set()
 
     SUB_GC_S = 120.0  # drop subs with no receivers this long (pubsub.rs GC)
     GC_SWEEP_S = 5.0
 
-    def _run(self) -> None:
-        try:
-            self._run_inner()
-        except BaseException:
-            # a dead worker must fail idle() loudly, not hang it
-            # (_draining stuck) or lie (popped batch never processed)
-            self._worker_died = True
-            raise
-
-    def _run_inner(self) -> None:
-        last_gc = time.monotonic()
-        while not self._closed:
-            woke = self._wake.wait(timeout=self.GC_SWEEP_S)
-            if self._closed:
-                return
-            # sweep on a deadline, NOT only when idle: a node with
-            # steady write traffic never times the wait out
-            if time.monotonic() - last_gc >= self.GC_SWEEP_S:
-                self._gc_idle_subs()
-                last_gc = time.monotonic()
-            if not woke:
+    def _fetch_rows(self, table: str, info,
+                    pks: List[bytes]) -> Dict[bytes, tuple]:
+        """Fetch current rows for a wave's live pks, ONCE per
+        (table, wave) — the single database touch the columnar match
+        pipeline makes.  Chunked to stay under sqlite's host-parameter
+        limit; keyed back by packed pk so verdicts line up with the
+        change stream's pk encoding."""
+        pk_cols = list(info.pk_cols)
+        npk = len(pk_cols)
+        sel_cols = ", ".join(
+            [f'"{c}"' for c in pk_cols]
+            + [f'"{c}"' for c in info.all_cols]
+        )
+        key_sql = ", ".join(f'"{c}"' for c in pk_cols)
+        chunk = max(1, FETCH_PARAM_BUDGET // npk)
+        out: Dict[bytes, tuple] = {}
+        for i in range(0, len(pks), chunk):
+            batch = pks[i:i + chunk]
+            values, params = [], []
+            for pk in batch:
+                cells = list(unpack_values(pk))
+                if len(cells) != npk:
+                    continue  # foreign-shaped pk cannot match a row
+                values.append(
+                    "(" + ", ".join("?" for _ in cells) + ")"
+                )
+                params.extend(cells)
+            if not values:
                 continue
-            time.sleep(DEBOUNCE_S)  # batch candidates
-            self._wake.clear()
-            with self._lock:
-                pending, self._pending = self._pending, set()
-                pending_pks, self._pending_pks = self._pending_pks, {}
-                # popped-but-unprocessed work keeps idle() false: the
-                # sets alone go empty the instant a round is claimed,
-                # long before its refresh/delta SQL has finished
-                self._draining = bool(pending or pending_pks)
+            _, rows = self.agent.storage.read_query(
+                f'SELECT {sel_cols} FROM "{table}"'
+                f" WHERE ({key_sql}) IN (VALUES {', '.join(values)})",
+                params,
+            )
+            for r in rows:
+                out[pack_values(list(r[:npk]))] = tuple(r[npk:])
+        return out
+
+    def _drain_waves(self, shard: "_MatcherShard",
+                     waves: Dict[str, List],
+                     pending: Set[str]) -> None:
+        """Columnar half of one shard round: resolve each table's
+        buffered wave once through the merge kernel, fan verdicts to
+        the shard's indexed predicates, and apply them per handle.  A
+        handle the fast path cannot serve right now (no projection yet,
+        fetch/apply error) degrades into ``pending`` — the oracle
+        refresh in the same round covers it."""
+        for table, changes in waves.items():
+            subs = shard.index.subs_on(table)
+            if not subs:
+                continue
+            self.agent.metrics.counter("corro_subs_columnar_rounds_total")
+            info = self.agent.storage._tables.get(table)
             try:
-                self._drain_round(pending, pending_pks)
-            finally:
-                with self._lock:
-                    self._draining = False
+                # the kernel coalesces the wave to one verdict slot per
+                # pk; the fetch (DB truth) decides each slot's final
+                # upsert/delete — see submatch.match_wave on why the
+                # wave-local liveness bits are advisory only
+                pks, _alive = submatch.resolve_wave(
+                    changes, backend="numpy"
+                )
+                verdicts, n_pairs = submatch.match_wave(
+                    shard.index, table, pks,
+                    lambda need: self._fetch_rows(table, info, need),
+                )
+            except sqlite3.Error:
+                self.agent.metrics.counter(
+                    "corro_subs_delta_fallbacks_total"
+                )
+                pending |= subs
+                continue
+            if n_pairs:
+                self.agent.metrics.counter(
+                    "corro_subs_columnar_verdicts_total", n_pairs
+                )
+            now = time.time()
+            for sub_id in subs:
+                h = self._subs.get(sub_id)
+                if h is None:
+                    continue
+                v = verdicts.get(sub_id)
+                if not v:
+                    # wave missed this sub's pk filter entirely — it is
+                    # as fresh as a delta round that found no work
+                    h.last_ok_at = now
+                    continue
+                if not h.columns:
+                    # projection unknown until the initial refresh ran
+                    pending.add(sub_id)
+                    continue
+                try:
+                    h.apply_columnar(v)
+                except sqlite3.Error:
+                    self.agent.metrics.counter(
+                        "corro_subs_delta_fallbacks_total"
+                    )
+                    pending.add(sub_id)
 
     def _drain_round(
         self, pending: Set[str],
@@ -1465,8 +2148,9 @@ class SubsManager:
         if self._worker_died:
             raise RuntimeError("subscription worker thread died")
         with self._lock:
-            return not (
-                self._pending or self._pending_pks or self._draining
+            return not any(
+                s.pending or s.pending_pks or s.waves or s.draining
+                for s in self._shards
             )
 
     def _gc_idle_subs(self) -> None:
@@ -1483,6 +2167,7 @@ class SubsManager:
             for h in dead:
                 self._subs.pop(h.id, None)
                 self._by_sql.pop(h.sql, None)
+                self._shards[h.shard].index.remove(h.id)
         for h in dead:
             h.close()
             try:
@@ -1529,7 +2214,20 @@ class SubsManager:
                 try:
                     q.put_nowait({"change": [kind, cells]})
                 except queue.Full:
-                    pass
+                    # backpressure contract (docs/pubsub.md): a slow
+                    # consumer loses its OLDEST buffered event, never
+                    # stalls the intake path, and the loss is counted
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        pass
+                    try:
+                        q.put_nowait({"change": [kind, cells]})
+                    except queue.Full:
+                        pass
+                    self.agent.metrics.counter(
+                        "corro_subs_updates_dropped_total", table=table
+                    )
 
 
 

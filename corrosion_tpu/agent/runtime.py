@@ -203,6 +203,9 @@ class AgentConfig:
     api_authz: Optional[str] = None
     subs_enabled: bool = True
     subs_path: Optional[str] = None
+    subs_shards: int = 4                # matcher worker shards (by sub_id)
+    subs_columnar: bool = True          # columnar wave matching fast path
+    subs_shard_max_pending: int = 50_000  # per-shard depth before overflow
     admin_path: Optional[str] = None
     # append finished spans as OTLP-flavored JSON lines ([telemetry.traces]);
     # bounded: one rotation at max_bytes, drops counted after that

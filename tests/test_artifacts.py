@@ -213,6 +213,66 @@ def test_perf_bench_artifact_schemas(name, value_floor):
         assert headline["kernel"] == "columnar"
 
 
+def test_subs_bench_artifact_schema():
+    """The subscription fan-out artifact (bench.py --subs): the
+    committed 100k-sub/10k-change headline must clear its >= 3x floor
+    WITH in-bench columnar/oracle verdict parity, the swarm's stall /
+    staleness / converged-parity gates must all be green with the
+    flight-recorder timeline attached, and the committed subs-off/on
+    paired A/B must hold the >= 0.95 write-path ratio."""
+    doc = _load("SUBS_BENCH.json")
+    _check(doc, {
+        "metric": lambda v: v == "subs_matcher_columnar_speedup",
+        "value": NUM,
+        "unit": lambda v: v == "x",
+        "conditions": str,
+        "headline": {
+            "n_subs": lambda v: v >= 100_000,
+            "n_changes": lambda v: v >= 10_000,
+        },
+        "points": lambda v: isinstance(v, list) and len(v) > 0,
+        "parity": {
+            "ok": lambda v: v is True,
+            "compared_pairs": lambda v: v > 0,
+            "mismatches": lambda v: v == 0,
+        },
+        "swarm": {
+            "n_subs": lambda v: v > 0,
+            "parity_ok": lambda v: v is True,
+            "stall_gate": {
+                "max_stall_ms": NUM,
+                "budget_ms": NUM,
+                "pass": lambda v: v is True,
+            },
+            "staleness_gate": {
+                "p99_s": NUM,
+                "slo_s": NUM,
+                "samples": lambda v: v > 0,
+                "pass": lambda v: v is True,
+            },
+            "counters": dict,
+            "timeline": {
+                "snapshots": lambda v: isinstance(v, int) and v > 0,
+                "event_counts": dict,
+                "events": list,
+            },
+        },
+        "overhead_gate": {
+            "ratio": NUM,
+            "pairs": list,
+            "pass": lambda v: v is True,
+        },
+    })
+    assert "error" not in doc
+    assert doc["value"] >= 3.0, (
+        f"committed subs headline {doc['value']} under its 3x gate"
+    )
+    assert doc["overhead_gate"]["ratio"] >= 0.95
+    # the committed swarm actually exercised the columnar fast path
+    assert doc["swarm"]["counters"][
+        "corro_subs_columnar_verdicts_total"] > 0
+
+
 def test_frontier_bench_artifact_schema():
     """The frontier-sparse BENCH headline (bench.py --frontier): the
     exact sampler's p99 convergence + msgs/node swept through N=1M,

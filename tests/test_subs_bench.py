@@ -1,0 +1,59 @@
+"""Subscription fan-out benchmark harness checks.
+
+Tier-1 runs the full ``bench.py --subs`` machinery at 500 subs over a
+500-change burst (a smoke: in-bench columnar/oracle verdict parity
+must hold, the swarm's stall/staleness/converged-parity gates must
+pass, the columnar path must actually fire); the 100k-sub/10k-change
+headline gates (>= 3x verdict-pair throughput, the subs-off/on
+write-path A/B >= 0.95) run in the @slow tier.
+"""
+
+import pytest
+
+from bench import run_subs_bench
+
+
+def test_subs_bench_smoke_500():
+    out = run_subs_bench(n_subs=500, n_changes=500, swarm_subs=48,
+                         swarm_writes=200, ab=False, out_path=None)
+    assert "error" not in out, out.get("error")
+    # a verdict mismatch voids the headline — the smoke pins that the
+    # comparison ran and held
+    assert out["value"] is not None and out["value"] > 0
+    assert out["parity"]["ok"] is True
+    assert out["parity"]["compared_pairs"] > 0
+    (p,) = out["points"]
+    # both arms delivered the same verdict pairs over the same burst
+    assert p["columnar"]["verdict_pairs"] > 0
+    assert p["oracle"]["verdict_pairs"] > 0
+    # the swarm's three gates all held at smoke scale
+    sw = out["swarm"]
+    assert sw["stall_gate"]["pass"] is True
+    assert sw["staleness_gate"]["pass"] is True
+    assert sw["parity_ok"] is True, sw["mismatched_subs"]
+    # the live plane actually exercised the columnar fast path and the
+    # widened detectors (a silently-degraded plane would pass parity
+    # vacuously)
+    assert sw["counters"]["corro_subs_columnar_verdicts_total"] > 0
+    assert sw["counters"]["corro_subs_bounded_refresh_total"] > 0
+    # flight-recorder timeline attached
+    assert sw["timeline"]["snapshots"] > 0
+    # the A/B is deliberately skipped at smoke scale
+    assert out["overhead_gate"]["pass"] is None
+
+
+@pytest.mark.slow
+def test_subs_bench_headline_100k():
+    out = run_subs_bench(out_path=None)
+    assert "error" not in out, out.get("error")
+    # acceptance gates: >= 3x sharded-columnar verdict throughput at
+    # the 100k-sub/10k-change headline with in-bench parity, swarm
+    # staleness SLO + <= 50 ms loop stall, subs plane write-path cost
+    # within 5% in the paired off/on A/B
+    assert out["value"] >= 3.0, out
+    assert out["parity"]["ok"] is True
+    assert out["swarm"]["stall_gate"]["pass"] is True
+    assert out["swarm"]["staleness_gate"]["pass"] is True
+    assert out["swarm"]["parity_ok"] is True
+    assert out["overhead_gate"]["pass"] is True
+    assert out["overhead_gate"]["ratio"] >= 0.95
