@@ -72,16 +72,19 @@ def _committed_json(path: str):
 # -- apply-path microbenchmark (bench.py --apply) ----------------------
 
 
-def _apply_bench_changes(n: int, site: bytes, col_version: int):
+def _apply_bench_changes(n: int, site: bytes, col_version: int,
+                         row_offset: int = 0):
     """``n`` cell changes over ``n // 4`` rows x 4 cells — the shape of
-    a sync-driven backfill (many rows, few cells each)."""
+    a sync-driven backfill (many rows, few cells each).  ``row_offset``
+    shifts the pk range so the device-arm flood scenario can make every
+    wave touch FRESH rows."""
     from corrosion_tpu.agent.pack import pack_values
     from corrosion_tpu.types.base import CrsqlDbVersion, CrsqlSeq
     from corrosion_tpu.types.change import Change
 
     changes = []
     seq = 0
-    for r in range(max(1, n // 4)):
+    for r in range(row_offset, row_offset + max(1, n // 4)):
         pk = pack_values([r])
         for cid in ("a", "b", "c", "d"):
             changes.append(Change(
@@ -532,6 +535,180 @@ def _apply_stall_gate(n_changes: int, budget_ms: float = 50.0) -> dict:
     }
 
 
+def _apply_device_arm(n_changes: int, waves: int = 12,
+                      committed_floor=None) -> dict:
+    """Device-resident apply arm (docs/crdts.md "Device-resident
+    apply") with an explicit cache-hit/invalidation model, two
+    scenarios:
+
+    - ``steady`` — steady-state broadcast over HOT keys: prefill the
+      rows once, then ``waves`` superseding passes over the SAME rows,
+      one batched apply per wave.  Consecutive waves hit the persistent
+      clock cache, so the device arm skips the per-wave SQLite
+      prefetch and coalesces the waves' flushes behind one barrier.
+      This is the arm the floor gates: its speedup over the per-change
+      oracle must beat the committed columnar cold headline.
+    - ``flood`` — sync-backfill flood over COLD/CONFLICTING keys:
+      every wave touches fresh rows and a mid-stream local write
+      invalidates the whole cache.  The model where the cache cannot
+      help; recorded (with its near-zero hit rate) so a hit-rate
+      regression in the steady arm can't hide behind averaging.
+
+    Device walls INCLUDE the final ``flush_barrier()`` — the win must
+    survive paying for durability, not defer it.  All three arms
+    (per-change oracle, plain batched, device) apply byte-identical
+    streams and must leave byte-identical CRDT state (in-bench
+    state-digest parity); divergence voids the point."""
+    import tempfile
+
+    from corrosion_tpu.agent.metrics import Metrics
+    from corrosion_tpu.agent.storage import CrConn
+
+    site = b"\x42" * 16
+
+    def _mk_db(d, tag, device):
+        db = CrConn(os.path.join(d, f"dev-{tag}.db"))
+        db.conn.execute(
+            "CREATE TABLE IF NOT EXISTS bench ("
+            " id INTEGER PRIMARY KEY NOT NULL, a, b, c, d)"
+        )
+        db.as_crr("bench")
+        db.metrics = Metrics()
+        if device:
+            db.enable_device_cache()
+        return db
+
+    def _scenario_waves(scenario):
+        if scenario == "steady":
+            # superseding col_versions over one fixed row set
+            return [
+                _apply_bench_changes(n_changes, site, col_version=2 + w)
+                for w in range(waves)
+            ]
+        # flood: fresh rows every wave
+        return [
+            _apply_bench_changes(
+                n_changes, site, col_version=1,
+                row_offset=w * max(1, n_changes // 4),
+            )
+            for w in range(waves)
+        ]
+
+    def _run_arm(d, scenario, mode, wave_changes):
+        db = _mk_db(d, f"{scenario}-{mode}", device=(mode == "device"))
+        try:
+            if scenario == "steady":
+                db.apply_changes_batched(
+                    _apply_bench_changes(n_changes, site, col_version=1)
+                )
+                # prefill flush excluded from the timed window: the
+                # measurement starts with a warm cache and no backlog
+                db.flush_barrier()
+            t0 = time.perf_counter()
+            for w, wc in enumerate(wave_changes):
+                if scenario == "flood" and w == waves // 2:
+                    # mid-stream local write: the invalidation event
+                    # every arm replays identically (digest parity)
+                    db.execute(
+                        "INSERT OR REPLACE INTO bench (id, a) "
+                        "VALUES (?, ?)", (-1, "local"),
+                    )
+                if mode == "per_change":
+                    with db.apply_tx():
+                        db.apply_changes_sequential_in_tx(list(wc))
+                else:
+                    db.apply_changes_batched(list(wc))
+            db.flush_barrier()
+            wall = time.perf_counter() - t0
+            total = sum(len(wc) for wc in wave_changes)
+            out = {
+                "wall_s": round(wall, 4),
+                "changes_per_s": round(total / max(wall, 1e-9), 1),
+            }
+            cache = None
+            if mode == "device":
+                m = db.metrics
+                hits = m.get_counter_sum("corro_apply_cache_hits_total")
+                misses = m.get_counter_sum(
+                    "corro_apply_cache_misses_total")
+                cache = {
+                    "corro_apply_cache_hits_total": hits,
+                    "corro_apply_cache_misses_total": misses,
+                    "corro_apply_cache_evictions_total":
+                        m.get_counter_sum(
+                            "corro_apply_cache_evictions_total"),
+                    "corro_apply_cache_invalidations_total":
+                        m.get_counter_sum(
+                            "corro_apply_cache_invalidations_total"),
+                    "hit_rate": round(
+                        hits / max(hits + misses, 1e-9), 4),
+                }
+            return out, _apply_state_digest(db), cache
+        finally:
+            db.close()
+
+    scenarios = {}
+    with tempfile.TemporaryDirectory(prefix="corro-apply-dev-") as d:
+        # one unrecorded device warmup: cache/table allocation and the
+        # ops import must not land inside the first timed scenario
+        _run_arm(d, "warm", "device",
+                 [_apply_bench_changes(512, site, col_version=2)])
+        for scenario in ("steady", "flood"):
+            wave_changes = _scenario_waves(scenario)
+            row = {
+                "waves": waves,
+                "n_changes_per_wave": n_changes,
+                "total_changes": sum(len(w) for w in wave_changes),
+            }
+            digests = {}
+            for mode in ("per_change", "batched", "device"):
+                out, dig, cache = _run_arm(d, scenario, mode,
+                                           wave_changes)
+                row[mode] = out
+                digests[mode] = dig
+                if cache is not None:
+                    row["cache"] = cache
+            row["parity"] = (
+                digests["per_change"] == digests["batched"]
+                == digests["device"]
+            )
+            row["speedup"] = round(
+                row["device"]["changes_per_s"]
+                / max(row["per_change"]["changes_per_s"], 1e-9), 2
+            )
+            row["speedup_batched"] = round(
+                row["batched"]["changes_per_s"]
+                / max(row["per_change"]["changes_per_s"], 1e-9), 2
+            )
+            scenarios[scenario] = row
+
+    steady = scenarios["steady"]
+    parity = all(s["parity"] for s in scenarios.values())
+    floor = committed_floor
+    return {
+        "method": (
+            f"{waves} waves of the headline change count per arm "
+            "(pre-generated outside the timed window), one batched "
+            "apply (or one per-change transaction) per wave; "
+            "steady = superseding col_versions over one hot row set "
+            "(prefilled, warm cache), flood = fresh rows every wave "
+            "plus a mid-stream local write (whole-cache invalidation); "
+            "device walls include the final flush_barrier; state "
+            "digests asserted equal across per-change, batched and "
+            "device arms per scenario"
+        ),
+        "n_changes": n_changes,
+        "scenarios": scenarios,
+        "parity": parity,
+        "floor": floor,
+        "pass": bool(
+            parity
+            and (floor is None or steady["speedup"] > floor)
+            and steady["cache"]["hit_rate"] > 0.5
+        ),
+    }
+
+
 def run_apply_bench(sizes=(1000, 10000), out_path="APPLY_BENCH.json"):
     """Per-change vs batched CRDT apply throughput (changes/s), cold
     (fresh rows) and warm (existing rows, superseding col_versions).
@@ -699,6 +876,21 @@ def run_apply_bench(sizes=(1000, 10000), out_path="APPLY_BENCH.json"):
                 "apply stall gate failed: event-loop max stall over "
                 "the 50 ms budget during batched applies",
             )
+        # device-resident apply arm (docs/crdts.md "Device-resident
+        # apply"): hot-cache steady-state must beat the committed
+        # columnar cold headline, with digest parity across arms
+        out["device_arm"] = _apply_device_arm(
+            headline["n_changes"],
+            committed_floor=committed.get("value") if committed
+            else None,
+        )
+        if out["device_arm"]["pass"] is False:
+            out.setdefault(
+                "error",
+                "device-resident arm failed: steady-state hot-cache "
+                "speedup under the committed columnar headline floor, "
+                "hit rate under 0.5, or state-digest divergence",
+            )
         out["overhead_gate"] = _apply_overhead_ab(
             headline["n_changes"],
             committed=committed_hl,
@@ -732,6 +924,7 @@ def run_apply_bench(sizes=(1000, 10000), out_path="APPLY_BENCH.json"):
         out["sig_overhead_gate"] = dict(out["overhead_gate"])
         out["kernel_ab"] = dict(out["overhead_gate"])
         out["stall_gate"] = dict(out["overhead_gate"])
+        out["device_arm"] = dict(out["overhead_gate"])
     if out_path:
         with open(out_path, "w") as f:
             json.dump(_sanitize(out), f, indent=2)

@@ -181,6 +181,12 @@ def load_config(path: Optional[str] = None, **overrides) -> AgentConfig:
         "subs_shards",
         "subs_columnar",
         "subs_shard_max_pending",
+        # batched-apply merge plane (docs/crdts.md)
+        "columnar_merge",
+        "columnar_merge_min",
+        # device-resident apply (docs/crdts.md "Device-resident apply")
+        "device_cache",
+        "device_cache_slots",
     ):
         if key in perf:
             kwargs[key] = perf[key]

@@ -195,6 +195,14 @@ class AgentConfig:
     # dict replay — the parity oracle — runs instead.
     columnar_merge: bool = True
     columnar_merge_min: int = 256
+    # device-resident apply (docs/crdts.md "Device-resident apply"):
+    # keep hot (pk, cid) clock state in cross-batch device arrays and
+    # flush SQLite through the write-behind journal.  None = auto —
+    # enabled only when JAX is loaded with a non-CPU backend (default
+    # OFF on CPU-only hosts); True forces it on (NumPy store when no
+    # accelerator), False forces the classic prefetch path.
+    device_cache: Optional[bool] = None
+    device_cache_slots: int = 262144
     # broadcast buffering + governor (broadcast/mod.rs:399-458,745-801)
     bcast_buffer_cutoff: int = 64 * 1024
     bcast_flush_interval: float = 0.5
@@ -480,6 +488,23 @@ class Agent:
         self.storage.metrics = self.metrics
         self.storage.columnar_merge = config.columnar_merge
         self.storage.columnar_merge_min = config.columnar_merge_min
+        dev_on = config.device_cache
+        if dev_on is None:
+            from corrosion_tpu.ops.devcache import default_enabled
+
+            dev_on = default_enabled()
+        if dev_on:
+            self.storage.enable_device_cache(
+                slots=config.device_cache_slots
+            )
+        if self.storage.flush_journal_recovered:
+            # boot classified the crash window between a committed
+            # device-merge and its async flush (storage replayed the
+            # journal before we got here)
+            self.metrics.counter(
+                "corro_apply_flush_recoveries_total",
+                float(self.storage.flush_journal_recovered),
+            )
         if self._snap_recovered is not None:
             self.metrics.counter(
                 "corro_snapshot_recoveries_total",
@@ -2131,6 +2156,10 @@ class Agent:
         cleared + ledger rows compacted, counted under
         ``corro_compaction_maintenance_clears_total``."""
         work = 0
+        # device-resident apply: compaction reads + rewrites clock
+        # bookkeeping, so unflushed winners must land first, and the
+        # cache view is invalid once floors advance
+        self.storage.flush_pending()
         try:
             cleared = self._find_and_clear_overwritten()
             work += sum(e - s + 1 for s, e in cleared)
@@ -2140,6 +2169,8 @@ class Agent:
             work += self._advance_snapshot_floors()
         except Exception:
             self.metrics.counter("corro_compaction_sweep_errors_total")
+        if work:
+            self.storage.device_cache_invalidate("compaction")
         if work:
             self.metrics.counter(
                 "corro_compaction_maintenance_clears_total", work
@@ -2762,6 +2793,12 @@ class Agent:
                 # one provenance flush for the whole batch (the
                 # per-item calls above defer with record_prov=False)
                 self._record_provenance_many(out)
+                # device-resident apply: drain the write-behind queue
+                # on this worker (the "ordered executemany on the apply
+                # pool") once enough batches have accumulated; the
+                # maintenance tick sweeps stragglers
+                if self.storage.flush_should_drain():
+                    self.storage.flush_pending()
         finally:
             with self._apply_gauge_lock:
                 self._apply_active -= 1
@@ -5412,6 +5449,9 @@ class Agent:
         tmp = cache + ".tmp"
         if os.path.exists(tmp):
             os.unlink(tmp)
+        # write-behind barrier: the snapshot must carry every winner
+        # whose apply was already announced, not just the flushed ones
+        self.storage.flush_barrier()
         snaplib.build_snapshot(self.config.db_path, tmp)
         os.replace(tmp, cache)
         digest = snaplib.file_digest(cache)

@@ -59,6 +59,10 @@ SNAP_SCRUB = frozenset({
     "__corro_members",
     "__corro_versions_impacted",
     "__corro_equiv_digests",
+    # write-behind flush journal (device-resident apply): donor-local
+    # crash bookkeeping — the donor drains before building a snapshot,
+    # and a receiver must never replay another node's flush intents
+    "__corro_flush_journal",
 })
 
 #: portable cluster state a snapshot MUST carry: the data's version

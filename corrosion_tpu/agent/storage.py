@@ -78,6 +78,110 @@ class TableInfo:
     fused_insert_ok: bool = True
 
 
+class _WriteBehind:
+    """In-memory ledger of merged-but-unflushed table batches (the
+    device-resident apply's async flush queue).  Each entry is
+    ``(table, states, journal_id)``: the net merged ``states`` the
+    flush will consume, and the ``__corro_flush_journal`` row that
+    makes it crash-durable.
+
+    Lifecycle: an apply stages entries on ``tx_staged`` (journal row
+    inserted in the same transaction); commit moves them to
+    ``pending``; a drain moves pending entries being flushed inside an
+    open apply transaction to ``draining`` so a rollback can requeue
+    them at the FRONT (their journal deletes roll back with the tx).
+    ``unflushed`` maps table -> pks with any not-yet-flushed state —
+    the overlap guard that forces a flush before SQLite is read for
+    those rows."""
+
+    __slots__ = ("pending", "draining", "tx_staged", "unflushed")
+
+    def __init__(self):
+        self.pending: List[tuple] = []
+        self.draining: List[tuple] = []
+        self.tx_staged: List[tuple] = []
+        self.unflushed: Dict[str, set] = {}
+
+    def recompute(self) -> None:
+        u: Dict[str, set] = {}
+        for t, states, _jid in self.pending:
+            u.setdefault(t, set()).update(states)
+        for t, states, _jid in self.tx_staged:
+            u.setdefault(t, set()).update(states)
+        self.unflushed = u
+
+    def cells_pending(self) -> int:
+        return sum(
+            len(st[5]) + 1
+            for _t, states, _j in self.pending
+            for st in states.values()
+        )
+
+
+def _wb_coalesce(s1: list, s2: list) -> list:
+    """Merge two staged net states for the same (table, pk), s2 newer.
+    A newer generation (row replaced) supersedes everything earlier;
+    otherwise the newer cells overlay the older ones.  Sound because s2
+    was merged against a seed view that already included s1 (the cache
+    shadow), so s2's decisions account for s1."""
+    if s2[2]:  # GEN
+        c1, c2 = s1[1], s2[1]
+        if c1 is None:
+            return s2
+        out = list(s2)
+        if c2 is None:
+            # no cl write in s2: a sequential flush would have left
+            # s1's cl row in place
+            out[1] = c1
+        elif c1[5] and not c2[5]:
+            # sequential flushes MAX the sentinel flag across the
+            # upsert (s1's row would already be in the DB) — coalescing
+            # must not lose s1's flag before the DB ever sees it
+            out[1] = c2[:5] + (1,)
+        return out
+    out = list(s1)
+    if s2[1] is not None:
+        c1 = s1[1]
+        out[1] = (s2[1][:5] + (1,)
+                  if c1 is not None and c1[5] and not s2[1][5]
+                  else s2[1])
+    if s2[0] is not None:
+        out[0] = s2[0]
+    out[4] = s1[4] or s2[4]  # ENSURE
+    cells = dict(s1[5])
+    cells.update(s2[5])
+    out[5] = cells
+    return out
+
+
+def _wb_encode_states(states: Dict[bytes, list]) -> bytes:
+    """Versioned net states for the flush journal.  Net STATES, not
+    winner Changes: replaying winners through apply_changes is not
+    idempotent for fresh implicit-cl rows (the generation branch of the
+    per-change path wipes sibling cells a batched flush preserved), so
+    the journal stores exactly what ``_flush_table_states`` consumes.
+
+    pickle, not speedy: the encode runs inside the apply transaction's
+    critical section on every device-path batch, and the per-field
+    Python writer dominated the whole apply wall (55% in profile) where
+    pickle's C encoder is noise.  This is safe ONLY because the journal
+    never crosses a trust boundary: payloads are written and read by
+    this node alone — boot recovery decodes bytes this process family
+    wrote, and ``install_snapshot`` PURGES (never replays) journal rows
+    arriving inside a donor's snapshot file."""
+    import pickle
+
+    return b"\x01" + pickle.dumps(states, protocol=4)
+
+
+def _wb_decode_states(payload: bytes) -> Dict[bytes, list]:
+    import pickle
+
+    if payload[:1] != b"\x01":
+        raise ValueError("unknown flush-journal payload version")
+    return pickle.loads(payload[1:])
+
+
 def register_udfs(conn: sqlite3.Connection) -> None:
     """Register every SQL function the CRR layer depends on.  ANY
     connection touching an agent database needs these: the CRR tables
@@ -150,6 +254,19 @@ class CrConn:
         # optional Metrics sink (set by the agent): merge-phase timing
         # lands in corro_apply_merge_seconds{kernel=}
         self.metrics = None
+        # device-resident apply (docs/crdts.md "Device-resident apply"):
+        # when enabled, batched applies seed from the cross-batch clock
+        # cache and SQLite becomes the durable sink behind the
+        # write-behind flush below.  None == classic prefetch path.
+        self.device_cache = None
+        self._wb = _WriteBehind()
+        # metric-delta snapshot for the cache's monotonic counters
+        self._devcache_emitted: Dict = {}
+        # flush-journal rows replayed at boot (crash between an apply
+        # commit and its async flush); the agent re-emits this as
+        # corro_apply_flush_recoveries_total once metrics attach
+        self.flush_journal_recovered = 0
+        self._recover_flush_journal()
 
     def _connect_rw(self) -> sqlite3.Connection:
         """The ONE RW-connection recipe, shared by construction and the
@@ -244,6 +361,10 @@ class CrConn:
         checked-out connection, then with None on completion) lets a
         caller interrupt a long-running read — the PG front-end's
         CancelRequest path."""
+        # write-behind barrier: serve reads (API queries, subscription
+        # evaluation, snapshot assembly) must not observe a merged-but-
+        # unflushed winner; no-op unless the device path staged state
+        self.flush_barrier()
         with self.reader() as conn:
             if on_conn is not None:
                 on_conn(conn)
@@ -288,6 +409,16 @@ class CrConn:
             "CREATE TABLE IF NOT EXISTS __corro_versions_impacted "
             "(site_ordinal INTEGER NOT NULL, db_version INTEGER NOT NULL, "
             " PRIMARY KEY (site_ordinal, db_version))"
+        )
+        # write-behind flush journal (device-resident apply): one row
+        # per merged-but-unflushed table batch, inserted in the apply
+        # transaction and deleted in the transaction that flushes it —
+        # a crash in the window between the two replays at boot
+        # (_recover_flush_journal), so no committed winner is ever lost
+        c.execute(
+            "CREATE TABLE IF NOT EXISTS __corro_flush_journal "
+            "(id INTEGER PRIMARY KEY AUTOINCREMENT, "
+            " tbl TEXT NOT NULL, payload BLOB NOT NULL)"
         )
         row = c.execute(
             "SELECT site_id FROM __corro_sites WHERE ordinal = 1"
@@ -439,6 +570,10 @@ class CrConn:
         self._create_impact_triggers(t)
         c.execute("INSERT OR IGNORE INTO __corro_crr_tables VALUES (?)", (t,))
         self._tables[t] = info
+        if self.device_cache is not None:
+            # (re-)declaring a CRR changes the cid ordinal space the
+            # cache packs its keys with — drop its view of this table
+            self.device_cache.invalidate_table(t)
         self._backfill(info)
 
     def _create_impact_triggers(self, t: str) -> None:
@@ -771,6 +906,11 @@ END;
         from corrosion_tpu.agent.locks import PRIO_HIGH
 
         with self._lock.prio(PRIO_HIGH, "write", kind="write"):
+            # local-write triggers read the clock tables (col_version
+            # continuation), so any staged-but-unflushed winner must
+            # land first; after COMMIT the trigger-written clocks make
+            # the cache view stale — the write-combiner invalidation
+            self._wb_drain_locked()
             self.conn.execute("BEGIN IMMEDIATE")
             pending = self.begin_write_batch()
             try:
@@ -786,6 +926,9 @@ END;
             if wrote:
                 self._set_state("db_version", pending)
             self.conn.execute("COMMIT")
+            if wrote and self.device_cache is not None:
+                self.device_cache.invalidate_all("local_write")
+                self._emit_cache_metrics()
 
     def speculative_read(self, writes: Sequence, sql: str,
                          params: Sequence = ()):
@@ -803,6 +946,9 @@ END;
         from corrosion_tpu.agent.locks import PRIO_HIGH
 
         with self._lock.prio(PRIO_HIGH, "speculative-read", kind="write"):
+            # the sandbox fires the CRR triggers, which read the clock
+            # tables — staged-but-unflushed winners must land first
+            self._wb_drain_locked()
             self.conn.execute("BEGIN")
             try:
                 pending = self._state("db_version") + 1
@@ -838,6 +984,9 @@ END;
         """All cell changes stamped with a db_version in the inclusive
         range, for one origin site (default: local)."""
         with self._lock:
+            # barrier: collection reads the clock tables, which lag the
+            # merge while the write-behind queue is non-empty
+            self._wb_drain_locked()
             ordinal = 1 if site_id is None else self.site_ordinal(site_id)
             origin = self.site_id if site_id is None else site_id
             return self._collect_changes_on(
@@ -864,6 +1013,11 @@ END;
         path's off-loop range collection.  The site must already be
         interned (it is for any site we hold versions of); an unknown
         site collects nothing."""
+        # write-behind barrier (docs/crdts.md ordering contract): any
+        # version announced to a peer was journaled + enqueued inside
+        # its apply commit, so draining here guarantees the serve read
+        # never observes an unflushed winner for a requested version
+        self.flush_barrier()
         if site_id is None:
             ordinal: Optional[int] = 1
             origin = self.site_id
@@ -989,9 +1143,11 @@ END;
                     # see write_tx: the tx may have auto-rolled-back
                     if self.conn.in_transaction:
                         self.conn.execute("ROLLBACK")
+                    self._tx_finish(False)
                 raise
             self._set_state("apply_mode", 0)
             self.conn.execute("COMMIT")
+            self._tx_finish(True)
 
     def apply_changes_in_tx(self, changes: Iterable[Change]) -> int:
         """Merge changes inside an open ``apply_tx``; returns rows impacted.
@@ -1001,13 +1157,35 @@ END;
         randomized parity suite (tests/test_apply_batched.py)."""
         changes = list(changes)
         if len(changes) <= 2:
-            return sum(self._apply_one(ch) for ch in changes)
+            return self._apply_small_in_tx(changes)
         return self._apply_changes_batched(changes)
+
+    def _apply_small_in_tx(self, changes: List[Change]) -> int:
+        """Per-change path with device-cache hygiene: ``_apply_one``
+        reads and writes the clock tables directly, so staged state for
+        the touched rows must flush first and the cache must forget
+        them afterwards (their DB state changed behind its back)."""
+        if self.device_cache is not None and changes:
+            touched: Dict[str, List[bytes]] = {}
+            for ch in changes:
+                touched.setdefault(ch.table, []).append(ch.pk)
+            for t, t_pks in touched.items():
+                self._wb_overlap_flush_in_tx(t, t_pks)
+            n = sum(self._apply_one(ch) for ch in changes)
+            for t, t_pks in touched.items():
+                self.device_cache.invalidate_pks(
+                    t, t_pks, reason="small_apply"
+                )
+            return n
+        return sum(self._apply_one(ch) for ch in changes)
 
     def apply_changes_sequential_in_tx(self, changes: Iterable[Change]) -> int:
         """The per-change reference path (one row-CL lookup + cell write +
         clock upsert per change).  Kept as the parity oracle for the
         batched pipeline and the ``bench.py --apply`` baseline."""
+        changes = list(changes)
+        if self.device_cache is not None:
+            return self._apply_small_in_tx(changes)
         return sum(self._apply_one(ch) for ch in changes)
 
     def apply_changes(self, changes: Iterable[Change]) -> int:
@@ -1240,8 +1418,12 @@ END;
             if ch.site_id not in ordinals:
                 ordinals[ch.site_id] = self.site_ordinal(ch.site_id)
         impacted = 0
+        apply_table = (
+            self._apply_table_device if self.device_cache is not None
+            else self._apply_table_batched
+        )
         for t, t_changes in by_table.items():
-            impacted += self._apply_table_batched(
+            impacted += apply_table(
                 self._tables[t], t_changes, ordinals
             )
         return impacted
@@ -1282,13 +1464,10 @@ END;
             )
         return out
 
-    def _apply_table_batched(
-        self, info: TableInfo, t_changes: List[Change],
-        ordinals: Dict[bytes, int],
-    ) -> int:
-        import time as _time
-
-        t = info.name
+    @staticmethod
+    def _batch_pks_cids(
+        t_changes: List[Change],
+    ) -> Tuple[List[bytes], set]:
         pks: List[bytes] = []
         seen_pk = set()
         ref_cids = set()
@@ -1298,9 +1477,18 @@ END;
                 pks.append(ch.pk)
             if ch.cid != SENTINEL_CID:
                 ref_cids.add(ch.cid)
+        return pks, ref_cids
 
-        # one IN (...) prefetch per kind: row causal lengths, cell clock
-        # versions, and current cell values (the LWW tie-break operand)
+    def _prefetch_table_view(
+        self, info: TableInfo, pks: List[bytes], ref_cids,
+    ) -> Tuple[Dict[bytes, int], Dict[Tuple[bytes, str], int],
+               Dict[bytes, dict]]:
+        """One IN (...) prefetch per kind: row causal lengths, cell
+        clock versions, and current cell values (the LWW tie-break
+        operand).  With an empty ``ref_cids`` the value query selects
+        only the packed pk — a pure row-existence view, which is all
+        the write-behind flush needs."""
+        t = info.name
         cl_by_pk: Dict[bytes, int] = {}
         for pk, cl in self._prefetch_rows(
             f'SELECT pk, cl FROM "{t}__corro_cl" WHERE pk IN (', pks
@@ -1331,38 +1519,165 @@ END;
                 vals_by_pk[bytes(row[0])] = dict(
                     zip(sel_cols, row[1:])
                 )
+        return cl_by_pk, clock_by_cell, vals_by_pk
 
-        # in-memory merge: the columnar kernel (ops/merge.py segment
-        # reductions) past the batch-size threshold, the per-change
-        # dict replay below it — and as the fallback when a hostile
-        # batch cannot encode.  Identical net state either way, pinned
-        # by the three-way parity suite (tests/test_apply_batched.py).
+    def _merge_table(
+        self, info: TableInfo, t_changes: List[Change],
+        ordinals: Dict[bytes, int],
+        cl_by_pk: Dict[bytes, int],
+        clock_by_cell: Optional[Dict[Tuple[bytes, str], int]],
+        vals_by_pk,
+        seed_cols: Optional[tuple] = None,
+    ) -> Tuple[Dict[bytes, list], int]:
+        """In-memory merge: the columnar kernel (ops/merge.py segment
+        reductions) past the batch-size threshold, the per-change dict
+        replay below it — and as the fallback when a hostile batch
+        cannot encode.  Identical net state either way, pinned by the
+        three-way parity suite (tests/test_apply_batched.py).  The
+        merge timing lands in ``corro_apply_merge_seconds{kernel=}`` on
+        EVERY path — including the encode-impossible fallback, which
+        additionally counts ``corro_apply_columnar_fallbacks_total`` so
+        the A/B series stays complete under hostile batches."""
+        import time as _time
+
         t0 = _time.perf_counter()
         kernel = "dict"
         merged = None
+        # the kernel's flat winner arrays, for the device cache's
+        # vectorized commit promote (consumed by _apply_table_device)
+        self._columnar_plan = None
         if (
             self.columnar_merge
             and len(t_changes) >= self.columnar_merge_min
         ):
             merged = self._merge_table_columnar(
                 info, t_changes, ordinals, cl_by_pk, clock_by_cell,
-                vals_by_pk,
+                vals_by_pk, seed_cols=seed_cols,
             )
             if merged is not None:
                 kernel = "columnar"
+            elif self.metrics is not None:
+                self.metrics.counter(
+                    "corro_apply_columnar_fallbacks_total",
+                    table=info.name,
+                )
         if merged is None:
+            if clock_by_cell is None:
+                # device fast path handed us encoder-parallel seed
+                # columns and a presence *set*; the dict oracle needs
+                # the classic dict views — materialize them here, on
+                # the rare fallback only.
+                clock_by_cell = {}
+                vals_dict: Dict[bytes, dict] = {
+                    pk: {} for pk in vals_by_pk
+                }
+                if seed_cols is not None:
+                    for pk, cid, ver, val in zip(*seed_cols):
+                        clock_by_cell[(pk, cid)] = ver
+                        vd = vals_dict.get(pk)
+                        if vd is not None:
+                            vd[cid] = val
+                vals_by_pk = vals_dict
             merged = self._merge_table_dict(
                 t_changes, ordinals, cl_by_pk, clock_by_cell, vals_by_pk
             )
-        states, impacted = merged
         if self.metrics is not None:
             self.metrics.histogram(
                 "corro_apply_merge_seconds",
                 _time.perf_counter() - t0, kernel=kernel,
             )
+        return merged
+
+    def _apply_table_batched(
+        self, info: TableInfo, t_changes: List[Change],
+        ordinals: Dict[bytes, int],
+    ) -> int:
+        pks, ref_cids = self._batch_pks_cids(t_changes)
+        cl_by_pk, clock_by_cell, vals_by_pk = self._prefetch_table_view(
+            info, pks, ref_cids
+        )
+        states, impacted = self._merge_table(
+            info, t_changes, ordinals, cl_by_pk, clock_by_cell,
+            vals_by_pk,
+        )
         self._flush_table_states(
             info, states, cl_by_pk, clock_by_cell, vals_by_pk
         )
+        return impacted
+
+    def _apply_table_device(
+        self, info: TableInfo, t_changes: List[Change],
+        ordinals: Dict[bytes, int],
+    ) -> int:
+        """Device-resident apply: seed the merge from the cross-batch
+        clock cache instead of SQLite prefetches, stage the net result
+        back into the cache's transaction shadow, and defer the SQL
+        flush to the write-behind queue (journaled in this transaction,
+        drained on the apply pool).  Cache misses fall back to the
+        prefetch path for exactly the missed pks and install the
+        fetched seeds."""
+        dc = self.device_cache
+        t = info.name
+        pks, ref_cids = self._batch_pks_cids(t_changes)
+        if not ref_cids <= set(info.data_cols):
+            # junk cid outside the schema: uncacheable batch — flush
+            # any staged state for these rows, then run the classic
+            # prefetch path against consistent SQLite
+            self._wb_overlap_flush_in_tx(t, pks)
+            return self._apply_table_batched(info, t_changes, ordinals)
+        # hot path: the seed view comes back in the columnar encoder's
+        # native parallel-sequence form (plus a row-presence set) and
+        # the per-cell dicts are never built; a live same-tx overlay
+        # returns None and takes the dict route below
+        seed_cols = None
+        fast = dc.lookup_seed(info, pks, ref_cids)
+        if fast is not None:
+            miss, cl_by_pk, seed_cols, vals_by_pk = fast
+            clock_by_cell = None
+        else:
+            miss, cl_by_pk, clock_by_cell, vals_by_pk = dc.lookup(
+                info, pks, ref_cids
+            )
+        if miss:
+            # a missed pk may carry unflushed staged state (rare:
+            # value-unknown re-miss) — SQLite must be consistent for
+            # those rows before the prefetch reads it
+            self._wb_overlap_flush_in_tx(t, miss)
+            p_cl, p_clock, p_vals = self._prefetch_table_view(
+                info, miss, ref_cids
+            )
+            dc.install(info, miss, p_cl, p_clock, p_vals, ref_cids)
+            # hit pks keep the cache view (it includes staged state the
+            # DB may not have yet); miss pks come from the prefetch
+            p_cl.update(cl_by_pk)
+            cl_by_pk = p_cl
+            if seed_cols is not None:
+                s_pks, s_cids, s_vers, s_vals = seed_cols
+                for (pk, cid), ver in p_clock.items():
+                    s_pks.append(pk)
+                    s_cids.append(cid)
+                    s_vers.append(ver)
+                    s_vals.append(p_vals.get(pk, {}).get(cid))
+                vals_by_pk.update(p_vals)
+            else:
+                p_clock.update(clock_by_cell)
+                clock_by_cell = p_clock
+                p_vals.update(vals_by_pk)
+                vals_by_pk = p_vals
+        states, impacted = self._merge_table(
+            info, t_changes, ordinals, cl_by_pk, clock_by_cell,
+            vals_by_pk, seed_cols=seed_cols,
+        )
+        dc.stage_states(info, states, cl_by_pk, vals_by_pk,
+                        columnar=self._columnar_plan)
+        self._columnar_plan = None
+        cur = self.conn.execute(
+            "INSERT INTO __corro_flush_journal (tbl, payload) "
+            "VALUES (?, ?)",
+            (t, _wb_encode_states(states)),
+        )
+        self._wb.tx_staged.append((t, states, cur.lastrowid))
+        self._wb.unflushed.setdefault(t, set()).update(states)
         return impacted
 
     def _merge_table_dict(
@@ -1452,8 +1767,9 @@ END;
         self, info: TableInfo, t_changes: List[Change],
         ordinals: Dict[bytes, int],
         cl_by_pk: Dict[bytes, int],
-        clock_by_cell: Dict[Tuple[bytes, str], int],
-        vals_by_pk: Dict[bytes, dict],
+        clock_by_cell: Optional[Dict[Tuple[bytes, str], int]],
+        vals_by_pk,
+        seed_cols: Optional[tuple] = None,
     ) -> Optional[Tuple[Dict[bytes, list], int]]:
         """Columnar winner selection (docs/crdts.md "Columnar merge
         kernel"): encode the batch + the prefetched DB view to flat
@@ -1462,14 +1778,19 @@ END;
         decision back into the same net ``states`` structure the flush
         consumes.  Returns ``None`` (fall back to the dict oracle) when
         the batch cannot encode — out-of-range hostile fields, unknown
-        value types."""
+        value types.  ``seed_cols`` — the device cache's native
+        encoder-parallel seed columns — skips the dict flatten
+        entirely; when absent the classic prefetch dicts are flattened
+        here."""
         try:
             from corrosion_tpu.ops import merge as mergeops
         except Exception:  # pragma: no cover - no-numpy deployments
             return None
 
-        seed_cols = None
-        if clock_by_cell:
+        if seed_cols is not None:
+            if not seed_cols[0]:
+                seed_cols = None
+        elif clock_by_cell:
             s_pks, s_cids = zip(*clock_by_cell)
             s_vers = list(clock_by_cell.values())
             _empty: dict = {}
@@ -1507,6 +1828,9 @@ END;
         ord_l = list(map(
             ordinals.__getitem__, map(ag("site_id"), t_changes)
         ))
+        # one C-level zip builds every winner cell tuple up front —
+        # the decode loop then only indexes, never constructs
+        cell_t = list(zip(val_l, ver_l, dbv_l, seq_l, ord_l))
         for p, pk in enumerate(plan.pk_values):
             gen = gen_l[p]
             final_cl = final_l[p]
@@ -1522,16 +1846,14 @@ END;
             for c in range(n_cid):
                 w = win_l[base + c]
                 if w >= 0:
-                    cells[cid_values[c]] = (
-                        val_l[w], ver_l[w], dbv_l[w], seq_l[w],
-                        ord_l[w],
-                    )
+                    cells[cid_values[c]] = cell_t[w]
             states[pk] = [
                 final_cl if (gen or pk in cl_by_pk) else None,
                 clrow, gen,
                 alive_l[p] if gen else None,
                 ensure_l[p], cells, not gen,
             ]
+        self._columnar_plan = (plan, dec)
         return states, int(dec.impacted)
 
     def _flush_table_states(
@@ -1624,6 +1946,240 @@ END;
             )
         self._flush_insert(("clock_ins", t), clock_ins)
         self._flush_insert(("clock_ups", t), clock_ups)
+
+    # ------------------------------------------------------------------
+    # device-resident apply: cache wiring + write-behind flush
+    # ------------------------------------------------------------------
+
+    def enable_device_cache(self, slots: Optional[int] = None,
+                            backend: str = "auto") -> None:
+        """Switch batched applies to the device-resident path
+        (docs/crdts.md "Device-resident apply").  Idempotent; the agent
+        calls this from config wiring."""
+        from corrosion_tpu.ops.devcache import DEFAULT_SLOTS, \
+            DeviceClockCache
+
+        if self.device_cache is not None:
+            return
+        self.device_cache = DeviceClockCache(
+            slots=slots or DEFAULT_SLOTS, backend=backend
+        )
+
+    def flush_pending(self) -> None:
+        """Drain the write-behind queue to SQLite.  The read-side
+        BARRIER: any apply whose commit was observable before this call
+        takes the lock has its winners durably in the clock tables when
+        it returns (entries are journaled + enqueued inside the apply
+        transaction itself).  Cheap no-op when nothing is pending."""
+        wb = self._wb
+        if not wb.pending and not wb.tx_staged:
+            return
+        from corrosion_tpu.agent.locks import PRIO_HIGH
+
+        with self._lock.prio(PRIO_HIGH, "flush-barrier", kind="apply"):
+            self._wb_drain_locked()
+
+    # the serve/snapshot/subscription read paths call it by this name
+    flush_barrier = flush_pending
+
+    def flush_should_drain(self) -> bool:
+        """Scheduling hint for the apply pool: drain once enough
+        batches (or cells) have accumulated to amortize the flush.
+        Thresholds trade journal memory (each pending batch keeps its
+        net states alive) against coalescing — crash safety is the
+        journal's job either way, so these only bound RAM and the
+        worst-case barrier latency for a serve-path read."""
+        wb = self._wb
+        return len(wb.pending) >= 64 or (
+            len(wb.pending) > 0 and wb.cells_pending() >= 131072
+        )
+
+    def device_cache_invalidate(self, reason: str) -> None:
+        """Whole-cache invalidation hook for out-of-band CRR rewrites
+        (compaction floor advance, schema surgery).  Takes the storage
+        lock; flushes first so no staged state is stranded."""
+        if self.device_cache is None:
+            return
+        from corrosion_tpu.agent.locks import PRIO_HIGH
+
+        with self._lock.prio(PRIO_HIGH, "devcache-inval", kind="apply"):
+            self._wb_drain_locked()
+            self.device_cache.invalidate_all(reason)
+            self._emit_cache_metrics()
+
+    def _wb_drain_locked(self) -> None:
+        """Drain with the storage lock held.  Outside a transaction the
+        flush runs in its own BEGIN IMMEDIATE apply-mode transaction;
+        inside one (reentrant barrier from an apply/collect path) it
+        folds into the open transaction."""
+        wb = self._wb
+        if self.conn.in_transaction:
+            self._wb_flush_all_in_tx()
+            return
+        if not wb.pending:
+            return
+        entries, wb.pending = wb.pending, []
+        wb.recompute()
+        self.conn.execute("BEGIN IMMEDIATE")
+        try:
+            self._set_state("apply_mode", 1)
+            self._wb_flush_entries_in_tx(entries)
+            self._set_state("apply_mode", 0)
+        except BaseException:
+            wb.pending = entries + wb.pending
+            wb.recompute()
+            if self.conn.in_transaction:
+                self.conn.execute("ROLLBACK")
+            raise
+        self.conn.execute("COMMIT")
+        self._emit_cache_metrics()
+
+    def _wb_flush_all_in_tx(self) -> None:
+        """Flush pending + current-transaction staged entries inside
+        the OPEN transaction.  Pending entries move to ``draining`` so
+        a rollback requeues them (their journal deletes roll back with
+        the transaction); staged entries simply leave the ledger — on
+        rollback their journal inserts and flushed rows vanish with the
+        merge itself."""
+        wb = self._wb
+        entries = wb.pending + wb.tx_staged
+        if not entries:
+            return
+        mode = self._state("apply_mode")
+        if not mode:
+            self._set_state("apply_mode", 1)
+        try:
+            self._wb_flush_entries_in_tx(entries)
+        finally:
+            if not mode:
+                self._set_state("apply_mode", 0)
+        wb.draining.extend(wb.pending)
+        wb.pending = []
+        wb.tx_staged = []
+        wb.recompute()
+
+    def _wb_overlap_flush_in_tx(self, table: str,
+                                pks: Optional[List[bytes]] = None) -> None:
+        """Order guard inside an open apply transaction: if any of
+        ``pks`` (or any row of ``table`` when None) has unflushed
+        staged state, flush everything so the imminent SQLite read sees
+        a consistent view."""
+        u = self._wb.unflushed.get(table)
+        if not u:
+            return
+        if pks is not None and not any(pk in u for pk in pks):
+            return
+        self._wb_flush_all_in_tx()
+
+    def _wb_flush_entries_in_tx(self, entries: List[tuple]) -> None:
+        """The flush itself: coalesce per (table, pk), re-derive the
+        presence views from SQLite (NOT the cache — the flush is the
+        one consumer that must see the durable truth), run the ordered
+        ``_flush_table_states`` executemany batches, and retire the
+        journal rows in the same transaction."""
+        by_table: Dict[str, Dict[bytes, list]] = {}
+        for t, states, _jid in entries:
+            d = by_table.setdefault(t, {})
+            for pk, st in states.items():
+                prev = d.get(pk)
+                d[pk] = st if prev is None else _wb_coalesce(prev, st)
+        for t, merged in by_table.items():
+            info = self._tables.get(t)
+            if info is None:
+                continue  # table dropped since staging: nothing to do
+            view = self._prefetch_table_view(info, list(merged), ())
+            self._flush_table_states(info, merged, *view)
+        self.conn.executemany(
+            "DELETE FROM __corro_flush_journal WHERE id = ?",
+            [(jid,) for _t, _s, jid in entries],
+        )
+
+    def _recover_flush_journal(self) -> None:
+        """Boot classification of the crash window between a committed
+        device-merge and its async flush: replay every surviving
+        journal row (in id order, each in its own transaction deleting
+        its row) through ``_flush_table_states`` against presence views
+        re-derived from the database — exact by construction, because a
+        flush transaction deletes its journal row atomically, so a
+        surviving row's pre-state is exactly the merge-time view."""
+        rows = self.conn.execute(
+            "SELECT id, tbl, payload FROM __corro_flush_journal "
+            "ORDER BY id"
+        ).fetchall()
+        for jid, tbl, payload in rows:
+            self.conn.execute("BEGIN IMMEDIATE")
+            try:
+                self._set_state("apply_mode", 1)
+                info = self._tables.get(tbl)
+                if info is not None:
+                    states = _wb_decode_states(bytes(payload))
+                    view = self._prefetch_table_view(
+                        info, list(states), ()
+                    )
+                    self._flush_table_states(info, states, *view)
+                self.conn.execute(
+                    "DELETE FROM __corro_flush_journal WHERE id = ?",
+                    (jid,),
+                )
+                self._set_state("apply_mode", 0)
+            except BaseException:
+                self._set_state("apply_mode", 0)
+                if self.conn.in_transaction:
+                    self.conn.execute("ROLLBACK")
+                raise
+            self.conn.execute("COMMIT")
+            self.flush_journal_recovered += 1
+
+    def _tx_finish(self, committed: bool) -> None:
+        """Transaction epilogue for the device-resident ledger: promote
+        or discard the cache shadow, and move/requeue write-behind
+        entries to match what the database actually did."""
+        wb = self._wb
+        dc = self.device_cache
+        if dc is None and not (wb.draining or wb.tx_staged):
+            return
+        if committed:
+            wb.draining = []
+            wb.pending.extend(wb.tx_staged)
+            wb.tx_staged = []
+            if dc is not None:
+                dc.commit_tx()
+        else:
+            wb.pending = wb.draining + wb.pending
+            wb.draining = []
+            wb.tx_staged = []
+            if dc is not None:
+                dc.abort_tx()
+        wb.recompute()
+        self._emit_cache_metrics()
+
+    def _emit_cache_metrics(self) -> None:
+        """Emit the cache's monotonic counters as metric deltas, plus
+        the flush-queue depth gauge."""
+        m = self.metrics
+        dc = self.device_cache
+        if m is None or dc is None:
+            return
+        snap = self._devcache_emitted
+        for key, series in (
+            ("hits", "corro_apply_cache_hits_total"),
+            ("misses", "corro_apply_cache_misses_total"),
+            ("evictions", "corro_apply_cache_evictions_total"),
+        ):
+            cur = dc.counters[key]
+            d = cur - snap.get(key, 0.0)
+            if d:
+                m.counter(series, d)
+                snap[key] = cur
+        for reason, cur in dc.invalidations.items():
+            d = cur - snap.get(("inv", reason), 0.0)
+            if d:
+                m.counter(
+                    "corro_apply_cache_invalidations_total", d,
+                    reason=reason,
+                )
+                snap[("inv", reason)] = cur
+        m.gauge("corro_apply_flush_pending", float(len(self._wb.pending)))
 
     # -- row helpers ----------------------------------------------------
 
@@ -1730,7 +2286,17 @@ END;
 
         from corrosion_tpu.agent.snapshot import fsync_dir
 
+        # device-resident apply: pending flushes target the file being
+        # REPLACED — discard them (their journal rows live in the old
+        # inode; if the swap fails and we come back up on the previous
+        # file, _recover_flush_journal below replays them from there)
+        # and drop every cached clock view of the outgoing database
+        self._wb = _WriteBehind()
+        if self.device_cache is not None:
+            self.device_cache.invalidate_all("snapshot_install")
+            self._emit_cache_metrics()
         self.conn.close()
+        swapped = False
         try:
             with self._ro_cv:
                 for conn in self._ro_free:
@@ -1741,6 +2307,7 @@ END;
                 self._ro_stale.update(self._ro_all)
                 self._ro_all = []
                 os.replace(staged, self.path)
+                swapped = True
                 fsync_dir(self.path)
                 for ext in ("-wal", "-shm"):
                     p = self.path + ext
@@ -1763,8 +2330,27 @@ END;
             self._init_meta(None)
             self._tables = {}
             self._load_crr_tables()
+            if swapped:
+                # the installed file came from a REMOTE donor: any
+                # flush-journal rows it carries are the donor's intents
+                # (normally none — the donor drains before building and
+                # the snapshot scrub drops the table) and must be
+                # purged, never replayed: this node only ever decodes
+                # journal payloads it wrote itself
+                self.conn.execute("DELETE FROM __corro_flush_journal")
+                self.conn.commit()
+            else:
+                # failed swap: we came back up on OUR previous file —
+                # replay our own journal rows before serving from it
+                self._recover_flush_journal()
 
     def close(self) -> None:
+        # drain the write-behind queue while the connection is still
+        # usable; on failure the journal rows replay at next boot
+        try:
+            self.flush_pending()
+        except Exception:
+            pass
         with self._ro_cv:
             self._ro_closed = True
             # close only the FREE readers: a conn mid-query belongs to

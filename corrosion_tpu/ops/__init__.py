@@ -13,8 +13,10 @@ is actually dispatched.
 
 _KEYS = ("KeyCodec", "DEFAULT_CODEC")
 _MERGE = ("merge_keys", "scatter_merge", "merge_cells")
+_DEVCACHE = ("DeviceClockCache", "NumpyStore", "JaxStore",
+             "default_enabled", "DEFAULT_SLOTS")
 
-__all__ = list(_KEYS + _MERGE)
+__all__ = list(_KEYS + _MERGE + _DEVCACHE)
 
 
 def __getattr__(name):
@@ -26,4 +28,8 @@ def __getattr__(name):
         from corrosion_tpu.ops import merge
 
         return getattr(merge, name)
+    if name in _DEVCACHE:
+        from corrosion_tpu.ops import devcache
+
+        return getattr(devcache, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

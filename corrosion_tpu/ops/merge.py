@@ -590,6 +590,12 @@ def _bucket(n: int) -> int:
     return b
 
 
+#: public alias: the device-resident clock cache (ops/devcache.py)
+#: buckets its scatter/gather shapes with the same discipline so both
+#: layers share one set of compiled kernel shapes
+bucket_pow2 = _bucket
+
+
 def _seg_cummax_jnp(x, seg, n: int):
     jnp = _jnp()
     shift = 1

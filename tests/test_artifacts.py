@@ -211,6 +211,29 @@ def test_perf_bench_artifact_schemas(name, value_floor):
             )
         )
         assert headline["kernel"] == "columnar"
+        # device-resident apply (docs/crdts.md "Device-resident
+        # apply"): the committed steady-state hot-cache arm beat the
+        # committed columnar cold headline with three-arm state-digest
+        # parity and a majority cache-hit rate; flood is recorded as
+        # the honest cold-cache bound, not gated
+        da = doc["device_arm"]
+        assert da["pass"] is True
+        assert da["parity"] is True
+        assert da["n_changes"] == headline["n_changes"]
+        steady = da["scenarios"]["steady"]
+        assert steady["parity"] is True
+        assert steady["speedup"] > da["floor"]
+        assert steady["speedup"] > value_floor
+        cache = steady["cache"]
+        for key in (
+            "corro_apply_cache_hits_total",
+            "corro_apply_cache_misses_total",
+            "corro_apply_cache_evictions_total",
+            "corro_apply_cache_invalidations_total",
+        ):
+            assert key in cache, key
+        assert cache["hit_rate"] > 0.5
+        assert da["scenarios"]["flood"]["parity"] is True
 
 
 def test_subs_bench_artifact_schema():

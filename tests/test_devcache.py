@@ -1,0 +1,362 @@
+"""Device-resident apply tests (docs/crdts.md "Device-resident apply").
+
+The contract under test: with ``enable_device_cache()`` the batched
+apply path seeds merges from the cross-batch clock cache and defers the
+SQL flush behind the write-behind journal — and must leave EXACTLY the
+state the per-change sequential oracle leaves, across cache hits,
+misses, evictions, invalidations (local writes, compaction, snapshot
+install), crash windows, and both array-store backends.  Plus a seeded
+stale-cache corruption negative control proving the parity harness
+actually reads through the cache.
+"""
+
+import random
+
+import pytest
+
+from corrosion_tpu.agent.metrics import Metrics
+from corrosion_tpu.agent.pack import pack_values
+from corrosion_tpu.agent.storage import CrConn
+from corrosion_tpu.types.base import CrsqlDbVersion, CrsqlSeq
+from corrosion_tpu.types.change import Change, SENTINEL_CID
+from tests.test_apply_batched import (
+    SITES,
+    _assert_state_equal,
+    _mk,
+    _stream,
+)
+
+
+def _mk_dev(tmp_path, name, slots=None, backend="numpy"):
+    """A CRR database on the device-resident apply path, columnar
+    kernel forced for every batch size."""
+    conn = _mk(tmp_path, name, columnar=True)
+    conn.enable_device_cache(slots=slots, backend=backend)
+    return conn
+
+
+def _journal_rows(conn):
+    return conn.conn.execute(
+        "SELECT COUNT(*) FROM __corro_flush_journal"
+    ).fetchone()[0]
+
+
+# ---------------------------------------------------------------------------
+# randomized parity: device-cached vs the sequential oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_device_parity_randomized(tmp_path, seed):
+    """Interleaved applies, local writes (whole-cache invalidation via
+    the local-write hook) and an out-of-band compaction invalidation:
+    the device arm must match the ``_apply_one`` oracle after every
+    round, and the flush journal must be empty after every barrier."""
+    rng = random.Random(seed)
+    seq = _mk(tmp_path, f"seq{seed}")
+    dev = _mk_dev(tmp_path, f"dev{seed}", slots=64)
+    for rnd in range(4):
+        batch = _stream(rng, 60)
+        with seq.apply_tx():
+            n1 = seq.apply_changes_sequential_in_tx(list(batch))
+        n2 = dev.apply_changes_batched(list(batch))
+        assert n1 == n2, (seed, rnd, n1, n2)
+        if rnd == 1:
+            for c in (seq, dev):
+                c.execute(
+                    "INSERT OR REPLACE INTO items (id, a) "
+                    "VALUES (4, 'mid')"
+                )
+        if rnd == 2:
+            dev.device_cache_invalidate("compaction")
+        dev.flush_barrier()
+        assert _journal_rows(dev) == 0
+        _assert_state_equal(seq, dev)
+    assert dev.device_cache.invalidations.get("compaction", 0) > 0
+    assert dev.device_cache.invalidations.get("local_write", 0) > 0
+    seq.close()
+    dev.close()
+
+
+def _wide_changes(n_rows, col_version):
+    """One cell change per pk over a WIDE pk range — capacity pressure
+    for a small cache."""
+    site = SITES[0]
+    return [
+        Change(
+            table="items", pk=pack_values([i]), cid="a",
+            val=f"v{col_version}-{i}", col_version=col_version,
+            db_version=CrsqlDbVersion(col_version),
+            seq=CrsqlSeq(i), site_id=site, cl=1,
+        )
+        for i in range(n_rows)
+    ]
+
+
+def test_eviction_pressure_parity(tmp_path):
+    """More distinct pks than the cache has slots: capacity pressure
+    clears the table (counted as evictions), the next batch re-seeds
+    from SQLite, and state parity holds throughout."""
+    seq = _mk(tmp_path, "evseq")
+    dev = _mk_dev(tmp_path, "evdev", slots=64)  # max 64 rows / 64 cells
+    for cv in (1, 2):
+        changes = _wide_changes(150, cv)
+        for lo in range(0, 150, 50):
+            batch = changes[lo:lo + 50]
+            with seq.apply_tx():
+                seq.apply_changes_sequential_in_tx(list(batch))
+            dev.apply_changes_batched(list(batch))
+    dev.flush_barrier()
+    _assert_state_equal(seq, dev)
+    assert dev.device_cache.counters["evictions"] > 0
+    seq.close()
+    dev.close()
+
+
+def test_stale_cache_corruption_detected(tmp_path):
+    """Negative control: seed the cache with a CORRUPTED causal length
+    and prove the oracle comparison diverges.  If this test ever
+    passes equality, the apply path stopped reading through the cache
+    and the whole parity suite above is vacuous."""
+    seq = _mk(tmp_path, "corrseq")
+    dev = _mk_dev(tmp_path, "corrdev")
+    pk = pack_values([1])
+    first = [Change(
+        table="items", pk=pk, cid="a", val="v1", col_version=1,
+        db_version=CrsqlDbVersion(1), seq=CrsqlSeq(0),
+        site_id=SITES[0], cl=1,
+    )]
+    with seq.apply_tx():
+        seq.apply_changes_sequential_in_tx(list(first))
+    dev.apply_changes_batched(list(first))
+    dev.flush_barrier()
+    _assert_state_equal(seq, dev)
+    # corrupt the cached cl: pretend the row is at causal length 9
+    tc = dev.device_cache._tables["items"]
+    tc.row_cl[tc.pk_slot[pk]] = 9
+    # a cl=2 delete must win against the real cl=1; against the
+    # corrupted cl=9 the device arm wrongly keeps the row alive
+    delete = [Change(
+        table="items", pk=pk, cid=SENTINEL_CID, val=None,
+        col_version=2, db_version=CrsqlDbVersion(2), seq=CrsqlSeq(0),
+        site_id=SITES[1], cl=2,
+    )]
+    with seq.apply_tx():
+        seq.apply_changes_sequential_in_tx(list(delete))
+    dev.apply_changes_batched(list(delete))
+    dev.flush_barrier()
+    with pytest.raises(AssertionError):
+        _assert_state_equal(seq, dev)
+    seq.close()
+    dev.close()
+
+
+# ---------------------------------------------------------------------------
+# crash window: committed-but-unflushed winners
+# ---------------------------------------------------------------------------
+
+
+def test_crash_window_journal_recovery(tmp_path):
+    """Kill the process between a committed device-merge and its async
+    flush: the journal rows written inside the apply transaction must
+    replay at reopen, losing NO committed winner (acceptance gate)."""
+    rng = random.Random(99)
+    seq = _mk(tmp_path, "cseq")
+    dev = _mk_dev(tmp_path, "cdev", slots=128)
+    for _ in range(5):
+        batch = _stream(rng, 50)
+        with seq.apply_tx():
+            seq.apply_changes_sequential_in_tx(list(batch))
+        dev.apply_changes_batched(list(batch))
+    pend = len(dev._wb.pending)
+    assert pend > 0, "nothing pending — crash window not exercised"
+    assert _journal_rows(dev) > 0
+    path = dev.path
+    dev.conn.close()  # raw close: no drain — the simulated crash
+    dev2 = CrConn(path, site_id=b"\x77" * 16)
+    # boot classified the crash window: rows replayed, journal empty
+    assert dev2.flush_journal_recovered == pend
+    assert _journal_rows(dev2) == 0
+    _assert_state_equal(seq, dev2)
+    seq.close()
+    dev2.close()
+
+
+# ---------------------------------------------------------------------------
+# write-behind barriers on the read paths
+# ---------------------------------------------------------------------------
+
+
+def test_read_paths_barrier_unflushed_winners(tmp_path):
+    """``read_query`` and ``collect_changes_ro`` must never observe a
+    merged-but-unflushed winner: both drain the write-behind queue
+    before reading."""
+    seq = _mk(tmp_path, "bseq")
+    dev = _mk_dev(tmp_path, "bdev")
+    batch = _wide_changes(10, 1)
+    with seq.apply_tx():
+        seq.apply_changes_sequential_in_tx(list(batch))
+    dev.apply_changes_batched(list(batch))
+    assert len(dev._wb.pending) > 0  # winners not yet in SQLite
+    _cols, rows = dev.read_query(
+        "SELECT a FROM items WHERE id = 3"
+    )
+    assert rows == [("v1-3",)]
+    assert len(dev._wb.pending) == 0  # the read drained the queue
+    dev.apply_changes_batched(list(_wide_changes(10, 2)))
+    with seq.apply_tx():
+        seq.apply_changes_sequential_in_tx(list(_wide_changes(10, 2)))
+    with dev.reader() as conn:
+        got = dev.collect_changes_ro(conn, (1, 64), SITES[0])
+    want = seq.collect_changes((1, 64), SITES[0])
+    assert got == want
+    seq.close()
+    dev.close()
+
+
+# ---------------------------------------------------------------------------
+# snapshot install: cache invalidated, journal purged (never replayed)
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_install_invalidates_and_purges_journal(tmp_path):
+    """Installing a snapshot swaps the database file: every cached
+    clock view is invalid, pending flushes target the dead inode, and
+    any flush-journal rows the installed file carries are the DONOR's
+    intents — purged without decoding (a receiver must never unpickle
+    another node's journal payloads)."""
+    donor = _mk(tmp_path, "donor")
+    donor.execute("INSERT INTO items (id, a) VALUES (9, 'donor')")
+    # a poisoned donor journal row: if install ever replays instead of
+    # purging, the payload decode raises and this test fails loudly
+    donor.conn.execute(
+        "INSERT INTO __corro_flush_journal (tbl, payload) VALUES (?, ?)",
+        ("items", b"\x01not-a-pickle"),
+    )
+    donor.conn.commit()
+    donor_path = donor.path
+    donor.close()
+
+    dev = _mk_dev(tmp_path, "recv")
+    dev.apply_changes_batched(_wide_changes(8, 1))
+    assert len(dev._wb.pending) > 0
+    dev.install_snapshot(donor_path)
+    assert _journal_rows(dev) == 0
+    assert len(dev._wb.pending) == 0
+    assert dev.device_cache.invalidations.get("snapshot_install", 0) > 0
+    _cols, rows = dev.read_query("SELECT a FROM items WHERE id = 9")
+    assert rows == [("donor",)]
+    # the cache re-seeds from the installed file: post-install applies
+    # still match a fresh oracle replaying the same post-install stream
+    oracle = _mk(tmp_path, "postseq")
+    oracle.execute("INSERT INTO items (id, a) VALUES (9, 'donor')")
+    post = _wide_changes(8, 3)
+    with oracle.apply_tx():
+        oracle.apply_changes_sequential_in_tx(list(post))
+    dev.apply_changes_batched(list(post))
+    dev.flush_barrier()
+    got = dev.conn.execute(
+        'SELECT pk, cid, col_version FROM "items__corro_clock" '
+        'ORDER BY pk, cid'
+    ).fetchall()
+    want = oracle.conn.execute(
+        'SELECT pk, cid, col_version FROM "items__corro_clock" '
+        'ORDER BY pk, cid'
+    ).fetchall()
+    assert got == want
+    oracle.close()
+    dev.close()
+
+
+# ---------------------------------------------------------------------------
+# columnar fallback accounting (hostile batches under the device path)
+# ---------------------------------------------------------------------------
+
+
+def test_columnar_fallback_counter_and_dict_timing(tmp_path):
+    """A batch the kernel cannot encode (col_version over the 62-bit
+    key budget) must fall back to the dict oracle, count
+    ``corro_apply_columnar_fallbacks_total{table=}``, time the merge
+    under ``kernel=dict`` — and still match the sequential oracle
+    (the device path materializes the dict seed view on fallback)."""
+    seq = _mk(tmp_path, "fbseq")
+    dev = _mk_dev(tmp_path, "fbdev")
+    dev.metrics = Metrics()
+    # prime the cache so the hostile batch seeds from HITS
+    warm = _wide_changes(6, 1)
+    with seq.apply_tx():
+        seq.apply_changes_sequential_in_tx(list(warm))
+    dev.apply_changes_batched(list(warm))
+    dev.flush_barrier()
+    hostile = [Change(
+        table="items", pk=pack_values([i]), cid="a", val="big",
+        col_version=(1 << 62) + 5, db_version=CrsqlDbVersion(7),
+        seq=CrsqlSeq(i), site_id=SITES[1], cl=1,
+    ) for i in range(6)]
+    with seq.apply_tx():
+        seq.apply_changes_sequential_in_tx(list(hostile))
+    dev.apply_changes_batched(list(hostile))
+    dev.flush_barrier()
+    _assert_state_equal(seq, dev)
+    assert dev.metrics.get_counter(
+        "corro_apply_columnar_fallbacks_total", table="items"
+    ) >= 1
+    n_dict, _total = dev.metrics.histogram_stats(
+        "corro_apply_merge_seconds", kernel="dict"
+    )
+    assert n_dict >= 1
+    seq.close()
+    dev.close()
+
+
+# ---------------------------------------------------------------------------
+# backend bit-equality: NumPy store == JaxStore(x64) == uncached kernel
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_store_backend_bit_equality(tmp_path, seed):
+    """The JAX device store must be bit-identical to the NumPy twin,
+    and both identical to the uncached columnar path — the tier-1
+    ``JAX_PLATFORMS=cpu`` equality gate for the device arm."""
+    from jax.experimental import enable_x64
+
+    rng = random.Random(7000 + seed)
+    streams = [_stream(rng, 50) for _ in range(3)]
+    uncached = _mk(tmp_path, f"unc{seed}", columnar=True)
+    dev_np = _mk_dev(tmp_path, f"np{seed}", backend="numpy")
+    with enable_x64():
+        dev_jx = _mk_dev(tmp_path, f"jx{seed}", backend="jax")
+        for batch in streams:
+            uncached.apply_changes_batched(list(batch))
+            dev_np.apply_changes_batched(list(batch))
+            dev_jx.apply_changes_batched(list(batch))
+            dev_np.flush_barrier()
+            dev_jx.flush_barrier()
+            _assert_state_equal(uncached, dev_np)
+            _assert_state_equal(uncached, dev_jx)
+    for c in (uncached, dev_np, dev_jx):
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# cache metric accounting
+# ---------------------------------------------------------------------------
+
+
+def test_cache_hit_miss_metrics_emitted(tmp_path):
+    """Steady-state re-applies over the same rows are HITS; the deltas
+    reach the metrics registry at commit, and the flush-pending gauge
+    tracks the write-behind queue depth."""
+    dev = _mk_dev(tmp_path, "metdev")
+    dev.metrics = Metrics()
+    dev.apply_changes_batched(_wide_changes(20, 1))  # cold: misses
+    dev.apply_changes_batched(_wide_changes(20, 2))  # hot: hits
+    dev.apply_changes_batched(_wide_changes(20, 3))
+    m = dev.metrics
+    assert m.get_counter_sum("corro_apply_cache_misses_total") >= 20
+    assert m.get_counter_sum("corro_apply_cache_hits_total") >= 40
+    assert m._gauges["corro_apply_flush_pending"][()] == 3.0
+    dev.flush_barrier()
+    assert m._gauges["corro_apply_flush_pending"][()] == 0.0
+    dev.close()
