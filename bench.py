@@ -2473,10 +2473,14 @@ async def _devcluster3() -> dict:
 def _device_bitmap_budget() -> tuple:
     """Per-device byte budget for the exact sampler's dense ``sent_to``
     bitmap, derived from the backend's REPORTED device memory (half of
-    it: the other half stays for XLA temps, stats and the small state)
-    with the historical 256 MiB constant as the fallback when the
-    backend exposes no memory stats (CPU).  Returns (bytes, source) so
-    artifacts can record where the number came from."""
+    it: the other half stays for XLA temps, stats and the small state).
+    When the backend exposes no memory stats (CPU), the host's
+    ``/proc/meminfo`` MemAvailable split across the devices that share
+    it stands in (``sim/calibrate.py host_memory_budget_bytes`` — the
+    same derivation ``frontier_seed_batch`` uses for the host-sharded
+    kernel), with the historical 256 MiB constant as the last resort.
+    Returns (bytes, source) so artifacts can record where the number
+    came from."""
     import jax
 
     try:
@@ -2488,6 +2492,14 @@ def _device_bitmap_budget() -> tuple:
             return int(limit) // 2, "device_memory_stats/2"
     except Exception:  # noqa: BLE001 - backend-dependent API surface
         pass
+    from corrosion_tpu.sim.calibrate import host_memory_budget_bytes
+
+    try:
+        budget = host_memory_budget_bytes(jax.device_count())
+    except Exception:  # noqa: BLE001 - /proc surface varies by platform
+        budget = None
+    if budget:
+        return int(budget), "host_meminfo/2/devices"
     return 256 << 20, "fallback_constant_256MiB"
 
 
@@ -2504,8 +2516,17 @@ def _exact_kernel_plan(n: int):
     import numpy as np
     from jax.sharding import Mesh
 
-    budget, _src = _device_bitmap_budget()
+    budget, src = _device_bitmap_budget()
     bitmap = n * (-(-n // 8))
+    if src.startswith("host_meminfo"):
+        # every "device" is a virtual CPU device sharing ONE RAM pool:
+        # row-sharding the bitmap buys zero memory headroom, so dense
+        # dispatch asks whether the whole bitmap (plus its donated
+        # double during the scan) fits the per-device share, and beyond
+        # that goes straight to sparse
+        if 2 * bitmap <= budget:
+            return "dense", None
+        return "sparse", None
     if bitmap < budget:
         return "dense", None
     d = jax.device_count()
@@ -2537,22 +2558,29 @@ def _run_exact_planned(ecfg, seeds: int, kernel=None, mesh=None) -> dict:
     ``run_exact_headline`` under the budget-derived kernel plan; the
     result carries the kernel tag for the artifact.  ``kernel`` may be
     a plan tag (``sharded-`` prefixed): the runner takes the base
-    representation and re-derives the prefix from ``mesh``."""
+    representation and re-derives the prefix from ``mesh``.
+    ``"host-sparse"`` selects the MULTI-HOST frontier layout (mesh must
+    carry a ``hosts`` axis)."""
     from corrosion_tpu.sim.calibrate import run_exact_headline
 
     if kernel is None:
         kernel, mesh = _exact_kernel_plan(ecfg.n_nodes)
+    host_sharded = kernel == "host-sparse"
     base = "sparse" if kernel.endswith("sparse") else "dense"
     run_exact_headline(ecfg, n_seeds=seeds, seed=1, mesh=mesh,
-                       warm_chunks=1, kernel=base)
+                       warm_chunks=1, kernel=base,
+                       host_sharded=host_sharded)
     return run_exact_headline(ecfg, n_seeds=seeds, seed=0, mesh=mesh,
-                              kernel=base)
+                              kernel=base, host_sharded=host_sharded)
 
 
 def _frontier_point(n: int, res: dict) -> dict:
     """One exact-sampler sweep row (shared by the lossonly sweep and
-    the frontier artifact — one hand-maintained schema, not two)."""
-    return {
+    the frontier artifact — one hand-maintained schema, not two).
+    Every row records the bitmap budget its kernel dispatch was derived
+    from, so a reader can re-check the dense/sharded/sparse choice."""
+    budget, budget_src = _device_bitmap_budget()
+    row = {
         "n": n,
         "ticks_p50": res["ticks_p50"],
         "ticks_p99": res["ticks_p99"],
@@ -2564,8 +2592,13 @@ def _frontier_point(n: int, res: dict) -> dict:
         "n_seeds": res["n_seeds"],
         "seed_batch": res.get("seed_batch"),
         "n_shards": res.get("n_shards"),
+        "bitmap_budget_bytes": budget,
+        "budget_source": budget_src,
         "wall_s": round(res["wall_s"], 2),
     }
+    if res.get("n_hosts", 1) > 1:
+        row["n_hosts"] = res["n_hosts"]
+    return row
 
 
 def _frontier_perf_gate_100k(sweep_100k: dict, n_seeds: int,
@@ -2627,41 +2660,228 @@ def _frontier_perf_gate_100k(sweep_100k: dict, n_seeds: int,
     }
 
 
+def _frontier_multi_host_gate(measured_weights, wan_latency_ticks: int,
+                              n: int = 256, ticks: int = 10,
+                              n_seeds: int = 2) -> dict:
+    """In-record multi-host exactness witness: the host-sharded
+    frontier step, run tick-by-tick on the emulated host mesh, must
+    leave EVERY state leaf (infected, tx, next_send, ring, msgs,
+    pending) bitwise equal to the single-chip ``frontier_exact_tick``
+    — across the headline protocol shape and BOTH new topology
+    families (measured-RTT ring, tick-quantized WAN latency).  The
+    committed artifact carries its own dispatch-invariance proof for
+    the kernel that produced the 10M headline; the seeded-corruption
+    negative control lives in tests/test_sharding.py."""
+    from dataclasses import replace as _replace
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from corrosion_tpu.models.sharded import sharded_frontier_host_step
+    from corrosion_tpu.sim.calibrate import (
+        HeadlineExactConfig,
+        frontier_exact_init,
+        frontier_exact_tick,
+        frontier_host_shardings,
+    )
+
+    n_hosts = max(h for h in (1, 2, 4, 8)
+                  if h <= jax.device_count() and n % (8 * h) == 0)
+    base_cfg = HeadlineExactConfig(
+        n_nodes=n, fanout=4, ring0_size=16, max_transmissions=8,
+        loss=0.05, sync_interval=4, backoff_ticks=0.5, max_ticks=64,
+    )
+    families = {
+        "headline": {},
+        "measured_ring": {
+            "topology": "measured_ring",
+            "rtt_tier_weights": tuple(measured_weights),
+        },
+        "wan_latency": {
+            "topology": "wan_two_region",
+            "wan_cross_loss": 0.0,
+            "wan_latency_ticks": wan_latency_ticks,
+        },
+    }
+    mesh = Mesh(np.array(jax.devices()[:n_hosts]), ("hosts",))
+    fields = ("infected", "tx", "next_send", "ring", "msgs", "tick",
+              "pending")
+    out = {"n": n, "n_hosts": n_hosts, "ticks": ticks,
+           "n_seeds": n_seeds, "fields_compared": list(fields)}
+    ok_all = True
+    for fam, overrides in families.items():
+        cfg = _replace(base_cfg, **overrides)
+        base = [jax.random.PRNGKey(31 + s) for s in range(n_seeds)]
+        refs = [
+            frontier_exact_init(cfg, jax.random.fold_in(kk, 2**20))
+            for kk in base
+        ]
+        batched = jax.vmap(
+            lambda kk: frontier_exact_init(
+                cfg, jax.random.fold_in(kk, 2**20)
+            )
+        )(jnp.stack(base))
+        batched = jax.device_put(batched, frontier_host_shardings(mesh))
+        step = sharded_frontier_host_step(mesh, cfg)
+        ok = True
+        for t in range(ticks):
+            keys_t = jnp.stack([jax.random.fold_in(kk, t) for kk in base])
+            refs = [
+                frontier_exact_tick(r, jax.random.fold_in(kk, t), cfg)
+                for r, kk in zip(refs, base)
+            ]
+            batched = step(batched, keys_t)
+            for s in range(n_seeds):
+                for field in fields:
+                    ok &= bool(np.array_equal(
+                        np.asarray(getattr(batched, field)[s]),
+                        np.asarray(getattr(refs[s], field)),
+                    ))
+        # the gate must witness a live epidemic, not a trivially-equal
+        # no-progress trajectory (full convergence within the compared
+        # ticks is fine — the slower families stay partial)
+        alive = float(np.asarray(batched.infected).mean()) > 2.0 / n
+        out[fam] = {"bitwise_equal": ok, "epidemic_live": alive}
+        ok_all &= ok and alive
+    out["pass"] = ok_all
+    return out
+
+
+#: tier weights the measured_ring cells fall back to when no captured
+#: topology artifact exists (shape of the capture campaign's output:
+#: most nodes in the mid tiers, a small far tail)
+_MEASURED_WEIGHTS_FALLBACK = (0, 0, 2, 2, 6, 1)
+
+
+def run_capture_topology(out_path: str = "TOPOLOGY_MEASURED.json",
+                         n: int = 24, seed: int = 7,
+                         sim_s: float = 30.0) -> dict:
+    """Deterministic measured-topology capture campaign: N real agents
+    on the virtual-time cluster with a ring-distance per-pair RTT
+    (2 ms adjacent, +8 ms per hop), probed long enough for every
+    Members ring to fill its RTT windows, then aggregated with
+    ``capture_rtt_topology`` into the measured_ring topology JSON that
+    ``--frontier`` (and ``HeadlineExactConfig(rtt_tier_weights=...)``)
+    consume.  Same (n, seed, sim_s) -> byte-identical artifact.  The
+    single-node path of the same export is the agent admin
+    ``corro-tpu rtt dump`` command."""
+    from corrosion_tpu.sim.vcluster import (
+        VirtualCluster,
+        capture_rtt_topology,
+    )
+
+    t0 = time.perf_counter()
+
+    def ring_rtt(i: int, j: int) -> float:
+        d = min(abs(i - j), n - abs(i - j))
+        return 0.002 + 0.008 * d
+
+    c = VirtualCluster(n, seed=seed, link_rtt_fn=ring_rtt)
+    try:
+        c.run_for(sim_s)
+        topo = capture_rtt_topology(c)
+    finally:
+        c.close()
+    topo["capture"] = {
+        "campaign": "vcluster_ring_distance",
+        "n": n,
+        "seed": seed,
+        "sim_s": sim_s,
+        "link_rtt_s": "0.002 + 0.008 * ring_distance",
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(_sanitize(topo), f, indent=2)
+            f.write("\n")
+    return topo
+
+
 def run_frontier_bench(
     out_path: str = "BENCH_FRONTIER.json",
-    ns=(1000, 16000, 100000, 256000, 1000000),
+    ns=(1000, 16000, 100000, 256000, 1000000, 10_000_000),
     n_seeds: int = 4,
     topo_n: int = 100_000,
+    host_seeds: int = 2,
+    n_hosts: int = 2,
+    topo_names=None,
+    topology_json: str = None,
+    wan_latency_ticks: int = 2,
 ) -> dict:
     """The frontier-sparse BENCH headline: the exact sampler's p99
-    convergence ticks + msgs/node swept through N=1M (the million-node
-    point the dense [N, N/8] ``sent_to`` bitmap could never reach —
-    ~125 GB at 1M vs the ring's 128 MB), every point tagged with the
-    kernel that produced it (dense / sharded-dense / sparse per the
-    device-memory-derived budget), plus:
+    convergence ticks + msgs/node swept through N=10M, every point
+    tagged with the kernel that produced it (dense / sharded-dense /
+    sparse per the memory-derived budget; the dense [N, N/8]
+    ``sent_to`` bitmap tops out around 100k — ~125 GB at 1M vs the
+    ring's 128 MB), plus:
 
+    * the MULTI-HOST headline: beyond the 1M single-host point the
+      sweep switches to the host-sharded frontier kernel (``n_hosts``
+      emulated hosts on the virtual-device mesh) — per-host row shards
+      of every O(N) leaf, and ONLY the rejection loop's bitpacked
+      validity deltas crossing the host fabric per tick;
     * an EXACTNESS gate: the sparse runner's per-seed rank statistics
       equal the dense runner's at a small N (the committed artifact's
       own witness that kernel dispatch cannot move the numbers; the
       bitwise per-tick contract is pinned by tests/test_frontier.py);
+    * a MULTI-HOST gate: the host-sharded step bitwise-equal to the
+      single-chip frontier kernel at N=256 across the headline shape
+      and both new topology families (measured ring, WAN latency);
     * a PERF gate at N=100k: the sparse kernel's wall must not exceed
       the dense kernel's on the same host at matched seeds (the
       acceptance bound — the representation change must not cost the
       existing scale anything);
     * one sweep point per scenario topology beyond uniform fanout
-      (heterogeneous-RTT ring, two-region WAN) at ``topo_n``.
+      (heterogeneous-RTT ring, two-region WAN, measured-RTT ring from
+      the captured TOPOLOGY_MEASURED.json distribution, tick-quantized
+      WAN latency queues) at ``topo_n``.
     """
     import jax
+    import numpy as np
+    from jax.sharding import Mesh
 
     budget, budget_src = _device_bitmap_budget()
     t_total = time.perf_counter()
     _point = _frontier_point
 
+    # measured_ring weights: an explicit --topology-json wins, else the
+    # committed capture-campaign artifact, else the documented fallback
+    here = os.path.dirname(os.path.abspath(__file__))
+    measured = _committed_json(
+        topology_json or os.path.join(here, "TOPOLOGY_MEASURED.json")
+    )
+    if measured and measured.get("weights"):
+        m_weights = tuple(int(w) for w in measured["weights"])
+        m_src = topology_json or "TOPOLOGY_MEASURED.json"
+    else:
+        m_weights = _MEASURED_WEIGHTS_FALLBACK
+        m_src = "fallback_default"
+
     points = []
     for n in ns:
         ecfg = _frontier_exact_cfg(n, partitioned=False)
+        kernel = mesh = None
+        seeds_n = n_seeds
+        if n > 1_000_000:
+            # the multi-host headline: host-sharded frontier kernel on
+            # an emulated n_hosts mesh (forced minimum H=2 — the point
+            # exists to run the delta-only exchange layer, and on a
+            # shared-RAM virtual mesh more hosts only multiply the
+            # replicated work), fewer seeds (each costs 10M-node ticks)
+            seeds_n = host_seeds
+            if jax.device_count() < n_hosts or n % (8 * n_hosts):
+                points.append({
+                    "n": n, "error": f"host-sparse needs {n_hosts} "
+                    f"devices and n % (8 * {n_hosts}) == 0",
+                })
+                continue
+            kernel = "host-sparse"
+            mesh = Mesh(np.array(jax.devices()[:n_hosts]), ("hosts",))
         try:
-            res = _run_exact_planned(ecfg, n_seeds)
+            res = _run_exact_planned(ecfg, seeds_n, kernel=kernel,
+                                     mesh=mesh)
         except Exception as e:  # noqa: BLE001 - surfaced in the record
             points.append({"n": n, "error": f"{type(e).__name__}: {e}"})
             continue
@@ -2711,14 +2931,45 @@ def run_frontier_bench(
         perf = {"n": 100_000, "pass": None, "error":
                 "no successful 100k sweep point to gate against"}
 
-    # scenario diversity beyond uniform fanout: one sweep point each
+    # multi-host exactness gate: host-sharded step bitwise vs the
+    # single-chip frontier kernel across the headline shape and BOTH
+    # new topology families (guarded: a gate crash voids the artifact
+    # via the error field, never discards the measured sweep)
+    try:
+        multi_host = _frontier_multi_host_gate(
+            m_weights, wan_latency_ticks
+        )
+    except Exception as e:  # noqa: BLE001 - surfaced in the record
+        multi_host = {"error": f"{type(e).__name__}: {e}", "pass": False}
+
+    # scenario diversity beyond uniform fanout: one sweep point each —
+    # the two PR-15 families plus the measured-RTT ring (data-driven
+    # tier map from the capture campaign) and the WAN latency-queue
+    # family (delayed cross-region delivery, zero extra loss)
+    topo_families = {
+        "het_ring": {"topology": "het_ring"},
+        "wan_two_region": {"topology": "wan_two_region"},
+        "measured_ring": {
+            "topology": "measured_ring",
+            "rtt_tier_weights": m_weights,
+        },
+        "wan_latency": {
+            "topology": "wan_two_region",
+            "wan_cross_loss": 0.0,
+            "wan_latency_ticks": wan_latency_ticks,
+        },
+    }
+    if topo_names:
+        topo_families = {
+            k: v for k, v in topo_families.items() if k in topo_names
+        }
     topologies = {}
-    for topo in ("het_ring", "wan_two_region"):
+    for topo, overrides in topo_families.items():
         from dataclasses import replace as _replace
 
         tcfg = _replace(
             _frontier_exact_cfg(topo_n, partitioned=False),
-            topology=topo,
+            **overrides,
         )
         try:
             res = _run_exact_planned(tcfg, n_seeds, kernel="sparse")
@@ -2728,9 +2979,16 @@ def run_frontier_bench(
             }
             continue
         row = _point(topo_n, res)
-        row["topology"] = topo
+        row["topology"] = tcfg.topology
         if topo == "het_ring":
             row["rtt_tiers"] = tcfg.rtt_tiers
+        elif topo == "measured_ring":
+            row["rtt_tier_weights"] = list(m_weights)
+            row["weights_source"] = m_src
+        elif topo == "wan_latency":
+            row["wan_blocks"] = tcfg.wan_blocks
+            row["wan_latency_ticks"] = tcfg.wan_latency_ticks
+            row["wan_cross_loss"] = tcfg.wan_cross_loss
         else:
             row["wan_blocks"] = tcfg.wan_blocks
             row["wan_cross_loss"] = tcfg.wan_cross_loss
@@ -2761,6 +3019,7 @@ def run_frontier_bench(
         "points": points,
         "headline": headline,
         "exactness_gate": exactness,
+        "multi_host_gate": multi_host,
         "perf_gate_100k": perf,
         "topologies": topologies,
         "wall_s_total": round(time.perf_counter() - t_total, 2),
@@ -2770,6 +3029,8 @@ def run_frontier_bench(
         errs.append(f"no N={max(ns)} headline point")
     if not exactness["pass"]:
         errs.append("dense/sparse runner stats diverged")
+    if not multi_host.get("pass"):
+        errs.append("multi-host gate failed")
     if perf is not None:
         if "error" in perf:
             errs.append(f"100k perf gate failed to run: {perf['error']}")
@@ -2987,11 +3248,32 @@ def main() -> None:
                          "1k-16k vs perm fanout; ~3-5 min) and exit")
     ap.add_argument("--frontier", action="store_true",
                     help="run the frontier-sparse exact-sampler sweep "
-                         "through N=1M (per-point kernel dispatch from "
-                         "the device-memory bitmap budget, dense-vs-"
-                         "sparse exactness + 100k perf gates, het-RTT "
-                         "ring and two-region WAN topology points), "
-                         "write BENCH_FRONTIER.json, and exit")
+                         "through N=10M (per-point kernel dispatch "
+                         "from the memory-derived bitmap budget; the "
+                         "10M headline runs the multi-host frontier "
+                         "kernel with delta-only cross-host exchange "
+                         "on an emulated host mesh; dense-vs-sparse "
+                         "exactness, multi-host bitwise and 100k perf "
+                         "gates; het-RTT ring, two-region WAN, "
+                         "measured-RTT ring and WAN-latency topology "
+                         "points), write BENCH_FRONTIER.json, and "
+                         "exit")
+    ap.add_argument("--topology", default=None,
+                    help="comma-separated subset of the --frontier "
+                         "topology families (het_ring, wan_two_region, "
+                         "measured_ring, wan_latency; default all)")
+    ap.add_argument("--topology-json", default=None,
+                    help="measured-topology JSON (TOPOLOGY_MEASURED."
+                         "json schema, e.g. from `corro-tpu rtt dump "
+                         "--out` or --capture-topology) whose weights "
+                         "drive the --frontier measured_ring cells "
+                         "(default: the committed TOPOLOGY_MEASURED."
+                         "json, then a built-in fallback)")
+    ap.add_argument("--capture-topology", action="store_true",
+                    help="run the deterministic virtual-cluster RTT "
+                         "capture campaign (ring-distance per-pair "
+                         "latency, real agents, real SWIM probes), "
+                         "write TOPOLOGY_MEASURED.json, and exit")
     ap.add_argument("--chaos", action="store_true",
                     help="run the N=32 chaos soak (live cluster under "
                          "the headline fault family vs the sim's "
@@ -3131,13 +3413,38 @@ def main() -> None:
                              n_changes=args.subs_changes,
                              out_path=out_path))
         return
+    if args.capture_topology:
+        # virtual-time cluster campaign: no JAX setup needed
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "TOPOLOGY_MEASURED.json",
+        )
+        _emit(run_capture_topology(out_path=out_path))
+        return
+    if args.frontier:
+        # the 10M multi-host headline and the multi-host gate need a
+        # >= 2-device mesh to emulate hosts: self-provision the same
+        # 8-device virtual CPU mesh tests/conftest.py uses when the
+        # backend is CPU and not yet initialized (a real multi-chip
+        # backend — JAX_PLATFORMS=tpu — is left alone)
+        from __graft_entry__ import _backend_initialized, _force_virtual_cpu
+
+        plat = os.environ.get("JAX_PLATFORMS", "cpu").split(",")[0]
+        if plat == "cpu" and not _backend_initialized():
+            _force_virtual_cpu(8)
     _enable_compile_cache()
     if args.frontier:
         out_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)),
             "BENCH_FRONTIER.json",
         )
-        _emit(run_frontier_bench(out_path=out_path))
+        topo_names = (
+            tuple(t.strip() for t in args.topology.split(",") if t.strip())
+            if args.topology else None
+        )
+        _emit(run_frontier_bench(out_path=out_path,
+                                 topo_names=topo_names,
+                                 topology_json=args.topology_json))
         return
     if args.calibrate_msgs:
         from corrosion_tpu.sim.calibrate import run_msgs_calibration

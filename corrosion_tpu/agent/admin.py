@@ -107,6 +107,29 @@ def _handle(agent: "Agent", msg: dict) -> dict:
             })
         return {"ok": out}
 
+    if cmd == "rtt_dump":
+        # measured-topology export: the Members RTT-ring tier
+        # distribution as topology JSON consumable by
+        # ``bench.py --frontier --topology measured_ring``
+        from corrosion_tpu.agent.members import (
+            DEFAULT_RTT_TIER_EDGES_MS,
+            rtt_topology,
+        )
+
+        edges = msg.get("tier_edges_ms")
+        if edges is not None:
+            try:
+                edges = tuple(float(e) for e in edges)
+                if not edges or any(
+                    b <= a for a, b in zip(edges, edges[1:])
+                ):
+                    raise ValueError("edges must strictly increase")
+            except (TypeError, ValueError) as e:
+                return {"error": f"bad tier_edges_ms: {e}"}
+        else:
+            edges = DEFAULT_RTT_TIER_EDGES_MS
+        return {"ok": rtt_topology(agent.members, edges)}
+
     if cmd == "transport_stats":
         if agent.transport is None:
             return {"ok": {}}

@@ -294,3 +294,68 @@ class Members:
         picked = list(ring0)
         picked += pick(rest, shunned, k)
         return picked
+
+
+# measured-topology export: RTT tier edges in ms.  Tier 1 is exactly
+# the reference's ring0 (<6 ms); the rest double per tier (geo-RTT
+# bands: metro, regional, continental, intercontinental); anything
+# past the last edge lands in one final open tier.
+DEFAULT_RTT_TIER_EDGES_MS: Tuple[float, ...] = (
+    RING0_MAX_RTT_MS, 12.0, 24.0, 48.0, 96.0
+)
+
+
+def rtt_tier_of(rtt_ms: float,
+                edges: Tuple[float, ...] = DEFAULT_RTT_TIER_EDGES_MS
+                ) -> int:
+    """1-based RTT tier of one mean RTT sample: the first edge the RTT
+    falls under; ``len(edges) + 1`` beyond the last edge."""
+    for t, edge in enumerate(edges, start=1):
+        if rtt_ms < edge:
+            return t
+    return len(edges) + 1
+
+
+def rtt_topology(members: "Members",
+                 edges: Tuple[float, ...] = DEFAULT_RTT_TIER_EDGES_MS
+                 ) -> Dict:
+    """Export this node's ``Members`` RTT-ring tier distribution as
+    measured-topology JSON — the capture path behind ``corro admin rtt
+    dump`` and the vcluster capture helper.
+
+    ``weights`` are per-tier MEMBER counts (each member placed by its
+    ring mean ``rtt_ms``), trailing empty tiers trimmed — exactly the
+    ``rtt_tier_weights`` the sim's ``measured_ring`` topology consumes
+    (``bench.py --frontier --topology measured_ring``).  Members with
+    no RTT samples yet are reported separately, not binned."""
+    nodes = []
+    counts = [0] * (len(edges) + 1)
+    unsampled = 0
+    for m in members.all():
+        rtt = m.rtt_ms
+        if rtt is None:
+            unsampled += 1
+            continue
+        tier = rtt_tier_of(rtt, edges)
+        counts[tier - 1] += 1
+        nodes.append({
+            "actor": m.actor_id.hex(),
+            "rtt_ms": round(rtt, 3),
+            "samples": len(m.rtts or ()),
+            "tier": tier,
+            "ring0": m.is_ring0,
+        })
+    last = 0
+    for t, c in enumerate(counts, start=1):
+        if c:
+            last = t
+    weights = counts[:last] if last else []
+    return {
+        "topology": "measured_ring",
+        "tier_edges_ms": list(edges),
+        "rtt_tiers": len(weights),
+        "weights": weights,
+        "members_sampled": len(nodes),
+        "members_unsampled": unsampled,
+        "nodes": nodes,
+    }

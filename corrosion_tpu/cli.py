@@ -135,6 +135,32 @@ def cmd_admin(args, command: str, **kwargs) -> int:
     return 0
 
 
+def cmd_rtt_dump(args) -> int:
+    """Export this node's Members RTT-ring tier distribution as
+    measured-topology JSON (``bench.py --frontier --topology
+    measured_ring`` consumes it directly)."""
+    client = _admin(args)
+    try:
+        kwargs = {}
+        if args.tier_edges_ms:
+            kwargs["tier_edges_ms"] = [
+                float(e) for e in args.tier_edges_ms.split(",")
+            ]
+        # call() returns the unwrapped ``ok`` payload and raises on error
+        doc = client.call("rtt_dump", **kwargs)
+    finally:
+        client.close()
+    text = json.dumps(doc, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"wrote {args.out} ({doc['members_sampled']} members, "
+              f"{doc['rtt_tiers']} tiers)")
+    else:
+        print(text)
+    return 0
+
+
 def cmd_template(args) -> int:
     from corrosion_tpu.tpl import render_loop, render_once
 
@@ -212,6 +238,21 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(
         fn=lambda a: cmd_admin(a, "cluster_set_id", cluster_id=a.cluster_id)
     )
+
+    rtt = sub.add_parser(
+        "rtt", help="Members RTT-ring topology tools"
+    ).add_subparsers(dest="sub", required=True)
+    sp = rtt.add_parser(
+        "dump",
+        help="export the RTT tier distribution as measured-topology "
+        "JSON (bench.py --frontier --topology measured_ring)",
+    )
+    sp.add_argument("--out", default=None,
+                    help="write JSON here instead of stdout")
+    sp.add_argument("--tier-edges-ms", default=None,
+                    help="comma-separated tier edges in ms "
+                    "(default: 6,12,24,48,96)")
+    sp.set_defaults(fn=cmd_rtt_dump)
 
     syncp = sub.add_parser("sync").add_subparsers(dest="sub", required=True)
     sp = syncp.add_parser("generate")

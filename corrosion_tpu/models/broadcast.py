@@ -68,19 +68,53 @@ class BroadcastParams:
     #   top of ``loss`` (long-RTT datagram timeouts).  Anti-entropy
     #   sessions cross unharmed (QUIC streams with retries) — see
     #   models/sync.py.  ``uniform`` executes the pre-topology path.
+    # - measured_ring: het_ring with a DATA-DRIVEN tier map — node
+    #   tiers follow ``rtt_tier_weights``, the per-tier node-count
+    #   weights of a measured Members RTT-ring distribution
+    #   (``corro admin rtt dump`` / ``capture_rtt_topology``).
     topology: str = "uniform"
     rtt_tiers: int = 4
     wan_blocks: int = 2
     wan_cross_loss: float = 0.25
+    # measured_ring only; a tuple so the params stay hashable
+    rtt_tier_weights: Optional[tuple] = None
 
     @property
     def fanout(self) -> int:
         return self.fanout_ring0 + self.fanout_global
 
 
+def measured_tier_map(n: int, weights) -> jnp.ndarray:
+    """[n] int32 tier map (1..len(weights)) from measured per-tier
+    node-count weights: tier t covers the next ``round(n *
+    weights[t-1] / sum)`` ids of the ring.  Plain numpy cumsum/
+    searchsorted over STATIC inputs, so under jit it constant-folds —
+    the shared tier-map core of the perm kernel's ``measured_ring``
+    and the exact kernels' (sim/calibrate.py ``_rtt_tier_of``)."""
+    import numpy as np
+
+    w = np.asarray(weights, np.float64)
+    if w.ndim != 1 or w.size < 1 or (w < 0).any() or w.sum() <= 0:
+        raise ValueError(
+            "measured tier weights must be a non-empty 1-D sequence "
+            "of non-negative values with a positive sum"
+        )
+    bounds = np.ceil(np.cumsum(w) / w.sum() * n).astype(np.int64)
+    bounds[-1] = n  # guard the float tail: the last tier always closes
+    tiers = 1 + np.searchsorted(bounds, np.arange(n), side="right")
+    return jnp.asarray(tiers, jnp.int32)
+
+
 def _rtt_tier(params: "BroadcastParams"):
-    """[N] int32 het_ring RTT tier (1..rtt_tiers, universe-local), or
-    None on other topologies — static arithmetic, constant-folds."""
+    """[N] int32 RTT tier of the het_ring (synthetic 1..rtt_tiers
+    ramp) or measured_ring (data-driven weights) topology,
+    universe-local, or None on other topologies — static arithmetic,
+    constant-folds."""
+    if params.topology == "measured_ring":
+        u = params.universe or params.n_nodes
+        per_u = measured_tier_map(u, params.rtt_tier_weights)
+        reps = -(-params.n_nodes // u)
+        return jnp.tile(per_u, reps)[: params.n_nodes]
     if params.topology != "het_ring":
         return None
     u = params.universe or params.n_nodes

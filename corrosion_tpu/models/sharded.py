@@ -44,13 +44,32 @@ from jax.sharding import PartitionSpec as P
 from corrosion_tpu.models.broadcast import BroadcastParams
 from corrosion_tpu.ops.merge import merge_keys
 
-def gather_nodes(x_l, axis: int = 0):
+def gather_nodes(x_l, axis: int = 0, axis_name: str = "nodes"):
     """Reassemble a node-sharded leaf: tiled ``all_gather`` over the
-    mesh's ``nodes`` axis, concatenating the shard blocks back along
+    mesh's ``nodes`` axis (or another named axis, e.g. the multi-host
+    kernel's ``hosts``), concatenating the shard blocks back along
     ``axis`` in device order (the inverse of the P(..., "nodes", ...)
     row split).  Shared by the broadcast fabrics here and the sharded
     exact rejection sampler (sim/calibrate.py)."""
-    return jax.lax.all_gather(x_l, "nodes", axis=axis, tiled=True)
+    return jax.lax.all_gather(x_l, axis_name, axis=axis, tiled=True)
+
+
+def _pack_bits(mask):
+    """Bitpack a [..., M] bool mask (M % 8 == 0) into [..., M//8]
+    uint8 wire bytes, LSB-first within each byte — the encoding the
+    multi-host frontier kernel puts on the fabric so a validity delta
+    costs one BIT per node-row instead of one bool byte."""
+    m = mask.shape[-1]
+    lanes = mask.reshape(mask.shape[:-1] + (m // 8, 8)).astype(jnp.uint8)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    return jnp.sum(lanes << shifts, axis=-1, dtype=jnp.uint8)
+
+
+def _unpack_bits(wire, m: int):
+    """Inverse of ``_pack_bits``: [..., M//8] uint8 -> [..., M] bool."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (wire[..., None] >> shifts) & jnp.uint8(1)
+    return bits.reshape(wire.shape[:-1] + (m,)).astype(bool)
 
 
 def _shard_map(f, mesh, in_specs, out_specs):
@@ -326,17 +345,21 @@ def sharded_frontier_exact_step(mesh, cfg):
 
 
 def _sharded_frontier_tick_local(infected, tx, next_send, ring_l, msgs,
-                                 ticks, keys, cfg, writer: int = 0):
+                                 ticks, pending, keys, cfg,
+                                 writer: int = 0):
     """One frontier tick on ONE shard for a seed batch.
 
-    Shapes: infected/tx/next_send/msgs [S, N] REPLICATED (identical on
-    every shard); ring_l [S, n_local, cap] my shard's ring rows; ticks
-    [S] lockstep; keys [S, 2] per-seed tick keys.  Consumes the RNG
-    stream in exactly ``packed_exact_tick``'s order (replicated integer
-    draws, the fabric idiom above)."""
+    Shapes: infected/tx/next_send/msgs/pending [S, N] REPLICATED
+    (identical on every shard); ring_l [S, n_local, cap] my shard's
+    ring rows; ticks [S] lockstep; keys [S, 2] per-seed tick keys.
+    Consumes the RNG stream in exactly ``packed_exact_tick``'s order
+    (replicated integer draws, the fabric idiom above)."""
     from corrosion_tpu.sim.calibrate import (
         _backoff_next_send,
         _frontier_invalid,
+        _latency_promote,
+        _latency_region_of,
+        _latency_split,
         _partition_of,
         _sync_pull,
         _wan_filter,
@@ -355,6 +378,11 @@ def _sharded_frontier_tick_local(infected, tx, next_send, ring_l, msgs,
     def slice_l(x):  # [S, n] -> my [S, n_local] block
         return jax.lax.dynamic_slice_in_dim(x, my_lo, n_local, axis=1)
 
+    # WAN queue promotion — fully replicated, like every dense leaf here
+    if _latency_region_of(cfg) is not None:
+        infected, tx, next_send, pending = _latency_promote(
+            infected, tx, next_send, pending, ticks[:, None], cfg
+        )
     active = infected & (tx > 0) & (next_send <= ticks[:, None])  # [S, N]
     part = _partition_of(cfg)
     part_active = ticks < cfg.heal_tick  # [S]
@@ -363,7 +391,7 @@ def _sharded_frontier_tick_local(infected, tx, next_send, ring_l, msgs,
     k_draw, k_loss, k_sync = ks[:, 0], ks[:, 1], ks[:, 2]
 
     def do_broadcast(args):
-        infected, tx, next_send, ring_l, msgs = args
+        infected, tx, next_send, ring_l, msgs, pending = args
 
         def draw(r):
             return jax.vmap(
@@ -409,6 +437,9 @@ def _sharded_frontier_tick_local(infected, tx, next_send, ring_l, msgs,
                 & part_active[:, None, None]
             )
         delivered = _wan_filter(delivered, cand, k_loss, cfg)
+        delivered, queued = _latency_split(delivered, cand, ticks, cfg)
+        if queued is not None:
+            pending = jnp.minimum(pending, queued)
 
         # delivery is replicated: every shard commits the same scatter
         tgt = jnp.where(delivered, cand, n).reshape(S, n * k)
@@ -436,11 +467,11 @@ def _sharded_frontier_tick_local(infected, tx, next_send, ring_l, msgs,
             active, learned, tx, next_send, ticks[:, None], cfg
         )
         tx = jnp.where(learned, cfg.max_transmissions, tx)
-        return new_infected, tx, next_send, new_ring_l, msgs
+        return new_infected, tx, next_send, new_ring_l, msgs, pending
 
-    infected, tx, next_send, ring_l, msgs = jax.lax.cond(
+    infected, tx, next_send, ring_l, msgs, pending = jax.lax.cond(
         jnp.any(active), do_broadcast, lambda args: args,
-        (infected, tx, next_send, ring_l, msgs),
+        (infected, tx, next_send, ring_l, msgs, pending),
     )
 
     if cfg.sync_interval > 0:
@@ -468,7 +499,7 @@ def _sharded_frontier_tick_local(infected, tx, next_send, ring_l, msgs,
             (infected, msgs),
         )
 
-    return infected, tx, next_send, ring_l, msgs, ticks + 1
+    return infected, tx, next_send, ring_l, msgs, ticks + 1, pending
 
 
 @lru_cache(maxsize=8)
@@ -498,6 +529,296 @@ def make_sharded_frontier_chunk(mesh, cfg):
             keys_t = jax.vmap(jax.random.fold_in)(seed_keys, carry[5])
             nxt = _sharded_frontier_tick_local(*carry, keys_t, cfg)
             msgs_f = nxt[4].astype(jnp.float32)
+            return nxt, (
+                jnp.all(nxt[0], axis=1),
+                jnp.mean(msgs_f, axis=1),
+                jnp.percentile(msgs_f, 99, axis=1),
+            )
+
+        carry, stats = jax.lax.scan(
+            body, tuple(state), xs=None, length=cfg.chunk_ticks,
+        )
+        return FrontierExactState(*carry), stats
+
+    return jax.jit(
+        _shard_map(
+            local_chunk, mesh,
+            in_specs=(specs, P()),
+            out_specs=(specs, (P(), P(), P())),
+        ),
+        donate_argnums=(0,),
+    )
+
+
+def _check_host_mesh(mesh, cfg):
+    h = mesh.shape["hosts"]
+    if cfg.n_nodes % (8 * h) != 0:
+        raise ValueError(
+            f"n_nodes {cfg.n_nodes} must divide over {h} hosts into "
+            "byte-aligned rows (n_nodes % (8 * n_hosts) == 0) for the "
+            "bitpacked delta exchange"
+        )
+    return h
+
+
+def _sharded_frontier_host_tick_local(infected, tx_l, next_send_l,
+                                      ring_l, msgs_l, ticks, pending,
+                                      keys, cfg, writer: int = 0):
+    """One frontier tick on ONE HOST of the multi-host mesh for a seed
+    batch — the TeraAgent-style delta-only exchange layer.
+
+    Layout (``_frontier_host_specs``): tx_l/next_send_l/msgs_l
+    [S, n_local] and ring_l [S, n_local, cap] are MY HOST'S row shard;
+    infected/pending [S, N] are REPLICATED BY CONSTRUCTION — every
+    host derives the identical full-width delivery commit, queue
+    update and sync heal from the replicated candidate tuples and
+    draws, so they never cross the fabric.
+
+    The ONLY cross-host traffic per tick is the rejection loop's
+    bitpacked validity deltas (one bit per owned row, 8 rows/byte):
+
+    * round 0 — each host's ``active`` frontier bits (which of its
+      rows draw a tuple this tick; this is also the emptiness signal
+      that gates the whole phase);
+    * round r — each host's still-bad bits (which of its rows'
+      replicated tuples failed its LOCAL ring test).
+
+    No ring rows, no infected masks, and NOTHING on sync rounds ever
+    crosses.  Bitwise identical per seed to the single-host
+    ``frontier_exact_tick`` (tests/test_sharding.py pins it across the
+    headline shape and both measured topology families, with a
+    seeded-corruption negative control)."""
+    from corrosion_tpu.sim.calibrate import (
+        LATENCY_NONE,
+        _backoff_next_send,
+        _frontier_invalid,
+        _latency_region_of,
+        _latency_split,
+        _partition_of,
+        _rtt_tier_of,
+        _sync_pull,
+        _wan_filter,
+    )
+
+    n, k = cfg.n_nodes, cfg.fanout
+    S = infected.shape[0]
+    n_local = ring_l.shape[1]
+    cap = ring_l.shape[2]
+    host = jax.lax.axis_index("hosts")
+    my_lo = host * n_local
+    idx_l = my_lo + jnp.arange(n_local, dtype=jnp.int32)
+    s_rows = jnp.arange(S, dtype=jnp.int32)
+
+    def slice_l(x):  # [S, n] -> my [S, n_local] block
+        return jax.lax.dynamic_slice_in_dim(x, my_lo, n_local, axis=1)
+
+    def exchange(mask_l):
+        """[S, n_local] bool -> [S, n] bool — the ONLY cross-host op:
+        one tiled all_gather of bitpacked delta bytes."""
+        wire = gather_nodes(
+            _pack_bits(mask_l), axis=1, axis_name="hosts"
+        )
+        return _unpack_bits(wire, n)
+
+    # WAN queue promotion: due/arrived derive from the REPLICATED
+    # infected+pending, so every host computes them identically and
+    # applies the slice to its own sharded rows — zero exchange
+    if _latency_region_of(cfg) is not None:
+        due = pending <= ticks[:, None]
+        arrived = due & ~infected
+        tier = _rtt_tier_of(cfg)
+        first_l = 1 if tier is None else tier[idx_l]
+        arrived_l = slice_l(arrived)
+        tx_l = jnp.where(arrived_l, cfg.max_transmissions, tx_l)
+        next_send_l = jnp.where(
+            arrived_l, ticks[:, None] + first_l, next_send_l
+        )
+        infected = infected | arrived
+        pending = jnp.where(due, LATENCY_NONE, pending)
+
+    active_l = (
+        slice_l(infected) & (tx_l > 0) & (next_send_l <= ticks[:, None])
+    )
+    active = exchange(active_l)  # round-0 delta: my frontier bits
+    part = _partition_of(cfg)
+    part_active = ticks < cfg.heal_tick  # [S]
+
+    ks = jax.vmap(lambda kk: jax.random.split(kk, 3))(keys)
+    k_draw, k_loss, k_sync = ks[:, 0], ks[:, 1], ks[:, 2]
+
+    def do_broadcast(args):
+        infected, tx_l, next_send_l, ring_l, msgs_l, pending = args
+
+        def draw(r):
+            return jax.vmap(
+                lambda kd: jax.random.randint(
+                    jax.random.fold_in(kd, r), (n, k), 0, n
+                )
+            )(k_draw)  # [S, n, k] replicated
+
+        def invalid_local(cand):
+            """[S, n_local]: my rows' invalid bits — the per-round
+            validity DELTA, bitpacked onto the fabric by
+            ``exchange``."""
+            cand_l = jax.lax.dynamic_slice_in_dim(
+                cand, my_lo, n_local, 1
+            )
+            return _frontier_invalid(cfg, ring_l, idx_l, cand_l, writer)
+
+        cand = draw(0)
+        bad = exchange(invalid_local(cand) & active_l)  # [S, n]
+
+        def cond(carry):
+            _, bad, _ = carry
+            return jnp.any(bad)
+
+        def body(carry):
+            cand, bad, r = carry
+            cand = jnp.where(bad[:, :, None], draw(r), cand)
+            bad_l = invalid_local(cand) & slice_l(bad)
+            return cand, exchange(bad_l), r + 1
+
+        cand, _, _ = jax.lax.while_loop(
+            cond, body, (cand, bad, jnp.int32(1))
+        )
+
+        delivered = jnp.broadcast_to(active[:, :, None], (S, n, k))
+        if cfg.loss > 0.0:
+            keep = jax.vmap(
+                lambda kl: jax.random.uniform(kl, (n, k))
+            )(k_loss) >= cfg.loss
+            delivered &= keep
+        if part is not None:
+            delivered &= ~(
+                (part[None, :, None] != part[cand])
+                & part_active[:, None, None]
+            )
+        delivered = _wan_filter(delivered, cand, k_loss, cfg)
+        delivered, queued = _latency_split(delivered, cand, ticks, cfg)
+        if queued is not None:
+            pending = jnp.minimum(pending, queued)
+
+        # delivery commit is replicated arithmetic on replicated
+        # operands — every host runs the same scatter, zero exchange
+        tgt = jnp.where(delivered, cand, n).reshape(S, n * k)
+        new_infected = (
+            infected.at[s_rows[:, None], tgt].set(True, mode="drop")
+        )
+
+        # mark on send — sender-local rows into MY ring shard
+        cand_l = jax.lax.dynamic_slice_in_dim(cand, my_lo, n_local, 1)
+        send_base = (cfg.max_transmissions - tx_l) * k
+        slot = send_base[:, :, None] + jnp.arange(k, dtype=jnp.int32)
+        slot = jnp.where(active_l[:, :, None], slot, cap)
+        new_ring_l = ring_l.at[
+            s_rows[:, None, None],
+            jnp.arange(n_local, dtype=jnp.int32)[None, :, None],
+            slot,
+        ].set(cand_l, mode="drop")
+        msgs_l = msgs_l + jnp.where(active_l, k, 0)
+
+        tx_l = jnp.where(active_l, tx_l - 1, tx_l)
+        learned_l = slice_l(new_infected & ~infected)
+        next_send_l = _backoff_next_send(
+            active_l, learned_l, tx_l, next_send_l, ticks[:, None],
+            cfg, idx=idx_l,
+        )
+        tx_l = jnp.where(learned_l, cfg.max_transmissions, tx_l)
+        return (new_infected, tx_l, next_send_l, new_ring_l, msgs_l,
+                pending)
+
+    infected, tx_l, next_send_l, ring_l, msgs_l, pending = jax.lax.cond(
+        jnp.any(active), do_broadcast, lambda args: args,
+        (infected, tx_l, next_send_l, ring_l, msgs_l, pending),
+    )
+
+    if cfg.sync_interval > 0:
+        # sync rounds are EXCHANGE-FREE: infected is already replicated
+        # (the dense fabric all_gathered it here; the host layer never
+        # moves it), peers are replicated draws, and each host keeps
+        # only its own rows of the session pay
+        def do_sync(args):
+            infected, msgs_l = args
+            p = cfg.sync_peers
+            peers = jax.vmap(
+                lambda kk: jax.random.randint(kk, (n, p), 0, n)
+            )(k_sync)  # [S, n, p] replicated
+            reachable = jnp.ones((S, n, p), bool)
+            if part is not None:
+                reachable &= ~(
+                    (part[None, :, None] != part[peers])
+                    & part_active[:, None, None]
+                )
+            healed, pay = _sync_pull(infected, peers, reachable, cfg)
+            return infected | healed, msgs_l + slice_l(pay)
+
+        infected, msgs_l = jax.lax.cond(
+            ticks[0] % cfg.sync_interval == cfg.sync_interval - 1,
+            do_sync,
+            lambda args: args,
+            (infected, msgs_l),
+        )
+
+    return (infected, tx_l, next_send_l, ring_l, msgs_l, ticks + 1,
+            pending)
+
+
+@lru_cache(maxsize=8)
+def sharded_frontier_host_step(mesh, cfg):
+    """Jitted multi-host frontier tick: ``step(state, keys) -> state``
+    on GLOBAL seed-batched FrontierExactState arrays laid out per
+    ``frontier_host_shardings`` (``mesh`` carries a ``hosts`` axis).
+    Cross-host traffic per tick is ONLY the rejection loop's bitpacked
+    validity deltas — see ``_sharded_frontier_host_tick_local``."""
+    from corrosion_tpu.sim.calibrate import (
+        FrontierExactState,
+        _frontier_host_specs,
+    )
+
+    _check_host_mesh(mesh, cfg)
+    specs = _frontier_host_specs()
+
+    def local(state, keys):
+        out = _sharded_frontier_host_tick_local(*state, keys, cfg)
+        return FrontierExactState(*out)
+
+    return jax.jit(
+        _shard_map(
+            local, mesh,
+            in_specs=(specs, P()),
+            out_specs=specs,
+        )
+    )
+
+
+@lru_cache(maxsize=8)
+def make_sharded_frontier_host_chunk(mesh, cfg):
+    """Jitted multi-host frontier scan chunk: ``chunk(state,
+    seed_keys) -> (state', (conv [C, S], msgs_mean [C, S], msgs_p99
+    [C, S]))`` — the host-axis twin of ``make_sharded_frontier_chunk``
+    (donated state for in-place pipelining; cached by (mesh, cfg)).
+
+    Convergence flags come free from the replicated ``infected``.  The
+    per-tick msgs stats DO gather the sharded [S, n_local] msgs leaf —
+    that is MEASUREMENT-plane instrumentation, not protocol exchange
+    (the protocol contract stays delta-only; stats run on the gathered
+    full array so the float reductions are bitwise the single-host
+    oracle's)."""
+    from corrosion_tpu.sim.calibrate import (
+        FrontierExactState,
+        _frontier_host_specs,
+    )
+
+    _check_host_mesh(mesh, cfg)
+    specs = _frontier_host_specs()
+
+    def local_chunk(state, seed_keys):
+        def body(carry, _):
+            keys_t = jax.vmap(jax.random.fold_in)(seed_keys, carry[5])
+            nxt = _sharded_frontier_host_tick_local(*carry, keys_t, cfg)
+            msgs_f = gather_nodes(
+                nxt[4], axis=1, axis_name="hosts"
+            ).astype(jnp.float32)
             return nxt, (
                 jnp.all(nxt[0], axis=1),
                 jnp.mean(msgs_f, axis=1),

@@ -271,19 +271,49 @@ class HeadlineExactConfig:
     #   ``wan_cross_loss`` on top of ``loss`` (long-RTT datagram
     #   timeouts), while anti-entropy sessions cross unharmed (the
     #   reference syncs over QUIC streams with retries).
+    # - ``measured_ring``: het_ring with a DATA-DRIVEN tier map — node
+    #   tiers follow the node-count weights of a measured ``Members``
+    #   RTT-ring distribution (``corro admin rtt dump`` /
+    #   ``capture_rtt_topology``) instead of the synthetic linear ramp.
     # ``uniform`` executes exactly the pre-topology code path.
     topology: str = "uniform"
     rtt_tiers: int = 4
     wan_blocks: int = 2
     wan_cross_loss: float = 0.25
+    # measured_ring only: per-tier node-count weights (tier t gets
+    # weights[t-1]/sum of the id ring).  A tuple so the config stays
+    # hashable (static jit arg / lru_cache key).
+    rtt_tier_weights: Optional[tuple] = None
+    # wan_two_region only: cross-region sends that survive loss are
+    # DELAYED this many ticks (tick-quantized WAN latency queue) instead
+    # of committing immediately.  0 = immediate delivery, bitwise the
+    # pre-latency kernel.
+    wan_latency_ticks: int = 0
 
     def __post_init__(self):
-        if self.topology not in ("uniform", "het_ring", "wan_two_region"):
+        if self.topology not in (
+            "uniform", "het_ring", "wan_two_region", "measured_ring"
+        ):
             raise ValueError(f"unknown topology {self.topology!r}")
         if self.topology == "het_ring" and self.rtt_tiers < 1:
             raise ValueError("het_ring needs rtt_tiers >= 1")
         if self.topology == "wan_two_region" and self.wan_blocks < 2:
             raise ValueError("wan_two_region needs wan_blocks >= 2")
+        if self.topology == "measured_ring":
+            w = self.rtt_tier_weights
+            if not w or any(x < 0 for x in w) or sum(w) <= 0:
+                raise ValueError(
+                    "measured_ring needs rtt_tier_weights: a non-empty "
+                    "tuple of non-negative per-tier node weights with a "
+                    "positive sum (corro admin rtt dump emits one)"
+                )
+        if self.wan_latency_ticks < 0:
+            raise ValueError("wan_latency_ticks must be >= 0")
+        if self.wan_latency_ticks > 0 and self.topology != "wan_two_region":
+            raise ValueError(
+                "wan_latency_ticks needs the wan_two_region topology "
+                "(latency is a property of the cross-region links)"
+            )
         # rejection sampling needs the excluded set to stay far below N
         # (it also guarantees coverage never exhausts, so the retire
         # path of the small-N kernels cannot trigger)
@@ -298,6 +328,12 @@ class HeadlineExactConfig:
             )
 
 
+# int32 sentinel for the WAN latency queue: "no delivery in flight".
+# Strictly above any reachable tick, strictly below int32 overflow
+# headroom (tick + wan_latency_ticks never wraps).
+LATENCY_NONE = (1 << 30) - 1
+
+
 class PackedExactState(NamedTuple):
     infected: jnp.ndarray  # [N] bool
     tx: jnp.ndarray  # [N] int32 remaining transmissions
@@ -305,6 +341,11 @@ class PackedExactState(NamedTuple):
     sent: jnp.ndarray  # [N, ceil(N/8)] uint8 bitpacked sent_to
     msgs: jnp.ndarray  # [N] int32 (broadcast + sync session msgs)
     tick: jnp.ndarray  # scalar int32
+    # [N] int32 WAN latency queue: earliest tick a queued cross-region
+    # delivery for this node lands (LATENCY_NONE = nothing in flight).
+    # Appended LAST so the positional leaf order the chunk builders
+    # index (tick at [5]) is unchanged.
+    pending: jnp.ndarray
 
 
 def packed_exact_init(
@@ -343,7 +384,8 @@ def packed_exact_init(
         sent = sent.at[writer].set(row)
         msgs = msgs.at[writer].add(in_tier.sum().astype(jnp.int32))
     return PackedExactState(
-        infected, tx, next_send, sent, msgs, jnp.zeros((), jnp.int32)
+        infected, tx, next_send, sent, msgs, jnp.zeros((), jnp.int32),
+        jnp.full((n,), LATENCY_NONE, jnp.int32),
     )
 
 
@@ -355,9 +397,14 @@ def _partition_of(cfg: HeadlineExactConfig):
 
 
 def _rtt_tier_of(cfg: HeadlineExactConfig):
-    """[N] int32 RTT tier (1..rtt_tiers) of the het_ring topology, or
-    None on other topologies.  Static arithmetic, so under jit it
-    constant-folds into the compiled tick."""
+    """[N] int32 RTT tier of the het_ring (synthetic linear ramp,
+    1..rtt_tiers) or measured_ring (data-driven node-count weights)
+    topology, or None on other topologies.  Static arithmetic, so under
+    jit it constant-folds into the compiled tick."""
+    if cfg.topology == "measured_ring":
+        from corrosion_tpu.models.broadcast import measured_tier_map
+
+        return measured_tier_map(cfg.n_nodes, cfg.rtt_tier_weights)
     if cfg.topology != "het_ring":
         return None
     idx = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
@@ -370,6 +417,75 @@ def _region_of(cfg: HeadlineExactConfig):
         return None
     idx = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
     return (idx * cfg.wan_blocks) // cfg.n_nodes
+
+
+def _latency_region_of(cfg: HeadlineExactConfig):
+    """[N] int32 region map for the WAN LATENCY queue, else None.
+    Distinct from ``_region_of`` (the extra cross-region LOSS filter,
+    gated on ``wan_cross_loss``) so the latency family runs with
+    cross-region loss at zero — and so that at ``wan_latency_ticks=0``
+    every queue op compiles out and the kernels are bitwise the
+    pre-latency code (tests/test_frontier.py pins it)."""
+    if cfg.topology != "wan_two_region" or cfg.wan_latency_ticks <= 0:
+        return None
+    idx = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
+    return (idx * cfg.wan_blocks) // cfg.n_nodes
+
+
+def _latency_promote(infected, tx, next_send, pending, tick,
+                     cfg: HeadlineExactConfig, idx=None):
+    """Commit due WAN-queue arrivals at the START of a tick, before the
+    active set is computed (shared by every exact kernel).  An arrival
+    behaves exactly like a learner: fresh budget, first forward after
+    its tier's worth of ticks; an arrival at an already-infected node
+    is a duplicate and only clears the queue slot.  ``idx`` slices the
+    tier to the caller's rows when its leaves are row-sharded (same
+    contract as ``_backoff_next_send``).  Returns ``(infected, tx,
+    next_send, pending)``."""
+    due = pending <= tick
+    arrived = due & ~infected
+    tier = _rtt_tier_of(cfg)
+    first = 1 if tier is None else (tier if idx is None else tier[idx])
+    infected = infected | arrived
+    tx = jnp.where(arrived, cfg.max_transmissions, tx)
+    next_send = jnp.where(arrived, tick + first, next_send)
+    pending = jnp.where(due, LATENCY_NONE, pending)
+    return infected, tx, next_send, pending
+
+
+def _latency_split(delivered, cand, tick, cfg: HeadlineExactConfig):
+    """Split a post-loss [..., N, K] delivered mask into immediate
+    commits and WAN-queued arrivals.  Returns ``(delivered_now,
+    queued)`` where ``queued`` is a [..., N] int32 per-target earliest
+    arrival tick (``tick + wan_latency_ticks``; LATENCY_NONE where
+    nothing was queued this tick) for the caller to fold in with
+    ``jnp.minimum(pending, queued)`` — a scatter-MIN, so no in-flight
+    delivery is ever dropped, later duplicates just collapse onto the
+    earliest arrival.  ``queued`` is None when the latency family is
+    off (the zero-latency identity: no queue op exists to disturb the
+    trajectory)."""
+    region = _latency_region_of(cfg)
+    if region is None:
+        return delivered, None
+    n = cfg.n_nodes
+    src = region.reshape((1,) * (cand.ndim - 2) + (n, 1))
+    delayed = delivered & (src != region[cand])
+    batch = cand.shape[:-2]
+    B = 1
+    for d in batch:
+        B *= d
+    # column n is the dump slot for non-delayed lanes
+    tgt = jnp.where(delayed, cand, n).reshape(B, -1)
+    arrival = (
+        jnp.asarray(tick, jnp.int32).reshape(B, 1)
+        + cfg.wan_latency_ticks
+    )
+    queued = (
+        jnp.full((B, n + 1), LATENCY_NONE, jnp.int32)
+        .at[jnp.arange(B, dtype=jnp.int32)[:, None], tgt]
+        .min(jnp.broadcast_to(arrival, tgt.shape))
+    )[:, :n].reshape(batch + (n,))
+    return delivered & ~delayed, queued
 
 
 def _wan_filter(delivered, cand, k_loss, cfg: HeadlineExactConfig):
@@ -473,8 +589,12 @@ def packed_exact_tick(
 ) -> PackedExactState:
     n, k = cfg.n_nodes, cfg.fanout
     nb = state.sent.shape[1]
-    infected, tx, next_send, sent, msgs, tick = state
+    infected, tx, next_send, sent, msgs, tick, pending = state
     idx = jnp.arange(n, dtype=jnp.int32)
+    if _latency_region_of(cfg) is not None:
+        infected, tx, next_send, pending = _latency_promote(
+            infected, tx, next_send, pending, tick, cfg
+        )
     active = infected & (tx > 0) & (next_send <= tick)
     part = _partition_of(cfg)
     part_active = tick < cfg.heal_tick
@@ -516,6 +636,9 @@ def packed_exact_tick(
     if part is not None:
         delivered &= ~((part[:, None] != part[cand]) & part_active)
     delivered = _wan_filter(delivered, cand, k_loss, cfg)
+    delivered, queued = _latency_split(delivered, cand, tick, cfg)
+    if queued is not None:
+        pending = jnp.minimum(pending, queued)
 
     new_infected = infected.at[
         jnp.where(delivered, cand, n).reshape(-1)
@@ -560,7 +683,7 @@ def packed_exact_tick(
         )
 
     return PackedExactState(
-        new_infected, tx, next_send, new_sent, msgs, tick + 1
+        new_infected, tx, next_send, new_sent, msgs, tick + 1, pending
     )
 
 
@@ -641,7 +764,8 @@ def _packed_scan_chunk_batch(state: PackedExactState, seed_keys,
 
 
 def _sharded_tick_local(infected_l, tx_l, next_send_l, sent_l, msgs_l,
-                        ticks, keys, cfg: HeadlineExactConfig):
+                        ticks, pending_l, keys,
+                        cfg: HeadlineExactConfig):
     """One exact-sampler tick on ONE shard's rows for a seed batch.
 
     Shapes (S = seed batch, n_local = N / D shards):
@@ -670,6 +794,11 @@ def _sharded_tick_local(infected_l, tx_l, next_send_l, sent_l, msgs_l,
     def slice_l(x):  # [S, n] -> my [S, n_local] block
         return jax.lax.dynamic_slice_in_dim(x, my_lo, n_local, axis=1)
 
+    if _latency_region_of(cfg) is not None:
+        infected_l, tx_l, next_send_l, pending_l = _latency_promote(
+            infected_l, tx_l, next_send_l, pending_l, ticks[:, None],
+            cfg, idx=idx_l,
+        )
     active_l = infected_l & (tx_l > 0) & (next_send_l <= ticks[:, None])
     active = gather_nodes(active_l, axis=1)  # [S, n]
     part = _partition_of(cfg)
@@ -728,6 +857,10 @@ def _sharded_tick_local(infected_l, tx_l, next_send_l, sent_l, msgs_l,
             & part_active[:, None, None]
         )
     delivered = _wan_filter(delivered, cand, k_loss, cfg)
+    delivered, queued = _latency_split(delivered, cand, ticks, cfg)
+    if queued is not None:
+        # full-width queue min is replicated arithmetic; fold my rows
+        pending_l = jnp.minimum(pending_l, slice_l(queued))
 
     # delivery: every shard knows every (replicated) tuple, so each
     # commits its own rows from one full-width scatter then slices
@@ -783,7 +916,7 @@ def _sharded_tick_local(infected_l, tx_l, next_send_l, sent_l, msgs_l,
         )
 
     return (new_infected_l, new_tx_l, new_next_send_l, new_sent_l,
-            new_msgs_l, ticks + 1)
+            new_msgs_l, ticks + 1, pending_l)
 
 
 def _exact_state_specs():
@@ -798,6 +931,7 @@ def _exact_state_specs():
         sent=P(None, "nodes", None),
         msgs=P(None, "nodes"),
         tick=P(),
+        pending=P(None, "nodes"),
     )
 
 
@@ -950,6 +1084,10 @@ class FrontierExactState(NamedTuple):
     ring: jnp.ndarray  # [N, cap] int32 sent-target ring (N = empty slot)
     msgs: jnp.ndarray  # [N] int32 (broadcast + sync session msgs)
     tick: jnp.ndarray  # scalar int32
+    # [N] int32 WAN latency queue (LATENCY_NONE = nothing in flight);
+    # appended LAST so tick stays at leaf index [5] for the chunk
+    # builders' positional indexing
+    pending: jnp.ndarray
 
 
 def frontier_exact_init(
@@ -978,7 +1116,8 @@ def frontier_exact_init(
         next_send = jnp.where(delivered, 1, next_send)
         msgs = msgs.at[writer].add(in_tier.sum().astype(jnp.int32))
     return FrontierExactState(
-        infected, tx, next_send, ring, msgs, jnp.zeros((), jnp.int32)
+        infected, tx, next_send, ring, msgs, jnp.zeros((), jnp.int32),
+        jnp.full((n,), LATENCY_NONE, jnp.int32),
     )
 
 
@@ -1024,8 +1163,15 @@ def frontier_exact_tick(
     ``writer`` must match the init's (the arithmetic ring0 tier)."""
     n, k = cfg.n_nodes, cfg.fanout
     cap = state.ring.shape[-1]
-    infected, tx, next_send, ring, msgs, tick = state
+    infected, tx, next_send, ring, msgs, tick, pending = state
     idx = jnp.arange(n, dtype=jnp.int32)
+    # queue arrivals promote OUTSIDE the frontier gate: an in-flight WAN
+    # delivery can revive an EMPTY frontier (everything local already
+    # spent its budget while the cross-region copy is still in the air)
+    if _latency_region_of(cfg) is not None:
+        infected, tx, next_send, pending = _latency_promote(
+            infected, tx, next_send, pending, tick, cfg
+        )
     active = infected & (tx > 0) & (next_send <= tick)
     part = _partition_of(cfg)
     part_active = tick < cfg.heal_tick
@@ -1033,7 +1179,7 @@ def frontier_exact_tick(
     k_draw, k_loss, k_sync = jax.random.split(key, 3)
 
     def do_broadcast(args):
-        infected, tx, next_send, ring, msgs = args
+        infected, tx, next_send, ring, msgs, pending = args
 
         def invalid_rows(cand):
             return _frontier_invalid(cfg, ring, idx, cand, writer)
@@ -1065,6 +1211,9 @@ def frontier_exact_tick(
         if part is not None:
             delivered &= ~((part[:, None] != part[cand]) & part_active)
         delivered = _wan_filter(delivered, cand, k_loss, cfg)
+        delivered, queued = _latency_split(delivered, cand, tick, cfg)
+        if queued is not None:
+            pending = jnp.minimum(pending, queued)
 
         new_infected = infected.at[
             jnp.where(delivered, cand, n).reshape(-1)
@@ -1085,14 +1234,14 @@ def frontier_exact_tick(
             active, learned, tx, next_send, tick, cfg
         )
         tx = jnp.where(learned, cfg.max_transmissions, tx)
-        return new_infected, tx, next_send, new_ring, msgs
+        return new_infected, tx, next_send, new_ring, msgs, pending
 
     # empty frontier => the whole draw/test/mark phase is a no-op in
     # the bitpacked kernel too (no draws are ever consumed: per-tick
     # keys are re-derived, not carried) — skip it
-    infected, tx, next_send, ring, msgs = jax.lax.cond(
+    infected, tx, next_send, ring, msgs, pending = jax.lax.cond(
         jnp.any(active), do_broadcast, lambda args: args,
-        (infected, tx, next_send, ring, msgs),
+        (infected, tx, next_send, ring, msgs, pending),
     )
 
     if cfg.sync_interval > 0:
@@ -1114,7 +1263,7 @@ def frontier_exact_tick(
         )
 
     return FrontierExactState(
-        infected, tx, next_send, ring, msgs, tick + 1
+        infected, tx, next_send, ring, msgs, tick + 1, pending
     )
 
 
@@ -1185,6 +1334,7 @@ def _frontier_state_specs():
         ring=P(None, "nodes", None),
         msgs=P(),
         tick=P(),
+        pending=P(),
     )
 
 
@@ -1199,21 +1349,93 @@ def frontier_shardings(mesh) -> FrontierExactState:
     )
 
 
+def _frontier_host_specs():
+    """PartitionSpecs for a seed-batched FrontierExactState on a
+    ``hosts`` mesh — the MULTI-HOST layout.  Every O(N) int32 leaf
+    (tx/next_send/msgs) row-shards alongside the ring: at N=10M the
+    dense per-node state is no longer small enough to replicate per
+    host.  ``infected`` and ``pending`` stay REPLICATED — but BY
+    CONSTRUCTION, not by exchange: every host derives the identical
+    full-width commit from the replicated candidate tuples and draws,
+    so they never cross the fabric.  The only cross-host traffic per
+    tick is the rejection loop's bitpacked validity deltas
+    (models/sharded.py ``_sharded_frontier_host_tick_local``)."""
+    from jax.sharding import PartitionSpec as P
+
+    return FrontierExactState(
+        infected=P(),
+        tx=P(None, "hosts"),
+        next_send=P(None, "hosts"),
+        ring=P(None, "hosts", None),
+        msgs=P(None, "hosts"),
+        tick=P(),
+        pending=P(),
+    )
+
+
+def frontier_host_shardings(mesh) -> FrontierExactState:
+    """NamedShardings for the multi-host frontier layout (one source
+    of truth with ``_frontier_host_specs``)."""
+    from jax.sharding import NamedSharding
+
+    return jax.tree.map(
+        lambda p: NamedSharding(mesh, p), _frontier_host_specs()
+    )
+
+
+def host_memory_budget_bytes(
+    n_hosts: int = 1, default: Optional[int] = None
+) -> Optional[int]:
+    """Per-host state budget derived from the machine's available RAM
+    (``/proc/meminfo`` MemAvailable), the way ``_device_bitmap_budget``
+    derives per-device HBM: half of what's available, split across the
+    ``n_hosts`` emulated on this machine (virtual hosts SHARE the one
+    RAM).  Returns ``default`` (None) when /proc/meminfo is unreadable
+    — callers fall back to their own constant."""
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    kib = int(line.split()[1])
+                    return (kib * 1024) // (2 * max(1, n_hosts))
+    except (OSError, ValueError, IndexError):
+        pass
+    return default
+
+
 def frontier_seed_batch(cfg: HeadlineExactConfig, n_seeds: int,
                         n_shards: int = 1,
-                        hbm_budget_bytes: Optional[int] = None) -> int:
+                        hbm_budget_bytes: Optional[int] = None,
+                        host_sharded: bool = False) -> int:
     """Seed-batching policy for the frontier kernel: the ring is the
     governing state at O(N * cap * 4) bytes per seed (vs the dense
     kernel's O(N^2/8) bitmap), so far more seeds fit the same budget.
-    Only the ring shards; the [S, N] dense leaves (~16 B/node) are
-    REPLICATED on every device (``_frontier_state_specs``), so their
-    term never divides by the shard count."""
+
+    Single-host mesh layout: only the ring shards; the [S, N] dense
+    leaves (20 B/node: tx/next_send/msgs/pending int32 + infected
+    bool) are REPLICATED on every device (``_frontier_state_specs``),
+    so their term never divides by the shard count.
+
+    ``host_sharded`` switches to the multi-host layout
+    (``_frontier_host_specs``): tx/next_send/msgs shard with the ring
+    (12 B/node over ``n_shards`` hosts) and only infected+pending
+    (5 B/node) replicate — and the default budget comes from HOST RAM
+    (``host_memory_budget_bytes``) the way ``_device_bitmap_budget``
+    derives HBM, because the sharded leaves now live in host memory on
+    every emulated host."""
     cap = frontier_ring_cap(cfg)
-    per_seed = (
-        (cfg.n_nodes // max(1, n_shards)) * cap * 4 + cfg.n_nodes * 16
-    )
-    budget = (DEFAULT_EXACT_HBM_BUDGET if hbm_budget_bytes is None
-              else hbm_budget_bytes)
+    shards = max(1, n_shards)
+    if host_sharded:
+        per_seed = (
+            (cfg.n_nodes // shards) * (cap * 4 + 12) + cfg.n_nodes * 5
+        )
+    else:
+        per_seed = (cfg.n_nodes // shards) * cap * 4 + cfg.n_nodes * 20
+    budget = hbm_budget_bytes
+    if budget is None and host_sharded:
+        budget = host_memory_budget_bytes(shards)
+    if budget is None:
+        budget = DEFAULT_EXACT_HBM_BUDGET
     fit = max(1, int(budget // max(1, 2 * per_seed)))
     return max(1, min(n_seeds, fit, 32))
 
@@ -1224,6 +1446,7 @@ def run_exact_headline(
     warm_chunks: Optional[int] = None,
     hbm_budget_bytes: Optional[int] = None,
     kernel: str = "dense",
+    host_sharded: bool = False,
 ) -> Dict:
     """Seed-parallel exact-sampler epidemics at headline scale.
 
@@ -1245,6 +1468,13 @@ def run_exact_headline(
     and tests/test_sharding.py); the result records which one ran under
     ``"kernel"`` (``sharded-`` prefixed when a mesh was used).
 
+    ``host_sharded`` (sparse kernel only, ``mesh`` must carry a
+    ``hosts`` axis) selects the MULTI-HOST frontier layout: every
+    O(N) int32 leaf row-shards over the host axis and the only
+    cross-host traffic per tick is the rejection loop's bitpacked
+    validity deltas.  The kernel tag becomes ``host-sparse`` and the
+    result records ``n_hosts``.
+
     Returns the same stat keys as ``run_epidemic_seeds`` (msgs/ticks at
     each seed's own convergence tick) with ``delivery_model: exact``.
     """
@@ -1253,16 +1483,33 @@ def run_exact_headline(
     if kernel not in ("dense", "sparse"):
         raise ValueError(f"unknown kernel {kernel!r}")
     sparse = kernel == "sparse"
+    if host_sharded and (not sparse or mesh is None):
+        raise ValueError(
+            "host_sharded needs kernel='sparse' and a mesh with a "
+            "'hosts' axis"
+        )
     t0 = time.perf_counter()
-    n_shards = int(mesh.shape["nodes"]) if mesh is not None else 1
-    batch_policy = frontier_seed_batch if sparse else exact_seed_batch
-    sb = seed_batch or batch_policy(
-        cfg, n_seeds, n_shards, hbm_budget_bytes
-    )
+    mesh_axis = "hosts" if host_sharded else "nodes"
+    n_shards = int(mesh.shape[mesh_axis]) if mesh is not None else 1
+    if sparse:
+        sb = seed_batch or frontier_seed_batch(
+            cfg, n_seeds, n_shards, hbm_budget_bytes,
+            host_sharded=host_sharded,
+        )
+    else:
+        sb = seed_batch or exact_seed_batch(
+            cfg, n_seeds, n_shards, hbm_budget_bytes
+        )
     init_fn = frontier_exact_init if sparse else packed_exact_init
     chunk_fn = None
     if mesh is not None:
-        if sparse:
+        if host_sharded:
+            from corrosion_tpu.models.sharded import (
+                make_sharded_frontier_host_chunk,
+            )
+
+            chunk_fn = make_sharded_frontier_host_chunk(mesh, cfg)
+        elif sparse:
             from corrosion_tpu.models.sharded import (
                 make_sharded_frontier_chunk,
             )
@@ -1292,11 +1539,13 @@ def run_exact_headline(
             lambda kk: init_fn(cfg, jax.random.fold_in(kk, 2**20))
         )(base_keys)
         if mesh is not None:
-            state = jax.device_put(
-                state,
-                frontier_shardings(mesh) if sparse
-                else exact_shardings(mesh),
-            )
+            if host_sharded:
+                shardings = frontier_host_shardings(mesh)
+            elif sparse:
+                shardings = frontier_shardings(mesh)
+            else:
+                shardings = exact_shardings(mesh)
+            state = jax.device_put(state, shardings)
         flags: List[np.ndarray] = []
         mm: List[np.ndarray] = []
         mp: List[np.ndarray] = []
@@ -1331,11 +1580,16 @@ def run_exact_headline(
         firsts.extend(float(x) for x in first)
         means.extend(float(x) for x in m_at)
         p99s.extend(float(x) for x in p_at)
+    if host_sharded:
+        kernel_tag = "host-sparse"
+    else:
+        kernel_tag = ("sharded-" if mesh is not None else "") + kernel
     return {
         "n_nodes": cfg.n_nodes,
         "n_seeds": n_seeds,
         "delivery_model": "exact",
-        "kernel": ("sharded-" if mesh is not None else "") + kernel,
+        "kernel": kernel_tag,
+        "n_hosts": n_shards if host_sharded else 1,
         "converged_frac": converged / n_seeds,
         "ticks_p50": float(np.percentile(firsts, 50)),
         "ticks_p99": float(np.percentile(firsts, 99)),

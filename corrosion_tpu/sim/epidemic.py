@@ -93,18 +93,32 @@ class EpidemicConfig:
     #   gossip crossing regions suffers an EXTRA i.i.d. drop of
     #   ``wan_cross_loss`` on top of ``loss``, while anti-entropy
     #   sessions cross unharmed (QUIC streams with retries).
+    # - ``measured_ring``: het_ring with a data-driven tier map from a
+    #   measured Members RTT-ring distribution (``rtt_tier_weights`` =
+    #   per-tier node-count weights; ``corro admin rtt dump`` emits
+    #   them).
     topology: str = "uniform"
     rtt_tiers: int = 4
     wan_blocks: int = 2
     wan_cross_loss: float = 0.25
+    rtt_tier_weights: Optional[tuple] = None
 
     def __post_init__(self):
-        if self.topology not in ("uniform", "het_ring", "wan_two_region"):
+        if self.topology not in (
+            "uniform", "het_ring", "wan_two_region", "measured_ring"
+        ):
             raise ValueError(f"unknown topology {self.topology!r}")
         if self.topology == "het_ring" and self.rtt_tiers < 1:
             raise ValueError("het_ring needs rtt_tiers >= 1")
         if self.topology == "wan_two_region" and self.wan_blocks < 2:
             raise ValueError("wan_two_region needs wan_blocks >= 2")
+        if self.topology == "measured_ring":
+            w = self.rtt_tier_weights
+            if not w or any(x < 0 for x in w) or sum(w) <= 0:
+                raise ValueError(
+                    "measured_ring needs rtt_tier_weights: non-empty, "
+                    "non-negative, positive sum (corro admin rtt dump)"
+                )
 
     @property
     def flat_nodes(self) -> int:
@@ -130,6 +144,7 @@ class EpidemicConfig:
             rtt_tiers=self.rtt_tiers,
             wan_blocks=self.wan_blocks,
             wan_cross_loss=self.wan_cross_loss,
+            rtt_tier_weights=self.rtt_tier_weights,
         )
 
     @property

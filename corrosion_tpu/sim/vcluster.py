@@ -142,6 +142,7 @@ class VirtualCluster:
         base_dir: Optional[str] = None,
         clock: Optional[VirtualClock] = None,
         link_rtt_s: float = LINK_RTT_S,
+        link_rtt_fn=None,
         sign: bool = False,
         defer_crashes: bool = False,
         **agent_overrides,
@@ -155,6 +156,12 @@ class VirtualCluster:
         self.seed = seed
         self.clock = clock or VirtualClock()
         self.link_rtt_s = link_rtt_s
+        # optional per-pair RTT: ``link_rtt_fn(i, j) -> seconds`` for
+        # node indices i -> j (None = uniform ``link_rtt_s``).  Drives
+        # both delivery delay and the probe RTT samples the Members
+        # rings record — a heterogeneous fn gives a deterministic
+        # multi-tier distribution for ``capture_rtt_topology``
+        self.link_rtt_fn = link_rtt_fn
         self.plan = plan or FaultPlan(seed=seed)
         self.ctrl = FaultController(self.plan, now=self.clock.monotonic)
         # signed changeset attribution (docs/faults.md): every node
@@ -541,7 +548,7 @@ class VirtualCluster:
                 if act.drop:
                     continue
                 self.clock.schedule(
-                    self.link_rtt_s + act.delay,
+                    self._pair_rtt_s(i, j) + act.delay,
                     lambda _d, _j=j, _f=e.frame, _i=i: self._deliver(
                         _j, _f, src=_i
                     ),
@@ -585,6 +592,12 @@ class VirtualCluster:
                                 meta=(tp, hop, sig, peer))
         if not a._bcast_queue.empty():
             self._arm_flush(j)
+
+    def _pair_rtt_s(self, i: int, j) -> float:
+        """One-way link latency node i -> node j in seconds."""
+        if self.link_rtt_fn is not None and j is not None:
+            return float(self.link_rtt_fn(i, j))
+        return self.link_rtt_s
 
     # -- SWIM probes on the heap ---------------------------------------
 
@@ -645,7 +658,7 @@ class VirtualCluster:
                             break
             if ok:
                 a.members.record_rtt(
-                    m.actor_id, self.link_rtt_s * 2e3
+                    m.actor_id, self._pair_rtt_s(i, tj) * 2e3
                 )
                 a._suspects.pop(m.actor_id, None)
                 a.members.revive(m.actor_id)
@@ -1163,3 +1176,49 @@ class VirtualCluster:
         self._serve_loop.close()
         if self._own_dir:
             shutil.rmtree(self.base_dir, ignore_errors=True)
+
+
+def capture_rtt_topology(cluster: "VirtualCluster", edges=None) -> dict:
+    """Aggregate every live node's Members RTT-ring view into one
+    measured-topology JSON dict (``topology: measured_ring``).
+
+    This is the deterministic campaign-side twin of the agent admin
+    ``rtt dump`` command: instead of querying one node over the UDS,
+    it merges the per-node tier distributions that SWIM probe rounds
+    recorded (``VirtualCluster(link_rtt_fn=...)`` makes those
+    heterogeneous and reproducible).  The resulting ``weights`` vector
+    feeds ``bench.py --frontier --topology measured_ring`` /
+    ``HeadlineExactConfig(rtt_tier_weights=...)`` directly.
+    """
+    from corrosion_tpu.agent.members import (
+        DEFAULT_RTT_TIER_EDGES_MS,
+        rtt_topology,
+    )
+
+    if edges is None:
+        edges = DEFAULT_RTT_TIER_EDGES_MS
+    n_tiers = len(edges) + 1
+    weights = [0] * n_tiers
+    sampled = unsampled = 0
+    per_node = []
+    for nm in cluster.names:
+        if nm in cluster._crashed:
+            continue
+        topo = rtt_topology(cluster.agents[nm].members, edges)
+        w = topo["weights"]
+        for t, c in enumerate(w):
+            weights[t] += c
+        sampled += topo["members_sampled"]
+        unsampled += topo["members_unsampled"]
+        per_node.append({"node": nm, "weights": w})
+    while len(weights) > 1 and weights[-1] == 0:
+        weights.pop()
+    return {
+        "topology": "measured_ring",
+        "tier_edges_ms": list(edges),
+        "rtt_tiers": len(weights),
+        "weights": weights,
+        "members_sampled": sampled,
+        "members_unsampled": unsampled,
+        "nodes": per_node,
+    }

@@ -298,13 +298,18 @@ def test_subs_bench_artifact_schema():
 
 def test_frontier_bench_artifact_schema():
     """The frontier-sparse BENCH headline (bench.py --frontier): the
-    exact sampler's p99 convergence + msgs/node swept through N=1M,
+    exact sampler's p99 convergence + msgs/node swept through N=10M,
     every point tagged with the kernel the bitmap-budget dispatch
-    selected, the dense/sparse exactness gate green, the 100k perf
-    gate green (the sparse kernel must not cost the existing scale
+    selected AND the budget it was derived from, the 10M headline
+    produced by the MULTI-HOST frontier kernel (delta-only cross-host
+    exchange) and converged, the dense/sparse exactness gate green,
+    the in-record multi-host bitwise gate green, the 100k perf gate
+    green (the sparse kernel must not cost the existing scale
     anything), and one sweep point per scenario topology beyond
-    uniform fanout."""
-    KERNELS = {"dense", "sharded-dense", "sparse", "sharded-sparse"}
+    uniform fanout — including the measured-RTT ring and WAN latency
+    families."""
+    KERNELS = {"dense", "sharded-dense", "sparse", "sharded-sparse",
+               "host-sparse"}
     doc = _load("BENCH_FRONTIER.json")
     _check(doc, {
         "metric": lambda v: v == "epidemic_exact_frontier_sweep_vs_n",
@@ -319,16 +324,23 @@ def test_frontier_bench_artifact_schema():
         },
         "points": lambda v: isinstance(v, list) and len(v) >= 3,
         "headline": {
-            "n": lambda v: v == 1_000_000,
+            # the 10M headline can only come from the multi-host
+            # frontier kernel (the dense bitmap is ~12.5 TB there, and
+            # the single-host sparse run is the 1M point's job)
+            "n": lambda v: v == 10_000_000,
             "ticks_p99": NUM,
             "msgs_per_node_mean": NUM,
             "msgs_per_node_p99": NUM,
             "converged_frac": lambda v: v == 1.0,
-            # the million-node point can only come from the sparse
-            # representation (the dense bitmap is ~125 GB there)
-            "kernel": lambda v: v in ("sparse", "sharded-sparse"),
+            "kernel": lambda v: v == "host-sparse",
+            "n_hosts": lambda v: isinstance(v, int) and v >= 2,
+            "wall_s": NUM,
         },
         "exactness_gate": {"pass": lambda v: v is True},
+        "multi_host_gate": {
+            "n_hosts": lambda v: isinstance(v, int) and v >= 2,
+            "pass": lambda v: v is True,
+        },
         "perf_gate_100k": {
             "dense_wall_s": NUM,
             "sparse_wall_s": NUM,
@@ -339,22 +351,38 @@ def test_frontier_bench_artifact_schema():
         "topologies": dict,
     })
     assert "error" not in doc
-    # headline floors: the committed 1M point converged with the
+    # headline floors: the committed 10M point converged with the
     # protocol's own message bound (budget*fanout broadcast + sync
     # session accounting), in sane epidemic depth
     hl = doc["headline"]
     assert hl["msgs_per_node_mean"] < 64
     assert 8 <= hl["ticks_p99"] <= 64
-    # every successful point carries a recognized kernel tag, and the
-    # sweep actually exercised more than one representation
-    tags = {p["kernel"] for p in doc["points"] if "error" not in p}
+    # the in-record multi-host witness covered the headline shape AND
+    # both new topology families, bitwise
+    mh = doc["multi_host_gate"]
+    for fam in ("headline", "measured_ring", "wan_latency"):
+        assert mh[fam]["bitwise_equal"] is True, fam
+    # every successful point carries a recognized kernel tag and the
+    # budget its dispatch was derived from, and the sweep exercised
+    # more than one representation
+    ok_points = [p for p in doc["points"] if "error" not in p]
+    tags = {p["kernel"] for p in ok_points}
     assert tags <= KERNELS and len(tags) >= 2, tags
-    # one committed sweep point per scenario topology, converged
-    for topo in ("het_ring", "wan_two_region"):
+    for p in ok_points:
+        assert p["bitmap_budget_bytes"] > 0, p
+        assert isinstance(p["budget_source"], str), p
+    # one committed sweep point per scenario topology, converged —
+    # including the measured-RTT ring (captured tier weights) and the
+    # WAN latency-queue family (delayed delivery, zero extra loss)
+    for topo in ("het_ring", "wan_two_region", "measured_ring",
+                 "wan_latency"):
         cell = doc["topologies"][topo]
         assert "error" not in cell, cell
         assert cell["converged_frac"] == 1.0
         assert cell["kernel"] in KERNELS
+    assert sum(doc["topologies"]["measured_ring"]["rtt_tier_weights"]) > 0
+    assert doc["topologies"]["wan_latency"]["wan_latency_ticks"] >= 1
+    assert doc["topologies"]["wan_latency"]["wan_cross_loss"] == 0.0
     # the wan family converges THROUGH sync; het_ring's slow arc may
     # not beat uniform's depth, but both stay within protocol bounds
     assert doc["topologies"]["het_ring"]["msgs_per_node_mean"] < 64
@@ -572,3 +600,27 @@ def test_virtual_campaign_wall_budget():
         f"virtual matrix+trajectory took {total:.1f}s wall combined"
     )
     assert scen["wall_s_total"] >= scen["wall_s_matrix"]
+
+
+def test_topology_measured_artifact_schema():
+    """The captured measured-RTT topology (bench.py --capture-topology
+    / the agent admin `rtt dump` export): a real multi-tier Members
+    RTT distribution from the deterministic virtual-cluster campaign,
+    in exactly the shape `--frontier --topology measured_ring` and
+    ``HeadlineExactConfig(rtt_tier_weights=...)`` consume."""
+    doc = _load("TOPOLOGY_MEASURED.json")
+    _check(doc, {
+        "topology": lambda v: v == "measured_ring",
+        "tier_edges_ms": lambda v: isinstance(v, list) and len(v) >= 2
+        and all(b > a for a, b in zip(v, v[1:])),
+        "rtt_tiers": lambda v: isinstance(v, int) and v >= 2,
+        "weights": lambda v: isinstance(v, list) and sum(v) > 0
+        and all(isinstance(w, int) and w >= 0 for w in v),
+        "members_sampled": lambda v: isinstance(v, int) and v > 0,
+        "members_unsampled": int,
+        "nodes": lambda v: isinstance(v, list) and len(v) >= 2,
+        "capture": {"campaign": str, "n": int, "seed": int},
+    })
+    # genuinely heterogeneous: the distribution spans >= 2 tiers
+    assert sum(1 for w in doc["weights"] if w > 0) >= 2
+    assert len(doc["weights"]) == doc["rtt_tiers"]

@@ -12,6 +12,8 @@ from __future__ import annotations
 import asyncio
 import random
 
+import pytest
+
 from corrosion_tpu.agent.members import Member, Members, MemberState
 from corrosion_tpu.agent.runtime import _drop_most_transmitted
 from corrosion_tpu.agent.testing import launch_test_agent, wait_for
@@ -152,3 +154,63 @@ def test_cleared_since_filters_by_ts(tmp_path):
         await a.stop()
 
     asyncio.run(main())
+
+
+def test_rtt_topology_bins_members_and_trims():
+    """The `rtt dump` capture path: members bin into 1-based RTT tiers
+    by ring mean, unsampled members are reported separately (never
+    binned), and trailing empty tiers are trimmed so the weights tuple
+    is exactly what `measured_ring` consumes."""
+    from corrosion_tpu.agent.members import rtt_tier_of, rtt_topology
+
+    members = Members(b"\x00" * 16)
+    # two ring0-fast (tier 1), one metro (tier 2: 6<=rtt<12), three
+    # regional (tier 3: 12<=rtt<24); nothing beyond -> tiers 4-6 trim
+    for i, rtt in enumerate((1.0, 2.0, 8.0, 15.0, 16.0, 20.0), start=1):
+        members.upsert(bytes([i]) * 16, ("127.0.0.1", 10000 + i))
+        members.record_rtt(bytes([i]) * 16, rtt)
+    members.upsert(bytes([99]) * 16, ("127.0.0.1", 10099))  # no samples
+
+    doc = rtt_topology(members)
+    assert doc["topology"] == "measured_ring"
+    assert doc["weights"] == [2, 1, 3]
+    assert doc["rtt_tiers"] == 3
+    assert doc["members_sampled"] == 6
+    assert doc["members_unsampled"] == 1
+    assert all(n["tier"] == rtt_tier_of(n["rtt_ms"]) for n in doc["nodes"])
+
+    # custom edges re-bin: one coarse 10ms edge -> 2 tiers, all binned
+    doc2 = rtt_topology(members, edges=(10.0,))
+    assert doc2["weights"] == [3, 3]
+    assert doc2["tier_edges_ms"] == [10.0]
+
+
+def test_admin_rtt_dump_serves_topology(tmp_path):
+    """The admin `rtt_dump` command round-trips the capture doc over
+    the admin socket, honoring custom (validated) tier edges."""
+    from corrosion_tpu.agent.admin import AdminClient
+
+    import asyncio as aio
+
+    async def main():
+        sock = str(tmp_path / "admin.sock")
+        a = await launch_test_agent(tmpdir=str(tmp_path), admin_path=sock)
+        for i in range(1, 4):
+            a.members.upsert(bytes([i]) * 16, ("127.0.0.1", 10000 + i))
+            a.members.record_rtt(bytes([i]) * 16, float(i * 7))
+
+        def call(cmd, **kw):
+            c = AdminClient(sock)
+            try:
+                return c.call(cmd, **kw)
+            finally:
+                c.close()
+
+        doc = await aio.to_thread(call, "rtt_dump")
+        assert doc["topology"] == "measured_ring"
+        assert sum(doc["weights"]) == 3
+        with pytest.raises(RuntimeError, match="tier_edges_ms"):
+            await aio.to_thread(call, "rtt_dump", tier_edges_ms=[5.0, 5.0])
+        await a.stop()
+
+    aio.run(main())

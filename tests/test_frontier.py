@@ -26,7 +26,11 @@ from corrosion_tpu.sim.calibrate import (
     run_exact_headline,
 )
 
-DENSE_FIELDS = ("infected", "tx", "next_send", "msgs")
+DENSE_FIELDS = ("infected", "tx", "next_send", "msgs", "pending")
+
+#: a captured Members RTT-ring distribution shape (leading empty tiers
+#: are real: nothing lives under the ring0 edge in the capture)
+MEASURED_WEIGHTS = (0, 0, 2, 2, 6, 1)
 
 
 def _headline_cfg(n=256, **over):
@@ -70,13 +74,22 @@ def test_frontier_matches_packed_bitwise_headline_shape():
     assert bool(np.asarray(ref.infected).all())
 
 
-@pytest.mark.parametrize("topology", ["het_ring", "wan_two_region"])
-def test_frontier_matches_packed_bitwise_topologies(topology):
+@pytest.mark.parametrize("overrides", [
+    {"topology": "het_ring"},
+    {"topology": "wan_two_region"},
+    {"topology": "measured_ring", "rtt_tier_weights": MEASURED_WEIGHTS},
+    {"topology": "wan_two_region", "wan_cross_loss": 0.0,
+     "wan_latency_ticks": 2},
+    {"topology": "wan_two_region", "wan_latency_ticks": 3},
+], ids=["het_ring", "wan_two_region", "measured_ring", "wan_latency",
+        "wan_latency_plus_loss"])
+def test_frontier_matches_packed_bitwise_topologies(overrides):
     """The scenario families beyond uniform fanout keep the bit-match:
     both kernels implement them from the same arithmetic + RNG
-    stream."""
+    stream — including the measured-RTT tier map and the WAN latency
+    queue (with and without cross-region loss on top)."""
     cfg = _headline_cfg(
-        n=256, partition_blocks=1, heal_tick=0, topology=topology,
+        n=256, partition_blocks=1, heal_tick=0, **overrides,
     )
     ref, _ = _assert_lockstep(cfg, jax.random.PRNGKey(5), ticks=20)
     assert bool(np.asarray(ref.infected).any())
@@ -286,3 +299,150 @@ def test_million_node_sweep_point():
     assert r["kernel"] == "sparse"
     # broadcast budget cap (32) + sync session accounting
     assert r["msgs_per_node_mean"] < 64
+
+
+# -- WAN latency queue (wan_latency_ticks) -----------------------------
+
+
+def test_latency_zero_queue_is_inert():
+    """The zero-latency identity: at ``wan_latency_ticks=0`` every
+    queue op compiles out — the wan_two_region trajectory keeps
+    ``pending`` all-sentinel, and a SEEDED pending entry is never
+    promoted (the dense leaves stay bitwise the unseeded run's)."""
+    from corrosion_tpu.sim.calibrate import LATENCY_NONE
+
+    cfg = _headline_cfg(n=256, partition_blocks=1, heal_tick=0,
+                        topology="wan_two_region")
+    key = jax.random.PRNGKey(9)
+    ref = packed_exact_init(cfg, jax.random.fold_in(key, 2**20))
+    seeded = ref._replace(
+        pending=ref.pending.at[200].set(jnp.int32(1))
+    )
+    for t in range(10):
+        kt = jax.random.fold_in(key, t)
+        ref = packed_exact_tick(ref, kt, cfg)
+        seeded = packed_exact_tick(seeded, kt, cfg)
+        for f in ("infected", "tx", "next_send", "msgs"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(seeded, f)),
+                np.asarray(getattr(ref, f)),
+                err_msg=f"{f} disturbed by a dead queue at tick {t}",
+            )
+    assert (np.asarray(ref.pending) == LATENCY_NONE).all()
+    assert bool(np.asarray(ref.infected).any())
+
+
+def test_latency_seeded_queue_negative_control():
+    """Discriminating power of the queue machinery: with
+    ``wan_latency_ticks>0`` the SAME seeded pending entry IS promoted —
+    the in-flight arrival infects its node and re-keys every later
+    draw, so the trajectory diverges from the unseeded run within a
+    few ticks."""
+    cfg = _headline_cfg(n=256, partition_blocks=1, heal_tick=0,
+                        topology="wan_two_region", wan_cross_loss=0.0,
+                        wan_latency_ticks=2)
+    key = jax.random.PRNGKey(9)
+    ref = packed_exact_init(cfg, jax.random.fold_in(key, 2**20))
+    seeded = ref._replace(
+        pending=ref.pending.at[200].set(jnp.int32(1))
+    )
+    diverged = False
+    for t in range(12):
+        kt = jax.random.fold_in(key, t)
+        ref = packed_exact_tick(ref, kt, cfg)
+        seeded = packed_exact_tick(seeded, kt, cfg)
+        if not np.array_equal(
+            np.asarray(seeded.infected), np.asarray(ref.infected)
+        ):
+            diverged = True
+            break
+    assert diverged, "a live queue entry left the trajectory untouched"
+    assert bool(np.asarray(seeded.infected)[200])
+
+
+def test_latency_conservation_no_message_dropped():
+    """Latency delays, it never drops.  With in-region loss 0 and
+    cross-region loss 0 every accepted delivery either commits now or
+    enters the queue with arrival exactly ``tick + L``; queue entries
+    only ever move earlier (scatter-MIN) and leave ONLY by promotion
+    at their due tick (the promoted node is infected that tick); each
+    sender's per-tick msgs increment is exactly ``fanout`` (nothing
+    vanishes on the send side); and at convergence every node is
+    infected with the queue all-sentinel."""
+    from corrosion_tpu.sim.calibrate import LATENCY_NONE
+
+    L = 2
+    cfg = _headline_cfg(
+        n=256, fanout=4, ring0_size=0, max_transmissions=8,
+        backoff_ticks=0.0, loss=0.0, partition_blocks=1, heal_tick=0,
+        sync_interval=0, topology="wan_two_region", wan_cross_loss=0.0,
+        wan_latency_ticks=L, max_ticks=64,
+    )
+    key = jax.random.PRNGKey(4)
+    st = packed_exact_init(cfg, jax.random.fold_in(key, 2**20))
+    ever_queued = np.zeros(cfg.n_nodes, dtype=bool)
+    for t in range(40):
+        prev_pending = np.asarray(st.pending)
+        prev_msgs = np.asarray(st.msgs)
+        prev_infected = np.asarray(st.infected)
+        st = packed_exact_tick(st, jax.random.fold_in(key, t), cfg)
+        pending = np.asarray(st.pending)
+        # send-side conservation: every sender emitted exactly fanout
+        d_msgs = np.asarray(st.msgs) - prev_msgs
+        assert set(np.unique(d_msgs).tolist()) <= {0, cfg.fanout}
+        # additions arrive exactly L ticks out; entries never move later
+        fresh = (prev_pending == LATENCY_NONE) & (pending != LATENCY_NONE)
+        assert (pending[fresh] == t + L).all()
+        kept = (prev_pending != LATENCY_NONE) & (pending != LATENCY_NONE)
+        not_due = kept & (prev_pending > t)
+        assert (pending[not_due] <= prev_pending[not_due]).all()
+        # a due entry may be promoted and re-queued the same tick by a
+        # fresh cross-region duplicate — the slot then holds t + L
+        readded = kept & (prev_pending <= t)
+        assert (pending[readded] == t + L).all()
+        assert np.asarray(st.infected)[readded].all()
+        # removals leave only by promotion at their due tick, and the
+        # promoted node is infected that very tick
+        gone = (prev_pending != LATENCY_NONE) & (pending == LATENCY_NONE)
+        assert (prev_pending[gone] <= t).all()
+        assert np.asarray(st.infected)[gone].all()
+        ever_queued |= fresh
+        del prev_infected
+        if bool(np.asarray(st.infected).all()) and (
+            pending == LATENCY_NONE
+        ).all():
+            break
+    assert bool(np.asarray(st.infected).all()), "epidemic did not converge"
+    assert (np.asarray(st.pending) == LATENCY_NONE).all()
+    assert ever_queued.any(), "no cross-region delivery was ever queued"
+    assert np.asarray(st.infected)[ever_queued].all()
+
+
+def test_measured_tier_map_follows_weights():
+    """``measured_tier_map`` partitions the id ring per the captured
+    node-count weights (cumsum bounds), skipping empty tiers and
+    always covering all n nodes."""
+    from corrosion_tpu.models.broadcast import measured_tier_map
+
+    tiers = np.asarray(measured_tier_map(100, (0, 0, 25, 25, 50)))
+    assert tiers.shape == (100,)
+    counts = {int(t): int((tiers == t).sum()) for t in np.unique(tiers)}
+    assert counts == {3: 25, 4: 25, 5: 50}
+    with pytest.raises(ValueError):
+        measured_tier_map(100, (0, 0))
+
+
+def test_host_memory_budget_reads_meminfo():
+    """The host-memory budget derivation (the multi-host twin of the
+    device-HBM budget): positive, halves per host, and the host-sharded
+    seed batch it governs is at least 1 at the 10M headline shape."""
+    from corrosion_tpu.sim.calibrate import host_memory_budget_bytes
+
+    b1 = host_memory_budget_bytes(1)
+    b2 = host_memory_budget_bytes(2)
+    if b1 is None:
+        pytest.skip("/proc/meminfo unavailable on this platform")
+    assert b1 > 0 and b2 > 0
+    assert abs(b1 - 2 * b2) <= 1024
+    big = HeadlineExactConfig(n_nodes=10_000_000, chunk_ticks=8)
+    assert frontier_seed_batch(big, 4, n_shards=2, host_sharded=True) >= 1

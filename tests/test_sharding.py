@@ -559,3 +559,129 @@ def test_ring_fabric_small_cap_reports_overflow():
         | (out == np.asarray(news)[None, :]).all(axis=1)
     )
     assert legal.all()
+
+
+@pytest.mark.parametrize("overrides", [
+    {},
+    {"topology": "measured_ring", "rtt_tier_weights": (0, 0, 2, 2, 6, 1)},
+    {"topology": "wan_two_region", "wan_cross_loss": 0.0,
+     "wan_latency_ticks": 2},
+], ids=["headline", "measured_ring", "wan_latency"])
+def test_sharded_frontier_host_matches_single_chip_bitwise(overrides):
+    """The MULTI-HOST frontier kernel — every O(N) leaf row-sharded
+    over a ``hosts`` axis, infected/pending replicated by
+    construction, ONLY the rejection loop's bitpacked validity deltas
+    crossing the host fabric — is BITWISE the single-chip
+    ``frontier_exact_tick`` per tick at N=256 on the 8-host mesh,
+    across the headline shape and both new topology families
+    (measured-RTT ring, tick-quantized WAN latency queue)."""
+    from dataclasses import replace as _replace
+
+    from corrosion_tpu.models.sharded import sharded_frontier_host_step
+    from corrosion_tpu.sim.calibrate import (
+        HeadlineExactConfig,
+        frontier_exact_init,
+        frontier_exact_tick,
+        frontier_host_shardings,
+    )
+
+    cfg = _replace(
+        HeadlineExactConfig(
+            n_nodes=256, fanout=4, ring0_size=16, max_transmissions=8,
+            loss=0.05, sync_interval=4, backoff_ticks=0.5, max_ticks=64,
+        ),
+        **overrides,
+    )
+    mesh = Mesh(np.array(jax.devices()[:8]), ("hosts",))
+    n_seeds = 2
+    base = [jax.random.PRNGKey(17 + s) for s in range(n_seeds)]
+
+    refs = [
+        frontier_exact_init(cfg, jax.random.fold_in(kk, 2**20))
+        for kk in base
+    ]
+    batched = jax.vmap(
+        lambda kk: frontier_exact_init(cfg, jax.random.fold_in(kk, 2**20))
+    )(jnp.stack(base))
+    batched = jax.device_put(batched, frontier_host_shardings(mesh))
+    step = sharded_frontier_host_step(mesh, cfg)
+
+    for t in range(6):
+        keys_t = jnp.stack([jax.random.fold_in(kk, t) for kk in base])
+        refs = [
+            frontier_exact_tick(r, jax.random.fold_in(kk, t), cfg)
+            for r, kk in zip(refs, base)
+        ]
+        batched = step(batched, keys_t)
+        for s in range(n_seeds):
+            for field in ("infected", "msgs", "ring", "tx", "next_send",
+                          "pending"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(batched, field)[s]),
+                    np.asarray(getattr(refs[s], field)),
+                    err_msg=f"{field} diverged at tick {t}, seed {s}",
+                )
+    assert 0.0 < float(np.asarray(batched.infected).mean())
+
+
+def test_sharded_frontier_host_negative_control():
+    """Discriminating power of the multi-host equality: a seeded
+    corruption of ONE host's tx shard (a ring0 sender's remaining
+    budget zeroed) desyncs the trajectory from the single-chip
+    reference on the very next tick — the silenced node's msgs row
+    stops counting, and the deliveries it owed never commit."""
+    from corrosion_tpu.models.sharded import sharded_frontier_host_step
+    from corrosion_tpu.sim.calibrate import (
+        HeadlineExactConfig,
+        frontier_exact_init,
+        frontier_exact_tick,
+        frontier_host_shardings,
+    )
+
+    cfg = HeadlineExactConfig(
+        n_nodes=256, fanout=4, ring0_size=16, max_transmissions=8,
+        loss=0.0, sync_interval=0, backoff_ticks=0.0, max_ticks=64,
+    )
+    mesh = Mesh(np.array(jax.devices()[:8]), ("hosts",))
+    key = jax.random.PRNGKey(17)
+
+    ref = frontier_exact_init(cfg, jax.random.fold_in(key, 2**20))
+    batched = jax.vmap(
+        lambda kk: frontier_exact_init(cfg, jax.random.fold_in(kk, 2**20))
+    )(jnp.stack([key]))
+    step = sharded_frontier_host_step(mesh, cfg)
+
+    # one clean tick so the epidemic is live but far from saturated
+    ref = frontier_exact_tick(ref, jax.random.fold_in(key, 0), cfg)
+    batched = jax.device_put(batched, frontier_host_shardings(mesh))
+    batched = step(batched, jnp.stack([jax.random.fold_in(key, 0)]))
+
+    # zero a ring0 sender's remaining budget on its owning host's shard
+    corrupt = batched.tx.at[0, 0].set(jnp.int32(0))
+    assert int(corrupt[0, 0]) != int(batched.tx[0, 0])
+    batched = batched._replace(tx=corrupt)
+    diverged = False
+    for t in range(1, 9):
+        ref = frontier_exact_tick(ref, jax.random.fold_in(key, t), cfg)
+        batched = step(batched, jnp.stack([jax.random.fold_in(key, t)]))
+        if not np.array_equal(
+            np.asarray(batched.msgs[0]), np.asarray(ref.msgs)
+        ) or not np.array_equal(
+            np.asarray(batched.infected[0]), np.asarray(ref.infected)
+        ):
+            diverged = True
+            break
+    assert diverged, "corrupted host shard produced an identical trajectory"
+
+
+def test_host_mesh_alignment_guard():
+    """The bitpacked delta exchange needs byte-aligned per-host rows:
+    a mesh whose host count does not divide n_nodes into multiples of
+    8 is rejected loudly, not silently mis-packed."""
+    from corrosion_tpu.models.sharded import sharded_frontier_host_step
+    from corrosion_tpu.sim.calibrate import HeadlineExactConfig
+
+    cfg = HeadlineExactConfig(n_nodes=264, ring0_size=16)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("hosts",))
+    with pytest.raises(ValueError, match="byte-aligned"):
+        sharded_frontier_host_step(mesh, cfg)
